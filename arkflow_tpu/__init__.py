@@ -1,0 +1,43 @@
+"""ArkFlow-TPU: a TPU-native streaming dataflow engine.
+
+A ground-up re-design of the capabilities of ArkFlow (arkflow-rs/arkflow, a
+Rust/Tokio/Arrow/DataFusion stream-processing engine) for TPU hardware:
+
+- Arrow ``RecordBatch`` data plane with queryable ``__meta_*`` metadata columns.
+- Config-driven streams of input -> buffer -> processors -> output, with
+  ack-based at-least-once delivery, backpressure and ordered emission.
+- Streaming ML inference processors that JIT-compile models with XLA and keep
+  the TPU fed with fixed-shape micro-batches (shape bucketing + executable
+  cache), sharded over ``jax.sharding.Mesh`` for multi-chip scale.
+
+Layer map (mirrors reference SURVEY.md section 1):
+
+- ``arkflow_tpu.batch``        data plane (ref: crates/arkflow-core/src/lib.rs)
+- ``arkflow_tpu.components``   component traits + registries (ref: arkflow-core/src/{input,output,...})
+- ``arkflow_tpu.runtime``      stream runtime / pipeline / engine / CLI
+- ``arkflow_tpu.config``       typed config (YAML/JSON/TOML)
+- ``arkflow_tpu.plugins``      all concrete components (ref: arkflow-plugin)
+- ``arkflow_tpu.sql``          Arrow-native SQL engine (DataFusion equivalent)
+- ``arkflow_tpu.tpu``          XLA execution layer: bucketing, executable cache, infeed
+- ``arkflow_tpu.models``       model families (BERT, ViT, LSTM-AE, decoder LM)
+- ``arkflow_tpu.ops``          Pallas kernels
+- ``arkflow_tpu.parallel``     mesh/sharding/collectives/ring attention
+- ``arkflow_tpu.native``       C++ host-runtime tier (ctypes)
+- ``arkflow_tpu.obs``          metrics + tracing
+"""
+
+__version__ = "0.1.0"
+
+from arkflow_tpu.errors import (  # noqa: F401
+    ArkError,
+    CodecError,
+    ConfigError,
+    ConnectError,
+    Disconnection,
+    EndOfInput,
+    ProcessError,
+    ReadError,
+    UnsupportedSql,
+    WriteError,
+)
+from arkflow_tpu.batch import MessageBatch  # noqa: F401

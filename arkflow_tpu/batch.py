@@ -1,0 +1,278 @@
+"""Data plane: Arrow-backed message batches.
+
+The unit of data flowing through every stream is a ``MessageBatch``: an
+immutable wrapper over a ``pyarrow.RecordBatch`` (ref:
+crates/arkflow-core/src/lib.rs:237-240). Two conventions carry over from the
+reference verbatim so SQL processors see the same table shape:
+
+- Raw/opaque payloads live in a binary column named ``__value__``
+  (``DEFAULT_BINARY_VALUE_FIELD``, ref lib.rs:46).
+- Broker-provenance metadata lives in ``__meta_*`` columns that are ordinary
+  Arrow columns, queryable from SQL (ref lib.rs:53-63, 464-789):
+  ``__meta_source``, ``__meta_partition``, ``__meta_offset``, ``__meta_key``,
+  ``__meta_timestamp``, ``__meta_ingest_time`` and free-form
+  ``__meta_ext_<name>`` columns.
+
+Batches are shared by reference through the pipeline (the Rust reference uses
+``Arc<MessageBatch>``, lib.rs:139); mutation always produces a new wrapper over
+new (or structurally shared) Arrow arrays — Arrow buffers themselves are never
+copied when a column is carried over.
+
+``split(max_rows)`` mirrors ``split_batch`` row-chunking with the same default
+chunk of 8192 rows (ref lib.rs:432-458).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterable, Mapping, Sequence
+
+import pyarrow as pa
+
+from arkflow_tpu.errors import ArkError
+
+DEFAULT_BINARY_VALUE_FIELD = "__value__"
+DEFAULT_RECORD_BATCH_ROWS = 8192
+
+META_SOURCE = "__meta_source"
+META_PARTITION = "__meta_partition"
+META_OFFSET = "__meta_offset"
+META_KEY = "__meta_key"
+META_TIMESTAMP = "__meta_timestamp"
+META_INGEST_TIME = "__meta_ingest_time"
+META_EXT_PREFIX = "__meta_ext_"
+
+#: The fixed (non-ext) metadata columns, in canonical order (ref lib.rs:53-63).
+META_COLUMNS = (
+    META_SOURCE,
+    META_PARTITION,
+    META_OFFSET,
+    META_KEY,
+    META_TIMESTAMP,
+    META_INGEST_TIME,
+)
+
+
+def is_meta_column(name: str) -> bool:
+    return name in META_COLUMNS or name.startswith(META_EXT_PREFIX)
+
+
+def _repeat_array(value: Any, typ: pa.DataType, n: int) -> pa.Array:
+    """Constant column of length ``n`` without a Python-level loop."""
+    if value is None:
+        return pa.nulls(n, typ)
+    return pa.repeat(pa.scalar(value, type=typ), n)
+
+
+class MessageBatch:
+    """Immutable Arrow record batch + helpers. The engine's unit of data."""
+
+    __slots__ = ("_rb",)
+
+    def __init__(self, record_batch: pa.RecordBatch):
+        if not isinstance(record_batch, pa.RecordBatch):
+            raise TypeError(f"expected pyarrow.RecordBatch, got {type(record_batch)!r}")
+        self._rb = record_batch
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def new_arrow(cls, record_batch: pa.RecordBatch) -> "MessageBatch":
+        """Wrap an existing Arrow batch (ref lib.rs ``new_arrow``)."""
+        return cls(record_batch)
+
+    @classmethod
+    def from_table(cls, table: pa.Table) -> "MessageBatch":
+        return cls(table.combine_chunks().to_batches(max_chunksize=None)[0]) if table.num_rows else cls(
+            pa.RecordBatch.from_arrays(
+                [pa.array([], type=f.type) for f in table.schema], schema=table.schema
+            )
+        )
+
+    @classmethod
+    def new_binary(cls, payloads: Sequence[bytes]) -> "MessageBatch":
+        """One row per opaque payload, in the ``__value__`` column (ref lib.rs ``new_binary``)."""
+        arr = pa.array(list(payloads), type=pa.binary())
+        rb = pa.RecordBatch.from_arrays([arr], names=[DEFAULT_BINARY_VALUE_FIELD])
+        return cls(rb)
+
+    @classmethod
+    def from_pydict(cls, data: Mapping[str, Sequence[Any]]) -> "MessageBatch":
+        return cls(pa.RecordBatch.from_pydict(dict(data)))
+
+    @classmethod
+    def empty(cls) -> "MessageBatch":
+        return cls(pa.RecordBatch.from_arrays([], names=[]))
+
+    # -- basic accessors ---------------------------------------------------
+
+    @property
+    def record_batch(self) -> pa.RecordBatch:
+        return self._rb
+
+    @property
+    def schema(self) -> pa.Schema:
+        return self._rb.schema
+
+    @property
+    def num_rows(self) -> int:
+        return self._rb.num_rows
+
+    def __len__(self) -> int:
+        return self._rb.num_rows
+
+    @property
+    def column_names(self) -> list[str]:
+        return self._rb.schema.names
+
+    def column(self, name: str) -> pa.Array:
+        idx = self._rb.schema.get_field_index(name)
+        if idx < 0:
+            raise ArkError(f"no such column: {name!r}")
+        return self._rb.column(idx)
+
+    def has_column(self, name: str) -> bool:
+        return self._rb.schema.get_field_index(name) >= 0
+
+    def to_pydict(self) -> dict[str, list[Any]]:
+        return self._rb.to_pydict()
+
+    def __repr__(self) -> str:
+        return f"MessageBatch(rows={self.num_rows}, cols={self.column_names})"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, MessageBatch) and self._rb.equals(other._rb)
+
+    # -- binary convention -------------------------------------------------
+
+    def to_binary(self, field: str = DEFAULT_BINARY_VALUE_FIELD) -> list[bytes]:
+        """Extract the opaque payload column as Python bytes (ref lib.rs ``to_binary``)."""
+        col = self.column(field)
+        if not (pa.types.is_binary(col.type) or pa.types.is_large_binary(col.type)
+                or pa.types.is_string(col.type) or pa.types.is_large_string(col.type)):
+            raise ArkError(f"column {field!r} is {col.type}, not binary/string")
+        out = []
+        for v in col:
+            pv = v.as_py()
+            if pv is None:
+                out.append(b"")
+            elif isinstance(pv, str):
+                out.append(pv.encode("utf-8"))
+            else:
+                out.append(pv)
+        return out
+
+    # -- column surgery ----------------------------------------------------
+
+    def filter_columns(self, names: Iterable[str]) -> "MessageBatch":
+        """Project to the given columns, preserving batch order (ref lib.rs ``filter_columns``)."""
+        keep_set = set(names)
+        keep = [n for n in self.column_names if n in keep_set]
+        return MessageBatch(self._rb.select(keep))
+
+    def drop_columns(self, names: Iterable[str]) -> "MessageBatch":
+        drop = set(names)
+        keep = [n for n in self.column_names if n not in drop]
+        return MessageBatch(self._rb.select(keep))
+
+    def with_column(self, name: str, array: pa.Array) -> "MessageBatch":
+        """Add or replace a column. Existing Arrow buffers are shared, not copied."""
+        if len(array) != self.num_rows and self._rb.num_columns > 0:
+            raise ArkError(
+                f"column {name!r} length {len(array)} != batch rows {self.num_rows}"
+            )
+        arrays = []
+        fields = []
+        replaced = False
+        for i, f in enumerate(self._rb.schema):
+            if f.name == name:
+                arrays.append(array)
+                fields.append(pa.field(name, array.type))
+                replaced = True
+            else:
+                arrays.append(self._rb.column(i))
+                fields.append(f)
+        if not replaced:
+            arrays.append(array)
+            fields.append(pa.field(name, array.type))
+        return MessageBatch(pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields)))
+
+    # -- metadata columns (ref lib.rs:464-789) -----------------------------
+
+    def with_source(self, source: str) -> "MessageBatch":
+        return self.with_column(META_SOURCE, _repeat_array(source, pa.string(), self.num_rows))
+
+    def with_partition(self, partition: int) -> "MessageBatch":
+        return self.with_column(META_PARTITION, _repeat_array(partition, pa.int64(), self.num_rows))
+
+    def with_offset(self, offset: int) -> "MessageBatch":
+        return self.with_column(META_OFFSET, _repeat_array(offset, pa.int64(), self.num_rows))
+
+    def with_key(self, key: bytes | None) -> "MessageBatch":
+        return self.with_column(META_KEY, _repeat_array(key, pa.binary(), self.num_rows))
+
+    def with_timestamp(self, ts_millis: int) -> "MessageBatch":
+        """Broker-assigned event timestamp, epoch millis."""
+        return self.with_column(META_TIMESTAMP, _repeat_array(ts_millis, pa.int64(), self.num_rows))
+
+    def with_ingest_time(self, ts_millis: int | None = None) -> "MessageBatch":
+        """Engine ingest wall-clock, epoch millis (defaults to now)."""
+        if ts_millis is None:
+            ts_millis = int(time.time() * 1000)
+        return self.with_column(META_INGEST_TIME, _repeat_array(ts_millis, pa.int64(), self.num_rows))
+
+    def with_ext_metadata(self, kv: Mapping[str, str]) -> "MessageBatch":
+        """Constant free-form metadata columns ``__meta_ext_<k>`` (ref lib.rs ``with_ext_metadata``)."""
+        out = self
+        for k, v in kv.items():
+            out = out.with_column(META_EXT_PREFIX + k, _repeat_array(v, pa.string(), out.num_rows))
+        return out
+
+    def with_ext_metadata_per_row(self, key: str, values: Sequence[str | None]) -> "MessageBatch":
+        """Per-row free-form metadata (ref lib.rs ``with_ext_metadata_per_row``)."""
+        return self.with_column(META_EXT_PREFIX + key, pa.array(list(values), type=pa.string()))
+
+    def metadata_columns(self) -> list[str]:
+        return [n for n in self.column_names if is_meta_column(n)]
+
+    def data_columns(self) -> list[str]:
+        return [n for n in self.column_names if not is_meta_column(n)]
+
+    def strip_metadata(self) -> "MessageBatch":
+        return MessageBatch(self._rb.select(self.data_columns()))
+
+    def get_meta(self, name: str) -> Any:
+        """First-row value of a metadata column, or None if absent/empty."""
+        if not self.has_column(name) or self.num_rows == 0:
+            return None
+        return self.column(name)[0].as_py()
+
+    # -- chunking / merge --------------------------------------------------
+
+    def split(self, max_rows: int = DEFAULT_RECORD_BATCH_ROWS) -> list["MessageBatch"]:
+        """Row-chunk into batches of at most ``max_rows`` (ref ``split_batch`` lib.rs:432-458).
+
+        Zero-copy: uses Arrow slices over the same buffers.
+        """
+        if max_rows <= 0:
+            raise ArkError("max_rows must be positive")
+        n = self.num_rows
+        if n <= max_rows:
+            return [self]
+        return [MessageBatch(self._rb.slice(i, min(max_rows, n - i))) for i in range(0, n, max_rows)]
+
+    def slice(self, offset: int, length: int | None = None) -> "MessageBatch":
+        return MessageBatch(self._rb.slice(offset, length))
+
+    @staticmethod
+    def concat(batches: Sequence["MessageBatch"]) -> "MessageBatch":
+        """Concatenate schema-compatible batches (ref ``concat_batches`` usage, buffer/memory.rs:106-138)."""
+        bs = [b for b in batches if b.num_rows > 0]
+        if not bs:
+            return batches[0] if batches else MessageBatch.empty()
+        if len(bs) == 1:
+            return bs[0]
+        table = pa.Table.from_batches([b.record_batch for b in bs])
+        rbs = table.combine_chunks().to_batches()
+        assert len(rbs) == 1, "combine_chunks yields a single chunk per column"
+        return MessageBatch(rbs[0])

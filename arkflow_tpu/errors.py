@@ -1,0 +1,59 @@
+"""Error taxonomy.
+
+Single error family with typed control-flow variants, mirroring the reference's
+``Error`` enum (ref: crates/arkflow-core/src/lib.rs:66-110). Two variants are
+control flow, not failures:
+
+- ``EndOfInput``  -- graceful end of a finite source; the stream drains and shuts
+  down (ref ``Error::EOF``, stream/mod.rs:178-181).
+- ``Disconnection`` -- transient transport loss; the input task enters a
+  reconnect loop (ref ``Error::Disconnection``, stream/mod.rs:183-194).
+"""
+
+from __future__ import annotations
+
+
+class ArkError(Exception):
+    """Base class for all engine errors."""
+
+
+class ConfigError(ArkError):
+    """Invalid or missing configuration."""
+
+
+class ConnectError(ArkError):
+    """Failed to establish a connection to an external system."""
+
+
+class ReadError(ArkError):
+    """Failed to read from an input."""
+
+
+class WriteError(ArkError):
+    """Failed to write to an output."""
+
+
+class ProcessError(ArkError):
+    """A processor failed on a batch."""
+
+
+class CodecError(ArkError):
+    """Encode/decode failure."""
+
+
+class EndOfInput(ArkError):
+    """Control flow: the input is exhausted; shut the stream down gracefully."""
+
+    def __init__(self, msg: str = "end of input"):
+        super().__init__(msg)
+
+
+class Disconnection(ArkError):
+    """Control flow: transient disconnect; the runtime retries the connection."""
+
+    def __init__(self, msg: str = "disconnected"):
+        super().__init__(msg)
+
+
+class UnsupportedSql(ArkError):
+    """Raised by the Arrow-native SQL planner when a query needs the fallback engine."""

@@ -1,0 +1,14 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before jax is imported.
+
+Multi-chip shardings are validated on CPU (the driver separately dry-runs
+``__graft_entry__.dryrun_multichip`` the same way); real-TPU benches run via
+bench.py outside pytest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

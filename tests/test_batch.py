@@ -1,0 +1,129 @@
+"""Data-plane tests (model: reference in-file tests at crates/arkflow-core/src/lib.rs:791+)."""
+
+import pyarrow as pa
+import pytest
+
+from arkflow_tpu.batch import (
+    DEFAULT_BINARY_VALUE_FIELD,
+    META_COLUMNS,
+    MessageBatch,
+    is_meta_column,
+)
+from arkflow_tpu.errors import ArkError
+
+
+def test_new_binary_roundtrip():
+    payloads = [b"hello", b"world", b""]
+    mb = MessageBatch.new_binary(payloads)
+    assert mb.num_rows == 3
+    assert mb.column_names == [DEFAULT_BINARY_VALUE_FIELD]
+    assert mb.to_binary() == payloads
+
+
+def test_to_binary_on_string_column():
+    mb = MessageBatch.from_pydict({"s": ["a", "b"]})
+    assert mb.to_binary("s") == [b"a", b"b"]
+
+
+def test_to_binary_rejects_numeric():
+    mb = MessageBatch.from_pydict({"x": [1, 2]})
+    with pytest.raises(ArkError):
+        mb.to_binary("x")
+
+
+def test_new_arrow_and_accessors():
+    rb = pa.RecordBatch.from_pydict({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    mb = MessageBatch.new_arrow(rb)
+    assert mb.num_rows == 3
+    assert mb.has_column("a") and not mb.has_column("c")
+    assert mb.column("a").to_pylist() == [1, 2, 3]
+    with pytest.raises(ArkError):
+        mb.column("nope")
+
+
+def test_filter_and_drop_columns():
+    mb = MessageBatch.from_pydict({"a": [1], "b": [2], "c": [3]})
+    assert mb.filter_columns(["c", "a"]).column_names == ["a", "c"]
+    assert mb.drop_columns(["b"]).column_names == ["a", "c"]
+
+
+def test_with_column_replace_shares_buffers():
+    mb = MessageBatch.from_pydict({"a": [1, 2], "b": [3, 4]})
+    new_b = pa.array([9, 9])
+    out = mb.with_column("b", new_b)
+    assert out.column("b").to_pylist() == [9, 9]
+    # column "a" must be the same Arrow object (zero copy)
+    assert out.column("a") is mb.column("a") or out.column("a").equals(mb.column("a"))
+
+
+def test_with_column_length_mismatch():
+    mb = MessageBatch.from_pydict({"a": [1, 2]})
+    with pytest.raises(ArkError):
+        mb.with_column("b", pa.array([1]))
+
+
+def test_metadata_columns_roundtrip():
+    mb = (
+        MessageBatch.new_binary([b"x", b"y"])
+        .with_source("kafka:topic1")
+        .with_partition(3)
+        .with_offset(42)
+        .with_key(b"k1")
+        .with_timestamp(1000)
+        .with_ingest_time(2000)
+        .with_ext_metadata({"topic": "topic1"})
+    )
+    for c in META_COLUMNS:
+        assert mb.has_column(c), c
+    assert mb.get_meta("__meta_source") == "kafka:topic1"
+    assert mb.get_meta("__meta_partition") == 3
+    assert mb.get_meta("__meta_offset") == 42
+    assert mb.get_meta("__meta_key") == b"k1"
+    assert mb.get_meta("__meta_ext_topic") == "topic1"
+    assert mb.metadata_columns() == [c for c in mb.column_names if is_meta_column(c)]
+    assert mb.data_columns() == [DEFAULT_BINARY_VALUE_FIELD]
+    stripped = mb.strip_metadata()
+    assert stripped.column_names == [DEFAULT_BINARY_VALUE_FIELD]
+    assert stripped.to_binary() == [b"x", b"y"]
+
+
+def test_ext_metadata_per_row():
+    mb = MessageBatch.new_binary([b"a", b"b"]).with_ext_metadata_per_row("topic", ["t1", None])
+    assert mb.column("__meta_ext_topic").to_pylist() == ["t1", None]
+
+
+def test_null_key_metadata():
+    mb = MessageBatch.new_binary([b"a"]).with_key(None)
+    assert mb.get_meta("__meta_key") is None
+
+
+def test_split_zero_copy_chunks():
+    mb = MessageBatch.from_pydict({"a": list(range(10))})
+    parts = mb.split(4)
+    assert [p.num_rows for p in parts] == [4, 4, 2]
+    assert parts[2].column("a").to_pylist() == [8, 9]
+    assert mb.split(100) == [mb]
+    with pytest.raises(ArkError):
+        mb.split(0)
+
+
+def test_concat():
+    a = MessageBatch.from_pydict({"a": [1, 2]})
+    b = MessageBatch.from_pydict({"a": [3]})
+    out = MessageBatch.concat([a, b])
+    assert out.column("a").to_pylist() == [1, 2, 3]
+    # empties are skipped
+    e = MessageBatch.from_pydict({"a": []})
+    assert MessageBatch.concat([e, a, e]).column("a").to_pylist() == [1, 2]
+    assert MessageBatch.concat([]).num_rows == 0
+
+
+def test_default_split_size_is_8192():
+    from arkflow_tpu.batch import DEFAULT_RECORD_BATCH_ROWS
+
+    assert DEFAULT_RECORD_BATCH_ROWS == 8192
+
+
+def test_get_meta_missing():
+    mb = MessageBatch.new_binary([b"x"])
+    assert mb.get_meta("__meta_source") is None
