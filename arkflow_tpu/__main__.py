@@ -1,0 +1,5 @@
+import sys
+
+from arkflow_tpu.runtime.cli import main
+
+sys.exit(main())

@@ -1,0 +1,155 @@
+"""Component contracts: the six trait families every stream is wired from.
+
+Mirrors the reference's trait layer (ref: crates/arkflow-core/src/{input,output,
+processor,buffer,codec,temporary}/mod.rs) with asyncio in place of Tokio:
+
+- ``Input``   pull-based source; ``read()`` returns ``(MessageBatch, Ack)``
+              (ref input/mod.rs:43-57). Raise ``EndOfInput`` when exhausted,
+              ``Disconnection`` on transient transport loss.
+- ``Output``  push sink (ref output/mod.rs:31-40).
+- ``Processor`` batch -> list-of-batches transform (ref processor/mod.rs:32-79).
+              An empty list is the reference's ``ProcessResult::None`` (drop +
+              ack); >1 entries is ``ProcessResult::Multiple`` (fan-out).
+- ``Buffer``  write-side accumulator between input and pipeline
+              (ref buffer/mod.rs:27-37).
+- ``Encoder``/``Decoder``/``Codec`` bytes <-> batch (ref codec/mod.rs:23-34).
+- ``Temporary`` async keyed lookup for SQL enrichment (ref temporary/mod.rs:40-44).
+
+Acks implement at-least-once delivery: an ``Ack`` is fired only after the
+produced batches were successfully written downstream (ref stream/mod.rs:379-396).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional, Sequence
+
+from arkflow_tpu.batch import MessageBatch
+
+
+class Ack(abc.ABC):
+    """Acknowledgement handle delivered alongside every read batch."""
+
+    @abc.abstractmethod
+    async def ack(self) -> None:
+        """Confirm downstream success (commit offsets, ack broker, ...)."""
+
+
+class NoopAck(Ack):
+    """For sources with nothing to acknowledge (ref input/mod.rs ``NoopAck``)."""
+
+    async def ack(self) -> None:
+        return None
+
+
+class VecAck(Ack):
+    """Composite ack: fires a collection of child acks in order (ref ``VecAck``)."""
+
+    def __init__(self, acks: Sequence[Ack] = ()):
+        self.acks: list[Ack] = list(acks)
+
+    def push(self, ack: Ack) -> None:
+        self.acks.append(ack)
+
+    async def ack(self) -> None:
+        for a in self.acks:
+            await a.ack()
+
+
+class FnAck(Ack):
+    """Ack from a coroutine function — convenience for connector callbacks."""
+
+    def __init__(self, fn: Callable[[], Awaitable[None]]):
+        self._fn = fn
+
+    async def ack(self) -> None:
+        await self._fn()
+
+
+@dataclass
+class Resource:
+    """Shared build-time context passed to every builder (ref lib.rs:112-116).
+
+    - ``temporaries``: named ``Temporary`` components for SQL enrichment.
+    - ``input_names``: child names registered by fan-in inputs, consumed by
+      windowed join buffers (ref input/multiple_inputs.rs:129-148).
+    """
+
+    temporaries: dict[str, "Temporary"] = field(default_factory=dict)
+    input_names: list[str] = field(default_factory=list)
+
+
+class Input(abc.ABC):
+    @abc.abstractmethod
+    async def connect(self) -> None: ...
+
+    @abc.abstractmethod
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        """Next batch + its ack. Raises EndOfInput / Disconnection / ReadError."""
+
+    async def close(self) -> None:
+        return None
+
+
+class Output(abc.ABC):
+    @abc.abstractmethod
+    async def connect(self) -> None: ...
+
+    @abc.abstractmethod
+    async def write(self, batch: MessageBatch) -> None: ...
+
+    async def close(self) -> None:
+        return None
+
+
+class Processor(abc.ABC):
+    @abc.abstractmethod
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        """Transform one batch into zero or more batches."""
+
+    async def close(self) -> None:
+        return None
+
+
+class Buffer(abc.ABC):
+    """Accumulator between input and pipeline (windows, micro-batchers)."""
+
+    @abc.abstractmethod
+    async def write(self, batch: MessageBatch, ack: Ack) -> None: ...
+
+    @abc.abstractmethod
+    async def read(self) -> Optional[tuple[MessageBatch, Ack]]:
+        """Blocks until a merged batch is due; None when closed and drained."""
+
+    async def close(self) -> None:
+        return None
+
+
+class Decoder(abc.ABC):
+    @abc.abstractmethod
+    def decode(self, payload: bytes) -> MessageBatch: ...
+
+
+class Encoder(abc.ABC):
+    @abc.abstractmethod
+    def encode(self, batch: MessageBatch) -> list[bytes]:
+        """One payload per logical message (often one per row)."""
+
+
+class Codec(Encoder, Decoder, abc.ABC):
+    """Bidirectional codec (ref codec/mod.rs blanket impl)."""
+
+
+class Temporary(abc.ABC):
+    """Async keyed lookup table for SQL enrichment (ref temporary/mod.rs:40-44)."""
+
+    @abc.abstractmethod
+    async def connect(self) -> None: ...
+
+    @abc.abstractmethod
+    async def get(self, keys: Sequence[object]) -> MessageBatch:
+        """Fetch rows for the given key values; absent keys yield no rows."""
+
+    async def close(self) -> None:
+        return None

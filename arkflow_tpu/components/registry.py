@@ -1,0 +1,94 @@
+"""Builder registries: ``type``-tagged component construction.
+
+One global registry per component family, exactly like the reference's
+``lazy_static`` registries + ``register_*_builder`` free functions
+(ref: crates/arkflow-core/src/input/mod.rs:28-40,131-144). A builder is a
+callable ``(config: dict, resource: Resource) -> component``; registration is a
+decorator so plugin modules self-register on import:
+
+    @register_input("generate")
+    def _build(config, resource): return GenerateInput(...)
+
+``build_component`` resolves the ``type`` tag and passes the remaining keys of
+the config mapping to the builder (the serde-flatten equivalent,
+ref input/mod.rs:98-106).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+from arkflow_tpu.components.base import Resource
+from arkflow_tpu.errors import ConfigError
+
+Builder = Callable[[dict, Resource], Any]
+
+_REGISTRIES: dict[str, dict[str, Builder]] = {
+    "input": {},
+    "output": {},
+    "processor": {},
+    "buffer": {},
+    "codec": {},
+    "temporary": {},
+}
+
+
+def _register(family: str, type_name: str) -> Callable[[Builder], Builder]:
+    def deco(builder: Builder) -> Builder:
+        reg = _REGISTRIES[family]
+        if type_name in reg:
+            raise ConfigError(f"{family} builder {type_name!r} already registered")
+        reg[type_name] = builder
+        return builder
+
+    return deco
+
+
+def register_input(type_name: str):
+    return _register("input", type_name)
+
+
+def register_output(type_name: str):
+    return _register("output", type_name)
+
+
+def register_processor(type_name: str):
+    return _register("processor", type_name)
+
+
+def register_buffer(type_name: str):
+    return _register("buffer", type_name)
+
+
+def register_codec(type_name: str):
+    return _register("codec", type_name)
+
+
+def register_temporary(type_name: str):
+    return _register("temporary", type_name)
+
+
+def registered_types(family: str) -> list[str]:
+    return sorted(_REGISTRIES[family])
+
+
+def build_component(family: str, config: Mapping[str, Any], resource: Resource) -> Any:
+    """Instantiate a component from its ``{"type": ..., **payload}`` config."""
+    if family not in _REGISTRIES:
+        raise ConfigError(f"unknown component family {family!r}")
+    if not isinstance(config, Mapping):
+        raise ConfigError(f"{family} config must be a mapping, got {type(config).__name__}")
+    cfg = dict(config)
+    type_name = cfg.pop("type", None)
+    if not type_name:
+        raise ConfigError(f"{family} config missing 'type' tag: {config!r}")
+    builder = _REGISTRIES[family].get(type_name)
+    if builder is None:
+        known = ", ".join(registered_types(family)) or "<none>"
+        raise ConfigError(f"unknown {family} type {type_name!r} (registered: {known})")
+    return builder(cfg, resource)
+
+
+def ensure_plugins_loaded() -> None:
+    """Import the plugin tree so all builders self-register (ref arkflow/src/main.rs:20-25)."""
+    import arkflow_tpu.plugins  # noqa: F401

@@ -1,0 +1,161 @@
+"""Engine configuration: single file, format by extension.
+
+YAML / JSON / TOML parse into typed config objects (ref:
+crates/arkflow-core/src/config.rs:87-107). Component configs stay as raw
+``{"type": ..., **payload}`` mappings — the builder registry consumes them
+(the serde-flatten equivalent, ref input/mod.rs:98-106).
+
+Defaults mirror the reference: health server on ``0.0.0.0:8080``
+(config.rs:26-172), pipeline ``thread_num`` = cpu count (pipeline/mod.rs:106).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tomllib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Mapping, Optional
+
+import yaml
+
+from arkflow_tpu.errors import ConfigError
+
+
+@dataclass
+class PipelineConfig:
+    thread_num: int = 0  # 0 -> cpu count
+    processors: list[dict] = field(default_factory=list)
+
+    @classmethod
+    def from_mapping(cls, m: Mapping[str, Any]) -> "PipelineConfig":
+        if not isinstance(m, Mapping):
+            raise ConfigError("pipeline config must be a mapping")
+        threads = m.get("thread_num", 0)
+        if not isinstance(threads, int) or threads < 0:
+            raise ConfigError(f"pipeline.thread_num must be a non-negative int, got {threads!r}")
+        procs = m.get("processors", [])
+        if not isinstance(procs, list):
+            raise ConfigError("pipeline.processors must be a list")
+        return cls(thread_num=threads, processors=[dict(p) for p in procs])
+
+    def effective_threads(self) -> int:
+        return self.thread_num if self.thread_num > 0 else (os.cpu_count() or 1)
+
+
+@dataclass
+class TemporaryConfig:
+    name: str
+    config: dict
+
+    @classmethod
+    def from_mapping(cls, m: Mapping[str, Any]) -> "TemporaryConfig":
+        m = dict(m)
+        name = m.pop("name", None)
+        if not name:
+            raise ConfigError("temporary config requires a 'name'")
+        return cls(name=name, config=m)
+
+
+@dataclass
+class StreamConfig:
+    input: dict
+    pipeline: PipelineConfig
+    output: dict
+    error_output: Optional[dict] = None
+    buffer: Optional[dict] = None
+    temporary: list[TemporaryConfig] = field(default_factory=list)
+    name: Optional[str] = None
+
+    @classmethod
+    def from_mapping(cls, m: Mapping[str, Any]) -> "StreamConfig":
+        if not isinstance(m, Mapping):
+            raise ConfigError("stream config must be a mapping")
+        for req in ("input", "output"):
+            if req not in m:
+                raise ConfigError(f"stream config missing required section {req!r}")
+        pipeline = PipelineConfig.from_mapping(m.get("pipeline", {}))
+        temps = [TemporaryConfig.from_mapping(t) for t in m.get("temporary", [])]
+        return cls(
+            input=dict(m["input"]),
+            pipeline=pipeline,
+            output=dict(m["output"]),
+            error_output=dict(m["error_output"]) if m.get("error_output") else None,
+            buffer=dict(m["buffer"]) if m.get("buffer") else None,
+            temporary=temps,
+            name=m.get("name"),
+        )
+
+
+@dataclass
+class HealthCheckConfig:
+    enabled: bool = True
+    host: str = "0.0.0.0"
+    port: int = 8080
+    path: str = "/health"
+
+    @classmethod
+    def from_mapping(cls, m: Mapping[str, Any]) -> "HealthCheckConfig":
+        c = cls()
+        c.enabled = bool(m.get("enabled", True))
+        c.host = str(m.get("host", c.host))
+        c.port = int(m.get("port", c.port))
+        c.path = str(m.get("path", c.path))
+        return c
+
+
+@dataclass
+class LoggingConfig:
+    level: str = "info"
+    file_path: Optional[str] = None
+    format: str = "plain"  # plain | json
+
+    @classmethod
+    def from_mapping(cls, m: Mapping[str, Any]) -> "LoggingConfig":
+        c = cls()
+        c.level = str(m.get("level", c.level)).lower()
+        c.file_path = m.get("file_path") or m.get("file")
+        c.format = str(m.get("format", c.format)).lower()
+        if c.format not in ("plain", "json"):
+            raise ConfigError(f"logging.format must be plain|json, got {c.format!r}")
+        return c
+
+
+@dataclass
+class EngineConfig:
+    streams: list[StreamConfig]
+    health_check: HealthCheckConfig = field(default_factory=HealthCheckConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+
+    @classmethod
+    def from_mapping(cls, m: Mapping[str, Any]) -> "EngineConfig":
+        if not isinstance(m, Mapping):
+            raise ConfigError("engine config must be a mapping")
+        raw_streams = m.get("streams")
+        if not raw_streams or not isinstance(raw_streams, list):
+            raise ConfigError("engine config requires a non-empty 'streams' list")
+        streams = [StreamConfig.from_mapping(s) for s in raw_streams]
+        health = HealthCheckConfig.from_mapping(m.get("health_check", {}) or {})
+        logging_ = LoggingConfig.from_mapping(m.get("logging", {}) or {})
+        return cls(streams=streams, health_check=health, logging=logging_)
+
+    @classmethod
+    def from_file(cls, path: str | Path) -> "EngineConfig":
+        p = Path(path)
+        if not p.exists():
+            raise ConfigError(f"config file not found: {p}")
+        suffix = p.suffix.lower()
+        text = p.read_text()
+        try:
+            if suffix in (".yaml", ".yml"):
+                data = yaml.safe_load(text)
+            elif suffix == ".json":
+                data = json.loads(text)
+            elif suffix == ".toml":
+                data = tomllib.loads(text)
+            else:
+                raise ConfigError(f"unsupported config extension {suffix!r} (use .yaml/.json/.toml)")
+        except (yaml.YAMLError, json.JSONDecodeError, tomllib.TOMLDecodeError) as e:
+            raise ConfigError(f"failed to parse {p}: {e}") from e
+        return cls.from_mapping(data or {})

@@ -1,0 +1,197 @@
+"""First-class metrics: counters, gauges, histograms + Prometheus exposition.
+
+The reference declares ``prometheus = "0.13"`` but never uses it — there is no
+metrics endpoint (SURVEY.md section 5; verified zero references in
+crates/**/*.rs). The BASELINE metric is rows/sec + p50/p99, so here
+throughput/latency instrumentation is built into the runtime rather than
+bolted on: stream stages update these metrics on the hot path and the engine
+serves ``/metrics`` in Prometheus text format.
+
+Implementation notes: asyncio runs stages on one thread, so plain Python
+arithmetic is race-free; histograms keep fixed log-spaced buckets plus a
+bounded reservoir for exact small-N quantiles.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import time
+from typing import Iterable, Optional
+
+
+class Counter:
+    __slots__ = ("name", "help", "labels", "value")
+
+    def __init__(self, name: str, help_: str = "", labels: Optional[dict[str, str]] = None):
+        self.name = name
+        self.help = help_
+        self.labels = labels or {}
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("name", "help", "labels", "value")
+
+    def __init__(self, name: str, help_: str = "", labels: Optional[dict[str, str]] = None):
+        self.name = name
+        self.help = help_
+        self.labels = labels or {}
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.value -= n
+
+
+#: default latency buckets: 0.1ms .. ~100s, log-spaced
+_DEFAULT_BUCKETS = tuple(0.0001 * (2.0 ** i) for i in range(21))
+
+
+class Histogram:
+    __slots__ = ("name", "help", "labels", "buckets", "counts", "sum", "count", "_reservoir", "_rng")
+
+    RESERVOIR = 2048
+
+    def __init__(self, name: str, help_: str = "", labels: Optional[dict[str, str]] = None,
+                 buckets: Iterable[float] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.labels = labels or {}
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._reservoir: list[float] = []
+        self._rng = random.Random(0xA2C)
+
+    def observe(self, v: float) -> None:
+        self.sum += v
+        self.count += 1
+        # linear scan is fine: ~21 buckets, and observe() is called per batch, not per row
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        r = self._reservoir
+        if len(r) < self.RESERVOIR:
+            r.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.RESERVOIR:
+                r[j] = v
+
+    def quantile(self, q: float) -> float:
+        if not self._reservoir:
+            return math.nan
+        s = sorted(self._reservoir)
+        idx = min(len(s) - 1, max(0, int(q * len(s))))
+        return s[idx]
+
+    def time(self):
+        return _Timer(self)
+
+
+class _Timer:
+    __slots__ = ("h", "t0")
+
+    def __init__(self, h: Histogram):
+        self.h = h
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.h.observe(time.perf_counter() - self.t0)
+        return False
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: dict[tuple[str, tuple[tuple[str, str], ...]], object] = {}
+
+    def _key(self, name: str, labels: Optional[dict[str, str]]):
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def counter(self, name: str, help_: str = "", labels: Optional[dict[str, str]] = None) -> Counter:
+        k = self._key(name, labels)
+        m = self._metrics.get(k)
+        if m is None:
+            m = Counter(name, help_, labels)
+            self._metrics[k] = m
+        return m  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str = "", labels: Optional[dict[str, str]] = None) -> Gauge:
+        k = self._key(name, labels)
+        m = self._metrics.get(k)
+        if m is None:
+            m = Gauge(name, help_, labels)
+            self._metrics[k] = m
+        return m  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "", labels: Optional[dict[str, str]] = None,
+                  buckets: Iterable[float] = _DEFAULT_BUCKETS) -> Histogram:
+        k = self._key(name, labels)
+        m = self._metrics.get(k)
+        if m is None:
+            m = Histogram(name, help_, labels, buckets)
+            self._metrics[k] = m
+        return m  # type: ignore[return-value]
+
+    def clear(self) -> None:
+        self._metrics.clear()
+
+    def collect(self) -> list[object]:
+        return list(self._metrics.values())
+
+    # -- Prometheus text exposition ---------------------------------------
+
+    @staticmethod
+    def _fmt_labels(labels: dict[str, str], extra: Optional[dict[str, str]] = None) -> str:
+        all_labels = {**labels, **(extra or {})}
+        if not all_labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(all_labels.items()))
+        return "{" + inner + "}"
+
+    def exposition(self) -> str:
+        lines: list[str] = []
+        seen_help: set[str] = set()
+        for m in self._metrics.values():
+            name = m.name  # type: ignore[attr-defined]
+            if name not in seen_help:
+                kind = "counter" if isinstance(m, Counter) else "gauge" if isinstance(m, Gauge) else "histogram"
+                if m.help:  # type: ignore[attr-defined]
+                    lines.append(f"# HELP {name} {m.help}")  # type: ignore[attr-defined]
+                lines.append(f"# TYPE {name} {kind}")
+                seen_help.add(name)
+            if isinstance(m, (Counter, Gauge)):
+                lines.append(f"{name}{self._fmt_labels(m.labels)} {m.value}")
+            elif isinstance(m, Histogram):
+                cum = 0
+                for b, c in zip(m.buckets, m.counts):
+                    cum += c
+                    lines.append(f'{name}_bucket{self._fmt_labels(m.labels, {"le": repr(b)})} {cum}')
+                cum += m.counts[-1]
+                lines.append(f'{name}_bucket{self._fmt_labels(m.labels, {"le": "+Inf"})} {cum}')
+                lines.append(f"{name}_sum{self._fmt_labels(m.labels)} {m.sum}")
+                lines.append(f"{name}_count{self._fmt_labels(m.labels)} {m.count}")
+        return "\n".join(lines) + "\n"
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def global_registry() -> MetricsRegistry:
+    return _GLOBAL

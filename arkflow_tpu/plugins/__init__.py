@@ -1,0 +1,10 @@
+"""Concrete components. Importing this package registers every builder
+(the equivalent of the reference binary calling each family's ``init()``,
+ref: crates/arkflow/src/main.rs:20-25)."""
+
+import arkflow_tpu.plugins.codec  # noqa: F401
+import arkflow_tpu.plugins.input  # noqa: F401
+import arkflow_tpu.plugins.output  # noqa: F401
+import arkflow_tpu.plugins.processor  # noqa: F401
+import arkflow_tpu.plugins.buffer  # noqa: F401
+import arkflow_tpu.plugins.temporary  # noqa: F401

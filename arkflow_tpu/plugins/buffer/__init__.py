@@ -1,0 +1,1 @@
+import arkflow_tpu.plugins.buffer.memory  # noqa: F401

@@ -1,0 +1,108 @@
+"""Memory buffer: capacity-or-timeout micro-batcher.
+
+Mirrors the reference's ``memory`` buffer (ref:
+crates/arkflow-plugin/src/buffer/memory.rs:39-197): accumulate written batches
+until ``capacity`` rows are held or ``timeout`` elapses since the first write,
+then emit one concatenated batch with a composite ack (``ArrayAck``
+equivalent); acks are held until the merged batch is acked downstream, so
+unacked rows replay from the broker after a crash.
+
+This is also the engine's micro-batching stage for TPU inference: it
+right-sizes ragged streaming input into batches near the compiled batch shape
+(see arkflow_tpu.tpu.bucketing for the shape policy).
+
+Config:
+
+    type: memory
+    capacity: 1024      # rows
+    timeout: 100ms
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Ack, Buffer, Resource, VecAck, register_buffer
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.utils.duration import parse_duration
+
+
+class MemoryBuffer(Buffer):
+    def __init__(self, capacity: int, timeout_s: Optional[float] = None):
+        if capacity <= 0:
+            raise ConfigError("buffer.capacity must be positive")
+        self.capacity = capacity
+        self.timeout_s = timeout_s
+        self._held: list[tuple[MessageBatch, Ack]] = []
+        self._held_rows = 0
+        self._first_write_at: Optional[float] = None
+        self._cond = asyncio.Condition()
+        self._closed = False
+
+    #: write() blocks once held rows exceed this multiple of capacity, restoring
+    #: the backpressure the bounded queues provide on the non-buffered path.
+    BACKPRESSURE_FACTOR = 4
+
+    async def write(self, batch: MessageBatch, ack: Ack) -> None:
+        async with self._cond:
+            while (
+                self._held_rows >= self.capacity * self.BACKPRESSURE_FACTOR
+                and not self._closed
+            ):
+                await self._cond.wait()
+            if self._first_write_at is None:
+                self._first_write_at = asyncio.get_running_loop().time()
+            self._held.append((batch, ack))
+            self._held_rows += batch.num_rows
+            # always notify: a waiting reader must recompute its timeout deadline
+            self._cond.notify_all()
+
+    def _emit_locked(self) -> tuple[MessageBatch, Ack]:
+        batches = [b for b, _ in self._held]
+        acks = VecAck([a for _, a in self._held])
+        self._held = []
+        self._held_rows = 0
+        self._first_write_at = None
+        self._cond.notify_all()  # wake writers blocked on backpressure
+        return MessageBatch.concat(batches), acks
+
+    async def read(self) -> Optional[tuple[MessageBatch, Ack]]:
+        while True:
+            async with self._cond:
+                if self._held_rows >= self.capacity:
+                    return self._emit_locked()
+                if self._closed:
+                    if self._held:
+                        return self._emit_locked()
+                    return None
+                # compute how long we may wait
+                timeout = None
+                if self.timeout_s is not None and self._first_write_at is not None:
+                    now = asyncio.get_running_loop().time()
+                    timeout = max(0.0, self._first_write_at + self.timeout_s - now)
+                    if timeout <= 0 and self._held:
+                        return self._emit_locked()
+                try:
+                    await asyncio.wait_for(self._cond.wait(), timeout=timeout)
+                except asyncio.TimeoutError:
+                    if self._held:
+                        return self._emit_locked()
+
+    async def close(self) -> None:
+        async with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+
+@register_buffer("memory")
+def _build(config: dict, resource: Resource) -> MemoryBuffer:
+    capacity = config.get("capacity")
+    if capacity is None:
+        raise ConfigError("memory buffer requires 'capacity'")
+    timeout = config.get("timeout")
+    return MemoryBuffer(
+        capacity=int(capacity),
+        timeout_s=parse_duration(timeout) if timeout is not None else None,
+    )

@@ -1,0 +1,43 @@
+"""Codec plumbing shared by inputs/outputs.
+
+``decode_payloads`` mirrors ``apply_codec_to_payload``
+(ref: crates/arkflow-plugin/src/input/codec_helper.rs): bytes become a batch
+via the configured codec, or land raw in the ``__value__`` binary column.
+``encode_batch`` is the write-side twin (ref output/codec_helper.rs): batch to
+payload bytes via codec, or the raw ``__value__`` column when no codec is set.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD, MessageBatch
+from arkflow_tpu.components import Codec, Resource, build_component
+
+
+def build_codec(config: Optional[dict], resource: Resource) -> Optional[Codec]:
+    if not config:
+        return None
+    if isinstance(config, str):
+        config = {"type": config}
+    return build_component("codec", config, resource)
+
+
+def decode_payloads(payloads: list[bytes], codec: Optional[Codec]) -> MessageBatch:
+    if codec is None:
+        return MessageBatch.new_binary(payloads)
+    batches = [codec.decode(p) for p in payloads]
+    batches = [b for b in batches if b.num_rows > 0]
+    if not batches:
+        return MessageBatch.empty()
+    return MessageBatch.concat(batches)
+
+
+def encode_batch(batch: MessageBatch, codec: Optional[Codec]) -> list[bytes]:
+    if codec is not None:
+        return codec.encode(batch)
+    if batch.has_column(DEFAULT_BINARY_VALUE_FIELD):
+        return batch.to_binary()
+    # no codec + no raw column: emit one JSON doc per row (pragmatic default)
+    return [json.dumps(row, default=str).encode() for row in batch.record_batch.to_pylist()]

@@ -1,0 +1,78 @@
+"""JSON codec: schema-inferred decode / line-delimited encode.
+
+Mirrors the reference codec (ref: crates/arkflow-plugin/src/codec/json.rs:21-47):
+decode accepts a JSON object or line-delimited objects and infers the Arrow
+schema; encode emits one JSON document per row.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any
+
+import pyarrow as pa
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Codec, register_codec
+from arkflow_tpu.errors import CodecError
+
+
+def _rows_to_batch(rows: list[dict[str, Any]]) -> MessageBatch:
+    if not rows:
+        return MessageBatch.empty()
+    try:
+        table = pa.Table.from_pylist(rows)
+    except (pa.ArrowInvalid, pa.ArrowTypeError) as e:
+        raise CodecError(f"cannot infer Arrow schema from JSON: {e}") from e
+    return MessageBatch.from_table(table)
+
+
+def _cell_to_json(v: Any) -> Any:
+    if isinstance(v, bytes):
+        try:
+            return v.decode("utf-8")
+        except UnicodeDecodeError:
+            return base64.b64encode(v).decode("ascii")
+    return v
+
+
+class JsonCodec(Codec):
+    def decode(self, payload: bytes) -> MessageBatch:
+        text = payload.decode("utf-8", "replace").strip()
+        if not text:
+            return MessageBatch.empty()
+        rows: list[dict[str, Any]]
+        if text.startswith("["):
+            try:
+                parsed = json.loads(text)
+            except json.JSONDecodeError as e:
+                raise CodecError(f"invalid JSON: {e}") from e
+            if not isinstance(parsed, list) or not all(isinstance(r, dict) for r in parsed):
+                raise CodecError("JSON array payload must contain objects")
+            rows = parsed
+        else:
+            rows = []
+            for line in text.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise CodecError(f"invalid JSON line: {e}") from e
+                if not isinstance(obj, dict):
+                    raise CodecError(f"JSON line must be an object, got {type(obj).__name__}")
+                rows.append(obj)
+        return _rows_to_batch(rows)
+
+    def encode(self, batch: MessageBatch) -> list[bytes]:
+        out = []
+        for row in batch.record_batch.to_pylist():
+            out.append(json.dumps({k: _cell_to_json(v) for k, v in row.items()}).encode())
+        return out
+
+
+@register_codec("json")
+def _build_json(config, resource):
+    return JsonCodec()

@@ -1,0 +1,2 @@
+import arkflow_tpu.plugins.input.generate  # noqa: F401
+import arkflow_tpu.plugins.input.memory  # noqa: F401

@@ -1,0 +1,57 @@
+"""Static in-config message list; EOF when drained — the unit-test source.
+
+Mirrors the reference's ``memory`` input (ref:
+crates/arkflow-plugin/src/input/memory.rs). Config:
+
+    type: memory
+    messages: ['{"a":1}', '{"a":2}']
+    codec: json   # optional
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
+from arkflow_tpu.errors import ConfigError, EndOfInput
+from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
+
+
+class MemoryInput(Input):
+    def __init__(self, messages: list[bytes], codec=None):
+        self._initial = list(messages)
+        self.codec = codec
+        self._queue: deque[bytes] = deque()
+
+    async def connect(self) -> None:
+        self._queue = deque(self._initial)
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        if not self._queue:
+            raise EndOfInput()
+        payload = self._queue.popleft()
+        batch = decode_payloads([payload], self.codec)
+        return batch.with_source("memory"), NoopAck()
+
+    def push(self, payload: bytes) -> None:
+        """Test hook: enqueue a message after construction."""
+        self._queue.append(payload)
+
+
+@register_input("memory")
+def _build(config: dict, resource: Resource) -> MemoryInput:
+    msgs = config.get("messages")
+    if msgs is None:
+        raise ConfigError("memory input requires 'messages'")
+    encoded = []
+    for m in msgs:
+        if isinstance(m, bytes):
+            encoded.append(m)
+        elif isinstance(m, str):
+            encoded.append(m.encode())
+        else:
+            import json
+
+            encoded.append(json.dumps(m).encode())
+    return MemoryInput(encoded, codec=build_codec(config.get("codec"), resource))

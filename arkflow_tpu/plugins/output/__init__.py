@@ -1,0 +1,2 @@
+import arkflow_tpu.plugins.output.stdout  # noqa: F401
+import arkflow_tpu.plugins.output.drop  # noqa: F401

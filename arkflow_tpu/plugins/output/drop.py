@@ -1,0 +1,25 @@
+"""Discard output — for ``error_output`` and benches
+(ref: crates/arkflow-plugin/src/output/drop.rs)."""
+
+from __future__ import annotations
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Output, Resource, register_output
+
+
+class DropOutput(Output):
+    def __init__(self):
+        self.dropped_batches = 0
+        self.dropped_rows = 0
+
+    async def connect(self) -> None:
+        return None
+
+    async def write(self, batch: MessageBatch) -> None:
+        self.dropped_batches += 1
+        self.dropped_rows += batch.num_rows
+
+
+@register_output("drop")
+def _build(config: dict, resource: Resource) -> DropOutput:
+    return DropOutput()

@@ -1,0 +1,39 @@
+"""stdout output with an injectable writer for capture in tests.
+
+Mirrors the reference's generics-over-trait testing seam
+(``StdoutOutput<T: StdWriter>`` with a ``MockWriter``,
+ref: crates/arkflow-plugin/src/output/stdout.rs:38-110,122-168).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Output, Resource, register_output
+from arkflow_tpu.plugins.codec.helper import build_codec, encode_batch
+
+
+class StdoutOutput(Output):
+    def __init__(self, codec=None, writer: Optional[Callable[[bytes], None]] = None):
+        self.codec = codec
+        self._write = writer or (lambda b: sys.stdout.buffer.write(b + b"\n"))
+
+    async def connect(self) -> None:
+        return None
+
+    async def write(self, batch: MessageBatch) -> None:
+        for payload in encode_batch(batch.strip_metadata(), self.codec):
+            self._write(payload)
+
+    async def close(self) -> None:
+        try:
+            sys.stdout.flush()
+        except ValueError:
+            pass
+
+
+@register_output("stdout")
+def _build(config: dict, resource: Resource) -> StdoutOutput:
+    return StdoutOutput(codec=build_codec(config.get("codec"), resource))

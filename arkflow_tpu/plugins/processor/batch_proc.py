@@ -1,0 +1,79 @@
+"""In-pipeline batch accumulator.
+
+Mirrors the reference's ``batch`` processor (ref:
+crates/arkflow-plugin/src/processor/batch.rs:30-125): accumulate incoming
+batches until ``count`` rows or ``timeout`` elapses, then emit one concatenated
+batch; otherwise emit nothing (the ``ProcessResult::None`` path — the runtime
+acks the contributing messages immediately, so use this only where replay
+semantics allow it; the window *buffers* hold acks instead).
+
+Config:
+
+    type: batch
+    count: 1024
+    timeout: 100ms
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Processor, Resource, register_processor
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.utils.duration import parse_duration
+
+
+class BatchProcessor(Processor):
+    def __init__(self, count: int, timeout_s: Optional[float] = None):
+        if count <= 0:
+            raise ConfigError("batch.count must be positive")
+        self.count = count
+        self.timeout_s = timeout_s
+        self._held: list[MessageBatch] = []
+        self._held_rows = 0
+        self._deadline: Optional[float] = None
+
+    def _due(self) -> bool:
+        if self._held_rows >= self.count:
+            return True
+        if self.timeout_s is not None and self._deadline is not None:
+            return time.monotonic() >= self._deadline
+        return False
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows:
+            if not self._held and self.timeout_s is not None:
+                self._deadline = time.monotonic() + self.timeout_s
+            self._held.append(batch)
+            self._held_rows += batch.num_rows
+        if not self._due():
+            return []
+        return self._flush()
+
+    def _flush(self) -> list[MessageBatch]:
+        if not self._held:
+            return []
+        merged = MessageBatch.concat(self._held)
+        self._held = []
+        self._held_rows = 0
+        self._deadline = None
+        return [merged]
+
+    async def close(self) -> None:
+        # remaining rows are dropped at close like the reference (state is volatile)
+        self._held = []
+        self._held_rows = 0
+
+
+@register_processor("batch")
+def _build(config: dict, resource: Resource) -> BatchProcessor:
+    count = config.get("count")
+    if count is None:
+        raise ConfigError("batch processor requires 'count'")
+    timeout = config.get("timeout")
+    return BatchProcessor(
+        count=int(count),
+        timeout_s=parse_duration(timeout) if timeout is not None else None,
+    )

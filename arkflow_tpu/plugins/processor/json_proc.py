@@ -1,0 +1,78 @@
+"""JSON <-> Arrow processors.
+
+Mirrors the reference's ``json_to_arrow`` / ``arrow_to_json`` processors
+(ref: crates/arkflow-plugin/src/processor/json.rs:37-156, schema inference in
+component/json.rs:22-58). ``json_to_arrow`` decodes the ``__value__`` payload
+column into typed columns; ``arrow_to_json`` serialises rows back into
+``__value__`` as line-delimited JSON, with an optional field filter.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD, MessageBatch
+from arkflow_tpu.components import Processor, Resource, register_processor
+from arkflow_tpu.errors import ProcessError
+from arkflow_tpu.plugins.codec.json_codec import JsonCodec, _rows_to_batch
+
+
+class JsonToArrowProcessor(Processor):
+    def __init__(self, value_field: str = DEFAULT_BINARY_VALUE_FIELD):
+        self.value_field = value_field
+        self.codec = JsonCodec()
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []
+        if not batch.has_column(self.value_field):
+            raise ProcessError(f"json_to_arrow: no {self.value_field!r} column")
+        rows = []
+        for payload in batch.to_binary(self.value_field):
+            text = payload.decode("utf-8", "replace").strip()
+            if not text:
+                continue
+            try:
+                obj = json.loads(text)
+            except json.JSONDecodeError as e:
+                raise ProcessError(f"json_to_arrow: invalid JSON: {e}") from e
+            if isinstance(obj, list):
+                rows.extend(obj)
+            else:
+                rows.append(obj)
+        out = _rows_to_batch(rows)
+        # carry metadata columns through (same row count only)
+        meta = batch.metadata_columns()
+        if meta and out.num_rows == batch.num_rows:
+            for name in meta:
+                out = out.with_column(name, batch.column(name))
+        return [out] if out.num_rows else []
+
+
+class ArrowToJsonProcessor(Processor):
+    def __init__(self, fields: Optional[list[str]] = None):
+        self.fields = fields
+        self.codec = JsonCodec()
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []
+        data = batch.strip_metadata()
+        if self.fields:
+            data = data.filter_columns(self.fields)
+        payloads = self.codec.encode(data)
+        out = MessageBatch.new_binary(payloads)
+        for name in batch.metadata_columns():
+            out = out.with_column(name, batch.column(name))
+        return [out]
+
+
+@register_processor("json_to_arrow")
+def _build_j2a(config: dict, resource: Resource) -> JsonToArrowProcessor:
+    return JsonToArrowProcessor(value_field=config.get("value_field", DEFAULT_BINARY_VALUE_FIELD))
+
+
+@register_processor("arrow_to_json")
+def _build_a2j(config: dict, resource: Resource) -> ArrowToJsonProcessor:
+    return ArrowToJsonProcessor(fields=config.get("fields"))

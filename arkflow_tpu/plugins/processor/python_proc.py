@@ -1,0 +1,106 @@
+"""Python processor: user code over Arrow batches, in-process.
+
+The architectural slot where the reference embeds CPython via PyO3 and hands
+the batch across the Arrow C-data interface (ref:
+crates/arkflow-plugin/src/processor/python.rs:46-147). Here the engine *is*
+Python, so the handoff is a direct zero-copy ``pyarrow.RecordBatch`` — no FFI,
+no GIL shuffle. The user function receives a ``pyarrow.RecordBatch`` and
+returns one of: a RecordBatch, a list of RecordBatches, a dict of columns, a
+list of row-dicts, or None (drop).
+
+CPU-bound user code can opt into a thread via ``blocking: true`` (the
+``spawn_blocking`` equivalent, ref python.rs:49).
+
+Config (script inline or module import, ref python.rs:104-147):
+
+    type: python
+    script: |
+      def process(batch):
+          import pyarrow.compute as pc
+          return batch.filter(pc.greater(batch.column("temp"), 30.0))
+    # or:
+    module: mypkg.transforms
+    function: process        # default "process"
+    blocking: false
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib
+from typing import Any, Callable
+
+import pyarrow as pa
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Processor, Resource, register_processor
+from arkflow_tpu.errors import ConfigError, ProcessError
+
+
+def _coerce_result(res: Any) -> list[MessageBatch]:
+    if res is None:
+        return []
+    if isinstance(res, pa.RecordBatch):
+        return [MessageBatch(res)] if res.num_rows else []
+    if isinstance(res, pa.Table):
+        return [MessageBatch.from_table(res)] if res.num_rows else []
+    if isinstance(res, MessageBatch):
+        return [res] if res.num_rows else []
+    if isinstance(res, dict):
+        return [MessageBatch.from_pydict(res)]
+    if isinstance(res, list):
+        if not res:
+            return []
+        if all(isinstance(r, dict) for r in res):
+            return [MessageBatch(pa.RecordBatch.from_pylist(res))]
+        out: list[MessageBatch] = []
+        for r in res:
+            out.extend(_coerce_result(r))
+        return out
+    raise ProcessError(f"python processor returned unsupported type {type(res).__name__}")
+
+
+class PythonProcessor(Processor):
+    def __init__(self, fn: Callable, blocking: bool = False):
+        self.fn = fn
+        self.blocking = blocking
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        rb = batch.record_batch
+        try:
+            if self.blocking:
+                res = await asyncio.get_running_loop().run_in_executor(None, self.fn, rb)
+            else:
+                res = self.fn(rb)
+                if asyncio.iscoroutine(res):
+                    res = await res
+        except ProcessError:
+            raise
+        except Exception as e:
+            raise ProcessError(f"python processor failed: {e}") from e
+        return _coerce_result(res)
+
+
+@register_processor("python")
+def _build(config: dict, resource: Resource) -> PythonProcessor:
+    script = config.get("script")
+    module = config.get("module")
+    fn_name = config.get("function", "process")
+    if bool(script) == bool(module):
+        raise ConfigError("python processor requires exactly one of 'script' or 'module'")
+    if script:
+        namespace: dict[str, Any] = {}
+        try:
+            exec(compile(script, "<python processor>", "exec"), namespace)
+        except SyntaxError as e:
+            raise ConfigError(f"python processor script error: {e}") from e
+        fn = namespace.get(fn_name)
+    else:
+        try:
+            mod = importlib.import_module(module)
+        except ImportError as e:
+            raise ConfigError(f"python processor: cannot import {module!r}: {e}") from e
+        fn = getattr(mod, fn_name, None)
+    if not callable(fn):
+        raise ConfigError(f"python processor: function {fn_name!r} not found or not callable")
+    return PythonProcessor(fn, blocking=bool(config.get("blocking", False)))

@@ -1,0 +1,96 @@
+"""SQL processor — the workhorse.
+
+Mirrors the reference SQL processor (ref:
+crates/arkflow-plugin/src/processor/sql.rs): the in-flight batch is registered
+as table ``flow`` (:38,112-120), the statement is pre-parsed at build time
+(:91-98), DDL/DML is forbidden (:192-195), ``Temporary`` enrichment tables are
+registered per batch with keys evaluated from an expression (:151-186), and
+contexts come from a fixed pool (:89; context_pool.rs:30-131).
+
+Config:
+
+    type: sql
+    query: "SELECT * FROM flow WHERE temp > 30"
+    table_name: flow            # optional override
+    temporary:                  # optional enrichment tables
+      - name: devices           # Temporary registered in the stream's resource
+        table: devices          # SQL table name to expose
+        key: "device_id"        # expression over flow producing lookup keys
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Processor, Resource, Temporary, register_processor
+from arkflow_tpu.errors import ConfigError, UnsupportedSql
+from arkflow_tpu.sql import ContextPool
+from arkflow_tpu.sql.eval import evaluate_expression
+from arkflow_tpu.sql.parser import assert_query_only, parse_select
+
+DEFAULT_TABLE_NAME = "flow"
+POOL_SIZE = 4  # ref processor/sql.rs:89
+
+
+@dataclass
+class TemporaryBinding:
+    table: str
+    temporary: Temporary
+    key_expr: str
+
+
+class SqlProcessor(Processor):
+    def __init__(self, query: str, table_name: str = DEFAULT_TABLE_NAME,
+                 temporaries: Optional[list[TemporaryBinding]] = None):
+        assert_query_only(query)
+        try:
+            parse_select(query)  # pre-parse; fallback-dialect queries may still fail here
+        except UnsupportedSql:
+            pass  # executed by the fallback tier at runtime
+        self.query = query
+        self.table_name = table_name
+        self.temporaries = temporaries or []
+        self.pool = ContextPool(POOL_SIZE)
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []  # ref :211-213
+        async with self.pool.acquire() as ctx:
+            for binding in self.temporaries:
+                keys = evaluate_expression(batch, binding.key_expr).to_pylist()
+                lookup = await binding.temporary.get(keys)
+                ctx.register_batch(binding.table, lookup)
+            ctx.register_batch(self.table_name, batch)
+            result = ctx.sql(self.query)
+        return [result] if result.num_rows > 0 else []
+
+
+@register_processor("sql")
+def _build(config: dict, resource: Resource) -> SqlProcessor:
+    query = config.get("query")
+    if not query:
+        raise ConfigError("sql processor requires 'query'")
+    bindings = []
+    for t in config.get("temporary", []) or []:
+        name = t.get("name")
+        if name not in resource.temporaries:
+            raise ConfigError(
+                f"sql processor references unknown temporary {name!r} "
+                f"(declared: {sorted(resource.temporaries)})"
+            )
+        bindings.append(
+            TemporaryBinding(
+                table=t.get("table", name),
+                temporary=resource.temporaries[name],
+                key_expr=t.get("key", ""),
+            )
+        )
+        if not bindings[-1].key_expr:
+            raise ConfigError(f"temporary {name!r} binding requires a 'key' expression")
+    return SqlProcessor(
+        query=query,
+        table_name=config.get("table_name", DEFAULT_TABLE_NAME),
+        temporaries=bindings,
+    )

@@ -1,0 +1,1 @@
+import arkflow_tpu.plugins.temporary.memory  # noqa: F401

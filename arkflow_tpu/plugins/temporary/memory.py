@@ -1,0 +1,55 @@
+"""In-memory Temporary: static keyed lookup table for SQL enrichment.
+
+Hermetic stand-in for the reference's Redis temporary (ref:
+crates/arkflow-plugin/src/temporary/redis.rs:31-136) — same contract
+(``get(keys) -> batch of matching rows``) with the rows supplied in config.
+
+Config:
+
+    type: memory
+    key: id
+    rows:
+      - {id: 1, name: "pump"}
+      - {id: 2, name: "valve"}
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Resource, Temporary, register_temporary
+from arkflow_tpu.errors import ConfigError
+
+
+class MemoryTemporary(Temporary):
+    def __init__(self, key_column: str, batch: MessageBatch):
+        if not batch.has_column(key_column):
+            raise ConfigError(f"memory temporary: key column {key_column!r} not in rows")
+        self.key_column = key_column
+        self.batch = batch
+
+    async def connect(self) -> None:
+        return None
+
+    async def get(self, keys: Sequence[object]) -> MessageBatch:
+        if not keys:
+            return self.batch.slice(0, 0)
+        col = self.batch.column(self.key_column)
+        mask = pc.is_in(col, value_set=pa.array(list(dict.fromkeys(keys))))
+        return MessageBatch(self.batch.record_batch.filter(mask))
+
+
+@register_temporary("memory")
+def _build(config: dict, resource: Resource) -> MemoryTemporary:
+    key = config.get("key")
+    rows = config.get("rows")
+    if not key or rows is None:
+        raise ConfigError("memory temporary requires 'key' and 'rows'")
+    if not isinstance(rows, list) or not all(isinstance(r, dict) for r in rows):
+        raise ConfigError("memory temporary 'rows' must be a list of mappings")
+    batch = MessageBatch(pa.RecordBatch.from_pylist(rows)) if rows else MessageBatch.empty()
+    return MemoryTemporary(key, batch)

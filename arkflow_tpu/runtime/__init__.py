@@ -1,0 +1,3 @@
+from arkflow_tpu.runtime.pipeline import Pipeline  # noqa: F401
+from arkflow_tpu.runtime.stream import Stream, build_stream  # noqa: F401
+from arkflow_tpu.runtime.engine import Engine  # noqa: F401

@@ -1,0 +1,113 @@
+"""CLI: ``python -m arkflow_tpu --config pipeline.yaml [--validate]``.
+
+Mirrors the reference CLI (ref: crates/arkflow-core/src/cli/mod.rs:22-147):
+``--config`` + ``--validate`` flags and logging initialisation with
+level / optional file / JSON-or-plain format from the ``logging`` config
+section.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+import time
+from typing import Optional, Sequence
+
+from arkflow_tpu.config import EngineConfig, LoggingConfig
+from arkflow_tpu.errors import ConfigError
+
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        body = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)),
+            "level": record.levelname.lower(),
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            body["exception"] = self.formatException(record.exc_info)
+        return json.dumps(body)
+
+
+def init_logging(cfg: LoggingConfig) -> None:
+    level = _LEVELS.get(cfg.level, logging.INFO)
+    root = logging.getLogger()
+    root.setLevel(level)
+    root.handlers.clear()
+    handler: logging.Handler
+    handler = logging.FileHandler(cfg.file_path) if cfg.file_path else logging.StreamHandler(sys.stderr)
+    if cfg.format == "json":
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)-5s %(name)s: %(message)s", "%H:%M:%S")
+        )
+    root.addHandler(handler)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="arkflow-tpu", description="TPU-native streaming dataflow engine"
+    )
+    parser.add_argument("-c", "--config", required=True, help="path to YAML/JSON/TOML config")
+    parser.add_argument(
+        "-v", "--validate", action="store_true", help="validate the config and exit"
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        cfg = EngineConfig.from_file(args.config)
+    except ConfigError as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
+
+    if args.validate:
+        # also check every component type resolves (goes beyond the reference's parse-only check)
+        from arkflow_tpu.components.registry import ensure_plugins_loaded, registered_types
+
+        ensure_plugins_loaded()
+        problems = []
+        for i, s in enumerate(cfg.streams):
+            for family, c in (
+                ("input", s.input),
+                ("output", s.output),
+                *((("output", s.error_output),) if s.error_output else ()),
+                *((("buffer", s.buffer),) if s.buffer else ()),
+                *((("processor", p) for p in s.pipeline.processors)),
+                *((("temporary", t.config) for t in s.temporary)),
+            ):
+                t = c.get("type")
+                if t not in registered_types(family):
+                    problems.append(f"stream[{i}]: unknown {family} type {t!r}")
+        if problems:
+            print("\n".join(problems), file=sys.stderr)
+            return 2
+        print(f"config OK: {len(cfg.streams)} stream(s)")
+        return 0
+
+    init_logging(cfg.logging)
+    from arkflow_tpu.runtime.engine import Engine
+
+    engine = Engine(cfg)
+    try:
+        asyncio.run(engine.run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

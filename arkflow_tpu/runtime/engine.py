@@ -1,0 +1,112 @@
+"""Engine: builds and supervises all streams + serves health/metrics.
+
+Mirrors ``Engine::run`` (ref: crates/arkflow-core/src/engine/mod.rs:81-289):
+build every stream from config, spawn them concurrently, install
+SIGINT/SIGTERM handlers that flip a cancellation event (ref :246-262), and run
+an HTTP server with ``/health``, ``/readiness``, ``/liveness`` endpoints
+(ref :99-209) — here extended with the ``/metrics`` Prometheus endpoint the
+reference declared a dependency for but never shipped (SURVEY.md section 5).
+
+A crashed stream is logged without taking the engine down (ref :268-273).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+import signal
+from typing import Optional
+
+from aiohttp import web
+
+from arkflow_tpu.components.registry import ensure_plugins_loaded
+from arkflow_tpu.config import EngineConfig
+from arkflow_tpu.obs import global_registry
+from arkflow_tpu.runtime.stream import Stream, build_stream
+
+logger = logging.getLogger("arkflow.engine")
+
+
+class Engine:
+    def __init__(self, config: EngineConfig):
+        self.config = config
+        self.cancel = asyncio.Event()
+        self.streams: list[Stream] = []
+        self._ready = False
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- health/metrics server (ref engine/mod.rs:99-209) ------------------
+
+    async def _start_health_server(self) -> None:
+        hc = self.config.health_check
+        if not hc.enabled:
+            return
+        app = web.Application()
+
+        def health(_req):
+            body = {"status": "ok" if not self.cancel.is_set() else "shutting_down",
+                    "streams": len(self.streams)}
+            return web.Response(text=json.dumps(body), content_type="application/json")
+
+        def readiness(_req):
+            if self._ready:
+                return web.Response(text='{"status":"ready"}', content_type="application/json")
+            return web.Response(status=503, text='{"status":"not_ready"}', content_type="application/json")
+
+        def liveness(_req):
+            return web.Response(text='{"status":"alive"}', content_type="application/json")
+
+        def metrics(_req):
+            return web.Response(text=global_registry().exposition(),
+                                content_type="text/plain", charset="utf-8")
+
+        app.router.add_get(hc.path, health)
+        app.router.add_get("/readiness", readiness)
+        app.router.add_get("/liveness", liveness)
+        app.router.add_get("/metrics", metrics)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, hc.host, hc.port)
+        await site.start()
+        self._runner = runner
+        logger.info("health server on %s:%d", hc.host, hc.port)
+
+    def _install_signal_handlers(self) -> None:
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self.cancel.set)
+            except (NotImplementedError, RuntimeError):  # non-main thread / platform
+                pass
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def run(self) -> None:
+        ensure_plugins_loaded()
+        await self._start_health_server()
+        self._install_signal_handlers()
+
+        async def run_one(stream: Stream) -> None:
+            try:
+                await stream.run(self.cancel)
+                logger.info("[%s] finished", stream.name)
+            except Exception:
+                logger.exception("[%s] stream crashed", stream.name)
+
+        try:
+            self.streams = [
+                build_stream(s, name=s.name or f"stream-{i}")
+                for i, s in enumerate(self.config.streams)
+            ]
+            self._ready = True
+            await asyncio.gather(*(run_one(s) for s in self.streams))
+        finally:
+            self._ready = False
+            if self._runner is not None:
+                with contextlib.suppress(Exception):
+                    await self._runner.cleanup()
+
+    def shutdown(self) -> None:
+        self.cancel.set()

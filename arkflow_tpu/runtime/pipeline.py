@@ -1,0 +1,35 @@
+"""Pipeline: a sequential processor chain with fan-out.
+
+Mirrors the reference's ``Pipeline::process`` fold (ref:
+crates/arkflow-core/src/pipeline/mod.rs:57-85): each processor maps every
+in-flight batch to zero or more batches; an empty result short-circuits the
+chain (the ``ProcessResult::None`` drop path); multiple results fan out
+through the remaining processors (``ProcessResult::Multiple``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components.base import Processor
+
+
+class Pipeline:
+    def __init__(self, processors: Sequence[Processor]):
+        self.processors = list(processors)
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        current = [batch]
+        for proc in self.processors:
+            nxt: list[MessageBatch] = []
+            for b in current:
+                nxt.extend(await proc.process(b))
+            if not nxt:
+                return []
+            current = nxt
+        return current
+
+    async def close(self) -> None:
+        for proc in self.processors:
+            await proc.close()

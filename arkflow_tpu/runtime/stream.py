@@ -1,0 +1,311 @@
+"""Stream runtime: the 4-stage hot loop.
+
+Functional clone of the reference's ``Stream::run`` (ref:
+crates/arkflow-core/src/stream/mod.rs:79-398), re-expressed for asyncio:
+
+    do_input -> [buffer] -> do_processor x N workers -> do_output
+
+- Bounded queues of ``thread_num * 4`` between stages (ref :90-93).
+- Workers stamp a sequence number at dequeue; the output task restores global
+  order with a reorder map before writing (ref :280,319-353).
+- Backpressure: when ``assigned - emitted > MAX_PENDING`` the workers pause
+  (ref :34,263-273).
+- Acks fire only after every produced batch was written (at-least-once,
+  ref :379-396). A processor chain returning nothing acks immediately
+  (ref :301-303).
+- ``EndOfInput`` drains and shuts the stream down; ``Disconnection`` puts the
+  input into a 5s reconnect-forever loop (ref :176-203).
+- Errors during processing route the original batch to ``error_output`` when
+  configured, else are logged and acked (ref :358-397).
+- Ordered close: input -> buffer -> pipeline -> output (ref :400-437).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components.base import Ack, Buffer, Input, Output, Resource, Temporary
+from arkflow_tpu.components.registry import build_component
+from arkflow_tpu.config import StreamConfig
+from arkflow_tpu.errors import ArkError, Disconnection, EndOfInput
+from arkflow_tpu.obs import global_registry
+from arkflow_tpu.runtime.pipeline import Pipeline
+
+logger = logging.getLogger("arkflow.stream")
+
+MAX_PENDING = 1024  # ref stream/mod.rs:34
+RECONNECT_DELAY_S = 5.0  # ref stream/mod.rs:190
+
+
+@dataclass
+class _WorkItem:
+    batch: MessageBatch
+    ack: Ack
+
+
+class _Done:
+    """Queue sentinel: upstream stage finished."""
+
+
+_DONE = _Done()
+
+
+class Stream:
+    def __init__(
+        self,
+        input_: Input,
+        pipeline: Pipeline,
+        output: Output,
+        error_output: Optional[Output] = None,
+        buffer: Optional[Buffer] = None,
+        temporaries: Optional[dict[str, Temporary]] = None,
+        thread_num: int = 1,
+        name: str = "stream",
+    ):
+        self.input = input_
+        self.pipeline = pipeline
+        self.output = output
+        self.error_output = error_output
+        self.buffer = buffer
+        self.temporaries = temporaries or {}
+        self.thread_num = max(1, thread_num)
+        self.name = name
+
+        reg = global_registry()
+        labels = {"stream": name}
+        self.m_rows_in = reg.counter("arkflow_rows_in_total", "rows read from input", labels)
+        self.m_rows_out = reg.counter("arkflow_rows_out_total", "rows written to output", labels)
+        self.m_batches_in = reg.counter("arkflow_batches_in_total", "batches read from input", labels)
+        self.m_batches_out = reg.counter("arkflow_batches_out_total", "batches written", labels)
+        self.m_errors = reg.counter("arkflow_process_errors_total", "processor errors", labels)
+        self.m_write_errors = reg.counter("arkflow_write_errors_total", "output write errors", labels)
+        self.m_proc_latency = reg.histogram("arkflow_process_seconds", "pipeline latency", labels)
+        self.m_e2e_latency = reg.histogram("arkflow_e2e_seconds", "read-to-written latency", labels)
+        self.m_pending = reg.gauge("arkflow_pending_batches", "in-flight batches", labels)
+
+        # runtime state
+        self._seq_assigned = 0
+        self._seq_emitted = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def run(self, cancel: asyncio.Event) -> None:
+        """Run until the input ends or ``cancel`` is set; drains before returning."""
+        await self.input.connect()
+        await self.output.connect()
+        if self.error_output is not None:
+            await self.error_output.connect()
+        for t in self.temporaries.values():
+            await t.connect()
+
+        qsize = self.thread_num * 4  # ref stream/mod.rs:90-93
+        input_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
+        output_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
+
+        tasks = [asyncio.create_task(self._do_input(input_q, cancel), name=f"{self.name}-input")]
+        if self.buffer is not None:
+            tasks.append(asyncio.create_task(self._do_buffer(input_q), name=f"{self.name}-buffer"))
+        for i in range(self.thread_num):
+            tasks.append(
+                asyncio.create_task(self._do_processor(input_q, output_q), name=f"{self.name}-proc-{i}")
+            )
+        out_task = asyncio.create_task(self._do_output(output_q), name=f"{self.name}-output")
+
+        try:
+            await asyncio.gather(*tasks)
+            # each worker sent its sentinel; output drains the reorder map and exits
+            await out_task
+        except BaseException:
+            for t in [*tasks, out_task]:
+                t.cancel()
+            await asyncio.gather(*tasks, out_task, return_exceptions=True)
+            raise
+        finally:
+            await self._close_all()
+
+    async def _close_all(self) -> None:
+        # ordered close: input -> buffer -> pipeline -> output (ref :400-437)
+        for closer in (
+            self.input.close,
+            *((self.buffer.close,) if self.buffer else ()),
+            self.pipeline.close,
+            *(t.close for t in self.temporaries.values()),
+            *((self.error_output.close,) if self.error_output else ()),
+            self.output.close,
+        ):
+            try:
+                await closer()
+            except Exception:
+                logger.exception("error during close")
+
+    # -- stages ------------------------------------------------------------
+
+    async def _do_input(self, input_q: asyncio.Queue, cancel: asyncio.Event) -> None:
+        """Read loop; feeds the buffer (if any) or the worker queue directly."""
+        cancel_wait = asyncio.ensure_future(cancel.wait())
+        try:
+            while not cancel.is_set():
+                read_f = asyncio.ensure_future(self.input.read())
+                done, _ = await asyncio.wait(
+                    {read_f, cancel_wait}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if read_f not in done:
+                    read_f.cancel()
+                    try:
+                        await read_f
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                    break
+                try:
+                    batch, ack = read_f.result()
+                except EndOfInput:
+                    logger.info("[%s] input exhausted (EOF)", self.name)
+                    break
+                except Disconnection as e:
+                    logger.warning("[%s] input disconnected (%s); reconnecting in %.0fs",
+                                   self.name, e, RECONNECT_DELAY_S)
+                    # reconnect-forever loop (ref :183-194)
+                    while not cancel.is_set():
+                        try:
+                            await asyncio.sleep(RECONNECT_DELAY_S)
+                            await self.input.connect()
+                            break
+                        except Exception as re:
+                            logger.warning("[%s] reconnect failed: %s", self.name, re)
+                    continue
+                except ArkError as e:
+                    logger.error("[%s] input read error: %s", self.name, e)
+                    await asyncio.sleep(0.1)
+                    continue
+                item = _WorkItem(batch.with_ingest_time(), ack)
+                self.m_batches_in.inc()
+                self.m_rows_in.inc(batch.num_rows)
+                if self.buffer is not None:
+                    await self.buffer.write(item.batch, item.ack)
+                else:
+                    await input_q.put(item)
+        finally:
+            cancel_wait.cancel()
+            if self.buffer is not None:
+                await self.buffer.close()  # buffer drains remaining windows, then its reader exits
+            else:
+                for _ in range(self.thread_num):
+                    await input_q.put(_DONE)
+
+    async def _do_buffer(self, input_q: asyncio.Queue) -> None:
+        """Move merged window/micro-batches from the buffer into the worker queue."""
+        while True:
+            item = await self.buffer.read()
+            if item is None:
+                for _ in range(self.thread_num):
+                    await input_q.put(_DONE)
+                return
+            batch, ack = item
+            await input_q.put(_WorkItem(batch, ack))
+
+    async def _do_processor(self, input_q: asyncio.Queue, output_q: asyncio.Queue) -> None:
+        """Worker: pipeline.process with seq stamping + backpressure (THE hot loop)."""
+        while True:
+            # backpressure (ref :263-273)
+            while (self._seq_assigned - self._seq_emitted) > MAX_PENDING:
+                await asyncio.sleep(0.1)
+            item = await input_q.get()
+            if isinstance(item, _Done):
+                await output_q.put(_DONE)
+                return
+            seq = self._seq_assigned
+            self._seq_assigned += 1
+            self.m_pending.set(self._seq_assigned - self._seq_emitted)
+            t0 = asyncio.get_running_loop().time()
+            try:
+                results = await self.pipeline.process(item.batch)
+                err = None
+            except Exception as e:  # processor failure -> error path
+                results = []
+                err = e
+            self.m_proc_latency.observe(asyncio.get_running_loop().time() - t0)
+            await output_q.put((seq, item, results, err))
+
+    async def _do_output(self, output_q: asyncio.Queue) -> None:
+        """Reorder by seq and write; ack only on full success (ref :319-397)."""
+        reorder: dict[int, tuple] = {}
+        next_seq = 0
+        done_workers = 0
+        total_workers = self.thread_num
+        while True:
+            msg = await output_q.get()
+            if isinstance(msg, _Done):
+                done_workers += 1
+                if done_workers >= total_workers:
+                    if reorder:
+                        logger.error("[%s] %d batches stuck in reorder at shutdown", self.name, len(reorder))
+                    return
+                continue
+            seq, item, results, err = msg
+            reorder[seq] = (item, results, err)
+            while next_seq in reorder:
+                item, results, err = reorder.pop(next_seq)
+                next_seq += 1
+                self._seq_emitted = next_seq
+                await self._emit(item, results, err)
+
+    async def _emit(self, item: _WorkItem, results: list[MessageBatch], err: Optional[Exception]) -> None:
+        if err is not None:
+            self.m_errors.inc()
+            if self.error_output is not None:
+                try:
+                    tagged = item.batch.with_ext_metadata({"error": str(err)})
+                    await self.error_output.write(tagged)
+                    await item.ack.ack()
+                except Exception:
+                    logger.exception("[%s] error_output write failed", self.name)
+            else:
+                logger.error("[%s] processing error (no error_output): %s", self.name, err)
+                await item.ack.ack()
+            return
+        if not results:
+            # ProcessResult::None -> drop + ack (ref :301-303)
+            await item.ack.ack()
+            return
+        try:
+            for b in results:
+                await self.output.write(b)
+                self.m_batches_out.inc()
+                self.m_rows_out.inc(b.num_rows)
+        except Exception as e:
+            self.m_write_errors.inc()
+            logger.error("[%s] output write failed; not acking: %s", self.name, e)
+            return
+        ingest = item.batch.get_meta("__meta_ingest_time")
+        if ingest is not None:
+            self.m_e2e_latency.observe(max(0.0, time.time() - ingest / 1000.0))
+        await item.ack.ack()
+
+
+def build_stream(cfg: StreamConfig, name: Optional[str] = None) -> Stream:
+    """Construct a Stream from config via the builder registries
+    (ref StreamConfig::build, stream/mod.rs:453-492)."""
+    resource = Resource()
+    # temporaries first, so processors can look them up (ref :459-467)
+    for tcfg in cfg.temporary:
+        resource.temporaries[tcfg.name] = build_component("temporary", tcfg.config, resource)
+    input_ = build_component("input", cfg.input, resource)
+    processors = [build_component("processor", p, resource) for p in cfg.pipeline.processors]
+    output = build_component("output", cfg.output, resource)
+    error_output = build_component("output", cfg.error_output, resource) if cfg.error_output else None
+    buffer = build_component("buffer", cfg.buffer, resource) if cfg.buffer else None
+    return Stream(
+        input_=input_,
+        pipeline=Pipeline(processors),
+        output=output,
+        error_output=error_output,
+        buffer=buffer,
+        temporaries=resource.temporaries,
+        thread_num=cfg.pipeline.effective_threads(),
+        name=name or cfg.name or "stream",
+    )

@@ -1,0 +1,26 @@
+"""Arrow-native SQL engine (the DataFusion-equivalent).
+
+The reference embeds DataFusion and registers the in-flight batch as table
+``flow`` (ref: crates/arkflow-plugin/src/processor/sql.rs:38,112-120). Neither
+DataFusion nor DuckDB is available in this image, so this package implements
+the same contract in two tiers:
+
+- **Native tier** (``planner.py``): SELECT / WHERE / GROUP BY / ORDER BY /
+  LIMIT compiled straight onto ``pyarrow.compute`` vectorized kernels —
+  zero-copy, columnar, no row materialisation. This covers the streaming hot
+  path (filters, projections, aggregations).
+- **Fallback tier** (``fallback.py``): anything the native planner doesn't
+  support (joins, subqueries, CTEs, window functions) is executed by the
+  stdlib ``sqlite3`` engine with batches bridged in as tables. Correct, not
+  fast — the native tier owns the hot path.
+
+``SessionContext`` (``engine.py``) is the user-facing object; a
+``ContextPool`` mirrors the reference's fixed 4-context pool
+(ref context_pool.rs:30-131). Scalar/aggregate UDFs registered via
+``arkflow_tpu.sql.functions`` are visible in both tiers
+(ref udf/mod.rs:38-43).
+"""
+
+from arkflow_tpu.sql.engine import ContextPool, SessionContext  # noqa: F401
+from arkflow_tpu.sql.eval import evaluate_expression  # noqa: F401
+from arkflow_tpu.sql.functions import register_aggregate_udf, register_scalar_udf  # noqa: F401

@@ -1,0 +1,74 @@
+"""SessionContext + ContextPool: the user-facing SQL entry points.
+
+``SessionContext.sql(query)`` mirrors DataFusion's batch-table contract:
+register Arrow batches under table names, run a query, get a batch back
+(ref: crates/arkflow-plugin/src/processor/sql.rs:112-129). Execution tries the
+native Arrow planner first and silently reroutes to the sqlite fallback on
+``UnsupportedSql``.
+
+``ContextPool`` reproduces the reference's fixed pool of contexts
+(ref context_pool.rs:30-131) as an async context manager over a semaphore.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.errors import UnsupportedSql
+from arkflow_tpu.sql.fallback import execute_fallback
+from arkflow_tpu.sql.parser import assert_query_only, parse_select
+from arkflow_tpu.sql.planner import execute_select
+
+
+class SessionContext:
+    def __init__(self) -> None:
+        self._tables: dict[str, MessageBatch] = {}
+
+    def register_batch(self, name: str, batch: MessageBatch) -> None:
+        self._tables[name] = batch
+
+    def deregister(self, name: str) -> None:
+        self._tables.pop(name, None)
+
+    def deregister_all(self) -> None:
+        self._tables.clear()
+
+    def table_names(self) -> list[str]:
+        return sorted(self._tables)
+
+    def sql(self, query: str) -> MessageBatch:
+        """Execute a read-only query over the registered tables."""
+        assert_query_only(query)
+        try:
+            sel = parse_select(query)
+            return execute_select(sel, self._tables)
+        except UnsupportedSql:
+            return execute_fallback(query, self._tables)
+
+
+class ContextPool:
+    """Fixed pool of SessionContexts (ref context_pool.rs: 4 contexts, spin-wait).
+
+    The asyncio equivalent uses a semaphore instead of a spin-wait; contexts
+    are handed out round-robin and wiped (tables deregistered) on release.
+    """
+
+    def __init__(self, size: int = 4):
+        if size <= 0:
+            raise ValueError("pool size must be positive")
+        self._contexts: list[SessionContext] = [SessionContext() for _ in range(size)]
+        self._free: asyncio.Queue[SessionContext] = asyncio.Queue()
+        for c in self._contexts:
+            self._free.put_nowait(c)
+
+    @contextlib.asynccontextmanager
+    async def acquire(self):
+        ctx = await self._free.get()
+        try:
+            yield ctx
+        finally:
+            ctx.deregister_all()
+            self._free.put_nowait(ctx)

@@ -1,0 +1,150 @@
+"""sqlite3 fallback tier: full-dialect SQL over bridged Arrow batches.
+
+Covers what the native Arrow planner declines — joins, subqueries, CTEs,
+window functions, UNION — by materialising registered batches into an
+in-memory sqlite database, executing there, and lifting the result back to
+Arrow. Row-materialising and therefore slow; the native tier owns the hot
+path. User UDFs (``arkflow_tpu.sql.functions``) are bridged via
+``create_function`` so both tiers see the same function surface.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Any, Mapping
+
+import pyarrow as pa
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.errors import ArkError
+from arkflow_tpu.sql.functions import as_array, get_aggregate_udf, scalar_udfs
+from arkflow_tpu.sql.parser import assert_query_only
+
+
+def _sqlite_type(t: pa.DataType) -> str:
+    if pa.types.is_integer(t) or pa.types.is_boolean(t):
+        return "INTEGER"
+    if pa.types.is_floating(t) or pa.types.is_decimal(t):
+        return "REAL"
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        return "BLOB"
+    return "TEXT"
+
+
+def _to_cell(v: Any) -> Any:
+    if v is None or isinstance(v, (int, float, str, bytes)):
+        return v
+    if isinstance(v, bool):
+        return int(v)
+    return str(v)
+
+
+_READONLY_OPS = {
+    sqlite3.SQLITE_SELECT,
+    sqlite3.SQLITE_READ,
+    sqlite3.SQLITE_FUNCTION,
+    sqlite3.SQLITE_RECURSIVE,
+}
+
+
+def _readonly_authorizer(action, *args):
+    return sqlite3.SQLITE_OK if action in _READONLY_OPS else sqlite3.SQLITE_DENY
+
+
+class _AggAdapter:
+    """Bridges ``fn(list_of_values) -> scalar`` UDFs onto sqlite's step/finalize."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.values: list[Any] = []
+
+    def step(self, *args):
+        self.values.append(args[0] if len(args) == 1 else args)
+
+    def finalize(self):
+        return _to_cell(self.fn(self.values))
+
+
+def execute_fallback(sql: str, tables: Mapping[str, MessageBatch]) -> MessageBatch:
+    assert_query_only(sql)
+    conn = sqlite3.connect(":memory:")
+    try:
+        conn.execute("PRAGMA temp_store=MEMORY")
+        for name, batch in tables.items():
+            _load_table(conn, name, batch)
+        for name, (fn, vectorized) in scalar_udfs().items():
+            conn.create_function(name, -1, _wrap_udf(fn, vectorized))
+        for name in _aggregate_udf_names():
+            fn = get_aggregate_udf(name)
+            conn.create_aggregate(name, -1, _make_agg_class(fn))
+        # defence in depth: after our own table loads, lock the connection to
+        # read-only operations (blocks ATTACH/DDL/DML even if a statement
+        # slips past assert_query_only)
+        conn.set_authorizer(_readonly_authorizer)
+        try:
+            cur = conn.execute(sql)
+        except sqlite3.Error as e:
+            raise ArkError(f"SQL error (fallback engine): {e}") from e
+        names = [d[0] for d in cur.description] if cur.description else []
+        rows = cur.fetchall()
+        cols = list(zip(*rows)) if rows else [[] for _ in names]
+        arrays = []
+        for i, _ in enumerate(names):
+            vals = list(cols[i]) if rows else []
+            arrays.append(pa.array(vals))
+        # de-duplicate output names the way DataFusion would (a, a -> a, a:1)
+        seen: dict[str, int] = {}
+        uniq = []
+        for nm in names:
+            if nm in seen:
+                seen[nm] += 1
+                uniq.append(f"{nm}:{seen[nm]}")
+            else:
+                seen[nm] = 0
+                uniq.append(nm)
+        return MessageBatch(pa.RecordBatch.from_arrays(arrays, names=uniq))
+    finally:
+        conn.close()
+
+
+def _aggregate_udf_names() -> list[str]:
+    from arkflow_tpu.sql import functions
+
+    return list(functions._AGGREGATE_UDFS)
+
+
+def _make_agg_class(fn):
+    class Agg(_AggAdapter):
+        def __init__(self):
+            super().__init__(fn)
+
+    return Agg
+
+
+def _wrap_udf(fn, vectorized: bool):
+    if not vectorized:
+        return lambda *args: _to_cell(fn(*args))
+
+    def call(*args):
+        arrs = [pa.array([a]) for a in args]
+        out = as_array(fn(*arrs), 1)
+        return _to_cell(out[0].as_py())
+
+    return call
+
+
+def _load_table(conn: sqlite3.Connection, name: str, batch: MessageBatch) -> None:
+    rb = batch.record_batch
+    qname = '"' + name.replace('"', '""') + '"'
+    col_defs = ", ".join(
+        f'"{f.name}" {_sqlite_type(f.type)}' for f in rb.schema
+    )
+    if not col_defs:
+        col_defs = '"__empty__" INTEGER'
+    conn.execute(f"CREATE TABLE {qname} ({col_defs})")
+    if rb.num_rows == 0 or rb.num_columns == 0:
+        return
+    placeholders = ", ".join("?" for _ in rb.schema)
+    cols = [c.to_pylist() for c in rb.columns]
+    rows = [tuple(_to_cell(v) for v in row) for row in zip(*cols)]
+    conn.executemany(f"INSERT INTO {qname} VALUES ({placeholders})", rows)

@@ -1,0 +1,260 @@
+"""Scalar/aggregate function registry: builtins + user UDFs.
+
+The reference exposes global scalar/aggregate/window UDF registries injected
+into every new SessionContext (ref: crates/arkflow-plugin/src/udf/mod.rs:38-43,
+scalar_udf.rs:33-63; public API documented in docs/docs/sql/9-udf.md). Here the
+same registry feeds both tiers: the native evaluator calls the callable on
+Arrow arrays; the sqlite fallback registers it via ``create_function``.
+
+A builtin is a callable ``(args, n) -> pa.Array | scalar`` where ``args`` are
+already-evaluated operands (pa.Array of length n, or Python scalar) — most are
+thin wrappers over ``pyarrow.compute`` vectorized kernels.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from typing import Any, Callable, Sequence
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from arkflow_tpu.errors import UnsupportedSql
+
+ScalarFn = Callable[[Sequence[Any], int], Any]
+
+
+def as_array(v: Any, n: int) -> pa.Array:
+    """Broadcast a Python scalar to an Arrow array of length n."""
+    if isinstance(v, (pa.Array, pa.ChunkedArray)):
+        return v.combine_chunks() if isinstance(v, pa.ChunkedArray) else v
+    if v is None:
+        return pa.nulls(n)
+    return pa.repeat(pa.scalar(v), n)
+
+
+def _all_scalar(args: Sequence[Any]) -> bool:
+    return not any(isinstance(a, (pa.Array, pa.ChunkedArray)) for a in args)
+
+
+def _wrap1(kernel):
+    def fn(args, n):
+        (x,) = args
+        if _all_scalar(args):
+            return kernel(pa.scalar(x)).as_py() if x is not None else None
+        return kernel(as_array(x, n))
+
+    return fn
+
+
+# -- string helpers --------------------------------------------------------
+
+def _substr(args, n):
+    s = as_array(args[0], n)
+    start = args[1] if not isinstance(args[1], pa.Array) else None
+    if start is None:
+        raise UnsupportedSql("substr start must be a literal")
+    start = int(start)
+    py_start = start - 1 if start > 0 else 0  # SQL is 1-based
+    if len(args) >= 3:
+        length = int(args[2])
+        return pc.utf8_slice_codeunits(s, py_start, py_start + length)
+    return pc.utf8_slice_codeunits(s, py_start)
+
+
+def _concat(args, n):
+    arrs = [pc.cast(as_array(a, n), pa.string()) for a in args]
+    return pc.binary_join_element_wise(*arrs, "", null_handling="replace", null_replacement="")
+
+
+def _coalesce(args, n):
+    out = as_array(args[0], n)
+    for a in args[1:]:
+        out = pc.if_else(pc.is_valid(out), out, as_array(a, n))
+    return out
+
+
+def _nullif(args, n):
+    a, b = as_array(args[0], n), as_array(args[1], n)
+    return pc.if_else(pc.equal(a, b), pa.nulls(n, a.type), a)
+
+
+def _round(args, n):
+    x = as_array(args[0], n)
+    digits = int(args[1]) if len(args) > 1 else 0
+    return pc.round(x, ndigits=digits)
+
+
+def _split_part(args, n):
+    s, sep, idx = as_array(args[0], n), str(args[1]), int(args[2])
+    parts = pc.split_pattern(s, sep)
+    return pc.list_element(parts, idx - 1)
+
+
+def _json_get(args, n, extract=None):
+    """Row-wise JSON field extraction from a string/binary column (fallback-speed)."""
+    s = as_array(args[0], n)
+    key = args[1]
+    if isinstance(key, pa.Array):
+        raise UnsupportedSql("json key must be a literal")
+    out = []
+    for v in s:
+        pv = v.as_py()
+        if pv is None:
+            out.append(None)
+            continue
+        if isinstance(pv, bytes):
+            pv = pv.decode("utf-8", "replace")
+        try:
+            doc = json.loads(pv)
+            cur: Any = doc
+            for part in str(key).split("."):
+                if isinstance(cur, dict):
+                    cur = cur.get(part)
+                elif isinstance(cur, list) and part.lstrip("-").isdigit():
+                    i = int(part)
+                    cur = cur[i] if -len(cur) <= i < len(cur) else None
+                else:
+                    cur = None
+            out.append(extract(cur) if extract else cur)
+        except (ValueError, TypeError):
+            out.append(None)
+    if extract is None:
+        out = [json.dumps(v) if isinstance(v, (dict, list)) else v for v in out]
+        return pa.array([None if v is None else str(v) for v in out], type=pa.string())
+    return pa.array(out)
+
+
+def _mod(args, n):
+    a, b = as_array(args[0], n), as_array(args[1], n)
+    return pc.subtract(a, pc.multiply(pc.cast(pc.floor(pc.divide(pc.cast(a, pa.float64()), pc.cast(b, pa.float64()))), b.type), b))
+
+
+def _fold(kernel, args, n):
+    out = as_array(args[0], n)
+    for a in args[1:]:
+        out = kernel(out, as_array(a, n))
+    return out
+
+
+_BUILTINS: dict[str, ScalarFn] = {
+    # math
+    "abs": _wrap1(pc.abs),
+    "ceil": _wrap1(pc.ceil),
+    "ceiling": _wrap1(pc.ceil),
+    "floor": _wrap1(pc.floor),
+    "sqrt": _wrap1(pc.sqrt),
+    "exp": _wrap1(pc.exp),
+    "ln": _wrap1(pc.ln),
+    "log10": _wrap1(pc.log10),
+    "log2": _wrap1(pc.log2),
+    "sign": _wrap1(pc.sign),
+    "round": _round,
+    "power": lambda args, n: pc.power(as_array(args[0], n), as_array(args[1], n)),
+    "pow": lambda args, n: pc.power(as_array(args[0], n), as_array(args[1], n)),
+    "mod": _mod,
+    # string
+    "upper": _wrap1(pc.utf8_upper),
+    "lower": _wrap1(pc.utf8_lower),
+    "length": _wrap1(pc.utf8_length),
+    "char_length": _wrap1(pc.utf8_length),
+    "character_length": _wrap1(pc.utf8_length),
+    "octet_length": _wrap1(pc.binary_length),
+    "trim": _wrap1(pc.utf8_trim_whitespace),
+    "ltrim": _wrap1(pc.utf8_ltrim_whitespace),
+    "rtrim": _wrap1(pc.utf8_rtrim_whitespace),
+    "reverse": _wrap1(pc.utf8_reverse),
+    "substr": _substr,
+    "substring": _substr,
+    "concat": _concat,
+    "replace": lambda args, n: pc.replace_substring(as_array(args[0], n), pattern=str(args[1]), replacement=str(args[2])),
+    "starts_with": lambda args, n: pc.starts_with(as_array(args[0], n), pattern=str(args[1])),
+    "ends_with": lambda args, n: pc.ends_with(as_array(args[0], n), pattern=str(args[1])),
+    "strpos": lambda args, n: pc.add(pc.find_substring(as_array(args[0], n), pattern=str(args[1])), 1),
+    "lpad": lambda args, n: pc.utf8_lpad(as_array(args[0], n), width=int(args[1]), padding=str(args[2]) if len(args) > 2 else " "),
+    "rpad": lambda args, n: pc.utf8_rpad(as_array(args[0], n), width=int(args[1]), padding=str(args[2]) if len(args) > 2 else " "),
+    "split_part": _split_part,
+    # null handling / misc
+    "coalesce": _coalesce,
+    "ifnull": _coalesce,
+    "nvl": _coalesce,
+    "nullif": _nullif,
+    "greatest": lambda args, n: _fold(pc.max_element_wise, args, n),
+    "least": lambda args, n: _fold(pc.min_element_wise, args, n),
+    # time
+    "now": lambda args, n: time.time(),
+    "unix_millis": lambda args, n: int(time.time() * 1000),
+    "current_timestamp": lambda args, n: time.time(),
+    # json (for the __value__ payload column)
+    "json_get": lambda args, n: _json_get(args, n),
+    "json_get_str": lambda args, n: _json_get(args, n, extract=lambda v: None if v is None else str(v)),
+    "json_get_int": lambda args, n: _json_get(args, n, extract=lambda v: int(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else None),
+    "json_get_float": lambda args, n: _json_get(args, n, extract=lambda v: float(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else None),
+    "json_get_bool": lambda args, n: _json_get(args, n, extract=lambda v: v if isinstance(v, bool) else None),
+}
+
+
+#: Aggregates the native GROUP BY planner maps onto pyarrow hash kernels.
+NATIVE_AGGREGATES = {
+    "count": "count",
+    "sum": "sum",
+    "min": "min",
+    "max": "max",
+    "avg": "mean",
+    "mean": "mean",
+    "stddev": "stddev",
+    "variance": "variance",
+    "var": "variance",
+    "first_value": "first",
+    "last_value": "last",
+    "approx_distinct": "count_distinct",
+}
+
+# -- user UDFs -------------------------------------------------------------
+
+_SCALAR_UDFS: dict[str, tuple[Callable, bool]] = {}
+_AGGREGATE_UDFS: dict[str, Callable] = {}
+
+
+def register_scalar_udf(name: str, fn: Callable, vectorized: bool = False) -> None:
+    """Register a scalar UDF usable from any SQL processor.
+
+    ``vectorized=True``: ``fn(*arrow_arrays) -> arrow array``.
+    ``vectorized=False``: ``fn(*python_scalars) -> python scalar`` applied row-wise.
+    (Public extension API — ref docs/docs/sql/9-udf.md.)
+    """
+    _SCALAR_UDFS[name.lower()] = (fn, vectorized)
+
+
+def register_aggregate_udf(name: str, fn: Callable) -> None:
+    """Register an aggregate UDF: ``fn(list_of_python_values) -> scalar``."""
+    _AGGREGATE_UDFS[name.lower()] = fn
+
+
+def get_aggregate_udf(name: str):
+    return _AGGREGATE_UDFS.get(name.lower())
+
+
+def scalar_udfs() -> dict[str, tuple[Callable, bool]]:
+    return dict(_SCALAR_UDFS)
+
+
+def call_scalar(name: str, args: Sequence[Any], n: int) -> Any:
+    """Dispatch a scalar function call: builtins first, then UDFs."""
+    fn = _BUILTINS.get(name)
+    if fn is not None:
+        return fn(args, n)
+    udf = _SCALAR_UDFS.get(name)
+    if udf is not None:
+        f, vectorized = udf
+        if vectorized:
+            return f(*[as_array(a, n) for a in args])
+        cols = [as_array(a, n).to_pylist() for a in args]
+        return pa.array([f(*row) for row in zip(*cols)] if cols else [f() for _ in range(n)])
+    raise UnsupportedSql(f"unknown function {name!r}")
+
+
+def has_function(name: str) -> bool:
+    return name in _BUILTINS or name in _SCALAR_UDFS

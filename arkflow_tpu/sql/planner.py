@@ -1,0 +1,307 @@
+"""Native SELECT execution on pyarrow kernels.
+
+Single-table SELECT / WHERE / GROUP BY / HAVING / ORDER BY / LIMIT / DISTINCT
+compiled onto vectorized Arrow compute. Aggregations run on Arrow's hash
+kernels via ``Table.group_by``. Scalar-over-aggregate expressions
+(``sum(x)/count(*)``) are handled by substituting computed aggregate columns
+into the expression tree and re-evaluating on the aggregated table.
+
+Queries outside this shape raise ``UnsupportedSql`` and the engine reroutes
+them to the sqlite fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.errors import UnsupportedSql
+from arkflow_tpu.sql import ast
+from arkflow_tpu.sql.eval import Evaluator
+from arkflow_tpu.sql.functions import NATIVE_AGGREGATES, as_array, has_function
+
+
+def render(e: ast.Expr) -> str:
+    """Stable display name for an unaliased expression column."""
+    if isinstance(e, ast.Column):
+        return e.name
+    if isinstance(e, ast.Literal):
+        return repr(e.value)
+    if isinstance(e, ast.Func):
+        inner = "*" if e.is_star else ", ".join(render(a) for a in e.args)
+        d = "DISTINCT " if e.distinct else ""
+        return f"{e.name}({d}{inner})"
+    if isinstance(e, ast.Binary):
+        return f"{render(e.left)} {e.op} {render(e.right)}"
+    if isinstance(e, ast.Unary):
+        return f"{e.op} {render(e.operand)}"
+    if isinstance(e, ast.Cast):
+        return f"cast({render(e.operand)} as {e.type_name})"
+    return type(e).__name__.lower()
+
+
+def _find_aggregates(e: ast.Expr, out: list[ast.Func]) -> None:
+    if isinstance(e, ast.Func) and (e.name in NATIVE_AGGREGATES or e.is_star and e.name == "count"):
+        if e.name in NATIVE_AGGREGATES or e.is_star:
+            out.append(e)
+            return  # don't descend into aggregate args
+    if isinstance(e, ast.Func) and not has_function(e.name) and not e.is_star:
+        # unknown function: could be an aggregate UDF -> not natively plannable
+        raise UnsupportedSql(f"unknown function {e.name!r} in native planner")
+    for child in _children(e):
+        _find_aggregates(child, out)
+
+
+def _children(e: ast.Expr) -> list[ast.Expr]:
+    if isinstance(e, ast.Unary):
+        return [e.operand]
+    if isinstance(e, ast.Binary):
+        return [e.left, e.right]
+    if isinstance(e, ast.IsNull):
+        return [e.operand]
+    if isinstance(e, ast.InList):
+        return [e.operand, *e.items]
+    if isinstance(e, ast.Between):
+        return [e.operand, e.low, e.high]
+    if isinstance(e, ast.Func):
+        return list(e.args)
+    if isinstance(e, ast.Cast):
+        return [e.operand]
+    if isinstance(e, ast.Case):
+        out = list(e.whens and [x for w in e.whens for x in w] or [])
+        if e.operand is not None:
+            out.append(e.operand)
+        if e.otherwise is not None:
+            out.append(e.otherwise)
+        return out
+    return []
+
+
+def _substitute(e: ast.Expr, mapping: dict[ast.Expr, ast.Column]) -> ast.Expr:
+    """Replace mapped subtrees (group keys / aggregates) with column refs."""
+    if e in mapping:
+        return mapping[e]
+    if isinstance(e, ast.Unary):
+        return ast.Unary(e.op, _substitute(e.operand, mapping))
+    if isinstance(e, ast.Binary):
+        return ast.Binary(e.op, _substitute(e.left, mapping), _substitute(e.right, mapping))
+    if isinstance(e, ast.IsNull):
+        return ast.IsNull(_substitute(e.operand, mapping), e.negated)
+    if isinstance(e, ast.InList):
+        return ast.InList(_substitute(e.operand, mapping), tuple(_substitute(i, mapping) for i in e.items), e.negated)
+    if isinstance(e, ast.Between):
+        return ast.Between(_substitute(e.operand, mapping), _substitute(e.low, mapping), _substitute(e.high, mapping), e.negated)
+    if isinstance(e, ast.Func):
+        return ast.Func(e.name, tuple(_substitute(a, mapping) for a in e.args), e.distinct, e.is_star)
+    if isinstance(e, ast.Cast):
+        return ast.Cast(_substitute(e.operand, mapping), e.type_name)
+    if isinstance(e, ast.Case):
+        return ast.Case(
+            _substitute(e.operand, mapping) if e.operand is not None else None,
+            tuple((_substitute(c, mapping), _substitute(v, mapping)) for c, v in e.whens),
+            _substitute(e.otherwise, mapping) if e.otherwise is not None else None,
+        )
+    return e
+
+
+def execute_select(sel: ast.Select, tables: dict[str, MessageBatch]) -> MessageBatch:
+    """Run a parsed single-table SELECT natively; raise UnsupportedSql otherwise."""
+    if sel.joins:
+        raise UnsupportedSql("joins run on the fallback engine")
+    if sel.table is None:
+        # SELECT <exprs> without FROM: single-row evaluation
+        batch = MessageBatch.from_pydict({})
+        ev = Evaluator({}, 1)
+        arrays, names = [], []
+        for i, item in enumerate(sel.items):
+            if isinstance(item.expr, ast.Star):
+                raise UnsupportedSql("* without FROM")
+            v = ev.eval(item.expr)
+            arrays.append(as_array(v, 1))
+            names.append(item.alias or render(item.expr))
+        return MessageBatch(pa.RecordBatch.from_arrays(arrays, names=names))
+
+    tname = sel.table.name
+    batch = tables.get(tname)
+    if batch is None:
+        raise UnsupportedSql(f"unknown table {tname!r} (registered: {sorted(tables)})")
+    alias = sel.table.alias or tname
+    rb = batch.record_batch
+
+    # WHERE
+    if sel.where is not None:
+        ev = Evaluator.for_batch(rb, table=alias)
+        mask = ev.eval(sel.where)
+        mask = as_array(mask, rb.num_rows)
+        if not pa.types.is_boolean(mask.type):
+            mask = pc.cast(mask, pa.bool_())
+        rb = rb.filter(mask)
+
+    # aggregate?
+    aggs: list[ast.Func] = []
+    for item in sel.items:
+        if not isinstance(item.expr, ast.Star):
+            _find_aggregates(item.expr, aggs)
+    if sel.having is not None:
+        _find_aggregates(sel.having, aggs)
+    if sel.group_by or aggs:
+        out = _execute_aggregate(sel, rb, alias, aggs)
+    else:
+        out = _execute_projection(sel, rb, alias)
+
+    # DISTINCT
+    if sel.distinct:
+        t = pa.Table.from_batches([out])
+        t = t.group_by(t.schema.names).aggregate([])
+        out = MessageBatch.from_table(t).record_batch
+
+    # ORDER BY
+    if sel.order_by:
+        out = _order(out, sel, alias, rb)
+
+    # LIMIT/OFFSET
+    if sel.offset is not None:
+        out = out.slice(sel.offset)
+    if sel.limit is not None:
+        out = out.slice(0, sel.limit)
+    return MessageBatch(out)
+
+
+def _execute_projection(sel: ast.Select, rb: pa.RecordBatch, alias: str) -> pa.RecordBatch:
+    ev = Evaluator.for_batch(rb, table=alias)
+    arrays: list[pa.Array] = []
+    names: list[str] = []
+    for item in sel.items:
+        if isinstance(item.expr, ast.Star):
+            for i, f in enumerate(rb.schema):
+                arrays.append(rb.column(i))
+                names.append(f.name)
+            continue
+        v = ev.eval(item.expr)
+        arrays.append(as_array(v, rb.num_rows))
+        names.append(item.alias or render(item.expr))
+    return pa.RecordBatch.from_arrays(arrays, names=names)
+
+
+_DISTINCT_AGGS = {"count": "count_distinct"}
+
+
+def _execute_aggregate(sel: ast.Select, rb: pa.RecordBatch, alias: str, aggs: list[ast.Func]) -> pa.RecordBatch:
+    ev = Evaluator.for_batch(rb, table=alias)
+    n = rb.num_rows
+
+    # Deduplicate aggregates structurally.
+    uniq: list[ast.Func] = []
+    for a in aggs:
+        if a not in uniq:
+            uniq.append(a)
+
+    # Build the pre-aggregation table: key columns + aggregate input columns.
+    key_names, key_arrays = [], []
+    mapping: dict[ast.Expr, ast.Column] = {}
+    for i, g in enumerate(sel.group_by):
+        kn = f"__key_{i}"
+        key_names.append(kn)
+        key_arrays.append(as_array(ev.eval(g), n))
+        mapping[g] = ast.Column(kn)
+
+    agg_specs = []  # (input_col_name_or_[], kernel, output_name)
+    in_names, in_arrays = [], []
+    for i, a in enumerate(uniq):
+        out_name = f"__agg_{i}"
+        if a.is_star:  # count(*)
+            agg_specs.append(([], "count_all", out_name))
+        else:
+            if len(a.args) != 1:
+                raise UnsupportedSql(f"aggregate {a.name} takes exactly one argument natively")
+            kernel = NATIVE_AGGREGATES[a.name]
+            if a.distinct:
+                kernel = _DISTINCT_AGGS.get(a.name)
+                if kernel is None:
+                    raise UnsupportedSql(f"DISTINCT {a.name} not supported natively")
+            col = f"__in_{i}"
+            in_names.append(col)
+            in_arrays.append(as_array(ev.eval(a.args[0]), n))
+            agg_specs.append((col, kernel, out_name))
+        mapping[a] = ast.Column(f"__agg_{i}")
+
+    pre = pa.table(dict(zip(key_names + in_names, key_arrays + in_arrays))) if (key_names or in_names) else pa.table({"__dummy__": pa.nulls(n)})
+
+    grouped = pre.group_by(key_names, use_threads=False).aggregate(
+        [(c, k) for c, k, _ in agg_specs]
+    )
+    # pyarrow names results "<col>_<kernel>"; rename to our __agg_i slots.
+    rename: dict[str, str] = {}
+    for c, k, out_name in agg_specs:
+        produced = f"{c}_{k}" if c != [] else k  # ([], "count_all") -> "count_all"
+        rename[produced] = out_name
+    grouped = grouped.rename_columns([rename.get(nm, nm) for nm in grouped.schema.names])
+    agg_rb = MessageBatch.from_table(grouped).record_batch
+
+    # HAVING on the aggregated table.
+    if sel.having is not None:
+        hev = Evaluator.for_batch(agg_rb)
+        mask = as_array(hev.eval(_substitute(sel.having, mapping)), agg_rb.num_rows)
+        if not pa.types.is_boolean(mask.type):
+            mask = pc.cast(mask, pa.bool_())
+        agg_rb = agg_rb.filter(mask)
+
+    # Final projection over key/agg columns.
+    fev = Evaluator.for_batch(agg_rb)
+    arrays, names = [], []
+    for item in sel.items:
+        if isinstance(item.expr, ast.Star):
+            raise UnsupportedSql("* not valid in aggregate query")
+        sub = _substitute(item.expr, mapping)
+        _assert_resolved(sub, set(agg_rb.schema.names))
+        arrays.append(as_array(fev.eval(sub), agg_rb.num_rows))
+        names.append(item.alias or render(item.expr))
+    return pa.RecordBatch.from_arrays(arrays, names=names)
+
+
+def _assert_resolved(e: ast.Expr, available: set[str]) -> None:
+    """Every column in a post-aggregation expression must be a key or agg slot."""
+    if isinstance(e, ast.Column) and e.name not in available:
+        raise UnsupportedSql(
+            f"column {e.name!r} must appear in GROUP BY or inside an aggregate"
+        )
+    for c in _children(e):
+        _assert_resolved(c, available)
+
+
+def _order(out: pa.RecordBatch, sel: ast.Select, alias: str, pre_rb: pa.RecordBatch) -> pa.RecordBatch:
+    sort_cols: list[tuple[str, str]] = []
+    extra: dict[str, pa.Array] = {}
+    tmp = out
+    for i, oi in enumerate(sel.order_by):
+        direction = "ascending" if oi.asc else "descending"
+        e = oi.expr
+        if isinstance(e, ast.Literal) and isinstance(e.value, int):
+            idx = e.value - 1
+            if not (0 <= idx < out.num_columns):
+                raise UnsupportedSql(f"ORDER BY position {e.value} out of range")
+            sort_cols.append((out.schema.names[idx], direction))
+            continue
+        if isinstance(e, ast.Column) and e.name in out.schema.names:
+            sort_cols.append((e.name, direction))
+            continue
+        # expression over output (aliases) or, failing that, the source rows
+        try:
+            v = as_array(Evaluator.for_batch(out).eval(e), out.num_rows)
+        except UnsupportedSql:
+            if pre_rb.num_rows != out.num_rows:
+                raise UnsupportedSql("ORDER BY expression not resolvable against output")
+            v = as_array(Evaluator.for_batch(pre_rb, table=alias).eval(e), out.num_rows)
+        name = f"__sort_{i}"
+        extra[name] = v
+        sort_cols.append((name, direction))
+    colmap: dict[str, pa.Array] = {}
+    for nm, arr in zip(out.schema.names, out.columns):
+        colmap.setdefault(nm, arr)
+    colmap.update(extra)
+    key_t = pa.table({c: colmap[c] for c, _ in sort_cols})
+    indices = pc.sort_indices(key_t, sort_keys=sort_cols)
+    return out.take(indices)

@@ -1,0 +1,1 @@
+from arkflow_tpu.utils.duration import parse_duration  # noqa: F401

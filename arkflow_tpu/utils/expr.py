@@ -1,0 +1,61 @@
+"""Dynamic config values: the ``Expr<T>`` equivalent.
+
+A config field may be a literal or a SQL expression evaluated against the
+in-flight batch (ref: crates/arkflow-plugin/src/expr/mod.rs:27-118 — used e.g.
+for dynamic Kafka topics/keys, ref output/kafka.rs:63-77):
+
+    topic: "static-topic"                 # literal
+    topic: { expr: "concat('t-', city)" } # evaluated per batch
+    topic: { value: "static-topic" }      # explicit literal form
+
+Compiled expression ASTs are cached globally by the evaluator, mirroring the
+reference's physical-expr cache (expr/mod.rs:92).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.sql.eval import evaluate_expression
+
+
+class DynValue:
+    """A literal or per-batch SQL expression."""
+
+    __slots__ = ("_literal", "_expr")
+
+    def __init__(self, literal: Any = None, expr: Optional[str] = None):
+        self._literal = literal
+        self._expr = expr
+
+    @classmethod
+    def from_config(cls, v: Any, field: str = "value") -> "DynValue":
+        if isinstance(v, Mapping):
+            if "expr" in v:
+                if not isinstance(v["expr"], str):
+                    raise ConfigError(f"{field}: 'expr' must be a string")
+                return cls(expr=v["expr"])
+            if "value" in v:
+                return cls(literal=v["value"])
+            raise ConfigError(f"{field}: mapping must contain 'expr' or 'value'")
+        return cls(literal=v)
+
+    @property
+    def is_expr(self) -> bool:
+        return self._expr is not None
+
+    def eval_per_row(self, batch: MessageBatch) -> list[Any]:
+        """One value per row (dynamic routing keys etc.)."""
+        if self._expr is None:
+            return [self._literal] * batch.num_rows
+        return evaluate_expression(batch, self._expr).to_pylist()
+
+    def eval_scalar(self, batch: Optional[MessageBatch] = None) -> Any:
+        """Single value for the batch (first row for expressions)."""
+        if self._expr is None:
+            return self._literal
+        if batch is None or batch.num_rows == 0:
+            raise ConfigError(f"expression {self._expr!r} needs a non-empty batch")
+        return evaluate_expression(batch, self._expr)[0].as_py()

@@ -1,0 +1,340 @@
+"""Stream runtime + end-to-end tests.
+
+Model: the reference's hermetic-source pattern — ``generate``/``memory`` input
++ ``stdout``-with-MockWriter output (SURVEY.md section 4).
+"""
+
+import asyncio
+
+import pytest
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Ack, NoopAck, ensure_plugins_loaded
+from arkflow_tpu.config import EngineConfig, StreamConfig
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.runtime import Pipeline, Stream, build_stream
+from arkflow_tpu.plugins.output.stdout import StdoutOutput
+from arkflow_tpu.plugins.output.drop import DropOutput
+
+ensure_plugins_loaded()
+
+
+class CollectOutput(DropOutput):
+    """Test sink that records every written batch."""
+
+    def __init__(self):
+        super().__init__()
+        self.batches: list[MessageBatch] = []
+
+    async def write(self, batch: MessageBatch) -> None:
+        await super().write(batch)
+        self.batches.append(batch)
+
+
+class CountingAck(Ack):
+    def __init__(self, counter: list):
+        self.counter = counter
+
+    async def ack(self) -> None:
+        self.counter.append(1)
+
+
+def run_stream_config(cfg_map: dict) -> CollectOutput:
+    """Build a stream from a config mapping, swap in a collecting sink, run it."""
+    cfg = StreamConfig.from_mapping(cfg_map)
+    stream = build_stream(cfg)
+    sink = CollectOutput()
+    stream.output = sink
+    asyncio.run(stream.run(asyncio.Event()))
+    return sink
+
+
+def test_memory_to_collect_passthrough():
+    sink = run_stream_config(
+        {
+            "input": {"type": "memory", "messages": ['{"a":1}', '{"a":2}', '{"a":3}']},
+            "output": {"type": "drop"},
+        }
+    )
+    assert sink.dropped_batches == 3
+    payloads = [b for batch in sink.batches for b in batch.to_binary()]
+    assert payloads == [b'{"a":1}', b'{"a":2}', b'{"a":3}']
+
+
+def test_generate_count_and_eof():
+    sink = run_stream_config(
+        {
+            "input": {"type": "generate", "payload": "xyz", "batch_size": 7, "count": 20},
+            "output": {"type": "drop"},
+        }
+    )
+    assert sink.dropped_rows == 20
+    assert [b.num_rows for b in sink.batches] == [7, 7, 6]
+
+
+def test_pipeline_json_sql_filter():
+    sink = run_stream_config(
+        {
+            "input": {
+                "type": "memory",
+                "messages": ['{"temp": 20.0}', '{"temp": 35.0}', '{"temp": 40.0}'],
+            },
+            "pipeline": {
+                "thread_num": 2,
+                "processors": [
+                    {"type": "json_to_arrow"},
+                    {"type": "sql", "query": "SELECT temp FROM flow WHERE temp > 30"},
+                ],
+            },
+            "output": {"type": "drop"},
+        }
+    )
+    # batch 1 filtered out entirely (dropped), batches 2,3 pass
+    assert sink.dropped_rows == 2
+    vals = [v for b in sink.batches for v in b.column("temp").to_pylist()]
+    assert vals == [35.0, 40.0]
+
+
+def test_ordering_preserved_with_many_workers():
+    msgs = ['{"i": %d}' % i for i in range(50)]
+    sink = run_stream_config(
+        {
+            "input": {"type": "memory", "messages": msgs, "codec": "json"},
+            "pipeline": {"thread_num": 8, "processors": []},
+            "output": {"type": "drop"},
+        }
+    )
+    seen = [v for b in sink.batches for v in b.column("i").to_pylist()]
+    assert seen == list(range(50))
+
+
+def test_acks_fire_after_write():
+    from arkflow_tpu.plugins.input.memory import MemoryInput
+
+    acked: list = []
+
+    class AckingInput(MemoryInput):
+        async def read(self):
+            batch, _ = await super().read()
+            return batch, CountingAck(acked)
+
+    inp = AckingInput([b"a", b"b", b"c"])
+    sink = CollectOutput()
+    stream = Stream(inp, Pipeline([]), sink, thread_num=2, name="acktest")
+    asyncio.run(stream.run(asyncio.Event()))
+    assert len(acked) == 3
+    assert sink.dropped_batches == 3
+
+
+def test_dropped_batches_still_acked():
+    """A processor returning [] must still ack (ProcessResult::None path)."""
+    from arkflow_tpu.plugins.input.memory import MemoryInput
+
+    acked: list = []
+
+    class AckingInput(MemoryInput):
+        async def read(self):
+            batch, _ = await super().read()
+            return batch, CountingAck(acked)
+
+    class DropAll:
+        async def process(self, batch):
+            return []
+
+        async def close(self):
+            pass
+
+    inp = AckingInput([b"a", b"b"])
+    sink = CollectOutput()
+    stream = Stream(inp, Pipeline([DropAll()]), sink, thread_num=1, name="droptest")
+    asyncio.run(stream.run(asyncio.Event()))
+    assert len(acked) == 2
+    assert sink.dropped_batches == 0
+
+
+def test_error_routes_to_error_output_and_acks():
+    from arkflow_tpu.plugins.input.memory import MemoryInput
+
+    acked: list = []
+
+    class AckingInput(MemoryInput):
+        async def read(self):
+            batch, _ = await super().read()
+            return batch, CountingAck(acked)
+
+    class Boom:
+        async def process(self, batch):
+            raise RuntimeError("boom")
+
+        async def close(self):
+            pass
+
+    err_sink = CollectOutput()
+    inp = AckingInput([b"a", b"b"])
+    stream = Stream(inp, Pipeline([Boom()]), CollectOutput(), error_output=err_sink,
+                    thread_num=1, name="errtest")
+    asyncio.run(stream.run(asyncio.Event()))
+    assert err_sink.dropped_batches == 2
+    assert len(acked) == 2
+    assert err_sink.batches[0].get_meta("__meta_ext_error") == "boom"
+
+
+def test_memory_buffer_micro_batching():
+    sink = run_stream_config(
+        {
+            "input": {"type": "memory", "messages": [f'{{"i":{i}}}' for i in range(10)]},
+            "buffer": {"type": "memory", "capacity": 4, "timeout": "50ms"},
+            "output": {"type": "drop"},
+        }
+    )
+    assert sink.dropped_rows == 10
+    # first two emits at capacity 4, remainder flushed at close
+    assert [b.num_rows for b in sink.batches][:2] == [4, 4]
+
+
+def test_stdout_output_writer_injection(capsys):
+    lines: list[bytes] = []
+    out = StdoutOutput(writer=lines.append)
+
+    async def go():
+        await out.connect()
+        await out.write(MessageBatch.new_binary([b"hello", b"world"]).with_source("t"))
+
+    asyncio.run(go())
+    assert lines == [b"hello", b"world"]
+
+
+def test_python_processor_script():
+    sink = run_stream_config(
+        {
+            "input": {"type": "memory", "messages": ['{"x": 1}', '{"x": 5}'], "codec": "json"},
+            "pipeline": {
+                "processors": [
+                    {
+                        "type": "python",
+                        "script": (
+                            "import pyarrow.compute as pc\n"
+                            "def process(batch):\n"
+                            "    return batch.filter(pc.greater(batch.column('x'), 2))\n"
+                        ),
+                    }
+                ]
+            },
+            "output": {"type": "drop"},
+        }
+    )
+    vals = [v for b in sink.batches for v in b.column("x").to_pylist()]
+    assert vals == [5]
+
+
+def test_sql_temporary_enrichment():
+    cfg = StreamConfig.from_mapping(
+        {
+            "input": {"type": "memory", "messages": ['{"dev": 1}', '{"dev": 2}'], "codec": "json"},
+            "temporary": [
+                {
+                    "name": "devices",
+                    "type": "memory",
+                    "key": "dev",
+                    "rows": [{"dev": 1, "label": "pump"}, {"dev": 2, "label": "valve"}, {"dev": 3, "label": "x"}],
+                }
+            ],
+            "pipeline": {
+                "processors": [
+                    {
+                        "type": "sql",
+                        "query": "SELECT flow.dev, devices.label FROM flow JOIN devices ON flow.dev = devices.dev",
+                        "temporary": [{"name": "devices", "key": "dev"}],
+                    }
+                ]
+            },
+            "output": {"type": "drop"},
+        }
+    )
+    stream = build_stream(cfg)
+    sink = CollectOutput()
+    stream.output = sink
+    asyncio.run(stream.run(asyncio.Event()))
+    rows = [r for b in sink.batches for r in b.record_batch.to_pylist()]
+    assert rows == [{"dev": 1, "label": "pump"}, {"dev": 2, "label": "valve"}]
+
+
+def test_batch_processor_accumulates():
+    sink = run_stream_config(
+        {
+            "input": {"type": "memory", "messages": [f'{{"i":{i}}}' for i in range(5)], "codec": "json"},
+            "pipeline": {"thread_num": 1, "processors": [{"type": "batch", "count": 2}]},
+            "output": {"type": "drop"},
+        }
+    )
+    # 5 messages -> two emitted pairs; the 5th is held and dropped at close
+    assert [b.num_rows for b in sink.batches] == [2, 2]
+
+
+def test_cancel_stops_infinite_generate():
+    cfg = StreamConfig.from_mapping(
+        {
+            "input": {"type": "generate", "payload": "x", "batch_size": 8, "interval": "1ms"},
+            "output": {"type": "drop"},
+        }
+    )
+    stream = build_stream(cfg)
+    sink = CollectOutput()
+    stream.output = sink
+
+    async def go():
+        cancel = asyncio.Event()
+
+        async def stopper():
+            await asyncio.sleep(0.15)
+            cancel.set()
+
+        await asyncio.gather(stream.run(cancel), stopper())
+
+    asyncio.run(asyncio.wait_for(go(), timeout=10))
+    assert sink.dropped_rows > 0
+
+
+def test_config_validation_errors():
+    with pytest.raises(ConfigError):
+        StreamConfig.from_mapping({"input": {"type": "memory"}})  # missing output
+    with pytest.raises(ConfigError):
+        EngineConfig.from_mapping({})  # no streams
+    with pytest.raises(ConfigError):
+        build_stream(StreamConfig.from_mapping({"input": {"type": "nope"}, "output": {"type": "drop"}}))
+
+
+def test_engine_config_from_yaml(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        """
+streams:
+  - input: {type: generate, payload: '{"a":1}', batch_size: 2, count: 4}
+    pipeline:
+      thread_num: 2
+      processors: []
+    output: {type: drop}
+health_check: {enabled: false}
+logging: {level: debug}
+"""
+    )
+    cfg = EngineConfig.from_file(p)
+    assert len(cfg.streams) == 1
+    assert cfg.streams[0].pipeline.thread_num == 2
+    assert cfg.health_check.enabled is False
+    assert cfg.logging.level == "debug"
+
+
+def test_memory_buffer_timeout_flush_with_waiting_reader():
+    """Reader blocked before first write must still flush on timeout (review fix)."""
+    from arkflow_tpu.plugins.buffer.memory import MemoryBuffer
+
+    async def go():
+        buf = MemoryBuffer(capacity=1000, timeout_s=0.05)
+        reader = asyncio.create_task(buf.read())
+        await asyncio.sleep(0.02)  # reader is already waiting
+        await buf.write(MessageBatch.from_pydict({"a": [1, 2]}), NoopAck())
+        batch, _ = await asyncio.wait_for(reader, timeout=1.0)
+        return batch.num_rows
+
+    assert asyncio.run(go()) == 2
