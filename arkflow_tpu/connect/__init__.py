@@ -1,0 +1,9 @@
+"""Native protocol clients.
+
+The image ships no broker client libraries, so the protocols simple enough to
+speak directly are implemented natively on asyncio (NATS core, Redis RESP2,
+MQTT 3.1.1, and a minimal Kafka subset); heavier protocols (Pulsar) are gated
+with clear errors. This mirrors the reference's approach of linking native
+client libraries (rdkafka/rumqttc/redis-rs/async-nats) — here the native tier
+is in-repo.
+"""

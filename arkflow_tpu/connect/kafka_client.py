@@ -1,0 +1,594 @@
+"""Minimal Kafka client: wire protocol over TCP, no external library.
+
+Speaks the classic (non-flexible) protocol versions, enough for an
+at-least-once streaming engine (the reference links librdkafka,
+ref: crates/arkflow-plugin/src/input/kafka.rs):
+
+- Metadata v1 (leader discovery), ListOffsets v1 (earliest/latest)
+- Produce v3 / Fetch v4 with record-batch format v2 (magic 2, crc32c from the
+  native tier, no compression)
+- FindCoordinator v0 + OffsetCommit v2 / OffsetFetch v1 using simple-consumer
+  semantics (generation -1, empty member) — consumer-group rebalancing
+  (JoinGroup/SyncGroup/Heartbeat) is not implemented; partitions are assigned
+  statically in config.
+
+One connection per broker node, requests serialised per connection with
+correlation-id matching.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from arkflow_tpu.errors import ConnectError, Disconnection, ReadError, WriteError
+from arkflow_tpu.native import crc32c
+
+logger = logging.getLogger("arkflow.kafka")
+
+API_PRODUCE = 0
+API_FETCH = 1
+API_LIST_OFFSETS = 2
+API_METADATA = 3
+API_OFFSET_COMMIT = 8
+API_OFFSET_FETCH = 9
+API_FIND_COORDINATOR = 10
+
+
+class KafkaProtocolError(ReadError):
+    def __init__(self, api: str, code: int):
+        super().__init__(f"kafka {api} error code {code}")
+        self.code = code
+
+
+# -- primitive encoding -----------------------------------------------------
+
+
+class Writer:
+    def __init__(self):
+        self.parts: list[bytes] = []
+
+    def i8(self, v): self.parts.append(struct.pack(">b", v)); return self
+    def i16(self, v): self.parts.append(struct.pack(">h", v)); return self
+    def i32(self, v): self.parts.append(struct.pack(">i", v)); return self
+    def i64(self, v): self.parts.append(struct.pack(">q", v)); return self
+    def u32(self, v): self.parts.append(struct.pack(">I", v)); return self
+
+    def string(self, s: Optional[str]):
+        if s is None:
+            return self.i16(-1)
+        b = s.encode()
+        self.i16(len(b))
+        self.parts.append(b)
+        return self
+
+    def bytes_(self, b: Optional[bytes]):
+        if b is None:
+            return self.i32(-1)
+        self.i32(len(b))
+        self.parts.append(b)
+        return self
+
+    def array(self, items, fn):
+        self.i32(len(items))
+        for it in items:
+            fn(self, it)
+        return self
+
+    def varint(self, v: int):
+        # zigzag
+        z = (v << 1) ^ (v >> 63)
+        while True:
+            b = z & 0x7F
+            z >>= 7
+            if z:
+                self.parts.append(bytes([b | 0x80]))
+            else:
+                self.parts.append(bytes([b]))
+                return self
+
+    def raw(self, b: bytes):
+        self.parts.append(b)
+        return self
+
+    def build(self) -> bytes:
+        return b"".join(self.parts)
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        b = self.data[self.pos : self.pos + n]
+        if len(b) < n:
+            raise ReadError("kafka: truncated response")
+        self.pos += n
+        return b
+
+    def i8(self) -> int: return struct.unpack(">b", self._take(1))[0]
+    def i16(self) -> int: return struct.unpack(">h", self._take(2))[0]
+    def i32(self) -> int: return struct.unpack(">i", self._take(4))[0]
+    def i64(self) -> int: return struct.unpack(">q", self._take(8))[0]
+    def u32(self) -> int: return struct.unpack(">I", self._take(4))[0]
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        return None if n < 0 else self._take(n).decode()
+
+    def bytes_(self) -> Optional[bytes]:
+        n = self.i32()
+        return None if n < 0 else self._take(n)
+
+    def varint(self) -> int:
+        shift = 0
+        result = 0
+        while True:
+            b = self._take(1)[0]
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (result >> 1) ^ -(result & 1)  # un-zigzag
+
+    def remaining(self) -> int:
+        return len(self.data) - self.pos
+
+
+# -- record batch v2 --------------------------------------------------------
+
+
+@dataclass
+class KafkaRecord:
+    offset: int
+    timestamp_ms: int
+    key: Optional[bytes]
+    value: Optional[bytes]
+
+
+def encode_record_batch(records: list[tuple[Optional[bytes], Optional[bytes]]],
+                        base_ts_ms: Optional[int] = None) -> bytes:
+    """records: [(key, value)] -> record-batch v2 bytes (no compression)."""
+    now = base_ts_ms if base_ts_ms is not None else int(time.time() * 1000)
+    body = Writer()
+    for i, (key, value) in enumerate(records):
+        rec = Writer()
+        rec.i8(0)  # attributes
+        rec.varint(0)  # timestamp delta
+        rec.varint(i)  # offset delta
+        if key is None:
+            rec.varint(-1)
+        else:
+            rec.varint(len(key)).raw(key)
+        if value is None:
+            rec.varint(-1)
+        else:
+            rec.varint(len(value)).raw(value)
+        rec.varint(0)  # headers count
+        encoded = rec.build()
+        body.varint(len(encoded)).raw(encoded)
+    records_bytes = body.build()
+
+    # fields covered by crc: attributes..records
+    crc_body = (
+        Writer()
+        .i16(0)  # attributes: no compression
+        .i32(len(records) - 1)  # lastOffsetDelta
+        .i64(now)  # firstTimestamp
+        .i64(now)  # maxTimestamp
+        .i64(-1)  # producerId
+        .i16(-1)  # producerEpoch
+        .i32(-1)  # baseSequence
+        .i32(len(records))
+        .raw(records_bytes)
+        .build()
+    )
+    crc = crc32c(crc_body)
+    after_length = (
+        Writer().i32(0).i8(2).u32(crc).raw(crc_body).build()  # leaderEpoch, magic, crc
+    )
+    return Writer().i64(0).i32(len(after_length)).raw(after_length).build()
+
+
+def decode_record_batches(data: bytes) -> list[KafkaRecord]:
+    """Parse a record set (possibly several v2 batches) into records."""
+    out: list[KafkaRecord] = []
+    r = Reader(data)
+    while r.remaining() >= 61:  # minimal batch header size
+        base_offset = r.i64()
+        batch_len = r.i32()
+        if r.remaining() < batch_len:
+            break  # partial batch at end of fetch response
+        end = r.pos + batch_len
+        r.i32()  # leader epoch
+        magic = r.i8()
+        if magic != 2:
+            r.pos = end
+            continue
+        r.u32()  # crc (trusted; validated by broker)
+        attrs = r.i16()
+        if attrs & 0x07:
+            raise ReadError("kafka: compressed record batches not supported")
+        r.i32()  # lastOffsetDelta
+        first_ts = r.i64()
+        r.i64()  # maxTimestamp
+        r.i64()  # producerId
+        r.i16()  # producerEpoch
+        r.i32()  # baseSequence
+        n = r.i32()
+        for _ in range(n):
+            r.varint()  # record length
+            r.i8()  # attributes
+            ts_delta = r.varint()
+            off_delta = r.varint()
+            klen = r.varint()
+            key = bytes(r._take(klen)) if klen >= 0 else None
+            vlen = r.varint()
+            value = bytes(r._take(vlen)) if vlen >= 0 else None
+            hn = r.varint()
+            for _ in range(hn):
+                hk = r.varint()
+                r._take(hk)
+                hv = r.varint()
+                if hv >= 0:
+                    r._take(hv)
+            out.append(KafkaRecord(base_offset + off_delta, first_ts + ts_delta, key, value))
+        r.pos = end
+    return out
+
+
+# -- connection -------------------------------------------------------------
+
+
+class _BrokerConn:
+    def __init__(self, host: str, port: int, client_id: str):
+        self.host = host
+        self.port = port
+        self.client_id = client_id
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._correlation = 0
+        self._lock = asyncio.Lock()
+
+    async def connect(self, timeout: float = 5.0) -> None:
+        try:
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), timeout
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ConnectError(f"kafka connect to {self.host}:{self.port} failed: {e}") from e
+
+    async def request(self, api_key: int, api_version: int, body: bytes,
+                      timeout: float = 30.0) -> Reader:
+        async with self._lock:
+            if self._writer is None:
+                await self.connect()
+            self._correlation += 1
+            corr = self._correlation
+            header = (
+                Writer().i16(api_key).i16(api_version).i32(corr).string(self.client_id).build()
+            )
+            frame = header + body
+            self._writer.write(struct.pack(">i", len(frame)) + frame)
+            try:
+                await self._writer.drain()
+                size_b = await asyncio.wait_for(self._reader.readexactly(4), timeout)
+                (size,) = struct.unpack(">i", size_b)
+                payload = await asyncio.wait_for(self._reader.readexactly(size), timeout)
+            except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
+                try:
+                    self._writer.close()
+                except Exception:
+                    pass
+                self._writer = None
+                self._reader = None
+                raise Disconnection(f"kafka broker {self.host}:{self.port} lost: {e}") from e
+            r = Reader(payload)
+            got_corr = r.i32()
+            if got_corr != corr:
+                raise ReadError(f"kafka correlation mismatch {got_corr} != {corr}")
+            return r
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+            self._writer = None
+
+
+@dataclass
+class PartitionMeta:
+    partition: int
+    leader: int
+
+
+@dataclass
+class TopicMeta:
+    name: str
+    partitions: dict[int, PartitionMeta] = field(default_factory=dict)
+
+
+class KafkaClient:
+    def __init__(self, bootstrap: str, client_id: str = "arkflow-tpu"):
+        # bootstrap: "host:port" or "host:port,host:port"
+        self.bootstrap = [
+            (h.strip().rsplit(":", 1)[0], int(h.strip().rsplit(":", 1)[1]))
+            for h in bootstrap.replace("kafka://", "").split(",")
+        ]
+        self.client_id = client_id
+        self._brokers: dict[int, tuple[str, int]] = {}
+        self._conns: dict[int, _BrokerConn] = {}
+        self._bootstrap_conn: Optional[_BrokerConn] = None
+        self.topics: dict[str, TopicMeta] = {}
+
+    async def connect(self) -> None:
+        last: Optional[Exception] = None
+        for host, port in self.bootstrap:
+            conn = _BrokerConn(host, port, self.client_id)
+            try:
+                await conn.connect()
+                self._bootstrap_conn = conn
+                return
+            except ConnectError as e:
+                last = e
+        raise ConnectError(f"kafka: no bootstrap broker reachable: {last}")
+
+    async def _conn_for_node(self, node: int) -> _BrokerConn:
+        conn = self._conns.get(node)
+        if conn is None:
+            host, port = self._brokers[node]
+            conn = _BrokerConn(host, port, self.client_id)
+            await conn.connect()
+            self._conns[node] = conn
+        return conn
+
+    async def refresh_metadata(self, topics: list[str]) -> None:
+        body = Writer().array(topics, lambda w, t: w.string(t)).build()
+        r = await self._bootstrap_conn.request(API_METADATA, 1, body)
+        n_brokers = r.i32()
+        for _ in range(n_brokers):
+            node = r.i32()
+            host = r.string()
+            port = r.i32()
+            r.string()  # rack
+            self._brokers[node] = (host, port)
+        r.i32()  # controller id
+        n_topics = r.i32()
+        for _ in range(n_topics):
+            err = r.i16()
+            name = r.string()
+            r.i8()  # is_internal
+            tm = TopicMeta(name)
+            n_parts = r.i32()
+            for _ in range(n_parts):
+                perr = r.i16()
+                pid = r.i32()
+                leader = r.i32()
+                nrep = r.i32()
+                for _ in range(nrep):
+                    r.i32()
+                nisr = r.i32()
+                for _ in range(nisr):
+                    r.i32()
+                if perr == 0:
+                    tm.partitions[pid] = PartitionMeta(pid, leader)
+            if err == 0:
+                self.topics[name] = tm
+            else:
+                raise KafkaProtocolError(f"metadata({name})", err)
+
+    def partitions(self, topic: str) -> list[int]:
+        tm = self.topics.get(topic)
+        return sorted(tm.partitions) if tm else []
+
+    async def _leader_conn(self, topic: str, partition: int) -> _BrokerConn:
+        tm = self.topics.get(topic)
+        if tm is None or partition not in tm.partitions:
+            await self.refresh_metadata([topic])
+            tm = self.topics.get(topic)
+            if tm is None or partition not in tm.partitions:
+                raise ReadError(f"kafka: unknown topic-partition {topic}/{partition}")
+        return await self._conn_for_node(tm.partitions[partition].leader)
+
+    # -- produce -----------------------------------------------------------
+
+    async def produce(self, topic: str, partition: int,
+                      records: list[tuple[Optional[bytes], Optional[bytes]]],
+                      acks: int = -1, timeout_ms: int = 30000) -> int:
+        batch = encode_record_batch(records)
+        body = (
+            Writer()
+            .string(None)  # transactional_id
+            .i16(acks)
+            .i32(timeout_ms)
+            .array(
+                [(topic, partition, batch)],
+                lambda w, t: w.string(t[0]).array(
+                    [(t[1], t[2])], lambda w2, p: w2.i32(p[0]).bytes_(p[1])
+                ),
+            )
+            .build()
+        )
+        conn = await self._leader_conn(topic, partition)
+        r = await conn.request(API_PRODUCE, 3, body)
+        base_offset = -1
+        n_topics = r.i32()
+        for _ in range(n_topics):
+            r.string()
+            n_parts = r.i32()
+            for _ in range(n_parts):
+                r.i32()  # partition
+                err = r.i16()
+                base_offset = r.i64()
+                r.i64()  # log_append_time
+                if err != 0:
+                    if err in (3, 6):  # unknown topic/partition, not leader
+                        self.topics.pop(topic, None)
+                    raise WriteError(f"kafka produce error code {err}")
+        return base_offset
+
+    # -- fetch -------------------------------------------------------------
+
+    async def fetch(self, topic: str, partition: int, offset: int,
+                    max_wait_ms: int = 500, min_bytes: int = 1,
+                    max_bytes: int = 4 << 20) -> tuple[list[KafkaRecord], int]:
+        """Returns (records, high_watermark)."""
+        body = (
+            Writer()
+            .i32(-1)  # replica_id
+            .i32(max_wait_ms)
+            .i32(min_bytes)
+            .i32(max_bytes)
+            .i8(0)  # isolation level: read_uncommitted
+            .array(
+                [(topic, partition, offset)],
+                lambda w, t: w.string(t[0]).array(
+                    [(t[1], t[2])],
+                    lambda w2, p: w2.i32(p[0]).i64(p[1]).i32(max_bytes),
+                ),
+            )
+            .build()
+        )
+        conn = await self._leader_conn(topic, partition)
+        r = await conn.request(API_FETCH, 4, body)
+        r.i32()  # throttle
+        records: list[KafkaRecord] = []
+        hwm = -1
+        n_topics = r.i32()
+        for _ in range(n_topics):
+            r.string()
+            n_parts = r.i32()
+            for _ in range(n_parts):
+                r.i32()  # partition
+                err = r.i16()
+                hwm = r.i64()
+                r.i64()  # last_stable_offset
+                n_aborted = r.i32()
+                for _ in range(max(0, n_aborted)):
+                    r.i64()
+                    r.i64()
+                record_set = r.bytes_() or b""
+                if err != 0:
+                    if err in (1,):  # offset out of range
+                        raise KafkaProtocolError("fetch", err)
+                    if err in (3, 6, 9):
+                        self.topics.pop(topic, None)
+                    raise Disconnection(f"kafka fetch error code {err}")
+                records.extend(
+                    rec for rec in decode_record_batches(record_set) if rec.offset >= offset
+                )
+        return records, hwm
+
+    async def list_offsets(self, topic: str, partition: int, earliest: bool) -> int:
+        ts = -2 if earliest else -1
+        body = (
+            Writer()
+            .i32(-1)
+            .array(
+                [(topic, partition)],
+                lambda w, t: w.string(t[0]).array(
+                    [t[1]], lambda w2, p: w2.i32(p).i64(ts)
+                ),
+            )
+            .build()
+        )
+        conn = await self._leader_conn(topic, partition)
+        r = await conn.request(API_LIST_OFFSETS, 1, body)
+        offset = -1
+        n_topics = r.i32()
+        for _ in range(n_topics):
+            r.string()
+            n_parts = r.i32()
+            for _ in range(n_parts):
+                r.i32()
+                err = r.i16()
+                r.i64()  # timestamp
+                offset = r.i64()
+                if err != 0:
+                    raise KafkaProtocolError("list_offsets", err)
+        return offset
+
+    # -- offsets (simple-consumer group semantics) -------------------------
+
+    async def _coordinator_conn(self, group: str) -> _BrokerConn:
+        body = Writer().string(group).build()
+        r = await self._bootstrap_conn.request(API_FIND_COORDINATOR, 0, body)
+        err = r.i16()
+        node = r.i32()
+        host = r.string()
+        port = r.i32()
+        if err != 0:
+            raise KafkaProtocolError("find_coordinator", err)
+        self._brokers[node] = (host, port)
+        return await self._conn_for_node(node)
+
+    async def offset_commit(self, group: str, topic: str, partition: int, offset: int) -> None:
+        body = (
+            Writer()
+            .string(group)
+            .i32(-1)  # generation: simple consumer
+            .string("")  # member id
+            .i64(-1)  # retention
+            .array(
+                [(topic, partition, offset)],
+                lambda w, t: w.string(t[0]).array(
+                    [(t[1], t[2])],
+                    lambda w2, p: w2.i32(p[0]).i64(p[1]).string(""),
+                ),
+            )
+            .build()
+        )
+        conn = await self._coordinator_conn(group)
+        r = await conn.request(API_OFFSET_COMMIT, 2, body)
+        n_topics = r.i32()
+        for _ in range(n_topics):
+            r.string()
+            n_parts = r.i32()
+            for _ in range(n_parts):
+                r.i32()
+                err = r.i16()
+                if err != 0:
+                    raise WriteError(f"kafka offset commit error code {err}")
+
+    async def offset_fetch(self, group: str, topic: str, partition: int) -> int:
+        """Committed offset, or -1 when none."""
+        body = (
+            Writer()
+            .string(group)
+            .array(
+                [(topic, partition)],
+                lambda w, t: w.string(t[0]).array([t[1]], lambda w2, p: w2.i32(p)),
+            )
+            .build()
+        )
+        conn = await self._coordinator_conn(group)
+        r = await conn.request(API_OFFSET_FETCH, 1, body)
+        offset = -1
+        n_topics = r.i32()
+        for _ in range(n_topics):
+            r.string()
+            n_parts = r.i32()
+            for _ in range(n_parts):
+                r.i32()
+                offset = r.i64()
+                r.string()  # metadata
+                err = r.i16()
+                if err != 0:
+                    raise KafkaProtocolError("offset_fetch", err)
+        return offset
+
+    async def close(self) -> None:
+        for conn in list(self._conns.values()):
+            await conn.close()
+        self._conns.clear()
+        if self._bootstrap_conn is not None:
+            await self._bootstrap_conn.close()
+            self._bootstrap_conn = None

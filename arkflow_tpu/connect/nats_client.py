@@ -1,0 +1,140 @@
+"""Minimal NATS core client (text protocol over TCP).
+
+Implements the client side of the NATS wire protocol: INFO/CONNECT handshake,
+PING/PONG keepalive, SUB/UNSUB, PUB, MSG dispatch. Core NATS only — JetStream
+(pull consumers, acks) is a JSON API layered on request/reply and is gated for
+now; the nats input/output document the gap. (Reference uses async-nats:
+crates/arkflow-plugin/src/input/nats.rs.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from arkflow_tpu.errors import ConnectError, Disconnection
+
+logger = logging.getLogger("arkflow.nats")
+
+
+@dataclass
+class NatsMessage:
+    subject: str
+    payload: bytes
+    reply: Optional[str] = None
+    sid: str = ""
+
+
+class NatsClient:
+    def __init__(self, url: str, name: str = "arkflow-tpu"):
+        # url: nats://host:port or host:port
+        addr = url.split("://", 1)[-1]
+        host, _, port = addr.partition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port or 4222)
+        self.name = name
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._loop_task: Optional[asyncio.Task] = None
+        self._subs: dict[str, Callable[[NatsMessage], None]] = {}
+        self._next_sid = 1
+        self._connected = False
+        self.server_info: dict = {}
+
+    async def connect(self, timeout: float = 5.0) -> None:
+        try:
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), timeout
+            )
+            line = await asyncio.wait_for(self._reader.readline(), timeout)
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ConnectError(f"nats connect to {self.host}:{self.port} failed: {e}") from e
+        if not line.startswith(b"INFO "):
+            raise ConnectError(f"nats: unexpected greeting {line[:64]!r}")
+        self.server_info = json.loads(line[5:].decode())
+        connect_opts = {
+            "verbose": False,
+            "pedantic": False,
+            "name": self.name,
+            "lang": "python-arkflow",
+            "version": "0.1.0",
+            "protocol": 1,
+        }
+        self._writer.write(b"CONNECT " + json.dumps(connect_opts).encode() + b"\r\nPING\r\n")
+        await self._writer.drain()
+        pong = await asyncio.wait_for(self._reader.readline(), timeout)
+        while pong.startswith(b"INFO "):
+            pong = await asyncio.wait_for(self._reader.readline(), timeout)
+        if not pong.startswith(b"PONG"):
+            raise ConnectError(f"nats: handshake failed, got {pong[:64]!r}")
+        self._connected = True
+        self._loop_task = asyncio.create_task(self._dispatch_loop())
+
+    async def _dispatch_loop(self) -> None:
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                if line.startswith(b"MSG "):
+                    parts = line[4:].strip().split(b" ")
+                    if len(parts) == 3:
+                        subject, sid, nbytes = parts
+                        reply = None
+                    else:
+                        subject, sid, reply_b, nbytes = parts
+                        reply = reply_b.decode()
+                    payload = await self._reader.readexactly(int(nbytes))
+                    await self._reader.readexactly(2)  # trailing \r\n
+                    cb = self._subs.get(sid.decode())
+                    if cb is not None:
+                        cb(NatsMessage(subject.decode(), payload, reply, sid.decode()))
+                elif line.startswith(b"PING"):
+                    self._writer.write(b"PONG\r\n")
+                    await self._writer.drain()
+                elif line.startswith(b"-ERR"):
+                    logger.warning("nats server error: %s", line.strip().decode())
+                # +OK / INFO: ignore
+        except (asyncio.CancelledError, asyncio.IncompleteReadError, OSError):
+            pass
+        finally:
+            self._connected = False
+
+    @property
+    def connected(self) -> bool:
+        return self._connected
+
+    async def subscribe(self, subject: str, cb: Callable[[NatsMessage], None],
+                        queue_group: Optional[str] = None) -> str:
+        sid = str(self._next_sid)
+        self._next_sid += 1
+        self._subs[sid] = cb
+        q = f" {queue_group}" if queue_group else ""
+        self._writer.write(f"SUB {subject}{q} {sid}\r\n".encode())
+        await self._writer.drain()
+        return sid
+
+    async def publish(self, subject: str, payload: bytes, reply: Optional[str] = None) -> None:
+        if not self._connected:
+            raise Disconnection("nats connection lost")
+        r = f" {reply}" if reply else ""
+        self._writer.write(f"PUB {subject}{r} {len(payload)}\r\n".encode() + payload + b"\r\n")
+        await self._writer.drain()
+
+    async def close(self) -> None:
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._writer is not None:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+        self._connected = False

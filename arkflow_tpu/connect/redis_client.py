@@ -1,0 +1,152 @@
+"""Minimal Redis client: RESP2 protocol over TCP.
+
+Covers the commands the engine uses (ref reference components:
+input/redis.rs pub/sub + BLPOP, output/redis.rs PUBLISH/LPUSH,
+temporary/redis.rs MGET/LRANGE): command pipelining, pub/sub push parsing,
+blocking list pops. Single-node only; cluster redirection is gated.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable, Optional
+
+from arkflow_tpu.errors import ConnectError, Disconnection, ReadError
+
+logger = logging.getLogger("arkflow.redis")
+
+
+def encode_command(*args: bytes | str | int | float) -> bytes:
+    out = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, str):
+            a = a.encode()
+        elif isinstance(a, (int, float)):
+            a = str(a).encode()
+        out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+    return b"".join(out)
+
+
+class RedisError(ReadError):
+    pass
+
+
+class RedisClient:
+    def __init__(self, url: str = "redis://127.0.0.1:6379", password: Optional[str] = None,
+                 db: int = 0):
+        addr = url.split("://", 1)[-1]
+        if "@" in addr:
+            cred, addr = addr.rsplit("@", 1)
+            if ":" in cred and password is None:
+                password = cred.split(":", 1)[1]
+        host, _, rest = addr.partition(":")
+        port_s, _, db_s = rest.partition("/")
+        self.host = host or "127.0.0.1"
+        self.port = int(port_s or 6379)
+        self.db = int(db_s) if db_s else db
+        self.password = password
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    async def connect(self, timeout: float = 5.0) -> None:
+        try:
+            self._reader, self._writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), timeout
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ConnectError(f"redis connect to {self.host}:{self.port} failed: {e}") from e
+        if self.password:
+            await self.command("AUTH", self.password)
+        if self.db:
+            await self.command("SELECT", self.db)
+
+    async def _read_reply(self) -> Any:
+        line = await self._reader.readline()
+        if not line:
+            raise Disconnection("redis connection closed")
+        kind, rest = line[:1], line[1:-2]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RedisError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = await self._reader.readexactly(n + 2)
+            return data[:-2]
+        if kind == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [await self._read_reply() for _ in range(n)]
+        raise RedisError(f"unexpected RESP type {kind!r}")
+
+    async def command(self, *args) -> Any:
+        """Send one command and await its reply (serialised)."""
+        async with self._lock:
+            self._writer.write(encode_command(*args))
+            await self._writer.drain()
+            return await self._read_reply()
+
+    # -- engine-facing helpers ----------------------------------------------
+
+    async def mget(self, keys: list) -> list:
+        if not keys:
+            return []
+        return await self.command("MGET", *keys)
+
+    async def lrange(self, key, start: int = 0, stop: int = -1) -> list:
+        return await self.command("LRANGE", key, start, stop)
+
+    async def publish(self, channel, payload: bytes) -> int:
+        return await self.command("PUBLISH", channel, payload)
+
+    async def lpush(self, key, payload: bytes) -> int:
+        return await self.command("LPUSH", key, payload)
+
+    async def rpush(self, key, payload: bytes) -> int:
+        return await self.command("RPUSH", key, payload)
+
+    async def blpop(self, keys: list, timeout_s: float = 1.0) -> Optional[tuple[bytes, bytes]]:
+        res = await self.command("BLPOP", *keys, int(max(1, timeout_s)))
+        if res is None:
+            return None
+        return res[0], res[1]
+
+    async def subscribe_loop(self, channels: list, patterns: list,
+                             cb: Callable[[bytes, bytes], None]) -> None:
+        """Enter pub/sub mode and dispatch messages until cancelled.
+
+        The connection is dedicated to pub/sub from this point (RESP rule).
+        """
+        async with self._lock:
+            if channels:
+                self._writer.write(encode_command("SUBSCRIBE", *channels))
+            if patterns:
+                self._writer.write(encode_command("PSUBSCRIBE", *patterns))
+            await self._writer.drain()
+            while True:
+                reply = await self._read_reply()
+                if not isinstance(reply, list) or not reply:
+                    continue
+                kind = reply[0]
+                if kind == b"message" and len(reply) == 3:
+                    cb(reply[1], reply[2])
+                elif kind == b"pmessage" and len(reply) == 4:
+                    cb(reply[2], reply[3])
+                # (p)subscribe acks ignored
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+            self._writer = None
+            self._reader = None

@@ -1,0 +1,23 @@
+"""Model families for streaming inference processors.
+
+The reference executes no models — its Python processor is the extension hook
+where user ML code runs (ref: crates/arkflow-plugin/src/processor/python.rs).
+Per BASELINE.json, this build makes model execution first-class: each family
+here is a pure-JAX functional model (params pytree + jittable apply) designed
+for the MXU — bfloat16 matmuls, static shapes, ``lax.scan`` for recurrence —
+and registered under a name the ``tpu_inference`` processor resolves from
+config.
+
+Families (mapped to BASELINE.json bench configs):
+- ``bert_classifier``  BERT-base sequence classification (Kafka->BERT->Kafka)
+- ``lstm_ae``          LSTM autoencoder anomaly score   (MQTT->LSTM-AE->stdout)
+- ``vit_embedder``     ViT-B/16 image embedding          (HTTP->ViT->Redis)
+- ``decoder_lm``       Llama-style decoder LM            (CDC->LLM-summary->NATS)
+"""
+
+from arkflow_tpu.models.registry import get_model, list_models, register_model  # noqa: F401
+
+import arkflow_tpu.models.bert  # noqa: F401
+import arkflow_tpu.models.lstm_ae  # noqa: F401
+import arkflow_tpu.models.vit  # noqa: F401
+import arkflow_tpu.models.decoder  # noqa: F401

@@ -1,0 +1,88 @@
+"""Shared pure-JAX building blocks: params-as-pytrees, jittable applies.
+
+Design rules (TPU-first):
+- Params live in nested dicts; applies are pure functions -> trivially
+  jittable, shardable with ``NamedSharding`` pytrees, no framework state.
+- Compute dtype is bfloat16 (MXU-native); normalisation statistics and softmax
+  run in float32 for stability; params are kept in float32 master copies and
+  cast at use (standard mixed-precision recipe).
+- No Python control flow on data; recurrences use ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = Any  # nested dict pytree
+
+
+def dense_init(key, in_dim: int, out_dim: int, *, bias: bool = True) -> Params:
+    w_key, _ = jax.random.split(key)
+    scale = 1.0 / math.sqrt(in_dim)
+    p = {"w": jax.random.uniform(w_key, (in_dim, out_dim), jnp.float32, -scale, scale)}
+    if bias:
+        p["b"] = jnp.zeros((out_dim,), jnp.float32)
+    return p
+
+
+def dense(p: Params, x: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    y = x.astype(dtype) @ p["w"].astype(dtype)
+    if "b" in p:
+        y = y + p["b"].astype(dtype)
+    return y
+
+
+def layer_norm_init(dim: int) -> Params:
+    return {"scale": jnp.ones((dim,), jnp.float32), "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def layer_norm(p: Params, x: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def rms_norm_init(dim: int) -> Params:
+    return {"scale": jnp.ones((dim,), jnp.float32)}
+
+
+def rms_norm(p: Params, x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1, keepdims=True) + eps)
+    return (y * p["scale"]).astype(x.dtype)
+
+
+def embedding_init(key, vocab: int, dim: int, scale: float = 0.02) -> Params:
+    return {"table": jax.random.normal(key, (vocab, dim), jnp.float32) * scale}
+
+
+def embedding(p: Params, ids: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return p["table"].astype(dtype)[ids]
+
+
+def gelu(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.nn.gelu(x, approximate=True)
+
+
+def attention(q, k, v, mask=None, *, softmax_dtype=jnp.float32):
+    """Batched multi-head attention core: [B, S, H, Dh] tensors.
+
+    Softmax in float32; matmuls in the input dtype (bfloat16) for the MXU.
+    ``mask``: broadcastable to [B, H, Sq, Sk], True = attend.
+    """
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(softmax_dtype) / math.sqrt(dh)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(softmax_dtype).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def count_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

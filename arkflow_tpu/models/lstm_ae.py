@@ -1,0 +1,88 @@
+"""LSTM autoencoder anomaly scorer (MQTT telemetry -> anomaly score).
+
+BASELINE.json config 3. Encoder LSTM compresses a [B, T, F] sensor window to a
+latent; decoder LSTM reconstructs; anomaly score = per-window reconstruction
+MSE. Recurrence is ``lax.scan`` (compiler-friendly, no Python loops); the
+gates' matmuls are fused into single [F+H, 4H] projections for the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from arkflow_tpu.models import common as cm
+from arkflow_tpu.models.registry import ModelFamily, register_model
+
+
+@dataclass(frozen=True)
+class LstmAeConfig:
+    features: int = 8
+    hidden: int = 64
+    latent: int = 16
+    window: int = 32  # time steps per example
+
+
+def _lstm_init(key, in_dim: int, hidden: int) -> dict:
+    return cm.dense_init(key, in_dim + hidden, 4 * hidden)
+
+
+def _lstm_scan(p: dict, xs: jnp.ndarray, hidden: int):
+    """xs: [T, B, F] -> (final (h, c), outputs [T, B, H]). Gates in one matmul."""
+    b = xs.shape[1]
+    h0 = jnp.zeros((b, hidden), jnp.float32)
+    c0 = jnp.zeros((b, hidden), jnp.float32)
+
+    def step(carry, x):
+        h, c = carry
+        z = cm.dense(p, jnp.concatenate([x, h], axis=-1), dtype=jnp.float32)
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), h
+
+    (h, c), ys = jax.lax.scan(step, (h0, c0), xs)
+    return (h, c), ys
+
+
+def init(rng, cfg: LstmAeConfig) -> dict:
+    k1, k2, k3, k4, k5 = jax.random.split(rng, 5)
+    return {
+        "encoder": _lstm_init(k1, cfg.features, cfg.hidden),
+        "to_latent": cm.dense_init(k2, cfg.hidden, cfg.latent),
+        "from_latent": cm.dense_init(k3, cfg.latent, cfg.hidden),
+        "decoder": _lstm_init(k4, cfg.hidden, cfg.hidden),
+        "head": cm.dense_init(k5, cfg.hidden, cfg.features),
+    }
+
+
+def apply(params: dict, cfg: LstmAeConfig, *, values) -> dict:
+    """values: [B, T, F] float32 sensor windows -> anomaly score per window."""
+    x = jnp.transpose(values.astype(jnp.float32), (1, 0, 2))  # [T, B, F]
+    (h, _), _ = _lstm_scan(params["encoder"], x, cfg.hidden)
+    latent = jnp.tanh(cm.dense(params["to_latent"], h, dtype=jnp.float32))
+    seed = cm.dense(params["from_latent"], latent, dtype=jnp.float32)
+    # decoder receives the latent seed at every step (standard AE unrolling)
+    dec_in = jnp.broadcast_to(seed[None], (cfg.window, *seed.shape))
+    _, ys = _lstm_scan(params["decoder"], dec_in, cfg.hidden)
+    recon = cm.dense(params["head"], ys, dtype=jnp.float32)  # [T, B, F]
+    recon = jnp.transpose(recon, (1, 0, 2))
+    err = jnp.mean(jnp.square(recon - values.astype(jnp.float32)), axis=(1, 2))
+    return {"score": err, "reconstruction": recon}
+
+
+def input_spec(cfg: LstmAeConfig) -> dict:
+    return {"values": ("float32", (cfg.window, cfg.features))}
+
+
+register_model(
+    ModelFamily(
+        name="lstm_ae",
+        make_config=LstmAeConfig,
+        init=init,
+        apply=apply,
+        input_spec=input_spec,
+    )
+)

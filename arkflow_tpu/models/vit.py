@@ -1,0 +1,106 @@
+"""ViT-B/16 image embedder (HTTP image ingest -> embedding -> vector sink).
+
+BASELINE.json config 4. Patchify is a reshape + single [P*P*C, D] matmul
+(equivalent to the conv patch-embed but expressed as a dense layer the MXU
+tiles perfectly); 12 pre-LN transformer layers, CLS-token embedding out.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from arkflow_tpu.models import common as cm
+from arkflow_tpu.models.registry import ModelFamily, register_model
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch: int = 16
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    ffn: int = 3072
+    channels: int = 3
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch) ** 2
+
+
+def init(rng, cfg: ViTConfig) -> dict:
+    keys = iter(jax.random.split(rng, 8 + 8 * cfg.layers))
+    patch_dim = cfg.patch * cfg.patch * cfg.channels
+    params = {
+        "patch_embed": cm.dense_init(next(keys), patch_dim, cfg.hidden),
+        "cls": jax.random.normal(next(keys), (1, 1, cfg.hidden), jnp.float32) * 0.02,
+        "pos": jax.random.normal(next(keys), (1, cfg.num_patches + 1, cfg.hidden), jnp.float32) * 0.02,
+        "ln_out": cm.layer_norm_init(cfg.hidden),
+        "layers": [],
+    }
+    for _ in range(cfg.layers):
+        params["layers"].append(
+            {
+                "ln1": cm.layer_norm_init(cfg.hidden),
+                "q": cm.dense_init(next(keys), cfg.hidden, cfg.hidden),
+                "k": cm.dense_init(next(keys), cfg.hidden, cfg.hidden),
+                "v": cm.dense_init(next(keys), cfg.hidden, cfg.hidden),
+                "attn_out": cm.dense_init(next(keys), cfg.hidden, cfg.hidden),
+                "ln2": cm.layer_norm_init(cfg.hidden),
+                "ffn_in": cm.dense_init(next(keys), cfg.hidden, cfg.ffn),
+                "ffn_out": cm.dense_init(next(keys), cfg.ffn, cfg.hidden),
+            }
+        )
+    params["layers"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params["layers"])
+    return params
+
+
+def _patchify(images: jnp.ndarray, cfg: ViTConfig) -> jnp.ndarray:
+    """[B, H, W, C] -> [B, N, P*P*C] by pure reshape/transpose (no conv)."""
+    b, h, w, c = images.shape
+    p = cfg.patch
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def apply(params: dict, cfg: ViTConfig, *, images) -> dict:
+    """images: [B, H, W, C] float32 in [0,1] -> {"embedding": [B, hidden]}."""
+    b = images.shape[0]
+    x = cm.dense(params["patch_embed"], _patchify(images.astype(jnp.bfloat16), cfg))
+    cls = jnp.broadcast_to(params["cls"].astype(x.dtype), (b, 1, cfg.hidden))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"].astype(x.dtype)
+    s = x.shape[1]
+
+    def layer(x, lp):
+        h, dh = cfg.heads, cfg.hidden // cfg.heads
+        y = cm.layer_norm(lp["ln1"], x)
+        q = cm.dense(lp["q"], y).reshape(b, s, h, dh)
+        k = cm.dense(lp["k"], y).reshape(b, s, h, dh)
+        v = cm.dense(lp["v"], y).reshape(b, s, h, dh)
+        x = x + cm.dense(lp["attn_out"], cm.attention(q, k, v).reshape(b, s, cfg.hidden))
+        y = cm.layer_norm(lp["ln2"], x)
+        x = x + cm.dense(lp["ffn_out"], cm.gelu(cm.dense(lp["ffn_in"], y)))
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    emb = cm.layer_norm(params["ln_out"], x)[:, 0, :].astype(jnp.float32)
+    return {"embedding": emb}
+
+
+def input_spec(cfg: ViTConfig) -> dict:
+    return {"images": ("float32", (cfg.image_size, cfg.image_size, cfg.channels))}
+
+
+register_model(
+    ModelFamily(
+        name="vit_embedder",
+        make_config=ViTConfig,
+        init=init,
+        apply=apply,
+        input_spec=input_spec,
+    )
+)

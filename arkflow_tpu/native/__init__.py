@@ -1,0 +1,153 @@
+"""Native tier loader: compiles native.cpp once, binds via ctypes.
+
+Every entry point has a pure-Python fallback, so the engine degrades
+gracefully on machines without a toolchain (``available()`` reports which
+tier is active). The .so is cached next to the source, keyed by source mtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("arkflow.native")
+
+_HERE = Path(__file__).parent
+_SRC = _HERE / "native.cpp"
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_lib() -> Optional[Path]:
+    so_path = _HERE / "_native.so"
+    try:
+        if so_path.exists() and so_path.stat().st_mtime >= _SRC.stat().st_mtime:
+            return so_path
+        with tempfile.TemporaryDirectory() as td:
+            tmp_so = Path(td) / "_native.so"
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", str(tmp_so)]
+            res = subprocess.run(cmd, capture_output=True, timeout=120)
+            if res.returncode != 0:
+                logger.warning("native build failed: %s", res.stderr.decode()[:500])
+                return None
+            os.replace(tmp_so, so_path)
+        return so_path
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native build unavailable: %s", e)
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    so = _build_lib()
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+        lib.ark_crc32c.restype = ctypes.c_uint32
+        lib.ark_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+        lib.ark_hash_tokenize.restype = None
+        lib.ark_hash_tokenize.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ark_pad_gather_i32.restype = None
+        lib.ark_pad_gather_i32.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        _LIB = lib
+    except OSError as e:
+        logger.warning("native load failed: %s", e)
+        _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# -- crc32c -----------------------------------------------------------------
+
+_CRC32C_TABLE: Optional[list[int]] = None
+
+
+def _py_crc32c(data: bytes, crc: int = 0) -> int:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc = ~crc & 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return ~crc & 0xFFFFFFFF
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    lib = _load()
+    if lib is not None:
+        return lib.ark_crc32c(data, len(data), crc)
+    return _py_crc32c(data, crc)
+
+
+# -- batch hash tokenizer ---------------------------------------------------
+
+def hash_tokenize_batch(texts: list[bytes], max_len: int, vocab_size: int):
+    """Native batch tokenize -> (ids, mask) int32 [n, max_len]; None if no lib."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(texts)
+    buf = b"".join(texts)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(t) for t in texts], out=offsets[1:])
+    ids = np.zeros((n, max_len), np.int32)
+    mask = np.zeros((n, max_len), np.int32)
+    lib.ark_hash_tokenize(
+        buf,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, max_len, vocab_size,
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return ids, mask
+
+
+def pad_gather_i32(values: np.ndarray, offsets: np.ndarray, seq: int,
+                   out_rows: int) -> Optional[np.ndarray]:
+    """Native ragged->padded gather; None if no lib."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(offsets) - 1
+    values = np.ascontiguousarray(values, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    out = np.zeros((out_rows, seq), np.int32)
+    lib.ark_pad_gather_i32(
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, seq,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out

@@ -1,0 +1,145 @@
+// Native host-runtime tier.
+//
+// The reference's entire engine is native (Rust); here the JAX/XLA path owns
+// device compute and this library owns the host-side hot loops that pure
+// Python would bottleneck:
+//   - crc32c (Castagnoli): Kafka record-batch checksums (slice-by-8).
+//   - hash tokenizer: batch text -> (ids, mask) for streaming token models;
+//     semantics identical to the Python fallback in arkflow_tpu/tpu/tokenizer.py
+//     (lowercase, [a-z0-9]+ runs or single symbol, FNV-1a 32-bit into [4, vocab)).
+//   - micro-batch assembler: gather+pad variable-length int32 rows into a
+//     fixed [batch, seq] bucket (the pad-to-bucket step of the TPU infeed).
+//
+// Built by arkflow_tpu/native/__init__.py with g++ -O3 -shared -fPIC; every
+// entry point has a Python fallback, so the engine still runs if no compiler
+// is present.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// crc32c, slice-by-8
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32c_table[8][256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+    const uint32_t poly = 0x82f63b78u;  // reflected Castagnoli
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t crc = i;
+        for (int j = 0; j < 8; j++)
+            crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+        crc32c_table[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t crc = crc32c_table[0][i];
+        for (int s = 1; s < 8; s++) {
+            crc = crc32c_table[0][crc & 0xff] ^ (crc >> 8);
+            crc32c_table[s][i] = crc;
+        }
+    }
+    crc32c_init_done = true;
+}
+
+uint32_t ark_crc32c(const uint8_t* data, size_t len, uint32_t crc) {
+    if (!crc32c_init_done) crc32c_init();
+    crc = ~crc;
+    while (len >= 8) {
+        uint64_t word;
+        memcpy(&word, data, 8);
+        word ^= crc;  // little-endian assumed (x86/arm64)
+        crc = crc32c_table[7][word & 0xff] ^
+              crc32c_table[6][(word >> 8) & 0xff] ^
+              crc32c_table[5][(word >> 16) & 0xff] ^
+              crc32c_table[4][(word >> 24) & 0xff] ^
+              crc32c_table[3][(word >> 32) & 0xff] ^
+              crc32c_table[2][(word >> 40) & 0xff] ^
+              crc32c_table[1][(word >> 48) & 0xff] ^
+              crc32c_table[0][(word >> 56) & 0xff];
+        data += 8;
+        len -= 8;
+    }
+    while (len--) crc = crc32c_table[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+    return ~crc;
+}
+
+// ---------------------------------------------------------------------------
+// hash tokenizer (must match tpu/tokenizer.py HashTokenizer exactly)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t fnv1a32(const uint8_t* s, size_t n) {
+    uint32_t h = 2166136261u;
+    for (size_t i = 0; i < n; i++) {
+        h ^= s[i];
+        h *= 16777619u;
+    }
+    return h;
+}
+
+static inline bool is_alnum_ascii(uint8_t c) {
+    return (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9');
+}
+
+// texts: concatenated lowercased-on-the-fly bytes; offsets[n+1] delimit texts.
+// Writes ids[n][max_len], mask[n][max_len] (row-major, pre-zeroed by caller).
+void ark_hash_tokenize(const uint8_t* buf, const int64_t* offsets, int n_texts,
+                       int max_len, int vocab_size, int32_t* ids, int32_t* mask) {
+    const int32_t CLS = 1, SEP = 2;
+    const int body = max_len - 2;
+    for (int t = 0; t < n_texts; t++) {
+        int32_t* row_ids = ids + (size_t)t * max_len;
+        int32_t* row_mask = mask + (size_t)t * max_len;
+        row_ids[0] = CLS;
+        int count = 0;  // tokens emitted (excluding cls/sep)
+        const uint8_t* p = buf + offsets[t];
+        const uint8_t* end = buf + offsets[t + 1];
+        while (p < end && count < body) {
+            uint8_t c = *p;
+            if (c >= 'A' && c <= 'Z') c += 32;
+            if (c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' || c == '\v') {
+                p++;
+                continue;
+            }
+            // stream the FNV hash over the token — unbounded length, exactly
+            // like the Python regex path hashing the whole run
+            uint32_t h = 2166136261u;
+            if (is_alnum_ascii(c)) {
+                while (p < end) {
+                    uint8_t d = *p;
+                    if (d >= 'A' && d <= 'Z') d += 32;
+                    if (!is_alnum_ascii(d)) break;
+                    h = (h ^ d) * 16777619u;
+                    p++;
+                }
+            } else {
+                h = (h ^ c) * 16777619u;
+                p++;
+            }
+            row_ids[1 + count] = 4 + (int32_t)(h % (uint32_t)(vocab_size - 4));
+            count++;
+        }
+        row_ids[1 + count] = SEP;
+        for (int i = 0; i < count + 2; i++) row_mask[i] = 1;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// micro-batch assembler: ragged int32 rows -> padded [batch, seq] bucket
+// ---------------------------------------------------------------------------
+
+// values: concatenated row values; offsets[n+1]; out: pre-zeroed [bucket_rows, seq].
+void ark_pad_gather_i32(const int32_t* values, const int64_t* offsets, int n_rows,
+                        int seq, int32_t* out) {
+    for (int r = 0; r < n_rows; r++) {
+        int64_t lo = offsets[r], hi = offsets[r + 1];
+        int64_t n = hi - lo;
+        if (n > seq) n = seq;
+        memcpy(out + (size_t)r * seq, values + lo, (size_t)n * sizeof(int32_t));
+    }
+}
+
+}  // extern "C"

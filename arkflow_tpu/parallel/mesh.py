@@ -1,0 +1,70 @@
+"""Device mesh + sharding helpers.
+
+The reference's only multi-node mechanism is Ballista SQL offload
+(SURVEY.md section 2.7); it has no model parallelism. Here multi-chip scale is
+first-class: a ``jax.sharding.Mesh`` over (dp, tp, sp) axes, parameter
+PartitionSpec pytrees from each model family, and GSPMD inserting the
+collectives (the scaling-book recipe: pick a mesh, annotate shardings, let XLA
+place psum/all-gather/reduce-scatter on ICI).
+
+Axes:
+- ``dp``  data parallel (batch)
+- ``tp``  tensor parallel (heads / FFN)
+- ``sp``  sequence parallel (long-context; pairs with ring attention)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    axis_names: tuple = ("dp", "tp", "sp")
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp * self.sp
+
+
+def create_mesh(spec: Optional[MeshSpec] = None, devices=None) -> Mesh:
+    """Build a Mesh; defaults to all devices on the dp axis."""
+    devices = devices if devices is not None else jax.devices()
+    if spec is None:
+        spec = MeshSpec(dp=len(devices))
+    if spec.num_devices > len(devices):
+        raise ValueError(
+            f"mesh {spec} needs {spec.num_devices} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[: spec.num_devices]).reshape(spec.dp, spec.tp, spec.sp)
+    return Mesh(arr, spec.axis_names)
+
+
+def shard_params(params, specs, mesh: Mesh):
+    """Place a param pytree onto the mesh per a PartitionSpec pytree.
+
+    ``specs`` must mirror the param tree (model families produce it via
+    ``param_specs``); ``None`` replicates everything.
+    """
+
+    def place(x, spec):
+        s = NamedSharding(mesh, spec if spec is not None else P())
+        return jax.device_put(x, s)
+
+    if specs is None:
+        return jax.tree_util.tree_map(lambda x: place(x, None), params)
+    return jax.tree_util.tree_map(
+        place, params, specs, is_leaf=lambda x: x is None or isinstance(x, P)
+    )
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

@@ -1,1 +1,2 @@
 import arkflow_tpu.plugins.buffer.memory  # noqa: F401
+import arkflow_tpu.plugins.buffer.window  # noqa: F401

@@ -1,0 +1,299 @@
+"""Window buffers: tumbling / sliding / session + windowed SQL join.
+
+Re-designs the reference's window stack (ref: crates/arkflow-plugin/src/buffer/
+{window,tumbling_window,sliding_window,session_window,join}.rs) on asyncio:
+
+- ``WindowBase`` keeps per-input-name queues (the reference's per-input
+  ``DashMap``, window.rs:29-48) — input names come from ``__meta_source`` so
+  fan-in streams (``multiple_inputs``) land in separate queues for joins.
+- Emission policies:
+  - tumbling: fixed ``interval``, non-overlapping (tumbling_window.rs:38-48)
+  - sliding: message-count ``window_size``/``slide_size`` with overlap
+    (sliding_window.rs:40-49); a message is acked when it can no longer
+    appear in any future window
+  - session: ``gap`` of inactivity closes the session (session_window.rs:39-62)
+- ``query`` config: on emit, each input's merged batch registers as a table
+  named by its input name and the configured SQL runs (join.rs:29-151);
+  emission is skipped when a declared input has no data (join.rs:102-109).
+
+Acks are held until the emitted window is acked downstream (at-least-once).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import (
+    Ack,
+    Buffer,
+    Resource,
+    VecAck,
+    register_buffer,
+)
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.sql import SessionContext
+from arkflow_tpu.utils.duration import parse_duration
+
+logger = logging.getLogger("arkflow.window")
+
+DEFAULT_INPUT = "__default__"
+
+
+class WindowBase(Buffer):
+    """Shared machinery: per-input queues, join-on-emit, condition plumbing."""
+
+    def __init__(self, query: Optional[str] = None, input_names: Optional[list[str]] = None):
+        self.query = query
+        self.declared_inputs = list(input_names or [])
+        self._queues: dict[str, deque] = {}
+        self._cond = asyncio.Condition()
+        self._closed = False
+
+    # -- subclass hooks ----------------------------------------------------
+
+    def _on_write_locked(self, now: float) -> None:
+        """Called under the lock after a batch is queued."""
+
+    def _next_deadline(self, now: float) -> Optional[float]:
+        """Next instant at which _take_due may produce output, or None."""
+        raise NotImplementedError
+
+    def _take_due_locked(self, now: float, closing: bool) -> Optional[tuple[dict, VecAck]]:
+        """If a window is due, drain it: {input_name: [batches]}, acks."""
+        raise NotImplementedError
+
+    # -- Buffer contract ---------------------------------------------------
+
+    async def write(self, batch: MessageBatch, ack: Ack) -> None:
+        name = batch.get_meta("__meta_source") or DEFAULT_INPUT
+        async with self._cond:
+            self._queues.setdefault(name, deque()).append((batch, ack))
+            self._on_write_locked(asyncio.get_running_loop().time())
+            self._cond.notify_all()
+
+    async def read(self) -> Optional[tuple[MessageBatch, Ack]]:
+        while True:
+            async with self._cond:
+                now = asyncio.get_running_loop().time()
+                due = self._take_due_locked(now, closing=self._closed)
+                if due is not None:
+                    emitted = self._emit(due)
+                    if emitted is not None:
+                        return emitted
+                    continue  # join skipped (missing input); try next window
+                if self._closed:
+                    return None
+                deadline = self._next_deadline(now)
+                timeout = None if deadline is None else max(0.0, deadline - now)
+                try:
+                    await asyncio.wait_for(self._cond.wait(), timeout=timeout)
+                except asyncio.TimeoutError:
+                    pass
+
+    async def close(self) -> None:
+        async with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- emission ----------------------------------------------------------
+
+    def _emit(self, due: tuple[dict, VecAck]) -> Optional[tuple[MessageBatch, Ack]]:
+        per_input, acks = due
+        merged = {
+            name: MessageBatch.concat(batches)
+            for name, batches in per_input.items()
+            if batches
+        }
+        if not merged:
+            return None
+        if self.query:
+            declared = self.declared_inputs or list(merged)
+            if any(name not in merged or merged[name].num_rows == 0 for name in declared):
+                # a declared input has no data in this window -> skip emission
+                # but consume+ack the window content (ref join.rs:102-109)
+                return self._skip(acks)
+            ctx = SessionContext()
+            for name in declared:
+                ctx.register_batch(name, merged[name])
+            try:
+                result = ctx.sql(self.query)
+            except Exception:
+                logger.exception("window join query failed")
+                return self._skip(acks)
+            return (result, acks)
+        out = MessageBatch.concat(list(merged.values()))
+        return (out, acks)
+
+    @staticmethod
+    def _skip(acks: VecAck) -> None:
+        # fire acks asynchronously; the window produced nothing
+        async def _ack():
+            await acks.ack()
+
+        asyncio.get_running_loop().create_task(_ack())
+        return None
+
+
+class TumblingWindow(WindowBase):
+    """Fixed, non-overlapping time window."""
+
+    def __init__(self, interval_s: float, **kw):
+        super().__init__(**kw)
+        if interval_s <= 0:
+            raise ConfigError("tumbling_window.interval must be positive")
+        self.interval_s = interval_s
+        self._window_start: Optional[float] = None
+
+    def _on_write_locked(self, now: float) -> None:
+        if self._window_start is None:
+            self._window_start = now
+
+    def _next_deadline(self, now: float) -> Optional[float]:
+        if self._window_start is None:
+            return None
+        return self._window_start + self.interval_s
+
+    def _take_due_locked(self, now: float, closing: bool):
+        has_data = any(self._queues.values())
+        if not has_data:
+            self._window_start = None
+            return None
+        due = closing or (
+            self._window_start is not None and now >= self._window_start + self.interval_s
+        )
+        if not due:
+            return None
+        per_input = {name: list(q) for name, q in self._queues.items()}
+        acks = VecAck([a for q in self._queues.values() for _, a in q])
+        for q in self._queues.values():
+            q.clear()
+        self._window_start = None
+        return ({k: [b for b, _ in v] for k, v in per_input.items()}, acks)
+
+
+class SlidingWindow(WindowBase):
+    """Message-count window with overlap: window k covers messages
+    ``[k*slide - window_size, k*slide)`` — deterministic regardless of
+    reader/writer interleaving. A message's ack fires with the emission after
+    which it can no longer appear in any future window."""
+
+    def __init__(self, window_size: int, slide_size: int, **kw):
+        super().__init__(**kw)
+        if window_size <= 0 or slide_size <= 0:
+            raise ConfigError("sliding_window sizes must be positive")
+        self.window_size = window_size
+        self.slide_size = slide_size
+        self._messages: deque = deque()  # (input_name, batch, ack, idx)
+        self._total = 0
+        self._next_boundary = slide_size
+        self._last_emit_end = 0
+
+    async def write(self, batch: MessageBatch, ack: Ack) -> None:  # override: global order matters
+        name = batch.get_meta("__meta_source") or DEFAULT_INPUT
+        async with self._cond:
+            self._messages.append((name, batch, ack, self._total))
+            self._total += 1
+            self._cond.notify_all()
+
+    def _next_deadline(self, now: float) -> Optional[float]:
+        return None  # purely count-driven
+
+    def _take_due_locked(self, now: float, closing: bool):
+        if not self._messages:
+            return None
+        if self._total >= self._next_boundary:
+            k = self._next_boundary
+            self._next_boundary += self.slide_size
+            expire_before = k + self.slide_size - self.window_size
+        elif closing and self._total > self._last_emit_end:
+            k = self._total  # final partial window of not-yet-emitted messages
+            self._next_boundary = k + self.slide_size
+            expire_before = self._total  # everything leaves scope
+        elif closing:
+            # every message was already delivered in a boundary window; just
+            # release the remaining acks without re-emitting
+            acks = VecAck([a for _, _, a, _ in self._messages])
+            self._messages.clear()
+            return self._skip(acks)
+        else:
+            return None
+        self._last_emit_end = k
+        lo = max(0, k - self.window_size)
+        per_input: dict[str, list] = {}
+        for name, b, _, idx in self._messages:
+            if lo <= idx < k:
+                per_input.setdefault(name, []).append(b)
+        acks = VecAck()
+        while self._messages and self._messages[0][3] < expire_before:
+            _, _, a, _ = self._messages.popleft()
+            acks.push(a)
+        return (per_input, acks)
+
+
+class SessionWindow(WindowBase):
+    """Activity-gap sessionisation: ``gap`` of silence closes the session."""
+
+    def __init__(self, gap_s: float, **kw):
+        super().__init__(**kw)
+        if gap_s <= 0:
+            raise ConfigError("session_window.gap must be positive")
+        self.gap_s = gap_s
+        self._last_write: Optional[float] = None
+
+    def _on_write_locked(self, now: float) -> None:
+        self._last_write = now
+
+    def _next_deadline(self, now: float) -> Optional[float]:
+        if self._last_write is None:
+            return None
+        return self._last_write + self.gap_s
+
+    def _take_due_locked(self, now: float, closing: bool):
+        has_data = any(self._queues.values())
+        if not has_data:
+            return None
+        due = closing or (self._last_write is not None and now >= self._last_write + self.gap_s)
+        if not due:
+            return None
+        per_input = {name: [b for b, _ in q] for name, q in self._queues.items()}
+        acks = VecAck([a for q in self._queues.values() for _, a in q])
+        for q in self._queues.values():
+            q.clear()
+        self._last_write = None
+        return (per_input, acks)
+
+
+def _common_kwargs(config: dict, resource: Resource) -> dict:
+    return {
+        "query": config.get("query"),
+        "input_names": config.get("inputs") or resource.input_names or None,
+    }
+
+
+@register_buffer("tumbling_window")
+def _build_tumbling(config: dict, resource: Resource) -> TumblingWindow:
+    interval = config.get("interval")
+    if interval is None:
+        raise ConfigError("tumbling_window requires 'interval'")
+    return TumblingWindow(parse_duration(interval), **_common_kwargs(config, resource))
+
+
+@register_buffer("sliding_window")
+def _build_sliding(config: dict, resource: Resource) -> SlidingWindow:
+    ws = config.get("window_size")
+    if ws is None:
+        raise ConfigError("sliding_window requires 'window_size'")
+    slide = config.get("slide_size", ws)
+    return SlidingWindow(int(ws), int(slide), **_common_kwargs(config, resource))
+
+
+@register_buffer("session_window")
+def _build_session(config: dict, resource: Resource) -> SessionWindow:
+    gap = config.get("gap")
+    if gap is None:
+        raise ConfigError("session_window requires 'gap'")
+    return SessionWindow(parse_duration(gap), **_common_kwargs(config, resource))

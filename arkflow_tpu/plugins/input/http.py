@@ -1,0 +1,128 @@
+"""HTTP server input: POST payloads become stream messages.
+
+Mirrors the reference's axum-based http input (ref:
+crates/arkflow-plugin/src/input/http.rs:61-126): an aiohttp server accepts
+POSTs on ``path``, payloads land in a bounded queue (1000, matching the
+reference's flume bound), with optional Basic/Bearer auth (http.rs:40-47),
+token-bucket rate limiting and CORS headers.
+
+Config:
+
+    type: http
+    host: 127.0.0.1
+    port: 8070
+    path: /ingest
+    codec: json                 # optional
+    auth: {type: basic, username: u, password: "${HTTP_PW}"}
+    rate_limit: {capacity: 100, per_second: 50}
+    cors: true
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from aiohttp import web
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
+from arkflow_tpu.errors import ConfigError, EndOfInput
+from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
+from arkflow_tpu.utils.auth import AuthConfig, Authenticator
+from arkflow_tpu.utils.rate_limiter import TokenBucket
+
+QUEUE_BOUND = 1000  # ref http.rs flume bound
+
+
+class HttpInput(Input):
+    def __init__(self, host: str, port: int, path: str, codec=None,
+                 auth: Optional[Authenticator] = None,
+                 limiter: Optional[TokenBucket] = None, cors: bool = False):
+        self.host = host
+        self.port = port
+        self.path = path
+        self.codec = codec
+        self.auth = auth
+        self.limiter = limiter
+        self.cors = cors
+        self._queue: Optional[asyncio.Queue] = None
+        self._runner: Optional[web.AppRunner] = None
+        self._closed = False
+
+    async def connect(self) -> None:
+        self._queue = asyncio.Queue(maxsize=QUEUE_BOUND)
+        app = web.Application()
+        app.router.add_post(self.path, self._handle)
+        if self.cors:
+            app.router.add_options(self.path, self._options)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+
+    def _cors_headers(self) -> dict:
+        if not self.cors:
+            return {}
+        return {
+            "Access-Control-Allow-Origin": "*",
+            "Access-Control-Allow-Methods": "POST, OPTIONS",
+            "Access-Control-Allow-Headers": "Authorization, Content-Type",
+        }
+
+    async def _options(self, _req) -> web.Response:
+        return web.Response(status=204, headers=self._cors_headers())
+
+    async def _handle(self, req: web.Request) -> web.Response:
+        client = req.remote or "?"
+        if self.auth is not None and not self.auth.check(req.headers.get("Authorization"), client):
+            return web.Response(status=401, headers=self._cors_headers())
+        if self.limiter is not None and not self.limiter.try_acquire():
+            return web.Response(status=429, headers=self._cors_headers())
+        body = await req.read()
+        try:
+            self._queue.put_nowait(body)
+        except asyncio.QueueFull:
+            return web.Response(status=503, text="queue full", headers=self._cors_headers())
+        return web.Response(status=200, text="ok", headers=self._cors_headers())
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        if self._closed:
+            raise EndOfInput()
+        payload = await self._queue.get()
+        if payload is None:
+            raise EndOfInput()
+        batch = decode_payloads([payload], self.codec)
+        return batch.with_source("http").with_ingest_time(), NoopAck()
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._queue is not None:
+            try:
+                self._queue.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+@register_input("http")
+def _build(config: dict, resource: Resource) -> HttpInput:
+    port = config.get("port")
+    if port is None:
+        raise ConfigError("http input requires 'port'")
+    auth_cfg = AuthConfig.from_config(config.get("auth"))
+    limiter = None
+    rl = config.get("rate_limit")
+    if rl:
+        limiter = TokenBucket(int(rl.get("capacity", 100)), float(rl.get("per_second", 100)))
+    return HttpInput(
+        host=str(config.get("host", "0.0.0.0")),
+        port=int(port),
+        path=str(config.get("path", "/")),
+        codec=build_codec(config.get("codec"), resource),
+        auth=Authenticator(auth_cfg) if auth_cfg.kind != "none" else None,
+        limiter=limiter,
+        cors=bool(config.get("cors", False)),
+    )

@@ -1,0 +1,118 @@
+"""MQTT input: subscribe to topics, QoS 0/1.
+
+Mirrors the reference's mqtt input (ref: crates/arkflow-plugin/src/input/
+mqtt.rs:97-175): background dispatch into a bounded queue; connection loss
+raises ``Disconnection`` for the runtime reconnect loop. QoS 1 messages are
+PUBACKed by the client on receipt (the reference acks manually post-pipeline;
+held-PUBACK support needs client-session replay and is noted as a gap).
+
+Config:
+
+    type: mqtt
+    host: 127.0.0.1
+    port: 1883
+    topics: ["sensors/#"]
+    qos: 1
+    client_id: arkflow-1
+    username: u            # optional
+    password: "${MQTT_PW}" # optional
+    codec: json
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
+from arkflow_tpu.connect.mqtt_client import MqttClient, MqttMessage
+from arkflow_tpu.errors import ConfigError, Disconnection, EndOfInput
+from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
+from arkflow_tpu.utils.auth import resolve_secret
+
+
+class MqttInput(Input):
+    def __init__(self, host: str, port: int, topics: list[str], qos: int,
+                 client_id: str, username: Optional[str], password: Optional[str],
+                 codec=None):
+        if not topics:
+            raise ConfigError("mqtt input requires 'topics'")
+        self.host = host
+        self.port = port
+        self.topics = topics
+        self.qos = qos
+        self.client_id = client_id
+        self.username = username
+        self.password = password
+        self.codec = codec
+        self._client: Optional[MqttClient] = None
+        self._queue: Optional[asyncio.Queue] = None
+        self._closed = False
+
+    async def connect(self) -> None:
+        self._client = MqttClient(
+            self.host, self.port, client_id=self.client_id,
+            username=self.username, password=self.password,
+        )
+        self._queue = asyncio.Queue(maxsize=1000)
+
+        def on_msg(msg: MqttMessage) -> None:
+            try:
+                self._queue.put_nowait(msg)
+            except asyncio.QueueFull:
+                pass
+
+        self._client.on_message(on_msg)
+        await self._client.connect()
+        for t in self.topics:
+            await self._client.subscribe(t, self.qos)
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        if self._closed:
+            raise EndOfInput()
+        while True:
+            try:
+                msg = await asyncio.wait_for(self._queue.get(), timeout=1.0)
+                break
+            except asyncio.TimeoutError:
+                if self._closed:
+                    raise EndOfInput() from None
+                if self._client is not None and not self._client.connected:
+                    raise Disconnection("mqtt connection lost") from None
+        batch = decode_payloads([msg.payload], self.codec)
+        return (
+            batch.with_source("mqtt").with_ext_metadata({"topic": msg.topic}).with_ingest_time(),
+            NoopAck(),
+        )
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._client is not None:
+            await self._client.close()
+
+
+@register_input("mqtt")
+def _build(config: dict, resource: Resource) -> MqttInput:
+    host = config.get("host") or config.get("url")
+    if not host:
+        raise ConfigError("mqtt input requires 'host'")
+    host = str(host).replace("mqtt://", "").replace("tcp://", "")
+    port = int(config.get("port", 1883))
+    if ":" in host:
+        host, _, p = host.partition(":")
+        port = int(p)
+    qos = int(config.get("qos", 0))
+    if qos > 1:
+        raise ConfigError("mqtt QoS 2 is not supported by the native client yet")
+    pw = config.get("password")
+    return MqttInput(
+        host=host,
+        port=port,
+        topics=list(config.get("topics") or ([config["topic"]] if config.get("topic") else [])),
+        qos=qos,
+        client_id=str(config.get("client_id", "arkflow-tpu-in")),
+        username=config.get("username"),
+        password=resolve_secret(str(pw)) if pw else None,
+        codec=build_codec(config.get("codec"), resource),
+    )

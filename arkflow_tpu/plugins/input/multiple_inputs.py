@@ -1,0 +1,98 @@
+"""Fan-in input: run N child inputs concurrently into one stream.
+
+Mirrors the reference's ``multiple_inputs`` (ref: crates/arkflow-plugin/src/
+input/multiple_inputs.rs:50-148): each child gets a reader task feeding a
+shared queue, child names are stamped into ``__meta_source`` and registered in
+``Resource.input_names`` so windowed join buffers know the declared inputs.
+
+Config:
+
+    type: multiple_inputs
+    inputs:
+      - {name: orders, type: memory, messages: [...], codec: json}
+      - {name: users,  type: memory, messages: [...], codec: json}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Ack, Input, Resource, build_component, register_input
+from arkflow_tpu.errors import ConfigError, EndOfInput
+
+logger = logging.getLogger("arkflow.input.multi")
+
+
+class MultipleInputs(Input):
+    def __init__(self, children: list[tuple[str, Input]]):
+        if not children:
+            raise ConfigError("multiple_inputs requires at least one child input")
+        self.children = children
+        self._queue: Optional[asyncio.Queue] = None
+        self._tasks: list[asyncio.Task] = []
+        self._live = 0
+
+    async def connect(self) -> None:
+        self._queue = asyncio.Queue(maxsize=64)
+        self._live = len(self.children)
+        for name, child in self.children:
+            await child.connect()
+            self._tasks.append(asyncio.create_task(self._reader(name, child)))
+
+    async def _reader(self, name: str, child: Input) -> None:
+        try:
+            while True:
+                try:
+                    batch, ack = await child.read()
+                except EndOfInput:
+                    break
+                await self._queue.put((batch.with_source(name), ack))
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("child input %r failed", name)
+        finally:
+            try:
+                self._queue.put_nowait(None)  # child finished marker
+            except asyncio.QueueFull:
+                self._live -= 1  # reader will never see the marker; count it out now
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        while True:
+            if self._live <= 0:
+                raise EndOfInput()
+            item = await self._queue.get()
+            if item is None:
+                self._live -= 1
+                continue
+            return item
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []
+        for _, child in self.children:
+            await child.close()
+
+
+@register_input("multiple_inputs")
+def _build(config: dict, resource: Resource) -> MultipleInputs:
+    raw = config.get("inputs")
+    if not raw or not isinstance(raw, list):
+        raise ConfigError("multiple_inputs requires a non-empty 'inputs' list")
+    children = []
+    for i, c in enumerate(raw):
+        c = dict(c)
+        name = c.pop("name", None) or f"input_{i}"
+        child = build_component("input", c, resource)
+        children.append((name, child))
+        resource.input_names.append(name)  # ref multiple_inputs.rs:129-148
+    return MultipleInputs(children)

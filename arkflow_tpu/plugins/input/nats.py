@@ -1,0 +1,90 @@
+"""NATS input: core subject subscription (+ queue group).
+
+Mirrors the reference's nats input core mode (ref: crates/arkflow-plugin/src/
+input/nats.rs:48-76). JetStream pull-consumer mode (durable acks) is gated —
+the native client speaks core NATS only for now; configs asking for JetStream
+get a clear error rather than silent at-most-once.
+
+Config:
+
+    type: nats
+    url: nats://127.0.0.1:4222
+    subject: events.>
+    queue_group: workers     # optional
+    codec: json
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
+from arkflow_tpu.connect.nats_client import NatsClient, NatsMessage
+from arkflow_tpu.errors import ConfigError, Disconnection, EndOfInput
+from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
+
+
+class NatsInput(Input):
+    def __init__(self, url: str, subject: str, queue_group: Optional[str] = None, codec=None):
+        self.url = url
+        self.subject = subject
+        self.queue_group = queue_group
+        self.codec = codec
+        self._client: Optional[NatsClient] = None
+        self._queue: Optional[asyncio.Queue] = None
+        self._closed = False
+
+    async def connect(self) -> None:
+        self._client = NatsClient(self.url)
+        await self._client.connect()
+        self._queue = asyncio.Queue(maxsize=1000)
+
+        def on_msg(msg: NatsMessage) -> None:
+            try:
+                self._queue.put_nowait(msg)
+            except asyncio.QueueFull:
+                pass
+
+        await self._client.subscribe(self.subject, on_msg, self.queue_group)
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        if self._closed:
+            raise EndOfInput()
+        while True:
+            try:
+                msg = await asyncio.wait_for(self._queue.get(), timeout=1.0)
+                break
+            except asyncio.TimeoutError:
+                if self._closed:
+                    raise EndOfInput() from None
+                if self._client is not None and not self._client.connected:
+                    raise Disconnection("nats connection lost") from None
+        batch = decode_payloads([msg.payload], self.codec)
+        return (
+            batch.with_source("nats").with_ext_metadata({"subject": msg.subject}).with_ingest_time(),
+            NoopAck(),
+        )
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._client is not None:
+            await self._client.close()
+
+
+@register_input("nats")
+def _build(config: dict, resource: Resource) -> NatsInput:
+    subject = config.get("subject")
+    if not subject:
+        raise ConfigError("nats input requires 'subject'")
+    if config.get("jetstream") or config.get("mode") == "jetstream":
+        raise ConfigError(
+            "nats JetStream mode is not supported by the native client yet; core mode only"
+        )
+    return NatsInput(
+        url=str(config.get("url", "nats://127.0.0.1:4222")),
+        subject=str(subject),
+        queue_group=config.get("queue_group"),
+        codec=build_codec(config.get("codec"), resource),
+    )

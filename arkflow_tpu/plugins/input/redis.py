@@ -1,0 +1,139 @@
+"""Redis input: pub/sub channels/patterns or BLPOP list mode.
+
+Mirrors the reference's redis input (ref: crates/arkflow-plugin/src/input/
+redis.rs:45-63,193-245): subscribe mode pumps a background task into a bounded
+queue; list mode BLPOPs. Connection loss raises ``Disconnection`` for the
+runtime's reconnect loop (temporary-vs-permanent triage, redis.rs:85+).
+Cluster mode is gated (single node native).
+
+Config:
+
+    type: redis
+    url: redis://127.0.0.1:6379
+    mode: subscribe              # subscribe | list
+    channels: [events]           # subscribe mode
+    patterns: ["sensor.*"]       # subscribe mode
+    keys: [queue1]               # list mode (BLPOP)
+    codec: json
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
+from arkflow_tpu.errors import ConfigError, Disconnection, EndOfInput
+from arkflow_tpu.connect.redis_client import RedisClient
+from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
+
+
+class RedisInput(Input):
+    def __init__(self, url: str, mode: str, channels: list, patterns: list,
+                 keys: list, codec=None, password: Optional[str] = None):
+        if mode not in ("subscribe", "list"):
+            raise ConfigError(f"redis input mode must be subscribe|list, got {mode!r}")
+        if mode == "subscribe" and not (channels or patterns):
+            raise ConfigError("redis subscribe mode requires 'channels' or 'patterns'")
+        if mode == "list" and not keys:
+            raise ConfigError("redis list mode requires 'keys'")
+        self.url = url
+        self.mode = mode
+        self.channels = channels
+        self.patterns = patterns
+        self.keys = keys
+        self.codec = codec
+        self.password = password
+        self._client: Optional[RedisClient] = None
+        self._queue: Optional[asyncio.Queue] = None
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    async def connect(self) -> None:
+        self._client = RedisClient(self.url, password=self.password)
+        await self._client.connect()
+        if self.mode == "subscribe":
+            self._queue = asyncio.Queue(maxsize=1000)
+
+            def on_msg(channel: bytes, payload: bytes) -> None:
+                try:
+                    self._queue.put_nowait((channel, payload))
+                except asyncio.QueueFull:
+                    pass  # drop under overload, like a slow pub/sub consumer
+
+            self._task = asyncio.create_task(self._pump(on_msg))
+
+    async def _pump(self, on_msg) -> None:
+        try:
+            await self._client.subscribe_loop(self.channels, self.patterns, on_msg)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            if self._queue is not None:
+                try:
+                    self._queue.put_nowait(None)
+                except asyncio.QueueFull:
+                    pass
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        if self._closed:
+            raise EndOfInput()
+        if self.mode == "subscribe":
+            item = await self._queue.get()
+            if item is None:
+                if self._closed:
+                    raise EndOfInput()
+                raise Disconnection("redis pub/sub connection lost")
+            channel, payload = item
+            batch = decode_payloads([payload], self.codec)
+            return (
+                batch.with_source("redis").with_ext_metadata({"channel": channel.decode("utf-8", "replace")}).with_ingest_time(),
+                NoopAck(),
+            )
+        # list mode
+        while not self._closed:
+            try:
+                res = await self._client.blpop(self.keys, timeout_s=1.0)
+            except Exception as e:
+                raise Disconnection(f"redis blpop failed: {e}") from e
+            if res is None:
+                continue
+            key, payload = res
+            batch = decode_payloads([payload], self.codec)
+            return (
+                batch.with_source("redis").with_key(key).with_ingest_time(),
+                NoopAck(),
+            )
+        raise EndOfInput()
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._queue is not None:
+            try:
+                self._queue.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
+        if self._client is not None:
+            await self._client.close()
+
+
+@register_input("redis")
+def _build(config: dict, resource: Resource) -> RedisInput:
+    if config.get("cluster"):
+        raise ConfigError("redis cluster mode is not supported by the native client yet")
+    return RedisInput(
+        url=str(config.get("url", "redis://127.0.0.1:6379")),
+        mode=str(config.get("mode", "subscribe")),
+        channels=list(config.get("channels") or []),
+        patterns=list(config.get("patterns") or []),
+        keys=list(config.get("keys") or []),
+        codec=build_codec(config.get("codec"), resource),
+        password=config.get("password"),
+    )

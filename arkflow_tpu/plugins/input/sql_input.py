@@ -1,0 +1,80 @@
+"""SQL database input: one-shot query, stream result batches, EOF.
+
+Mirrors the reference's sql input (ref: crates/arkflow-plugin/src/input/
+sql.rs:216-323): run a query against a database at connect, stream the result
+as batches, then EOF. sqlite is native (stdlib); MySQL/Postgres/DuckDB drivers
+are not in this image, so those configs raise a clear gating error.
+
+Config:
+
+    type: sql
+    driver: sqlite
+    path: /data/events.db       # sqlite file (or ":memory:")
+    query: "SELECT * FROM events WHERE ts > 0"
+    batch_rows: 8192
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Optional
+
+import pyarrow as pa
+
+from arkflow_tpu.batch import DEFAULT_RECORD_BATCH_ROWS, MessageBatch
+from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
+from arkflow_tpu.errors import ConfigError, EndOfInput, ReadError
+
+_GATED_DRIVERS = {"mysql", "postgres", "postgresql", "duckdb"}
+
+
+class SqliteInput(Input):
+    def __init__(self, path: str, query: str, batch_rows: int):
+        self.path = path
+        self.query = query
+        self.batch_rows = batch_rows
+        self._cursor: Optional[sqlite3.Cursor] = None
+        self._conn: Optional[sqlite3.Connection] = None
+        self._names: list[str] = []
+
+    async def connect(self) -> None:
+        try:
+            self._conn = sqlite3.connect(self.path)
+            self._cursor = self._conn.execute(self.query)
+        except sqlite3.Error as e:
+            raise ConfigError(f"sql input: {e}") from e
+        self._names = [d[0] for d in self._cursor.description or []]
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        if self._cursor is None:
+            raise ReadError("sql input not connected")
+        rows = self._cursor.fetchmany(self.batch_rows)
+        if not rows:
+            raise EndOfInput()
+        cols = list(zip(*rows))
+        arrays = [pa.array(list(c)) for c in cols]
+        rb = pa.RecordBatch.from_arrays(arrays, names=self._names)
+        return MessageBatch(rb).with_source("sql").with_ingest_time(), NoopAck()
+
+    async def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+            self._cursor = None
+
+
+@register_input("sql")
+def _build(config: dict, resource: Resource) -> SqliteInput:
+    driver = str(config.get("driver", "sqlite")).lower()
+    if driver in _GATED_DRIVERS:
+        raise ConfigError(
+            f"sql input driver {driver!r} requires a client library not present in "
+            f"this image; 'sqlite' is available natively"
+        )
+    if driver != "sqlite":
+        raise ConfigError(f"unknown sql driver {driver!r}")
+    query = config.get("query")
+    path = config.get("path")
+    if not query or not path:
+        raise ConfigError("sql input requires 'path' and 'query'")
+    return SqliteInput(str(path), str(query), int(config.get("batch_rows", DEFAULT_RECORD_BATCH_ROWS)))

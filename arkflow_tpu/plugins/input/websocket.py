@@ -1,0 +1,96 @@
+"""WebSocket client input.
+
+Mirrors the reference's tokio-tungstenite input (ref:
+crates/arkflow-plugin/src/input/websocket.rs:91-135): a reader task pumps
+frames into a bounded queue; connection loss surfaces as ``Disconnection`` so
+the runtime's 5s reconnect loop takes over.
+
+Config:
+
+    type: websocket
+    url: ws://host:port/path
+    codec: json
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
+from arkflow_tpu.errors import ConfigError, ConnectError, Disconnection, EndOfInput
+from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
+
+
+class WebsocketInput(Input):
+    def __init__(self, url: str, codec=None):
+        self.url = url
+        self.codec = codec
+        self._queue: Optional[asyncio.Queue] = None
+        self._task: Optional[asyncio.Task] = None
+        self._ws = None
+        self._closed = False
+
+    async def connect(self) -> None:
+        import websockets
+
+        try:
+            self._ws = await websockets.connect(self.url)
+        except Exception as e:
+            raise ConnectError(f"websocket connect failed: {e}") from e
+        self._queue = asyncio.Queue(maxsize=1000)
+        self._task = asyncio.create_task(self._reader())
+
+    async def _reader(self) -> None:
+        try:
+            async for msg in self._ws:
+                payload = msg.encode() if isinstance(msg, str) else bytes(msg)
+                await self._queue.put(payload)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass
+        finally:
+            try:
+                self._queue.put_nowait(None)  # signals disconnect/eof
+            except asyncio.QueueFull:
+                pass  # reader will notice the dead connection via close()
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        if self._closed:
+            raise EndOfInput()
+        payload = await self._queue.get()
+        if payload is None:
+            if self._closed:
+                raise EndOfInput()
+            raise Disconnection("websocket closed")
+        batch = decode_payloads([payload], self.codec)
+        return batch.with_source("websocket").with_ingest_time(), NoopAck()
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._ws is not None:
+            try:
+                await self._ws.close()
+            except Exception:
+                pass
+        if self._queue is not None:
+            try:
+                self._queue.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
+
+
+@register_input("websocket")
+def _build(config: dict, resource: Resource) -> WebsocketInput:
+    url = config.get("url")
+    if not url:
+        raise ConfigError("websocket input requires 'url'")
+    return WebsocketInput(url, codec=build_codec(config.get("codec"), resource))

@@ -1,0 +1,93 @@
+"""HTTP client output: POST/PUT each batch to an endpoint.
+
+Mirrors the reference's reqwest-based output (ref:
+crates/arkflow-plugin/src/output/http.rs): method, headers, auth, timeout,
+one request per encoded payload or one batched body.
+
+Config:
+
+    type: http
+    url: http://host:port/path
+    method: POST
+    headers: {X-Extra: "1"}
+    auth: {type: bearer, token: "${TOKEN}"}
+    timeout: 5s
+    batch_body: true    # true: one request per batch (payloads joined by \n)
+    codec: json
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import aiohttp
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Output, Resource, register_output
+from arkflow_tpu.errors import ConfigError, WriteError
+from arkflow_tpu.plugins.codec.helper import build_codec, encode_batch
+from arkflow_tpu.utils.auth import AuthConfig
+from arkflow_tpu.utils.duration import parse_duration
+
+
+class HttpOutput(Output):
+    def __init__(self, url: str, method: str = "POST", headers: Optional[dict] = None,
+                 timeout_s: float = 30.0, batch_body: bool = True, codec=None):
+        self.url = url
+        self.method = method
+        self.headers = headers or {}
+        self.timeout_s = timeout_s
+        self.batch_body = batch_body
+        self.codec = codec
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def connect(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+        )
+
+    async def write(self, batch: MessageBatch) -> None:
+        if self._session is None:
+            raise WriteError("http output not connected")
+        payloads = encode_batch(batch.strip_metadata(), self.codec)
+        bodies = [b"\n".join(payloads)] if self.batch_body else payloads
+        for body in bodies:
+            try:
+                async with self._session.request(
+                    self.method, self.url, data=body, headers=self.headers
+                ) as resp:
+                    if resp.status >= 400:
+                        text = await resp.text()
+                        raise WriteError(f"http output {resp.status}: {text[:200]}")
+            except aiohttp.ClientError as e:
+                raise WriteError(f"http output failed: {e}") from e
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+@register_output("http")
+def _build(config: dict, resource: Resource) -> HttpOutput:
+    url = config.get("url")
+    if not url:
+        raise ConfigError("http output requires 'url'")
+    headers = dict(config.get("headers") or {})
+    auth = AuthConfig.from_config(config.get("auth"))
+    if auth.kind == "bearer":
+        headers["Authorization"] = f"Bearer {auth.token}"
+    elif auth.kind == "basic":
+        import base64
+
+        headers["Authorization"] = "Basic " + base64.b64encode(
+            f"{auth.username}:{auth.password}".encode()
+        ).decode()
+    return HttpOutput(
+        url=url,
+        method=str(config.get("method", "POST")).upper(),
+        headers=headers,
+        timeout_s=parse_duration(config.get("timeout", 30)),
+        batch_body=bool(config.get("batch_body", True)),
+        codec=build_codec(config.get("codec"), resource),
+    )

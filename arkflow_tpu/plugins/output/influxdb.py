@@ -1,0 +1,184 @@
+"""InfluxDB output: line-protocol writer over HTTP.
+
+Mirrors the reference's influxdb output (ref: crates/arkflow-plugin/src/
+output/influxdb.rs:35-100): tag/field column mappings, batch accumulation
+with a flush interval, bounded retries. The line-protocol encoder is pure
+(testable without a server); transport is aiohttp against the v2 write API.
+
+Config:
+
+    type: influxdb
+    url: http://localhost:8086
+    org: myorg
+    bucket: metrics
+    token: "${INFLUX_TOKEN}"
+    measurement: sensors        # literal, or {expr: "..."} per batch
+    tags: {station: station}    # line tag -> column name
+    fields: {value: value}      # line field -> column name
+    timestamp_column: ts        # optional (epoch ns/ms/s int column)
+    batch_size: 1000
+    flush_interval: 1s
+    retries: 3
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import aiohttp
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Output, Resource, register_output
+from arkflow_tpu.errors import ConfigError, WriteError
+from arkflow_tpu.utils.auth import resolve_secret
+from arkflow_tpu.utils.duration import parse_duration
+from arkflow_tpu.utils.expr import DynValue
+
+
+def _escape_tag(v: str) -> str:
+    return v.replace("\\", "\\\\").replace(",", "\\,").replace(" ", "\\ ").replace("=", "\\=")
+
+
+def _field_value(v) -> Optional[str]:
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, int):
+        return f"{v}i"
+    if isinstance(v, float):
+        return repr(v)
+    if isinstance(v, bytes):
+        v = v.decode("utf-8", "replace")
+    s = str(v).replace("\\", "\\\\").replace('"', '\\"')
+    return f'"{s}"'
+
+
+def encode_lines(batch: MessageBatch, measurement: str, tags: dict[str, str],
+                 fields: dict[str, str], timestamp_column: Optional[str]) -> list[str]:
+    """Pure line-protocol encoding for one batch."""
+    data = batch.record_batch.to_pylist()
+    lines = []
+    for row in data:
+        parts = [_escape_tag(measurement)]
+        for tag_name, col in tags.items():
+            v = row.get(col)
+            if v is not None:
+                parts.append(f"{_escape_tag(tag_name)}={_escape_tag(str(v))}")
+        fvals = []
+        for field_name, col in fields.items():
+            fv = _field_value(row.get(col))
+            if fv is not None:
+                fvals.append(f"{_escape_tag(field_name)}={fv}")
+        if not fvals:
+            continue  # influx requires at least one field
+        line = ",".join(parts) + " " + ",".join(fvals)
+        if timestamp_column and row.get(timestamp_column) is not None:
+            line += f" {int(row[timestamp_column])}"
+        lines.append(line)
+    return lines
+
+
+class InfluxDbOutput(Output):
+    def __init__(self, url: str, org: str, bucket: str, token: str,
+                 measurement: DynValue, tags: dict, fields: dict,
+                 timestamp_column: Optional[str], batch_size: int,
+                 flush_interval_s: float, retries: int):
+        self.write_url = f"{url.rstrip('/')}/api/v2/write?org={org}&bucket={bucket}"
+        self.token = token
+        self.measurement = measurement
+        self.tags = tags
+        self.fields = fields
+        self.timestamp_column = timestamp_column
+        self.batch_size = batch_size
+        self.flush_interval_s = flush_interval_s
+        self.retries = retries
+        self._pending: list[str] = []
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._flusher: Optional[asyncio.Task] = None
+
+    async def connect(self) -> None:
+        self._session = aiohttp.ClientSession(
+            headers={"Authorization": f"Token {self.token}"},
+            timeout=aiohttp.ClientTimeout(total=30),
+        )
+        self._flusher = asyncio.create_task(self._flush_loop())
+
+    #: pending-line cap: beyond this a failing server starts shedding oldest lines
+    MAX_PENDING = 100_000
+
+    async def _flush_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self.flush_interval_s)
+                await self._flush()
+            except asyncio.CancelledError:
+                raise
+            except WriteError as e:
+                # keep the flusher alive; lines were re-queued by _flush
+                logging.getLogger("arkflow.influxdb").warning("%s", e)
+
+    async def _flush(self) -> None:
+        if not self._pending:
+            return
+        lines = self._pending
+        self._pending = []
+        body = "\n".join(lines).encode()
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            try:
+                async with self._session.post(self.write_url, data=body) as resp:
+                    if resp.status < 300:
+                        return
+                    text = await resp.text()
+                    last = WriteError(f"influxdb {resp.status}: {text[:200]}")
+            except aiohttp.ClientError as e:
+                last = e
+            await asyncio.sleep(min(2.0 ** attempt * 0.2, 5.0))
+        # re-queue so data survives a transient outage (bounded)
+        self._pending = (lines + self._pending)[-self.MAX_PENDING:]
+        raise WriteError(f"influxdb write failed after {self.retries + 1} attempts: {last}")
+
+    async def write(self, batch: MessageBatch) -> None:
+        measurement = str(self.measurement.eval_scalar(batch))
+        self._pending.extend(
+            encode_lines(batch, measurement, self.tags, self.fields, self.timestamp_column)
+        )
+        if len(self._pending) >= self.batch_size:
+            await self._flush()
+
+    async def close(self) -> None:
+        if self._flusher is not None:
+            self._flusher.cancel()
+            try:
+                await self._flusher
+            except (asyncio.CancelledError, Exception):
+                pass
+        try:
+            await self._flush()
+        finally:
+            if self._session is not None:
+                await self._session.close()
+                self._session = None
+
+
+@register_output("influxdb")
+def _build(config: dict, resource: Resource) -> InfluxDbOutput:
+    for req in ("url", "org", "bucket", "token", "measurement", "fields"):
+        if not config.get(req):
+            raise ConfigError(f"influxdb output requires {req!r}")
+    return InfluxDbOutput(
+        url=str(config["url"]),
+        org=str(config["org"]),
+        bucket=str(config["bucket"]),
+        token=resolve_secret(str(config["token"])),
+        measurement=DynValue.from_config(config["measurement"], "measurement"),
+        tags=dict(config.get("tags") or {}),
+        fields=dict(config["fields"]),
+        timestamp_column=config.get("timestamp_column"),
+        batch_size=int(config.get("batch_size", 1000)),
+        flush_interval_s=parse_duration(config.get("flush_interval", "1s")),
+        retries=int(config.get("retries", 3)),
+    )

@@ -1,0 +1,94 @@
+"""MQTT output: publish with QoS/retain and dynamic topic.
+
+Mirrors the reference's mqtt output (ref: crates/arkflow-plugin/src/output/
+mqtt.rs; generic-over-client seam for mock testing at mqtt.rs:287-303 — the
+client here is injectable the same way).
+
+Config:
+
+    type: mqtt
+    host: 127.0.0.1
+    port: 1883
+    topic: results/out          # literal or {expr: "..."}
+    qos: 1
+    retain: false
+    codec: json
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Output, Resource, register_output
+from arkflow_tpu.connect.mqtt_client import MqttClient
+from arkflow_tpu.errors import ConfigError, WriteError
+from arkflow_tpu.plugins.codec.helper import build_codec, encode_batch
+from arkflow_tpu.utils.auth import resolve_secret
+from arkflow_tpu.utils.expr import DynValue
+
+
+class MqttOutput(Output):
+    def __init__(self, host: str, port: int, topic: DynValue, qos: int = 0,
+                 retain: bool = False, client_id: str = "arkflow-tpu-out",
+                 username: Optional[str] = None, password: Optional[str] = None,
+                 codec=None, client: Optional[MqttClient] = None):
+        self.host = host
+        self.port = port
+        self.topic = topic
+        self.qos = qos
+        self.retain = retain
+        self.client_id = client_id
+        self.username = username
+        self.password = password
+        self.codec = codec
+        self._client = client  # injectable for tests
+
+    async def connect(self) -> None:
+        if self._client is None:
+            self._client = MqttClient(
+                self.host, self.port, client_id=self.client_id,
+                username=self.username, password=self.password,
+            )
+        await self._client.connect()
+
+    async def write(self, batch: MessageBatch) -> None:
+        if self._client is None:
+            raise WriteError("mqtt output not connected")
+        topic = str(self.topic.eval_scalar(batch))
+        try:
+            for p in encode_batch(batch.strip_metadata(), self.codec):
+                await self._client.publish(topic, p, qos=self.qos, retain=self.retain)
+        except Exception as e:
+            raise WriteError(f"mqtt publish failed: {e}") from e
+
+    async def close(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+
+
+@register_output("mqtt")
+def _build(config: dict, resource: Resource) -> MqttOutput:
+    topic = config.get("topic")
+    if not topic:
+        raise ConfigError("mqtt output requires 'topic'")
+    host = str(config.get("host", "127.0.0.1")).replace("mqtt://", "").replace("tcp://", "")
+    port = int(config.get("port", 1883))
+    if ":" in host:
+        host, _, p = host.partition(":")
+        port = int(p)
+    qos = int(config.get("qos", 0))
+    if qos > 1:
+        raise ConfigError("mqtt QoS 2 is not supported by the native client yet")
+    pw = config.get("password")
+    return MqttOutput(
+        host=host,
+        port=port,
+        topic=DynValue.from_config(topic, "topic"),
+        qos=qos,
+        retain=bool(config.get("retain", False)),
+        client_id=str(config.get("client_id", "arkflow-tpu-out")),
+        username=config.get("username"),
+        password=resolve_secret(str(pw)) if pw else None,
+        codec=build_codec(config.get("codec"), resource),
+    )

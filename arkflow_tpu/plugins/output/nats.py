@@ -1,0 +1,76 @@
+"""NATS output: core publish with dynamic subject.
+
+Mirrors the reference's nats output core mode (ref:
+crates/arkflow-plugin/src/output/nats.rs; subject can be an expression).
+
+Config:
+
+    type: nats
+    url: nats://127.0.0.1:4222
+    subject: results            # literal or {expr: "concat('out.', city)"}
+    codec: json
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Output, Resource, register_output
+from arkflow_tpu.connect.nats_client import NatsClient
+from arkflow_tpu.errors import ConfigError, WriteError
+from arkflow_tpu.plugins.codec.helper import build_codec, encode_batch
+from arkflow_tpu.utils.expr import DynValue
+
+
+class NatsOutput(Output):
+    def __init__(self, url: str, subject: DynValue, codec=None):
+        self.url = url
+        self.subject = subject
+        self.codec = codec
+        self._client: Optional[NatsClient] = None
+
+    async def connect(self) -> None:
+        self._client = NatsClient(self.url)
+        await self._client.connect()
+
+    async def write(self, batch: MessageBatch) -> None:
+        if self._client is None:
+            raise WriteError("nats output not connected")
+        if self.subject.is_expr:
+            # dynamic routing: per-row subjects
+            subjects = self.subject.eval_per_row(batch)
+            payloads = encode_batch(batch.strip_metadata(), self.codec)
+            if len(subjects) != len(payloads):
+                # batch-level encode (e.g. whole-batch codec): use first subject
+                subjects = [subjects[0]] * len(payloads)
+            try:
+                for subj, p in zip(subjects, payloads):
+                    await self._client.publish(str(subj), p)
+            except Exception as e:
+                raise WriteError(f"nats publish failed: {e}") from e
+            return
+        subj = str(self.subject.eval_scalar(batch))
+        try:
+            for p in encode_batch(batch.strip_metadata(), self.codec):
+                await self._client.publish(subj, p)
+        except Exception as e:
+            raise WriteError(f"nats publish failed: {e}") from e
+
+    async def close(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+
+
+@register_output("nats")
+def _build(config: dict, resource: Resource) -> NatsOutput:
+    subject = config.get("subject")
+    if not subject:
+        raise ConfigError("nats output requires 'subject'")
+    if config.get("jetstream"):
+        raise ConfigError("nats JetStream publish is not supported by the native client yet")
+    return NatsOutput(
+        url=str(config.get("url", "nats://127.0.0.1:4222")),
+        subject=DynValue.from_config(subject, "subject"),
+        codec=build_codec(config.get("codec"), resource),
+    )

@@ -1,0 +1,75 @@
+"""Redis output: PUBLISH or list push, with dynamic channel/key.
+
+Mirrors the reference's redis output (ref: crates/arkflow-plugin/src/output/
+redis.rs, mode enum shared with the input at component/redis.rs:23-31).
+
+Config:
+
+    type: redis
+    url: redis://127.0.0.1:6379
+    mode: publish               # publish | lpush | rpush
+    target: results             # channel/key; literal or {expr: "..."}
+    codec: json
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Output, Resource, register_output
+from arkflow_tpu.connect.redis_client import RedisClient
+from arkflow_tpu.errors import ConfigError, WriteError
+from arkflow_tpu.plugins.codec.helper import build_codec, encode_batch
+from arkflow_tpu.utils.expr import DynValue
+
+
+class RedisOutput(Output):
+    def __init__(self, url: str, mode: str, target: DynValue, codec=None,
+                 password: Optional[str] = None):
+        if mode not in ("publish", "lpush", "rpush"):
+            raise ConfigError(f"redis output mode must be publish|lpush|rpush, got {mode!r}")
+        self.url = url
+        self.mode = mode
+        self.target = target
+        self.codec = codec
+        self.password = password
+        self._client: Optional[RedisClient] = None
+
+    async def connect(self) -> None:
+        self._client = RedisClient(self.url, password=self.password)
+        await self._client.connect()
+
+    async def write(self, batch: MessageBatch) -> None:
+        if self._client is None:
+            raise WriteError("redis output not connected")
+        target = str(self.target.eval_scalar(batch))
+        payloads = encode_batch(batch.strip_metadata(), self.codec)
+        try:
+            for p in payloads:
+                if self.mode == "publish":
+                    await self._client.publish(target, p)
+                elif self.mode == "lpush":
+                    await self._client.lpush(target, p)
+                else:
+                    await self._client.rpush(target, p)
+        except Exception as e:
+            raise WriteError(f"redis output failed: {e}") from e
+
+    async def close(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+
+
+@register_output("redis")
+def _build(config: dict, resource: Resource) -> RedisOutput:
+    target = config.get("target") or config.get("channel") or config.get("key")
+    if not target:
+        raise ConfigError("redis output requires 'target'")
+    return RedisOutput(
+        url=str(config.get("url", "redis://127.0.0.1:6379")),
+        mode=str(config.get("mode", "publish")),
+        target=DynValue.from_config(target, "target"),
+        codec=build_codec(config.get("codec"), resource),
+        password=config.get("password"),
+    )

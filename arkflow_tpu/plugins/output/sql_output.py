@@ -1,0 +1,99 @@
+"""SQL database output: INSERT each batch's rows.
+
+Mirrors the reference's sqlx output (ref: crates/arkflow-plugin/src/output/
+sql.rs:138-262): batch rows bind into parameterised INSERTs. sqlite is native;
+MySQL/Postgres are gated (no drivers in this image).
+
+Config:
+
+    type: sql
+    driver: sqlite
+    path: /data/out.db
+    table: results
+    create: true      # create table from batch schema if missing
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Optional
+
+import pyarrow as pa
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Output, Resource, register_output
+from arkflow_tpu.errors import ConfigError, WriteError
+
+
+def _sqlite_type(t: pa.DataType) -> str:
+    if pa.types.is_integer(t) or pa.types.is_boolean(t):
+        return "INTEGER"
+    if pa.types.is_floating(t):
+        return "REAL"
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        return "BLOB"
+    return "TEXT"
+
+
+class SqliteOutput(Output):
+    def __init__(self, path: str, table: str, create: bool = True):
+        self.path = path
+        self.table = table
+        self.create = create
+        self._conn: Optional[sqlite3.Connection] = None
+        self._created = False
+
+    async def connect(self) -> None:
+        self._conn = sqlite3.connect(self.path)
+
+    def _ensure_table(self, batch: MessageBatch) -> None:
+        if self._created or not self.create:
+            return
+        cols = ", ".join(
+            f'"{f.name}" {_sqlite_type(f.type)}' for f in batch.record_batch.schema
+        )
+        self._conn.execute(f'CREATE TABLE IF NOT EXISTS "{self.table}" ({cols})')
+        self._created = True
+
+    async def write(self, batch: MessageBatch) -> None:
+        if self._conn is None:
+            raise WriteError("sql output not connected")
+        data = batch.strip_metadata()
+        if data.num_rows == 0:
+            return
+        self._ensure_table(data)
+        names = ", ".join(f'"{n}"' for n in data.column_names)
+        ph = ", ".join("?" for _ in data.column_names)
+        cols = [c.to_pylist() for c in data.record_batch.columns]
+        rows = [
+            tuple(v if isinstance(v, (int, float, str, bytes, type(None))) else str(v) for v in row)
+            for row in zip(*cols)
+        ]
+        try:
+            self._conn.executemany(
+                f'INSERT INTO "{self.table}" ({names}) VALUES ({ph})', rows
+            )
+            self._conn.commit()
+        except sqlite3.Error as e:
+            raise WriteError(f"sql output insert failed: {e}") from e
+
+    async def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+
+@register_output("sql")
+def _build(config: dict, resource: Resource) -> SqliteOutput:
+    driver = str(config.get("driver", "sqlite")).lower()
+    if driver in ("mysql", "postgres", "postgresql"):
+        raise ConfigError(
+            f"sql output driver {driver!r} requires a client library not present "
+            f"in this image; 'sqlite' is available natively"
+        )
+    if driver != "sqlite":
+        raise ConfigError(f"unknown sql driver {driver!r}")
+    path, table = config.get("path"), config.get("table")
+    if not path or not table:
+        raise ConfigError("sql output requires 'path' and 'table'")
+    return SqliteOutput(str(path), str(table), create=bool(config.get("create", True)))

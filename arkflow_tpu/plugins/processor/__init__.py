@@ -2,3 +2,4 @@ import arkflow_tpu.plugins.processor.json_proc  # noqa: F401
 import arkflow_tpu.plugins.processor.sql  # noqa: F401
 import arkflow_tpu.plugins.processor.batch_proc  # noqa: F401
 import arkflow_tpu.plugins.processor.python_proc  # noqa: F401
+import arkflow_tpu.plugins.processor.tpu_inference  # noqa: F401

@@ -1,0 +1,188 @@
+"""``tpu_inference`` processor: streaming ML inference on XLA.
+
+The reference's ML story is "run user Python under the GIL"
+(ref: crates/arkflow-plugin/src/processor/python.rs); this processor replaces
+that slot with a first-class model-execution provider (BASELINE.json north
+star): resolve a model family from config, bucket/pad the in-flight batch,
+execute the compiled model, and attach outputs as Arrow columns.
+
+Input extraction is driven by the family's ``input_spec``:
+- token models (``("seq",)`` inputs): tokenize ``text_field`` (default the raw
+  ``__value__`` payload) with an HF fast tokenizer or the hermetic hashing
+  fallback;
+- fixed-shape float inputs: read ``tensor_field`` (an Arrow list column,
+  reshaped) or decode raw bytes (images) from a binary column.
+
+Config:
+
+    type: tpu_inference
+    model: bert_classifier
+    model_config: {num_labels: 2}
+    text_field: __value__          # token models
+    tokenizer: bert-base-uncased   # optional (falls back to hashing)
+    max_seq: 128
+    tensor_field: window           # list/binary column for tensor models
+    outputs: [label, score]        # default: all rank-1 outputs
+    batch_buckets: [8, 32, 128]    # default pow2 grid
+    seq_buckets: [32, 64, 128]
+    mesh: {dp: 1, tp: 4}           # optional multi-chip serving
+    checkpoint: /path/to/orbax     # optional
+    warmup: false                  # precompile bucket grid at connect
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import TYPE_CHECKING, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD, MessageBatch
+from arkflow_tpu.components import Processor, Resource, register_processor
+from arkflow_tpu.errors import ConfigError, ProcessError
+from arkflow_tpu.tpu.bucketing import BucketPolicy
+from arkflow_tpu.tpu.tokenizer import build_tokenizer
+
+if TYPE_CHECKING:  # jax-importing modules load lazily in the builder
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+
+class TpuInferenceProcessor(Processor):
+    def __init__(self, runner: ModelRunner, *, text_field: str, tensor_field: Optional[str],
+                 tokenizer, max_seq: int, outputs: Optional[list[str]], warmup: bool = False):
+        self.runner = runner
+        self.text_field = text_field
+        self.tensor_field = tensor_field
+        self.tokenizer = tokenizer
+        self.max_seq = max_seq
+        self.outputs = outputs
+        self._warmed = not warmup
+
+    # -- input extraction --------------------------------------------------
+
+    def _extract(self, batch: MessageBatch) -> dict[str, np.ndarray]:
+        inputs: dict[str, np.ndarray] = {}
+        spec = self.runner.spec
+        needs_tokens = any(t == ("seq",) for _, t in spec.values()) and "input_ids" in spec
+        if needs_tokens:
+            texts = batch.to_binary(self.text_field)
+            # bucket sequence length by the longest text in the batch
+            ids, mask = self.tokenizer.encode_batch(texts, self.max_seq)
+            used = int(mask.sum(axis=1).max()) if mask.size else 1
+            sb = self.runner.buckets.seq_bucket(used)
+            inputs["input_ids"] = ids[:, :sb]
+            if "attention_mask" in spec:
+                inputs["attention_mask"] = mask[:, :sb]
+            return inputs
+        for name, (dtype, trailing) in spec.items():
+            inputs[name] = self._extract_tensor(batch, name, dtype, trailing)
+        return inputs
+
+    def _extract_tensor(self, batch: MessageBatch, name: str, dtype: str, trailing: tuple) -> np.ndarray:
+        field = self.tensor_field or name
+        if not batch.has_column(field):
+            raise ProcessError(
+                f"tpu_inference: column {field!r} not found for model input {name!r}"
+            )
+        col = batch.column(field)
+        n = batch.num_rows
+        want = tuple(int(d) for d in trailing)
+        if pa.types.is_binary(col.type) or pa.types.is_large_binary(col.type):
+            size = int(np.prod(want))
+            rows = []
+            for v in col:
+                buf = v.as_py() or b""
+                arr = np.frombuffer(buf, dtype=np.uint8)
+                if arr.size < size:
+                    arr = np.pad(arr, (0, size - arr.size))
+                rows.append(arr[:size].reshape(want).astype(dtype))
+            out = np.stack(rows) if rows else np.zeros((0, *want), dtype)
+            if dtype == "float32":
+                out = out / np.float32(255.0)
+            return out
+        if pa.types.is_list(col.type) or pa.types.is_fixed_size_list(col.type) or pa.types.is_large_list(col.type):
+            flat = col.flatten().to_numpy(zero_copy_only=False).astype(dtype)
+            try:
+                return flat.reshape(n, *want)
+            except ValueError as e:
+                raise ProcessError(
+                    f"tpu_inference: column {field!r} does not reshape to {want} per row: {e}"
+                ) from e
+        # plain numeric column -> [B] or broadcast error
+        arr = col.to_numpy(zero_copy_only=False).astype(dtype)
+        if want and int(np.prod(want)) != 1:
+            raise ProcessError(
+                f"tpu_inference: column {field!r} is scalar per row but input {name!r} wants {want}"
+            )
+        return arr.reshape(n, *([1] * len(want)))
+
+    # -- output attachment -------------------------------------------------
+
+    def _attach(self, batch: MessageBatch, outputs: dict[str, np.ndarray]) -> MessageBatch:
+        names = self.outputs or [k for k, v in outputs.items() if np.asarray(v).ndim == 1]
+        out = batch
+        for name in names:
+            if name not in outputs:
+                raise ProcessError(
+                    f"tpu_inference: model produced {sorted(outputs)}, no output {name!r}"
+                )
+            v = np.asarray(outputs[name])
+            if v.ndim == 1:
+                out = out.with_column(name, pa.array(v))
+            elif v.ndim == 2:
+                flat = pa.array(v.reshape(-1))
+                out = out.with_column(name, pa.FixedSizeListArray.from_arrays(flat, v.shape[1]))
+            else:
+                raise ProcessError(f"tpu_inference: cannot attach rank-{v.ndim} output {name!r}")
+        return out
+
+    # -- Processor ---------------------------------------------------------
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []
+        if not self._warmed:
+            self._warmed = True
+            await asyncio.get_running_loop().run_in_executor(None, self.runner.warmup)
+        inputs = self._extract(batch)
+        outputs = await self.runner.infer(inputs)
+        return [self._attach(batch, outputs)]
+
+
+@register_processor("tpu_inference")
+def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
+    # deferred: importing jax (and the TPU plugin) only when a model is built
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    model = config.get("model")
+    if not model:
+        raise ConfigError("tpu_inference requires 'model'")
+    max_seq = int(config.get("max_seq", 128))
+    buckets = BucketPolicy.from_config(config, max_seq=max_seq,
+                                       max_batch=int(config.get("max_batch", 256)))
+    mesh_cfg = config.get("mesh") or {}
+    mesh_spec = None
+    if mesh_cfg:
+        mesh_spec = MeshSpec(dp=int(mesh_cfg.get("dp", 1)), tp=int(mesh_cfg.get("tp", 1)),
+                             sp=int(mesh_cfg.get("sp", 1)))
+    runner = ModelRunner(
+        model,
+        config.get("model_config"),
+        buckets=buckets,
+        mesh_spec=mesh_spec,
+        checkpoint=config.get("checkpoint"),
+        seed=int(config.get("seed", 0)),
+    )
+    vocab = getattr(runner.cfg, "vocab_size", 30522)
+    tokenizer = build_tokenizer(config.get("tokenizer"), vocab_size=vocab)
+    return TpuInferenceProcessor(
+        runner,
+        text_field=config.get("text_field", DEFAULT_BINARY_VALUE_FIELD),
+        tensor_field=config.get("tensor_field"),
+        tokenizer=tokenizer,
+        max_seq=max_seq,
+        outputs=config.get("outputs"),
+        warmup=bool(config.get("warmup", False)),
+    )

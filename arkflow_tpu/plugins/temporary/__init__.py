@@ -1,1 +1,2 @@
 import arkflow_tpu.plugins.temporary.memory  # noqa: F401
+import arkflow_tpu.plugins.temporary.redis  # noqa: F401

@@ -1,0 +1,2 @@
+from arkflow_tpu.tpu.bucketing import BucketPolicy  # noqa: F401
+from arkflow_tpu.tpu.runner import ModelRunner  # noqa: F401

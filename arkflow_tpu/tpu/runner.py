@@ -1,0 +1,191 @@
+"""ModelRunner: the XLA execution provider for streaming inference.
+
+This is the TPU-native replacement for the reference's PyO3 Python-processor
+slot (ref: crates/arkflow-plugin/src/processor/python.rs; SURVEY.md section
+3.4): same pipeline position, but batch -> pad-to-bucket -> XLA-compiled
+model -> unpad -> batch.
+
+Responsibilities:
+- Resolve a model family + config, init or restore params.
+- Optionally shard params over a ``Mesh`` (tensor parallel serving).
+- Keep one compiled executable per (batch, seq) bucket warm; ``jax.jit``
+  owns the cache, ``warmup()`` precompiles the bucket grid so steady-state
+  never hits a compile.
+- Run inference off the event loop (``asyncio`` executor) so device sync
+  never stalls the stream's other stages; JAX's async dispatch overlaps the
+  host->device infeed of step n+1 with step n's compute.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.models import get_model
+from arkflow_tpu.obs import global_registry
+from arkflow_tpu.parallel.mesh import MeshSpec, create_mesh, shard_params
+from arkflow_tpu.tpu.bucketing import BucketPolicy, pad_batch_dim, pad_seq_dim
+
+logger = logging.getLogger("arkflow.tpu")
+
+
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        model: str,
+        model_config: Optional[dict] = None,
+        *,
+        buckets: Optional[BucketPolicy] = None,
+        mesh_spec: Optional[MeshSpec] = None,
+        checkpoint: Optional[str] = None,
+        seed: int = 0,
+        devices=None,
+    ):
+        self.family = get_model(model)
+        self.cfg = self.family.make_config(**(model_config or {}))
+        self.buckets = buckets or BucketPolicy()
+        self.spec = self.family.input_spec(self.cfg)
+
+        # init on host CPU (op-by-op init over a remote-TPU tunnel is pathological),
+        # then transfer to the execution device(s) in one hop
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            cpu = None
+        with jax.default_device(cpu) if cpu is not None else _nullcontext():
+            params = self.family.init(jax.random.PRNGKey(seed), self.cfg)
+        if checkpoint:
+            params = self._restore(checkpoint, params)
+
+        self.mesh = None
+        axes: dict[str, str] = {}
+        if mesh_spec is not None and mesh_spec.num_devices > 1:
+            self.mesh = create_mesh(mesh_spec, devices=devices)
+            axes = {name: name for name in self.mesh.axis_names}
+            pspecs = self.family.param_specs(self.cfg, axes) if self.family.param_specs else None
+            params = shard_params(params, pspecs, self.mesh)
+        else:
+            target = (devices[0] if devices else jax.devices()[0])
+            params = jax.device_put(params, target)
+        self.params = params
+        self._axes = axes
+
+        apply_fn = self.family.apply
+
+        def run(params, inputs):
+            return apply_fn(params, self.cfg, **inputs)
+
+        self._jitted = jax.jit(run)
+
+        reg = global_registry()
+        labels = {"model": model}
+        self.m_infer = reg.histogram("arkflow_tpu_infer_seconds", "device step latency", labels)
+        self.m_rows = reg.counter("arkflow_tpu_rows_total", "rows inferred", labels)
+        self.m_pad = reg.counter("arkflow_tpu_pad_rows_total", "padding rows (waste)", labels)
+        self.m_compiles = reg.counter("arkflow_tpu_compiles_total", "bucket compiles", labels)
+        self._seen_shapes: set[tuple] = set()
+
+    # -- checkpoint --------------------------------------------------------
+
+    def _restore(self, path: str, like_params):
+        try:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.StandardCheckpointer()
+            restored = ckptr.restore(path, like_params)
+            logger.info("restored checkpoint from %s", path)
+            return restored
+        except Exception as e:
+            raise ConfigError(f"failed to restore checkpoint {path!r}: {e}") from e
+
+    # -- shape plumbing ----------------------------------------------------
+
+    def _pad_inputs(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
+        """Pad every input to its bucket; returns (padded, true_batch)."""
+        n = next(iter(inputs.values())).shape[0]
+        bb = self.buckets.batch_bucket(n)
+        out = {}
+        for name, (dtype, trailing) in self.spec.items():
+            arr = inputs.get(name)
+            if arr is None:
+                raise ConfigError(f"model {self.family.name!r} missing input {name!r}")
+            arr = np.asarray(arr, dtype=dtype)
+            if "seq" in trailing:
+                sb = self.buckets.seq_bucket(arr.shape[1])
+                arr = pad_seq_dim(arr, sb, axis=1)
+            arr = pad_batch_dim(arr, bb)
+            out[name] = arr
+        self.m_pad.inc(bb - n)
+        return out, n
+
+    def _shape_key(self, padded: dict[str, np.ndarray]) -> tuple:
+        return tuple((k, v.shape) for k, v in sorted(padded.items()))
+
+    # -- execution ---------------------------------------------------------
+
+    def infer_sync(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        """Blocking inference: pad -> device -> unpad.
+
+        Batches larger than the biggest bucket are chunked and the outputs
+        re-concatenated (upstream buffers may over-merge under backpressure).
+        """
+        import time
+
+        n_total = next(iter(inputs.values())).shape[0]
+        mb = self.buckets.max_batch()
+        if n_total > mb:
+            chunks = [
+                self.infer_sync({k: v[i : i + mb] for k, v in inputs.items()})
+                for i in range(0, n_total, mb)
+            ]
+            return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
+
+        padded, n = self._pad_inputs(inputs)
+        key = self._shape_key(padded)
+        if key not in self._seen_shapes:
+            self._seen_shapes.add(key)
+            self.m_compiles.inc()
+        t0 = time.perf_counter()
+        if self.mesh is not None:
+            with self.mesh:
+                out = self._jitted(self.params, padded)
+        else:
+            out = self._jitted(self.params, padded)
+        out = jax.device_get(out)
+        self.m_infer.observe(time.perf_counter() - t0)
+        self.m_rows.inc(n)
+        return {k: np.asarray(v)[:n] for k, v in out.items()}
+
+    async def infer(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.infer_sync, inputs)
+
+    def warmup(self, seq_lens: Optional[list[int]] = None) -> int:
+        """Precompile the bucket grid; returns number of executables built."""
+        count = 0
+        has_seq = any("seq" in t for _, t in self.spec.values())
+        seqs = seq_lens or (list(self.buckets.seq_buckets) if has_seq else [None])
+        for bb in self.buckets.batch_buckets:
+            for sl in seqs:
+                fake = {}
+                for name, (dtype, trailing) in self.spec.items():
+                    dims = tuple(sl if d == "seq" else d for d in trailing)
+                    fake[name] = np.zeros((bb, *dims), dtype=dtype)
+                self.infer_sync(fake)
+                count += 1
+        logger.info("[%s] warmed %d bucket executables", self.family.name, count)
+        return count

@@ -1,0 +1,91 @@
+"""Tokenization for streaming text models.
+
+Prefers a real HuggingFace fast tokenizer when its files are cached locally
+(this image has no network egress); otherwise falls back to a deterministic
+hashing tokenizer so every pipeline stays hermetic. Throughput note: host-side
+tokenization is the classic bottleneck ahead of the TPU (SURVEY.md section 7
+hard part (d)) — the HF fast path releases the GIL and batches internally; the
+fallback is vectorised regex + stable hashing.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence
+
+import numpy as np
+
+from arkflow_tpu import native
+
+_WORD = re.compile(rb"[a-z0-9]+|[^\sa-z0-9]")
+
+
+def _fnv1a32(data: bytes) -> int:
+    h = 2166136261
+    for b in data:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class HashTokenizer:
+    """Deterministic hashing tokenizer: whitespace/punct split, stable ids.
+
+    ids: 0=pad, 1=cls, 2=sep, 3=unk; tokens FNV-1a-hash into [4, vocab).
+    Uses the native C++ batch kernel when available (identical semantics);
+    the Python path is the reference implementation.
+    """
+
+    def __init__(self, vocab_size: int = 30522):
+        self.vocab_size = vocab_size
+        self.pad_id, self.cls_id, self.sep_id = 0, 1, 2
+        self._cache: dict[bytes, int] = {}
+
+    def _token_id(self, tok: bytes) -> int:
+        tid = self._cache.get(tok)
+        if tid is None:
+            tid = 4 + _fnv1a32(tok) % (self.vocab_size - 4)
+            if len(self._cache) < 1_000_000:
+                self._cache[tok] = tid
+        return tid
+
+    def encode_batch(self, texts: Sequence[bytes], max_len: int) -> tuple[np.ndarray, np.ndarray]:
+        raw = [t if isinstance(t, bytes) else t.encode() for t in texts]
+        nat = native.hash_tokenize_batch(raw, max_len, self.vocab_size)
+        if nat is not None:
+            return nat
+        n = len(raw)
+        ids = np.zeros((n, max_len), np.int32)
+        mask = np.zeros((n, max_len), np.int32)
+        for i, t in enumerate(raw):
+            toks = _WORD.findall(t.lower())
+            row = [self.cls_id] + [self._token_id(tok) for tok in toks[: max_len - 2]] + [self.sep_id]
+            ids[i, : len(row)] = row
+            mask[i, : len(row)] = 1
+        return ids, mask
+
+
+class HFTokenizer:
+    """transformers fast-tokenizer wrapper (local files only)."""
+
+    def __init__(self, name: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(name, local_files_only=True, use_fast=True)
+
+    def encode_batch(self, texts: Sequence[bytes], max_len: int) -> tuple[np.ndarray, np.ndarray]:
+        decoded = [t.decode("utf-8", "replace") if isinstance(t, bytes) else t for t in texts]
+        enc = self._tok(
+            decoded, padding="max_length", truncation=True, max_length=max_len,
+            return_tensors="np", return_attention_mask=True,
+        )
+        return enc["input_ids"].astype(np.int32), enc["attention_mask"].astype(np.int32)
+
+
+def build_tokenizer(name: Optional[str], vocab_size: int = 30522):
+    """HF tokenizer when cached locally; hashing fallback otherwise."""
+    if name:
+        try:
+            return HFTokenizer(name)
+        except Exception:
+            pass
+    return HashTokenizer(vocab_size)

@@ -1,0 +1,110 @@
+"""HTTP auth: constant-time credential checks + failed-attempt lockout.
+
+Mirrors the reference's auth middleware (ref:
+crates/arkflow-plugin/src/auth_middleware.rs:37-216): Basic/Bearer credential
+validation with ``hmac.compare_digest`` (the ``subtle`` constant-time
+equivalent) and per-client lockout after repeated failures. Credentials may
+reference environment variables via ``${VAR}``.
+"""
+
+from __future__ import annotations
+
+import base64
+import hmac
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from arkflow_tpu.errors import ConfigError
+
+LOCKOUT_THRESHOLD = 5
+LOCKOUT_SECONDS = 300.0
+
+
+def resolve_secret(value: str) -> str:
+    """``${ENV_NAME}`` indirection for secrets in config files."""
+    if value.startswith("${") and value.endswith("}"):
+        name = value[2:-1]
+        resolved = os.environ.get(name)
+        if resolved is None:
+            raise ConfigError(f"auth: environment variable {name!r} is not set")
+        return resolved
+    return value
+
+
+@dataclass
+class AuthConfig:
+    kind: str  # "basic" | "bearer" | "none"
+    username: Optional[str] = None
+    password: Optional[str] = None
+    token: Optional[str] = None
+
+    @classmethod
+    def from_config(cls, m: Optional[dict]) -> "AuthConfig":
+        if not m:
+            return cls("none")
+        kind = str(m.get("type", "none")).lower()
+        if kind == "basic":
+            user, pw = m.get("username"), m.get("password")
+            if not user or not pw:
+                raise ConfigError("basic auth requires username and password")
+            return cls("basic", resolve_secret(str(user)), resolve_secret(str(pw)))
+        if kind == "bearer":
+            token = m.get("token")
+            if not token:
+                raise ConfigError("bearer auth requires token")
+            return cls("bearer", token=resolve_secret(str(token)))
+        if kind in ("none", ""):
+            return cls("none")
+        raise ConfigError(f"unknown auth type {kind!r}")
+
+
+@dataclass
+class Authenticator:
+    config: AuthConfig
+    _failures: dict[str, list] = field(default_factory=dict)
+
+    def _locked_out(self, client: str) -> bool:
+        entry = self._failures.get(client)
+        if not entry:
+            return False
+        count, first = entry
+        if count < LOCKOUT_THRESHOLD:
+            return False
+        if time.monotonic() - first > LOCKOUT_SECONDS:
+            del self._failures[client]
+            return False
+        return True
+
+    def _record_failure(self, client: str) -> None:
+        entry = self._failures.get(client)
+        if entry is None:
+            self._failures[client] = [1, time.monotonic()]
+        else:
+            entry[0] += 1
+
+    def check(self, authorization: Optional[str], client: str = "?") -> bool:
+        """Validate an Authorization header; tracks lockout per client."""
+        if self.config.kind == "none":
+            return True
+        if self._locked_out(client):
+            return False
+        ok = False
+        if authorization:
+            if self.config.kind == "basic" and authorization.startswith("Basic "):
+                try:
+                    decoded = base64.b64decode(authorization[6:]).decode()
+                    user, _, pw = decoded.partition(":")
+                    ok = hmac.compare_digest(user, self.config.username or "") and hmac.compare_digest(
+                        pw, self.config.password or ""
+                    )
+                except Exception:
+                    ok = False
+            elif self.config.kind == "bearer" and authorization.startswith("Bearer "):
+                ok = hmac.compare_digest(authorization[7:], self.config.token or "")
+        if ok:
+            self._failures.pop(client, None)
+        else:
+            self._record_failure(client)
+        return ok

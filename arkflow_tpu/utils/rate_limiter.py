@@ -1,0 +1,32 @@
+"""Token-bucket rate limiter for the HTTP input.
+
+Mirrors the reference's lock-free CAS bucket (ref:
+crates/arkflow-plugin/src/rate_limiter.rs:24-120) — asyncio is single-threaded
+so plain arithmetic replaces the atomics; semantics (capacity, refill rate,
+non-blocking try_acquire) carry over.
+"""
+
+from __future__ import annotations
+
+import time
+
+from arkflow_tpu.errors import ConfigError
+
+
+class TokenBucket:
+    def __init__(self, capacity: int, refill_per_sec: float):
+        if capacity <= 0 or refill_per_sec <= 0:
+            raise ConfigError("rate limiter needs positive capacity and refill rate")
+        self.capacity = float(capacity)
+        self.refill_per_sec = float(refill_per_sec)
+        self._tokens = float(capacity)
+        self._last = time.monotonic()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        now = time.monotonic()
+        self._tokens = min(self.capacity, self._tokens + (now - self._last) * self.refill_per_sec)
+        self._last = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False

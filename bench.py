@@ -1,0 +1,134 @@
+"""Benchmark: streaming BERT-base classification throughput on one TPU chip.
+
+Drives the real engine end-to-end (generate source -> memory-buffer
+micro-batching -> tpu_inference BERT-base -> drop sink) — the hermetic stand-in
+for BASELINE.json config 2 (Kafka -> BERT-base classify -> Kafka) with broker
+I/O excluded so the number is rows/sec/chip. Prints ONE JSON line.
+
+Env knobs: BENCH_SECONDS (default 15), BENCH_BATCH (256), BENCH_SEQ (32),
+BENCH_TINY=1 for a CPU-sized smoke run.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+
+
+def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
+    model_config = (
+        {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4, "ffn": 64,
+         "max_positions": 64, "num_labels": 2}
+        if tiny
+        else {}
+    )
+    payload = "stream processing on tpu: sensor reading nominal, no anomaly detected"
+    return {
+        "name": "bench",
+        "input": {
+            "type": "generate",
+            "payload": payload,
+            "interval": 0,
+            "batch_size": batch,
+        },
+        "buffer": {"type": "memory", "capacity": batch, "timeout": "5ms"},
+        "pipeline": {
+            "thread_num": 2,
+            "processors": [
+                {
+                    "type": "tpu_inference",
+                    "model": "bert_classifier",
+                    "model_config": model_config,
+                    "max_seq": seq,
+                    "batch_buckets": [batch],
+                    "seq_buckets": [seq],
+                    "outputs": ["label", "score"],
+                    "warmup": True,
+                }
+            ],
+        },
+        "output": {"type": "drop"},
+    }
+
+
+async def run_bench(seconds: float, batch: int, seq: int, tiny: bool) -> dict:
+    from arkflow_tpu.components import ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.obs import global_registry
+    from arkflow_tpu.runtime import build_stream
+
+    import sys
+
+    ensure_plugins_loaded()
+    cfg = StreamConfig.from_mapping(build_stream_config(batch, seq, tiny))
+    print("bench: building model...", file=sys.stderr, flush=True)
+    stream = build_stream(cfg, name="bench")
+    print("bench: model built; compiling + streaming...", file=sys.stderr, flush=True)
+    cancel = asyncio.Event()
+
+    # warmup phase: let the bucket executable compile, then reset counters
+    reg = global_registry()
+    rows_out = stream.m_rows_out
+    e2e = stream.m_e2e_latency
+
+    async def controller():
+        # wait until the first rows flow (compile done), then time the window
+        t_deadline = time.time() + 300
+        while rows_out.value == 0 and time.time() < t_deadline:
+            await asyncio.sleep(0.25)
+        rows_start = rows_out.value
+        t0 = time.perf_counter()
+        await asyncio.sleep(seconds)
+        elapsed = time.perf_counter() - t0
+        cancel.set()
+        controller.result = (rows_out.value - rows_start, elapsed)
+
+    controller.result = (0, 1.0)
+    await asyncio.gather(stream.run(cancel), controller())
+    rows, elapsed = controller.result
+    return {
+        "rows_per_sec": rows / elapsed if elapsed > 0 else 0.0,
+        "p50_ms": e2e.quantile(0.50) * 1000.0,
+        "p99_ms": e2e.quantile(0.99) * 1000.0,
+        "rows": rows,
+        "elapsed_s": elapsed,
+    }
+
+
+def main() -> None:
+    tiny = os.environ.get("BENCH_TINY", "0") == "1"
+    if tiny:  # CPU smoke mode: keep off the TPU tunnel
+        import jax
+
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+    seconds = float(os.environ.get("BENCH_SECONDS", "15"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    seq = int(os.environ.get("BENCH_SEQ", "32"))
+    res = asyncio.run(run_bench(seconds, batch, seq, tiny))
+    baseline = 100_000.0  # BASELINE.json north-star rows/sec/chip
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_classify_rows_per_sec_chip"
+                if not tiny
+                else "bert_tiny_classify_rows_per_sec_cpu",
+                "value": round(res["rows_per_sec"], 1),
+                "unit": "rows/s",
+                "vs_baseline": round(res["rows_per_sec"] / baseline, 4),
+                "detail": {
+                    "p50_ms": round(res["p50_ms"], 2),
+                    "p99_ms": round(res["p99_ms"], 2),
+                    "rows": res["rows"],
+                    "elapsed_s": round(res["elapsed_s"], 2),
+                    "batch": batch,
+                    "seq": seq,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

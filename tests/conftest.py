@@ -13,6 +13,12 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# The axon TPU plugin ignores JAX_PLATFORMS; pin the default device to CPU so
+# tests never compile over the TPU tunnel (bench.py targets the real chip).
+import jax  # noqa: E402
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
 import asyncio
 import inspect
 

@@ -1,0 +1,619 @@
+"""Connector tests against in-process fake servers (the hermetic-source
+pattern of SURVEY.md section 4, extended to network components)."""
+
+import asyncio
+import json
+
+import pyarrow as pa
+import pytest
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import ensure_plugins_loaded, build_component, Resource
+from arkflow_tpu.errors import ConfigError, EndOfInput
+from arkflow_tpu.utils.auth import AuthConfig, Authenticator
+from arkflow_tpu.utils.rate_limiter import TokenBucket
+
+ensure_plugins_loaded()
+
+
+def build(family, cfg):
+    return build_component(family, cfg, Resource())
+
+
+# -- HTTP -------------------------------------------------------------------
+
+
+def test_http_input_roundtrip_auth_and_ratelimit():
+    import aiohttp
+
+    async def go():
+        inp = build("input", {
+            "type": "http", "host": "127.0.0.1", "port": 18091, "path": "/ingest",
+            "auth": {"type": "bearer", "token": "sekret"},
+            "rate_limit": {"capacity": 2, "per_second": 0.001},
+        })
+        await inp.connect()
+        try:
+            async with aiohttp.ClientSession() as s:
+                url = "http://127.0.0.1:18091/ingest"
+                r = await s.post(url, data=b"{}")
+                assert r.status == 401  # no token
+                hdr = {"Authorization": "Bearer sekret"}
+                assert (await s.post(url, data=b'{"a":1}', headers=hdr)).status == 200
+                assert (await s.post(url, data=b'{"a":2}', headers=hdr)).status == 200
+                assert (await s.post(url, data=b'{"a":3}', headers=hdr)).status == 429  # bucket drained
+            batch, ack = await asyncio.wait_for(inp.read(), timeout=2)
+            assert batch.to_binary() == [b'{"a":1}']
+            await ack.ack()
+        finally:
+            await inp.close()
+
+    asyncio.run(go())
+
+
+def test_http_output_posts_batches():
+    from aiohttp import web
+
+    async def go():
+        received = []
+
+        async def handler(req):
+            received.append(await req.read())
+            return web.Response(text="ok")
+
+        app = web.Application()
+        app.router.add_post("/sink", handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", 18092).start()
+        try:
+            out = build("output", {"type": "http", "url": "http://127.0.0.1:18092/sink"})
+            await out.connect()
+            await out.write(MessageBatch.new_binary([b"x", b"y"]).with_source("t"))
+            await out.close()
+        finally:
+            await runner.cleanup()
+        assert received == [b"x\ny"]
+
+    asyncio.run(go())
+
+
+# -- NATS -------------------------------------------------------------------
+
+
+class FakeNatsServer:
+    """Core-protocol fake: INFO/CONNECT/PING/SUB/PUB with subject routing."""
+
+    def __init__(self):
+        self.subs = []  # (writer, subject, sid)
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def _client(self, reader, writer):
+        writer.write(b'INFO {"server_id":"fake","max_payload":1048576}\r\n')
+        await writer.drain()
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                if line.startswith(b"CONNECT"):
+                    continue
+                if line.startswith(b"PING"):
+                    writer.write(b"PONG\r\n")
+                    await writer.drain()
+                elif line.startswith(b"SUB "):
+                    parts = line.strip().split(b" ")
+                    subject, sid = parts[1], parts[-1]
+                    self.subs.append((writer, subject.decode(), sid.decode()))
+                elif line.startswith(b"PUB "):
+                    parts = line.strip().split(b" ")
+                    subject = parts[1].decode()
+                    nbytes = int(parts[-1])
+                    payload = await reader.readexactly(nbytes)
+                    await reader.readexactly(2)
+                    for w, sub, sid in self.subs:
+                        if sub == subject or sub.endswith(">"):
+                            w.write(
+                                f"MSG {subject} {sid} {len(payload)}\r\n".encode() + payload + b"\r\n"
+                            )
+                            await w.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return
+
+    async def stop(self):
+        self.server.close()
+        # 3.12 Server.wait_closed can hang even with all handlers done; bound it
+        try:
+            await asyncio.wait_for(self.server.wait_closed(), 1.0)
+        except asyncio.TimeoutError:
+            pass
+
+
+def test_nats_input_output_roundtrip():
+    async def go():
+        srv = FakeNatsServer()
+        await srv.start()
+        try:
+            url = f"nats://127.0.0.1:{srv.port}"
+            inp = build("input", {"type": "nats", "url": url, "subject": "events"})
+            out = build("output", {"type": "nats", "url": url, "subject": "events"})
+            await inp.connect()
+            await out.connect()
+            await out.write(MessageBatch.new_binary([b"hello"]))
+            batch, _ = await asyncio.wait_for(inp.read(), timeout=3)
+            assert batch.to_binary() == [b"hello"]
+            assert batch.get_meta("__meta_ext_subject") == "events"
+            await inp.close()
+            await out.close()
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_nats_jetstream_gated():
+    with pytest.raises(ConfigError):
+        build("input", {"type": "nats", "subject": "x", "jetstream": True})
+
+
+# -- Redis ------------------------------------------------------------------
+
+
+class FakeRedisServer:
+    """RESP2 fake: SUBSCRIBE/PUBLISH/LPUSH/BLPOP/MGET/LRANGE/AUTH/SELECT."""
+
+    def __init__(self):
+        self.lists = {}
+        self.kv = {}
+        self.subscribers = []  # (writer, channels)
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    @staticmethod
+    def _bulk(v):
+        if v is None:
+            return b"$-1\r\n"
+        if isinstance(v, str):
+            v = v.encode()
+        return b"$%d\r\n%s\r\n" % (len(v), v)
+
+    async def _read_command(self, reader):
+        line = await reader.readline()
+        if not line:
+            return None
+        assert line[:1] == b"*"
+        n = int(line[1:-2])
+        args = []
+        for _ in range(n):
+            hl = await reader.readline()
+            ln = int(hl[1:-2])
+            data = await reader.readexactly(ln + 2)
+            args.append(data[:-2])
+        return args
+
+    async def _client(self, reader, writer):
+        try:
+            while True:
+                args = await self._read_command(reader)
+                if args is None:
+                    return
+                cmd = args[0].upper()
+                if cmd in (b"AUTH", b"SELECT"):
+                    writer.write(b"+OK\r\n")
+                elif cmd in (b"LPUSH", b"RPUSH"):
+                    lst = self.lists.setdefault(args[1], [])
+                    if cmd == b"LPUSH":
+                        lst.insert(0, args[2])
+                    else:
+                        lst.append(args[2])
+                    writer.write(b":%d\r\n" % len(lst))
+                elif cmd == b"BLPOP":
+                    keys = args[1:-1]
+                    popped = None
+                    for k in keys:
+                        if self.lists.get(k):
+                            popped = (k, self.lists[k].pop(0))
+                            break
+                    if popped:
+                        writer.write(b"*2\r\n" + self._bulk(popped[0]) + self._bulk(popped[1]))
+                    else:
+                        await asyncio.sleep(0.05)
+                        writer.write(b"*-1\r\n")
+                elif cmd == b"MGET":
+                    writer.write(b"*%d\r\n" % (len(args) - 1))
+                    for k in args[1:]:
+                        writer.write(self._bulk(self.kv.get(k)))
+                elif cmd == b"LRANGE":
+                    vals = self.lists.get(args[1], [])
+                    writer.write(b"*%d\r\n" % len(vals))
+                    for v in vals:
+                        writer.write(self._bulk(v))
+                elif cmd == b"SUBSCRIBE":
+                    for ch in args[1:]:
+                        writer.write(b"*3\r\n" + self._bulk(b"subscribe") + self._bulk(ch) + b":1\r\n")
+                        self.subscribers.append((writer, ch))
+                elif cmd == b"PUBLISH":
+                    ch, payload = args[1], args[2]
+                    n = 0
+                    for w, sub in self.subscribers:
+                        if sub == ch:
+                            w.write(b"*3\r\n" + self._bulk(b"message") + self._bulk(ch) + self._bulk(payload))
+                            n += 1
+                    writer.write(b":%d\r\n" % n)
+                else:
+                    writer.write(b"-ERR unknown command\r\n")
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, AssertionError):
+            return
+
+    async def stop(self):
+        self.server.close()
+        # 3.12 Server.wait_closed can hang even with all handlers done; bound it
+        try:
+            await asyncio.wait_for(self.server.wait_closed(), 1.0)
+        except asyncio.TimeoutError:
+            pass
+
+
+def test_redis_list_input_and_output():
+    async def go():
+        srv = FakeRedisServer()
+        await srv.start()
+        try:
+            url = f"redis://127.0.0.1:{srv.port}"
+            out = build("output", {"type": "redis", "url": url, "mode": "rpush", "target": "q"})
+            await out.connect()
+            await out.write(MessageBatch.new_binary([b"one", b"two"]))
+            inp = build("input", {"type": "redis", "url": url, "mode": "list", "keys": ["q"]})
+            await inp.connect()
+            b1, _ = await asyncio.wait_for(inp.read(), timeout=3)
+            b2, _ = await asyncio.wait_for(inp.read(), timeout=3)
+            assert b1.to_binary() == [b"one"]
+            assert b2.to_binary() == [b"two"]
+            assert b1.get_meta("__meta_key") == b"q"
+            await inp.close()
+            await out.close()
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_redis_pubsub_roundtrip():
+    async def go():
+        srv = FakeRedisServer()
+        await srv.start()
+        try:
+            url = f"redis://127.0.0.1:{srv.port}"
+            inp = build("input", {"type": "redis", "url": url, "mode": "subscribe",
+                                  "channels": ["events"]})
+            await inp.connect()
+            await asyncio.sleep(0.05)  # let SUBSCRIBE land
+            out = build("output", {"type": "redis", "url": url, "mode": "publish",
+                                   "target": "events"})
+            await out.connect()
+            await out.write(MessageBatch.new_binary([b"ping"]))
+            batch, _ = await asyncio.wait_for(inp.read(), timeout=3)
+            assert batch.to_binary() == [b"ping"]
+            assert batch.get_meta("__meta_ext_channel") == "events"
+            await inp.close()
+            await out.close()
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_redis_temporary_mget():
+    async def go():
+        srv = FakeRedisServer()
+        await srv.start()
+        srv.kv[b"dev:1"] = b'{"dev": 1, "label": "pump"}'
+        srv.kv[b"dev:2"] = b'{"dev": 2, "label": "valve"}'
+        try:
+            url = f"redis://127.0.0.1:{srv.port}"
+            temp = build("temporary", {"type": "redis", "url": url, "key_prefix": "dev:",
+                                       "codec": "json"})
+            await temp.connect()
+            batch = await temp.get([1, 2, 99])
+            assert batch.num_rows == 2
+            assert batch.column("label").to_pylist() == ["pump", "valve"]
+            await temp.close()
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())
+
+
+# -- MQTT -------------------------------------------------------------------
+
+
+class FakeMqttBroker:
+    """3.1.1 fake: CONNACK, SUBACK, PUBACK, routes PUBLISH to subscribers."""
+
+    def __init__(self):
+        self.subs = []  # (writer, topic_filter)
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    @staticmethod
+    def _match(filt: str, topic: str) -> bool:
+        if filt == topic or filt == "#":
+            return True
+        fp, tp = filt.split("/"), topic.split("/")
+        for i, f in enumerate(fp):
+            if f == "#":
+                return True
+            if i >= len(tp) or (f != "+" and f != tp[i]):
+                return False
+        return len(fp) == len(tp)
+
+    async def _read_packet(self, reader):
+        h = await reader.readexactly(1)
+        mult, value = 1, 0
+        while True:
+            b = (await reader.readexactly(1))[0]
+            value += (b & 0x7F) * mult
+            if not b & 0x80:
+                break
+            mult *= 128
+        body = await reader.readexactly(value) if value else b""
+        return h[0] >> 4, h[0] & 0x0F, body
+
+    async def _client(self, reader, writer):
+        try:
+            while True:
+                ptype, flags, body = await self._read_packet(reader)
+                if ptype == 1:  # CONNECT
+                    writer.write(bytes([0x20, 2, 0, 0]))
+                elif ptype == 8:  # SUBSCRIBE
+                    pid = body[:2]
+                    tlen = int.from_bytes(body[2:4], "big")
+                    topic = body[4 : 4 + tlen].decode()
+                    self.subs.append((writer, topic))
+                    writer.write(bytes([0x90, 3]) + pid + bytes([0]))
+                elif ptype == 3:  # PUBLISH
+                    qos = (flags >> 1) & 3
+                    tlen = int.from_bytes(body[:2], "big")
+                    topic = body[2 : 2 + tlen].decode()
+                    pos = 2 + tlen
+                    if qos:
+                        pid = body[pos : pos + 2]
+                        pos += 2
+                        writer.write(bytes([0x40, 2]) + pid)
+                    payload = body[pos:]
+                    frame = (
+                        bytes([0x30])
+                        + bytes([len(topic.encode()) + 2 + len(payload)])
+                        + len(topic.encode()).to_bytes(2, "big")
+                        + topic.encode()
+                        + payload
+                    )
+                    for w, filt in self.subs:
+                        if self._match(filt, topic):
+                            w.write(frame)
+                elif ptype == 12:  # PINGREQ
+                    writer.write(bytes([0xD0, 0]))
+                elif ptype == 14:  # DISCONNECT
+                    return
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return
+
+    async def stop(self):
+        self.server.close()
+        # 3.12 Server.wait_closed can hang even with all handlers done; bound it
+        try:
+            await asyncio.wait_for(self.server.wait_closed(), 1.0)
+        except asyncio.TimeoutError:
+            pass
+
+
+def test_mqtt_roundtrip_qos1():
+    async def go():
+        broker = FakeMqttBroker()
+        await broker.start()
+        try:
+            inp = build("input", {"type": "mqtt", "host": "127.0.0.1", "port": broker.port,
+                                  "topics": ["sensors/#"], "qos": 1})
+            await inp.connect()
+            out = build("output", {"type": "mqtt", "host": "127.0.0.1", "port": broker.port,
+                                   "topic": "sensors/t1", "qos": 1})
+            await out.connect()
+            await out.write(MessageBatch.new_binary([b'{"t": 1}']))
+            batch, _ = await asyncio.wait_for(inp.read(), timeout=3)
+            assert batch.to_binary() == [b'{"t": 1}']
+            assert batch.get_meta("__meta_ext_topic") == "sensors/t1"
+            await inp.close()
+            await out.close()
+        finally:
+            await broker.stop()
+
+    asyncio.run(go())
+
+
+def test_mqtt_qos2_gated():
+    with pytest.raises(ConfigError):
+        build("input", {"type": "mqtt", "host": "h", "topics": ["t"], "qos": 2})
+
+
+# -- file / sqlite ----------------------------------------------------------
+
+
+def test_file_input_parquet_with_query(tmp_path):
+    import pyarrow.parquet as pq
+
+    path = tmp_path / "events.parquet"
+    pq.write_table(pa.table({"x": list(range(100)), "y": ["a"] * 100}), path)
+
+    async def go():
+        inp = build("input", {"type": "file", "path": str(path),
+                              "query": "SELECT x FROM flow WHERE x >= 98"})
+        await inp.connect()
+        batch, _ = await inp.read()
+        assert batch.column("x").to_pylist() == [98, 99]
+        with pytest.raises(EndOfInput):
+            await inp.read()
+
+    asyncio.run(go())
+
+
+def test_file_input_csv_and_json(tmp_path):
+    csv = tmp_path / "d.csv"
+    csv.write_text("a,b\n1,x\n2,y\n")
+    jsonl = tmp_path / "d.jsonl"
+    jsonl.write_text('{"a": 5}\n{"a": 6}\n')
+
+    async def go():
+        inp = build("input", {"type": "file", "path": [str(csv), str(jsonl)]})
+        await inp.connect()
+        b1, _ = await inp.read()
+        assert b1.column("a").to_pylist() == [1, 2]
+        b2, _ = await inp.read()
+        assert b2.column("a").to_pylist() == [5, 6]
+
+    asyncio.run(go())
+
+
+def test_sqlite_input_output_roundtrip(tmp_path):
+    db = tmp_path / "t.db"
+
+    async def go():
+        out = build("output", {"type": "sql", "path": str(db), "table": "results"})
+        await out.connect()
+        await out.write(MessageBatch.from_pydict({"a": [1, 2], "b": ["x", "y"]}))
+        await out.close()
+        inp = build("input", {"type": "sql", "path": str(db),
+                              "query": "SELECT a, b FROM results ORDER BY a"})
+        await inp.connect()
+        batch, _ = await inp.read()
+        assert batch.column("a").to_pylist() == [1, 2]
+        assert batch.column("b").to_pylist() == ["x", "y"]
+        with pytest.raises(EndOfInput):
+            await inp.read()
+        await inp.close()
+
+    asyncio.run(go())
+
+
+def test_sql_gated_drivers():
+    with pytest.raises(ConfigError):
+        build("input", {"type": "sql", "driver": "postgres", "path": "x", "query": "SELECT 1"})
+    with pytest.raises(ConfigError):
+        build("output", {"type": "sql", "driver": "mysql", "path": "x", "table": "t"})
+
+
+# -- websocket ----------------------------------------------------------------
+
+
+def test_websocket_input():
+    import websockets
+
+    async def go():
+        async def handler(ws):
+            await ws.send('{"v": 1}')
+            await ws.send(b'{"v": 2}')
+            await asyncio.sleep(0.5)
+
+        async with websockets.serve(handler, "127.0.0.1", 0) as server:
+            port = server.sockets[0].getsockname()[1]
+            inp = build("input", {"type": "websocket", "url": f"ws://127.0.0.1:{port}"})
+            await inp.connect()
+            b1, _ = await asyncio.wait_for(inp.read(), timeout=3)
+            b2, _ = await asyncio.wait_for(inp.read(), timeout=3)
+            assert b1.to_binary() == [b'{"v": 1}']
+            assert b2.to_binary() == [b'{"v": 2}']
+            await inp.close()
+
+    asyncio.run(go())
+
+
+# -- influxdb -----------------------------------------------------------------
+
+
+def test_influx_line_protocol_encoding():
+    from arkflow_tpu.plugins.output.influxdb import encode_lines
+
+    batch = MessageBatch.from_pydict(
+        {"station": ["eu 1", "us,2"], "value": [1.5, 2], "ok": [True, False], "ts": [100, 200]}
+    )
+    lines = encode_lines(batch, "m1", {"station": "station"}, {"value": "value", "ok": "ok"}, "ts")
+    assert lines[0] == 'm1,station=eu\\ 1 value=1.5,ok=true 100'
+    assert lines[1] == 'm1,station=us\\,2 value=2.0,ok=false 200'
+
+
+def test_influx_output_flush_and_retry():
+    from aiohttp import web
+
+    async def go():
+        bodies = []
+        fail_first = {"n": 1}
+
+        async def handler(req):
+            if fail_first["n"] > 0:
+                fail_first["n"] -= 1
+                return web.Response(status=500, text="boom")
+            bodies.append(await req.read())
+            return web.Response(status=204)
+
+        app = web.Application()
+        app.router.add_post("/api/v2/write", handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", 18093).start()
+        try:
+            out = build("output", {
+                "type": "influxdb", "url": "http://127.0.0.1:18093", "org": "o",
+                "bucket": "b", "token": "t", "measurement": "m",
+                "fields": {"v": "v"}, "batch_size": 1, "retries": 2,
+            })
+            await out.connect()
+            await out.write(MessageBatch.from_pydict({"v": [1.0]}))
+            await out.close()
+        finally:
+            await runner.cleanup()
+        assert bodies == [b"m v=1.0"]
+
+    asyncio.run(go())
+
+
+# -- auth/rate-limit units -----------------------------------------------------
+
+
+def test_authenticator_lockout():
+    auth = Authenticator(AuthConfig("bearer", token="good"))
+    assert auth.check("Bearer good", "c1")
+    for _ in range(5):
+        assert not auth.check("Bearer bad", "c2")
+    # locked out now, even with the right token
+    assert not auth.check("Bearer good", "c2")
+    assert auth.check("Bearer good", "c3")  # other clients unaffected
+
+
+def test_auth_env_resolution(monkeypatch):
+    monkeypatch.setenv("PW_X", "hunter2")
+    cfg = AuthConfig.from_config({"type": "basic", "username": "u", "password": "${PW_X}"})
+    assert cfg.password == "hunter2"
+    with pytest.raises(ConfigError):
+        AuthConfig.from_config({"type": "basic", "username": "u", "password": "${NOPE_Y}"})
+
+
+def test_token_bucket():
+    tb = TokenBucket(2, 1000.0)
+    assert tb.try_acquire() and tb.try_acquire()
+    # immediate third acquire may pass only if refill happened; drain fully first
+    tb._tokens = 0.0
+    assert not tb.try_acquire()

@@ -1,0 +1,205 @@
+"""TPU execution layer: bucketing, runner, tokenizer, and the e2e inference slice."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import ensure_plugins_loaded
+from arkflow_tpu.config import StreamConfig
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.runtime import build_stream
+from arkflow_tpu.tpu.bucketing import BucketPolicy, pad_batch_dim, pow2_buckets
+from arkflow_tpu.tpu.runner import ModelRunner
+from arkflow_tpu.tpu.tokenizer import HashTokenizer, build_tokenizer
+
+ensure_plugins_loaded()
+
+TINY_BERT = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4, "ffn": 64,
+             "max_positions": 64, "num_labels": 2}
+
+
+def test_pow2_buckets():
+    assert pow2_buckets(8, 128) == [8, 16, 32, 64, 128]
+    assert pow2_buckets(8, 100) == [8, 16, 32, 64, 100]
+    assert pow2_buckets(4, 4) == [4]
+
+
+def test_bucket_policy_pick():
+    p = BucketPolicy((8, 32, 128), (16, 64))
+    assert p.batch_bucket(1) == 8
+    assert p.batch_bucket(9) == 32
+    assert p.batch_bucket(500) == 128  # clamps to max
+    assert p.seq_bucket(17) == 64
+
+
+def test_pad_batch_dim():
+    a = np.ones((3, 5))
+    out = pad_batch_dim(a, 8)
+    assert out.shape == (8, 5)
+    assert out[3:].sum() == 0
+    with pytest.raises(ValueError):
+        pad_batch_dim(np.ones((9, 2)), 8)
+
+
+def test_hash_tokenizer_deterministic():
+    tok = HashTokenizer(1000)
+    ids1, mask1 = tok.encode_batch([b"hello world", b"foo"], 16)
+    ids2, _ = tok.encode_batch([b"hello world", b"foo"], 16)
+    np.testing.assert_array_equal(ids1, ids2)
+    assert ids1.shape == (2, 16)
+    assert mask1[0].sum() == 4  # cls + 2 tokens + sep
+    assert mask1[1].sum() == 3
+    assert build_tokenizer(None, 1000).__class__ is HashTokenizer
+
+
+def test_runner_pads_and_unpads():
+    runner = ModelRunner("bert_classifier", TINY_BERT,
+                         buckets=BucketPolicy((4, 8), (16, 32)))
+    ids = np.ones((3, 10), np.int32)
+    mask = np.ones((3, 10), np.int32)
+    out = runner.infer_sync({"input_ids": ids, "attention_mask": mask})
+    assert out["label"].shape == (3,)  # unpadded back to true rows
+    assert out["logits"].shape == (3, 2)
+
+
+def test_runner_bucket_reuse_no_retrace():
+    runner = ModelRunner("bert_classifier", TINY_BERT,
+                         buckets=BucketPolicy((4, 8), (16,)))
+    for n in (2, 3, 4):  # all land in the 4-bucket
+        runner.infer_sync({"input_ids": np.ones((n, 16), np.int32),
+                           "attention_mask": np.ones((n, 16), np.int32)})
+    assert len(runner._seen_shapes) == 1
+    runner.infer_sync({"input_ids": np.ones((5, 16), np.int32),
+                       "attention_mask": np.ones((5, 16), np.int32)})
+    assert len(runner._seen_shapes) == 2
+
+
+def test_runner_padding_does_not_change_results():
+    """Rows must score identically whether alone or padded into a bucket."""
+    runner = ModelRunner("bert_classifier", TINY_BERT,
+                         buckets=BucketPolicy((4, 8), (16,)))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 512, (3, 16)).astype(np.int32)
+    mask = np.ones((3, 16), np.int32)
+    full = runner.infer_sync({"input_ids": ids, "attention_mask": mask})
+    one = runner.infer_sync({"input_ids": ids[:1], "attention_mask": mask[:1]})
+    np.testing.assert_allclose(full["logits"][0], one["logits"][0], atol=2e-2)
+
+
+def test_runner_unknown_model():
+    with pytest.raises(ConfigError):
+        ModelRunner("nope", {})
+
+
+def test_e2e_streaming_bert_classification():
+    """The minimum end-to-end slice (SURVEY.md section 7 step 4):
+    generate -> memory buffer micro-batching -> tpu_inference -> sink."""
+    from tests.test_runtime import CollectOutput
+
+    cfg = StreamConfig.from_mapping(
+        {
+            "input": {"type": "memory",
+                      "messages": [f"sensor event number {i} looks fine" for i in range(10)]},
+            "buffer": {"type": "memory", "capacity": 4, "timeout": "20ms"},
+            "pipeline": {
+                "thread_num": 1,
+                "processors": [
+                    {
+                        "type": "tpu_inference",
+                        "model": "bert_classifier",
+                        "model_config": TINY_BERT,
+                        "max_seq": 32,
+                        "batch_buckets": [4, 8],
+                        "seq_buckets": [16, 32],
+                        "outputs": ["label", "score"],
+                    }
+                ],
+            },
+            "output": {"type": "drop"},
+        }
+    )
+    stream = build_stream(cfg)
+    sink = CollectOutput()
+    stream.output = sink
+    asyncio.run(stream.run(asyncio.Event()))
+    assert sink.dropped_rows == 10
+    for b in sink.batches:
+        assert b.has_column("label") and b.has_column("score")
+        assert b.has_column("__value__")  # original payload carried through
+        labels = b.column("label").to_pylist()
+        assert all(l in (0, 1) for l in labels)
+
+
+def test_e2e_lstm_ae_tensor_field():
+    """MQTT-telemetry-shaped config: list column -> LSTM-AE anomaly score."""
+    from tests.test_runtime import CollectOutput
+    import json
+
+    window, feats = 8, 2
+    msgs = []
+    for i in range(6):
+        vals = (np.ones((window, feats)) * (10.0 if i == 3 else 0.1)).reshape(-1).tolist()
+        msgs.append(json.dumps({"window": vals}))
+    cfg = StreamConfig.from_mapping(
+        {
+            "input": {"type": "memory", "messages": msgs, "codec": "json"},
+            "pipeline": {
+                "thread_num": 1,
+                "processors": [
+                    {
+                        "type": "tpu_inference",
+                        "model": "lstm_ae",
+                        "model_config": {"features": feats, "hidden": 8, "latent": 4, "window": window},
+                        "tensor_field": "window",
+                        "batch_buckets": [4, 8],
+                        "outputs": ["score"],
+                    }
+                ],
+            },
+            "output": {"type": "drop"},
+        }
+    )
+    stream = build_stream(cfg)
+    sink = CollectOutput()
+    stream.output = sink
+    asyncio.run(stream.run(asyncio.Event()))
+    scores = [v for b in sink.batches for v in b.column("score").to_pylist()]
+    assert len(scores) == 6
+    assert scores[3] == max(scores)  # the outlier window scores highest
+
+
+def test_vit_embedding_output_as_fixed_list():
+    """rank-2 outputs (embeddings) attach as FixedSizeList columns."""
+    from tests.test_runtime import CollectOutput
+
+    size = 32
+    img = bytes(range(256)) * ((size * size * 3) // 256)
+    cfg = StreamConfig.from_mapping(
+        {
+            "input": {"type": "memory", "messages": [img, img]},
+            "pipeline": {
+                "thread_num": 1,
+                "processors": [
+                    {
+                        "type": "tpu_inference",
+                        "model": "vit_embedder",
+                        "model_config": {"image_size": size, "patch": 16, "hidden": 32,
+                                         "layers": 1, "heads": 4, "ffn": 64},
+                        "tensor_field": "__value__",
+                        "batch_buckets": [2],
+                        "outputs": ["embedding"],
+                    }
+                ],
+            },
+            "output": {"type": "drop"},
+        }
+    )
+    stream = build_stream(cfg)
+    sink = CollectOutput()
+    stream.output = sink
+    asyncio.run(stream.run(asyncio.Event()))
+    cols = [b.column("embedding") for b in sink.batches]
+    assert all(c.type.list_size == 32 for c in cols)
+    assert sum(len(c) for c in cols) == 2

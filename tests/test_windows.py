@@ -1,0 +1,137 @@
+"""Window buffers + fan-in join tests (ref buffer family, SURVEY.md section 2.5)."""
+
+import asyncio
+
+import pytest
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import NoopAck, VecAck, ensure_plugins_loaded
+from arkflow_tpu.config import StreamConfig
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.plugins.buffer.window import SessionWindow, SlidingWindow, TumblingWindow
+from arkflow_tpu.runtime import build_stream
+from tests.test_runtime import CollectOutput, CountingAck
+
+ensure_plugins_loaded()
+
+
+def mb(i: int) -> MessageBatch:
+    return MessageBatch.from_pydict({"i": [i]})
+
+
+def test_tumbling_window_emits_on_interval():
+    async def go():
+        w = TumblingWindow(0.05)
+        for i in range(3):
+            await w.write(mb(i), NoopAck())
+        batch, ack = await asyncio.wait_for(w.read(), timeout=2)
+        assert batch.column("i").to_pylist() == [0, 1, 2]
+        # next window
+        await w.write(mb(9), NoopAck())
+        batch2, _ = await asyncio.wait_for(w.read(), timeout=2)
+        assert batch2.column("i").to_pylist() == [9]
+
+    asyncio.run(go())
+
+
+def test_tumbling_window_flush_on_close():
+    async def go():
+        w = TumblingWindow(60.0)  # long interval: only close flushes
+        await w.write(mb(1), NoopAck())
+        await w.close()
+        batch, _ = await asyncio.wait_for(w.read(), timeout=2)
+        assert batch.column("i").to_pylist() == [1]
+        assert await w.read() is None
+
+    asyncio.run(go())
+
+
+def test_sliding_window_overlap_and_acks():
+    async def go():
+        acked: list = []
+        w = SlidingWindow(window_size=3, slide_size=2)
+        for i in range(4):
+            await w.write(mb(i), CountingAck(acked))
+        # first emit after 2 arrivals: window = last 3 of [0,1] -> [0,1]
+        b1, a1 = await asyncio.wait_for(w.read(), timeout=2)
+        assert b1.column("i").to_pylist() == [0, 1]
+        b2, a2 = await asyncio.wait_for(w.read(), timeout=2)
+        assert b2.column("i").to_pylist() == [1, 2, 3]
+        await a1.ack()
+        await a2.ack()
+        assert len(acked) == 3  # 0 expired in first slide; 1,2 in second
+
+    asyncio.run(go())
+
+
+def test_session_window_gap():
+    async def go():
+        w = SessionWindow(0.05)
+        await w.write(mb(1), NoopAck())
+        await w.write(mb(2), NoopAck())
+        t0 = asyncio.get_running_loop().time()
+        batch, _ = await asyncio.wait_for(w.read(), timeout=2)
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert batch.column("i").to_pylist() == [1, 2]
+        assert elapsed >= 0.04  # waited for the gap
+
+    asyncio.run(go())
+
+
+def test_windowed_join_end_to_end():
+    """multiple_inputs fan-in -> session window -> SQL join (SURVEY.md 3.5)."""
+    cfg = StreamConfig.from_mapping(
+        {
+            "input": {
+                "type": "multiple_inputs",
+                "inputs": [
+                    {"name": "orders", "type": "memory", "codec": "json",
+                     "messages": ['{"oid": 1, "uid": 10}', '{"oid": 2, "uid": 20}']},
+                    {"name": "users", "type": "memory", "codec": "json",
+                     "messages": ['{"uid": 10, "city": "sf"}', '{"uid": 20, "city": "la"}']},
+                ],
+            },
+            "buffer": {
+                "type": "session_window",
+                "gap": "50ms",
+                "query": "SELECT orders.oid, users.city FROM orders JOIN users ON orders.uid = users.uid ORDER BY orders.oid",
+            },
+            "pipeline": {"thread_num": 1, "processors": []},
+            "output": {"type": "drop"},
+        }
+    )
+    stream = build_stream(cfg)
+    sink = CollectOutput()
+    stream.output = sink
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=10))
+    rows = [r for b in sink.batches for r in b.record_batch.to_pylist()]
+    assert rows == [{"oid": 1, "city": "sf"}, {"oid": 2, "city": "la"}]
+
+
+def test_join_skips_when_input_missing():
+    """A declared input with no data in the window -> no emission, acks fired."""
+
+    async def go():
+        acked: list = []
+        w = SessionWindow(0.03, query="SELECT * FROM a JOIN b ON a.k = b.k",
+                          input_names=["a", "b"])
+        await w.write(MessageBatch.from_pydict({"k": [1]}).with_source("a"), CountingAck(acked))
+        # only input "a" has data; close to force evaluation
+        await w.close()
+        out = await asyncio.wait_for(w.read(), timeout=2)
+        assert out is None  # drained with nothing emitted
+        await asyncio.sleep(0)  # let the ack task run
+        assert acked == [1]
+
+    asyncio.run(go())
+
+
+def test_window_config_validation():
+    from arkflow_tpu.components import build_component, Resource
+
+    with pytest.raises(ConfigError):
+        build_component("buffer", {"type": "tumbling_window"}, Resource())
+    with pytest.raises(ConfigError):
+        build_component("buffer", {"type": "sliding_window"}, Resource())
+    with pytest.raises(ConfigError):
+        build_component("buffer", {"type": "session_window"}, Resource())
