@@ -192,13 +192,83 @@ def param_specs(cfg: DecoderConfig, axes: dict) -> dict:
 # -- incremental decoding (batched summarization path) ---------------------
 
 def init_kv_cache(cfg: DecoderConfig, batch: int, max_len: int) -> dict:
+    """Cache layout for ragged batched generation:
+
+    - ``length``: scalar write cursor (same slot for every row).
+    - ``lengths``: per-row true context length (RoPE positions; right-padding
+      slots between ``lengths[i]`` and ``prompt_len`` are masked out of
+      attention forever).
+    - ``prompt_len``: width of the prefilled prompt block (0 = pure stepwise).
+    """
     dh = cfg.dim // cfg.heads
     shape = (cfg.layers, batch, max_len, cfg.kv_heads, dh)
     return {
         "k": jnp.zeros(shape, jnp.bfloat16),
         "v": jnp.zeros(shape, jnp.bfloat16),
         "length": jnp.zeros((), jnp.int32),
+        "lengths": jnp.zeros((batch,), jnp.int32),
+        "prompt_len": jnp.zeros((), jnp.int32),
     }
+
+
+def prefill(params: dict, cfg: DecoderConfig, input_ids, cache: dict,
+            lengths=None) -> tuple[jnp.ndarray, dict]:
+    """Fill a FRESH KV cache with right-padded prompts in one forward pass.
+
+    input_ids: [B, T]; ``lengths``: [B] true prompt lengths (default: T for
+    every row). Attention masks out each row's padding slots, and the greedy
+    next token is read from position ``lengths[i] - 1`` — padded prompts
+    condition only on real tokens. The cache write cursor lands at T;
+    continuing from a non-empty cache is not supported (cursor must be 0).
+    """
+    b, t = input_ids.shape
+    dh = cfg.dim // cfg.heads
+    group = cfg.heads // cfg.kv_heads
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    causal = jnp.tril(jnp.ones((t, t), bool))[None, None]
+    key_valid = (jnp.arange(t)[None, :] < lengths[:, None])[:, None, None, :]  # [B,1,1,T]
+    mask = jnp.logical_and(causal, key_valid)
+    x = cm.embedding(params["embed"], input_ids)
+
+    def layer(carry, lp):
+        x, li = carry
+        y = cm.rms_norm(lp["attn_norm"], x, cfg.norm_eps)
+        q = cm.dense(lp["wq"], y).reshape(b, t, cfg.heads, dh)
+        k = cm.dense(lp["wk"], y).reshape(b, t, cfg.kv_heads, dh)
+        v = cm.dense(lp["wv"], y).reshape(b, t, cfg.kv_heads, dh)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice(
+            cache["k"][li], k.astype(jnp.bfloat16), (0, 0, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            cache["v"][li], v.astype(jnp.bfloat16), (0, 0, 0, 0)
+        )
+        kk = jnp.repeat(k, group, axis=2)
+        vv = jnp.repeat(v, group, axis=2)
+        attn = cm.attention(q, kk, vv, mask).reshape(b, t, cfg.heads * dh)
+        x = x + cm.dense(lp["wo"], attn)
+        y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
+        gate = jax.nn.silu(cm.dense(lp["w_gate"], y).astype(jnp.float32)).astype(y.dtype)
+        x = x + cm.dense(lp["w_down"], gate * cm.dense(lp["w_up"], y))
+        return (x, li + 1), (k_cache, v_cache)
+
+    (x, _), (ks, vs) = jax.lax.scan(layer, (x, 0), params["layers"])
+    x = cm.rms_norm(params["norm_out"], x, cfg.norm_eps)
+    logits = cm.dense(params["lm_head"], x).astype(jnp.float32)  # [B, T, V]
+    # read each row's logits at its true last token, not at padding
+    last = jnp.clip(lengths - 1, 0, t - 1)
+    last_logits = jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0, :]
+    next_ids = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    new_cache = {
+        "k": ks, "v": vs,
+        "length": jnp.asarray(t, jnp.int32),
+        "lengths": lengths,
+        "prompt_len": jnp.asarray(t, jnp.int32),
+    }
+    return next_ids, new_cache
 
 
 def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict) -> tuple[jnp.ndarray, dict]:
@@ -210,12 +280,20 @@ def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict) -> tup
     b = token_ids.shape[0]
     dh = cfg.dim // cfg.heads
     group = cfg.heads // cfg.kv_heads
-    pos = cache["length"]
+    pos = cache["length"]  # scalar write cursor (shared slot)
+    lengths = cache["lengths"]  # [B] true per-row context lengths (RoPE)
+    prompt_len = cache["prompt_len"]
     max_len = cache["k"].shape[2]
-    positions = jnp.full((b, 1), pos, jnp.int32)
+    positions = lengths[:, None]
     x = cm.embedding(params["embed"], token_ids)
 
-    new_k, new_v = [], []
+    # valid keys per row: real prompt tokens + the generated block (padding
+    # slots between lengths[i] and prompt_len stay masked forever)
+    ks_idx = jnp.arange(max_len)[None, :]
+    valid = jnp.logical_or(
+        ks_idx < lengths[:, None],
+        jnp.logical_and(ks_idx >= prompt_len, ks_idx <= pos),
+    )[:, None, None, :]
 
     def layer(carry, inputs):
         x, li = carry[0], carry[1]
@@ -234,7 +312,6 @@ def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict) -> tup
         )
         kk = jnp.repeat(k_cache, group, axis=2)
         vv = jnp.repeat(v_cache, group, axis=2)
-        valid = (jnp.arange(max_len) <= pos)[None, None, None, :]
         attn = cm.attention(q, kk, vv, valid).reshape(b, 1, cfg.heads * dh)
         x = x + cm.dense(lp["wo"], attn)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
@@ -246,7 +323,12 @@ def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict) -> tup
     x = cm.rms_norm(params["norm_out"], x, cfg.norm_eps)
     logits = cm.dense(params["lm_head"], x).astype(jnp.float32)
     next_ids = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-    new_cache = {"k": ks, "v": vs, "length": pos + 1}
+    new_cache = {
+        "k": ks, "v": vs,
+        "length": pos + 1,
+        "lengths": lengths + 1,
+        "prompt_len": prompt_len,
+    }
     return next_ids, new_cache
 
 
@@ -268,6 +350,7 @@ register_model(
             "make_train_step": make_train_step,
             "llama3_8b": llama3_8b,
             "init_kv_cache": init_kv_cache,
+            "prefill": prefill,
             "decode_step": decode_step,
         },
     )

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the ops XLA fusion doesn't already cover."""
+
+from arkflow_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,91 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Streaming-softmax attention tiled for VMEM: the grid walks (batch, head,
+q-tile); each program holds one Q tile in VMEM, loops over K/V tiles with an
+online max/denominator accumulator in float32, and writes the normalised tile
+once — attention memory is O(TILE_Q * S) scores per program instead of
+materialising [S, S]. QK^T and PV run on the MXU in the input dtype.
+
+Used for variable-length/ragged batches where XLA's fused attention falls
+short (PAPERS.md: ragged paged attention); ``interpret=True`` runs the same
+kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, tile_k: int, causal: bool, tile_q: int):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [TQ, D]
+    tq, d = q.shape
+    s = k_ref.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    n_k = s // tile_k
+
+    q_pos = qi * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tile_k), 0)
+
+    def body(t, carry):
+        o, m, l = carry
+        k = k_ref[0, 0, pl.ds(t * tile_k, tile_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(t * tile_k, tile_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [TQ, TK]
+        if causal:
+            k_pos = t * tile_k + jax.lax.broadcasted_iota(jnp.int32, (tq, tile_k), 1)
+            scores = jnp.where(k_pos <= q_pos, scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((tq, d), jnp.float32)
+    m0 = jnp.full((tq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((tq,), jnp.float32)
+    if causal:
+        # skip fully-masked K tiles: tile t is relevant only while
+        # t*tile_k <= last query position of this Q tile
+        n_k_eff = ((qi + 1) * tq + tile_k - 1) // tile_k
+        upper = jnp.minimum(n_k, n_k_eff)
+    else:
+        upper = n_k
+    o, m, l = jax.lax.fori_loop(0, upper, body, (o0, m0, l0))
+    o_ref[0, 0] = (o / jnp.maximum(l[:, None], 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "tile_q", "tile_k", "interpret"))
+def flash_attention(q, k, v, *, causal: bool = False, tile_q: int = 128,
+                    tile_k: int = 128, interpret: bool = False):
+    """q/k/v: [B, H, S, D] -> [B, H, S, D]. S must divide by the tile sizes."""
+    b, h, s, d = q.shape
+    tile_q = min(tile_q, s)
+    tile_k = min(tile_k, s)
+    if s % tile_q or s % tile_k:
+        raise ValueError(f"seq len {s} must divide tiles ({tile_q}, {tile_k})")
+    grid = (b, h, s // tile_q)
+    kernel = functools.partial(_flash_kernel, tile_k=tile_k, causal=causal, tile_q=tile_q)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, tile_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, tile_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)

@@ -1,3 +1,4 @@
 import arkflow_tpu.plugins.codec.json_codec  # noqa: F401
+import arkflow_tpu.plugins.codec.protobuf_codec  # noqa: F401
 
 from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads, encode_batch  # noqa: F401

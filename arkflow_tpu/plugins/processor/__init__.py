@@ -3,3 +3,5 @@ import arkflow_tpu.plugins.processor.sql  # noqa: F401
 import arkflow_tpu.plugins.processor.batch_proc  # noqa: F401
 import arkflow_tpu.plugins.processor.python_proc  # noqa: F401
 import arkflow_tpu.plugins.processor.tpu_inference  # noqa: F401
+import arkflow_tpu.plugins.processor.tpu_generate  # noqa: F401
+import arkflow_tpu.plugins.processor.protobuf_proc  # noqa: F401

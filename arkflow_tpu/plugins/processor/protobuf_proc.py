@@ -1,0 +1,56 @@
+"""protobuf_to_arrow / arrow_to_protobuf processors.
+
+Mirrors the reference processors (ref: crates/arkflow-plugin/src/processor/
+protobuf.rs): decode the ``__value__`` payload column through a runtime-
+compiled proto schema into typed columns, and back.
+"""
+
+from __future__ import annotations
+
+from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD, MessageBatch
+from arkflow_tpu.components import Processor, Resource, register_processor
+from arkflow_tpu.errors import ProcessError
+from arkflow_tpu.plugins.codec.protobuf_codec import ProtobufCodec, _build as _build_codec_from_config
+
+
+class ProtobufToArrowProcessor(Processor):
+    def __init__(self, codec: ProtobufCodec, value_field: str = DEFAULT_BINARY_VALUE_FIELD):
+        self.codec = codec
+        self.value_field = value_field
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []
+        if not batch.has_column(self.value_field):
+            raise ProcessError(f"protobuf_to_arrow: no {self.value_field!r} column")
+        out = self.codec.decode_many(batch.to_binary(self.value_field))
+        meta = batch.metadata_columns()
+        if meta and out.num_rows == batch.num_rows:
+            for name in meta:
+                out = out.with_column(name, batch.column(name))
+        return [out] if out.num_rows else []
+
+
+class ArrowToProtobufProcessor(Processor):
+    def __init__(self, codec: ProtobufCodec):
+        self.codec = codec
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []
+        payloads = self.codec.encode(batch.strip_metadata())
+        out = MessageBatch.new_binary(payloads)
+        for name in batch.metadata_columns():
+            out = out.with_column(name, batch.column(name))
+        return [out]
+
+
+@register_processor("protobuf_to_arrow")
+def _build_p2a(config: dict, resource: Resource) -> ProtobufToArrowProcessor:
+    codec = _build_codec_from_config(dict(config), resource)
+    return ProtobufToArrowProcessor(codec, config.get("value_field", DEFAULT_BINARY_VALUE_FIELD))
+
+
+@register_processor("arrow_to_protobuf")
+def _build_a2p(config: dict, resource: Resource) -> ArrowToProtobufProcessor:
+    return ArrowToProtobufProcessor(_build_codec_from_config(dict(config), resource))

@@ -63,6 +63,10 @@ class HashTokenizer:
             mask[i, : len(row)] = 1
         return ids, mask
 
+    def decode(self, ids: Sequence[int]) -> str:
+        """Hashing has no inverse vocabulary; render ids as text verbatim."""
+        return " ".join(str(i) for i in ids)
+
 
 class HFTokenizer:
     """transformers fast-tokenizer wrapper (local files only)."""
@@ -79,6 +83,9 @@ class HFTokenizer:
             return_tensors="np", return_attention_mask=True,
         )
         return enc["input_ids"].astype(np.int32), enc["attention_mask"].astype(np.int32)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
 
 
 def build_tokenizer(name: Optional[str], vocab_size: int = 30522):

@@ -164,3 +164,49 @@ def test_bert_sharded_serving_matches_single_chip():
         out = jax.jit(lambda p, i, m: fam.apply(p, cfg, input_ids=i, attention_mask=m))(sp, ids, mask)
     np.testing.assert_allclose(np.asarray(ref["logits"]), np.asarray(out["logits"]), atol=3e-2)
     np.testing.assert_array_equal(np.asarray(ref["label"]), np.asarray(out["label"]))
+
+
+def test_decoder_prefill_matches_stepwise():
+    fam = get_model("decoder_lm")
+    cfg = fam.make_config(**TINY_DEC)
+    p = fam.init(jax.random.PRNGKey(5), cfg)
+    ex = fam.extras
+    seq = [3, 17, 42, 7]
+    # stepwise
+    cache_a = ex["init_kv_cache"](cfg, 1, 16)
+    for tok in seq:
+        nxt_a, cache_a = ex["decode_step"](p, cfg, jnp.array([[tok]], jnp.int32), cache_a)
+    # prefill
+    cache_b = ex["init_kv_cache"](cfg, 1, 16)
+    nxt_b, cache_b = ex["prefill"](p, cfg, jnp.array([seq], jnp.int32), cache_b)
+    assert int(nxt_a[0]) == int(nxt_b[0])
+    assert int(cache_b["length"]) == 4
+    np.testing.assert_allclose(
+        np.asarray(cache_a["k"][:, :, :4], np.float32),
+        np.asarray(cache_b["k"][:, :, :4], np.float32), atol=1e-2)
+
+
+def test_prefill_padded_prompt_conditions_on_true_last_token():
+    """Right-padded prompts must predict from the true last token (review fix)."""
+    fam = get_model("decoder_lm")
+    cfg = fam.make_config(**TINY_DEC)
+    p = fam.init(jax.random.PRNGKey(6), cfg)
+    ex = fam.extras
+    seq = [9, 21, 14]
+    # exact-length prefill is the ground truth
+    cache_exact = ex["init_kv_cache"](cfg, 1, 16)
+    nxt_exact, _ = ex["prefill"](p, cfg, jnp.array([seq], jnp.int32), cache_exact)
+    # bucket-padded prompt with true length passed
+    padded = seq + [0] * 5
+    cache_pad = ex["init_kv_cache"](cfg, 1, 16)
+    nxt_pad, cache_pad = ex["prefill"](
+        p, cfg, jnp.array([padded], jnp.int32), cache_pad,
+        lengths=jnp.array([3], jnp.int32),
+    )
+    assert int(nxt_exact[0]) == int(nxt_pad[0])
+    # and continued decoding must ignore the pad slots
+    nxt2_pad, _ = ex["decode_step"](p, cfg, nxt_pad[:, None], cache_pad)
+    cache_e2 = ex["init_kv_cache"](cfg, 1, 16)
+    _, cache_e2 = ex["prefill"](p, cfg, jnp.array([seq], jnp.int32), cache_e2)
+    nxt2_exact, _ = ex["decode_step"](p, cfg, nxt_exact[:, None], cache_e2)
+    assert int(nxt2_exact[0]) == int(nxt2_pad[0])

@@ -203,3 +203,40 @@ def test_vit_embedding_output_as_fixed_list():
     cols = [b.column("embedding") for b in sink.batches]
     assert all(c.type.list_size == 32 for c in cols)
     assert sum(len(c) for c in cols) == 2
+
+
+def test_e2e_tpu_generate():
+    """CDC-summarization-shaped config: decoder LM generates per message."""
+    from tests.test_runtime import CollectOutput
+
+    cfg = StreamConfig.from_mapping(
+        {
+            "input": {"type": "memory",
+                      "messages": ["update table orders set status paid", "delete from carts"]},
+            "pipeline": {
+                "thread_num": 1,
+                "processors": [
+                    {
+                        "type": "tpu_generate",
+                        "model": "decoder_lm",
+                        "model_config": {"vocab_size": 256, "dim": 32, "layers": 2,
+                                         "heads": 4, "kv_heads": 2, "ffn": 64, "max_seq": 128},
+                        "max_input": 32,
+                        "max_new_tokens": 8,
+                        "batch_buckets": [2],
+                        "seq_buckets": [16, 32],
+                        "output_field": "summary",
+                    }
+                ],
+            },
+            "output": {"type": "drop"},
+        }
+    )
+    stream = build_stream(cfg)
+    sink = CollectOutput()
+    stream.output = sink
+    asyncio.run(stream.run(asyncio.Event()))
+    rows = [r for b in sink.batches for r in b.record_batch.to_pylist()]
+    assert len(rows) == 2
+    for r in rows:
+        assert isinstance(r["summary"], str)
