@@ -36,6 +36,14 @@ class DecoderConfig:
     max_seq: int = 2048
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
+    #: route attention through the explicit sp-ring (long context): requires a
+    #: mesh with an "sp" axis passed to forward/train_step
+    use_ring_attention: bool = False
+    #: >1 turns the MLP into a switch-style top-1 MoE; experts shard over the
+    #: "ep" mesh axis. Dispatch is dense (every expert computes every token,
+    #: masked at combine) — correct and GSPMD-shardable; all-to-all token
+    #: dispatch is a later optimisation.
+    num_experts: int = 0
 
 
 def llama3_8b() -> DecoderConfig:
@@ -55,19 +63,29 @@ def init(rng, cfg: DecoderConfig) -> dict:
         "layers": [],
     }
     for _ in range(cfg.layers):
-        params["layers"].append(
-            {
-                "attn_norm": cm.rms_norm_init(cfg.dim),
-                "wq": cm.dense_init(next(keys), cfg.dim, cfg.heads * dh, bias=False),
-                "wk": cm.dense_init(next(keys), cfg.dim, cfg.kv_heads * dh, bias=False),
-                "wv": cm.dense_init(next(keys), cfg.dim, cfg.kv_heads * dh, bias=False),
-                "wo": cm.dense_init(next(keys), cfg.heads * dh, cfg.dim, bias=False),
-                "mlp_norm": cm.rms_norm_init(cfg.dim),
-                "w_gate": cm.dense_init(next(keys), cfg.dim, cfg.ffn, bias=False),
-                "w_up": cm.dense_init(next(keys), cfg.dim, cfg.ffn, bias=False),
-                "w_down": cm.dense_init(next(keys), cfg.ffn, cfg.dim, bias=False),
+        layer = {
+            "attn_norm": cm.rms_norm_init(cfg.dim),
+            "wq": cm.dense_init(next(keys), cfg.dim, cfg.heads * dh, bias=False),
+            "wk": cm.dense_init(next(keys), cfg.dim, cfg.kv_heads * dh, bias=False),
+            "wv": cm.dense_init(next(keys), cfg.dim, cfg.kv_heads * dh, bias=False),
+            "wo": cm.dense_init(next(keys), cfg.heads * dh, cfg.dim, bias=False),
+            "mlp_norm": cm.rms_norm_init(cfg.dim),
+        }
+        if cfg.num_experts > 1:
+            e = cfg.num_experts
+            sub = jax.random.split(next(keys), 4)
+            scale = 1.0 / (cfg.dim ** 0.5)
+            layer["router"] = cm.dense_init(sub[0], cfg.dim, e, bias=False)
+            layer["experts"] = {
+                "w_gate": jax.random.uniform(sub[1], (e, cfg.dim, cfg.ffn), jnp.float32, -scale, scale),
+                "w_up": jax.random.uniform(sub[2], (e, cfg.dim, cfg.ffn), jnp.float32, -scale, scale),
+                "w_down": jax.random.uniform(sub[3], (e, cfg.ffn, cfg.dim), jnp.float32, -scale, scale),
             }
-        )
+        else:
+            layer["w_gate"] = cm.dense_init(next(keys), cfg.dim, cfg.ffn, bias=False)
+            layer["w_up"] = cm.dense_init(next(keys), cfg.dim, cfg.ffn, bias=False)
+            layer["w_down"] = cm.dense_init(next(keys), cfg.ffn, cfg.dim, bias=False)
+        params["layers"].append(layer)
     params["layers"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params["layers"])
     return params
 
@@ -84,6 +102,25 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
     return out.astype(x.dtype)
 
 
+def _moe_mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
+    """Switch-style top-1 MoE SwiGLU. Experts shard over the "ep" mesh axis
+    (param specs put the leading expert dim on ep); GSPMD turns the masked
+    combine into a psum over expert shards."""
+    ex = lp["experts"]
+    dtype = y.dtype
+    router_logits = cm.dense(lp["router"], y, dtype=jnp.float32)  # [B,S,E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)  # [B,S]
+    onehot = jax.nn.one_hot(top, probs.shape[-1], dtype=jnp.float32)
+    weight = (probs * onehot).sum(-1)  # [B,S] routing prob of chosen expert
+    gate = jnp.einsum("bsd,edf->bsef", y.astype(dtype), ex["w_gate"].astype(dtype))
+    up = jnp.einsum("bsd,edf->bsef", y.astype(dtype), ex["w_up"].astype(dtype))
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(dtype) * up
+    out = jnp.einsum("bsef,efd->bsed", act, ex["w_down"].astype(dtype))
+    combined = jnp.einsum("bsed,bse->bsd", out.astype(jnp.float32), onehot)
+    return (combined * weight[..., None]).astype(dtype)
+
+
 def _shard_act(x, axes):
     """Constrain [B, S, ...] activations to (dp, sp) when a mesh is active."""
     if not axes:
@@ -95,8 +132,14 @@ def _shard_act(x, axes):
         return x  # no mesh in scope (single-chip eager/test path)
 
 
-def forward(params: dict, cfg: DecoderConfig, input_ids, *, axes=None) -> jnp.ndarray:
-    """[B, S] ids -> [B, S, vocab] float32 logits (causal)."""
+def forward(params: dict, cfg: DecoderConfig, input_ids, *, axes=None, mesh=None) -> jnp.ndarray:
+    """[B, S] ids -> [B, S, vocab] float32 logits (causal).
+
+    With ``cfg.use_ring_attention`` and a mesh carrying an ``sp`` axis, the
+    attention core runs as an explicit K/V ring over sequence shards
+    (arkflow_tpu.parallel.ring_attention) instead of GSPMD's default
+    all-gather — O(S/n) attention memory per chip for long context.
+    """
     axes = axes or {}
     b, s = input_ids.shape
     dh = cfg.dim // cfg.heads
@@ -105,6 +148,15 @@ def forward(params: dict, cfg: DecoderConfig, input_ids, *, axes=None) -> jnp.nd
     x = _shard_act(x, axes)
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], (b, s))
     causal = jnp.tril(jnp.ones((s, s), bool))[None, None, :, :]
+
+    ring_attn = None
+    if cfg.use_ring_attention and mesh is not None and axes.get("sp"):
+        from arkflow_tpu.parallel.ring_attention import make_ring_attention_spec
+
+        ring_attn = make_ring_attention_spec(
+            mesh, sp_axis=axes["sp"], batch_axis=axes.get("dp"),
+            head_axis=axes.get("tp"), causal=True,
+        )
 
     def layer(x, lp):
         y = cm.rms_norm(lp["attn_norm"], x, cfg.norm_eps)
@@ -116,12 +168,18 @@ def forward(params: dict, cfg: DecoderConfig, input_ids, *, axes=None) -> jnp.nd
         # GQA: repeat kv heads to match q heads
         k = jnp.repeat(k, group, axis=2)
         v = jnp.repeat(v, group, axis=2)
-        attn = cm.attention(q, k, v, causal).reshape(b, s, cfg.heads * dh)
+        if ring_attn is not None:
+            attn = ring_attn(q, k, v).reshape(b, s, cfg.heads * dh)
+        else:
+            attn = cm.attention(q, k, v, causal).reshape(b, s, cfg.heads * dh)
         x = x + cm.dense(lp["wo"], attn)
         x = _shard_act(x, axes)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
-        gate = jax.nn.silu(cm.dense(lp["w_gate"], y).astype(jnp.float32)).astype(y.dtype)
-        x = x + cm.dense(lp["w_down"], gate * cm.dense(lp["w_up"], y))
+        if cfg.num_experts > 1:
+            x = x + _moe_mlp(lp, y, cfg)
+        else:
+            gate = jax.nn.silu(cm.dense(lp["w_gate"], y).astype(jnp.float32)).astype(y.dtype)
+            x = x + cm.dense(lp["w_down"], gate * cm.dense(lp["w_up"], y))
         return _shard_act(x, axes), None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
@@ -129,21 +187,21 @@ def forward(params: dict, cfg: DecoderConfig, input_ids, *, axes=None) -> jnp.nd
     return cm.dense(params["lm_head"], x).astype(jnp.float32)
 
 
-def apply(params: dict, cfg: DecoderConfig, *, input_ids, axes=None) -> dict:
-    logits = forward(params, cfg, input_ids, axes=axes)
+def apply(params: dict, cfg: DecoderConfig, *, input_ids, axes=None, mesh=None) -> dict:
+    logits = forward(params, cfg, input_ids, axes=axes, mesh=mesh)
     return {"logits": logits, "next_token": jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)}
 
 
-def loss_fn(params: dict, cfg: DecoderConfig, input_ids, targets, mask, *, axes=None):
+def loss_fn(params: dict, cfg: DecoderConfig, input_ids, targets, mask, *, axes=None, mesh=None):
     """Causal LM cross-entropy, mean over unmasked target tokens."""
-    logits = forward(params, cfg, input_ids, axes=axes)
+    logits = forward(params, cfg, input_ids, axes=axes, mesh=mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     maskf = mask.astype(jnp.float32)
     return -(ll * maskf).sum() / jnp.maximum(maskf.sum(), 1.0)
 
 
-def make_train_step(cfg: DecoderConfig, optimizer, *, axes=None):
+def make_train_step(cfg: DecoderConfig, optimizer, *, axes=None, mesh=None):
     """Returns ``train_step(params, opt_state, batch) -> (params, opt_state, loss)``.
 
     Jit this over a Mesh with sharded params/batch for the full
@@ -152,7 +210,8 @@ def make_train_step(cfg: DecoderConfig, optimizer, *, axes=None):
 
     def train_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(
-            params, cfg, batch["input_ids"], batch["targets"], batch["mask"], axes=axes
+            params, cfg, batch["input_ids"], batch["targets"], batch["mask"],
+            axes=axes, mesh=mesh,
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         import optax
@@ -164,9 +223,10 @@ def make_train_step(cfg: DecoderConfig, optimizer, *, axes=None):
 
 
 def param_specs(cfg: DecoderConfig, axes: dict) -> dict:
-    """Tensor-parallel layout: attention heads and FFN sharded over ``tp``;
-    embed/lm_head sharded on the vocab dim; norms replicated."""
+    """Sharding layout: attention heads and FFN over ``tp``; expert dim over
+    ``ep`` (MoE); embed/lm_head on the vocab dim; norms replicated."""
     tp = axes.get("tp")
+    ep = axes.get("ep")
     layer = {
         "attn_norm": {"scale": P(None)},
         "wq": {"w": P(None, tp)},
@@ -174,10 +234,18 @@ def param_specs(cfg: DecoderConfig, axes: dict) -> dict:
         "wv": {"w": P(None, tp)},
         "wo": {"w": P(tp, None)},
         "mlp_norm": {"scale": P(None)},
-        "w_gate": {"w": P(None, tp)},
-        "w_up": {"w": P(None, tp)},
-        "w_down": {"w": P(tp, None)},
     }
+    if cfg.num_experts > 1:
+        layer["router"] = {"w": P(None, None)}
+        layer["experts"] = {
+            "w_gate": P(ep, None, tp),
+            "w_up": P(ep, None, tp),
+            "w_down": P(ep, tp, None),
+        }
+    else:
+        layer["w_gate"] = {"w": P(None, tp)}
+        layer["w_up"] = {"w": P(None, tp)}
+        layer["w_down"] = {"w": P(tp, None)}
     layer = jax.tree_util.tree_map(
         lambda sp: P(None, *sp), layer, is_leaf=lambda x: isinstance(x, P)
     )
@@ -221,6 +289,8 @@ def prefill(params: dict, cfg: DecoderConfig, input_ids, cache: dict,
     condition only on real tokens. The cache write cursor lands at T;
     continuing from a non-empty cache is not supported (cursor must be 0).
     """
+    if cfg.num_experts > 1:
+        raise ValueError("incremental decoding does not support MoE layers yet")
     b, t = input_ids.shape
     dh = cfg.dim // cfg.heads
     group = cfg.heads // cfg.kv_heads
@@ -277,6 +347,8 @@ def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict) -> tup
     Jittable with a static cache size; the python generation loop lives in
     the summarization processor.
     """
+    if cfg.num_experts > 1:
+        raise ValueError("incremental decoding does not support MoE layers yet")
     b = token_ids.shape[0]
     dh = cfg.dim // cfg.heads
     group = cfg.heads // cfg.kv_heads

@@ -43,7 +43,7 @@ class Gauge:
         self.value = 0.0
 
     def set(self, v: float) -> None:
-        self.value = v
+        self.value = float(v)
 
     def inc(self, n: float = 1.0) -> None:
         self.value += n

@@ -28,11 +28,12 @@ class MeshSpec:
     dp: int = 1
     tp: int = 1
     sp: int = 1
-    axis_names: tuple = ("dp", "tp", "sp")
+    ep: int = 1  # expert parallel (MoE)
+    axis_names: tuple = ("dp", "tp", "sp", "ep")
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp * self.sp
+        return self.dp * self.tp * self.sp * self.ep
 
 
 def create_mesh(spec: Optional[MeshSpec] = None, devices=None) -> Mesh:
@@ -44,7 +45,7 @@ def create_mesh(spec: Optional[MeshSpec] = None, devices=None) -> Mesh:
         raise ValueError(
             f"mesh {spec} needs {spec.num_devices} devices, have {len(devices)}"
         )
-    arr = np.array(devices[: spec.num_devices]).reshape(spec.dp, spec.tp, spec.sp)
+    arr = np.array(devices[: spec.num_devices]).reshape(spec.dp, spec.tp, spec.sp, spec.ep)
     return Mesh(arr, spec.axis_names)
 
 

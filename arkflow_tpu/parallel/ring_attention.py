@@ -63,21 +63,29 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, axis: str = "sp", causal: bool = False):
-    """Build a jittable ring attention over ``mesh[axis]``.
+def make_ring_attention_spec(mesh: Mesh, sp_axis: str = "sp",
+                             batch_axis: str | None = None,
+                             head_axis: str | None = None, causal: bool = False):
+    """Ring attention for use inside a sharded model forward.
 
-    Inputs/outputs are [B, S, H, Dh] arrays sequence-sharded over ``axis``;
-    S must divide evenly by the axis size.
+    Inputs/outputs are [B, S, H, Dh]: the sequence dim rings over ``sp_axis``;
+    the batch dim may be dp-sharded (``batch_axis``) and the head dim
+    tp-sharded (``head_axis``) — each tp shard rings only its own heads, so
+    attention memory/FLOPs stay O(S/n_sp * H/n_tp) per chip.
     """
-    spec = P(None, axis, None, None)
-    fn = shard_map(
-        partial(_ring_attention_local, axis_name=axis, causal=causal),
+    spec = P(batch_axis, sp_axis, head_axis, None)
+    return shard_map(
+        partial(_ring_attention_local, axis_name=sp_axis, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
         check_rep=False,
     )
-    return fn
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "sp", causal: bool = False):
+    """Jittable ring attention over ``mesh[axis]`` (sequence-sharded only)."""
+    return make_ring_attention_spec(mesh, sp_axis=axis, causal=causal)
 
 
 def reference_attention(q, k, v, causal: bool = False):

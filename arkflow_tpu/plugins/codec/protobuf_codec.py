@@ -52,7 +52,10 @@ def compile_proto(proto_source: Optional[str], proto_file: Optional[str],
         for inc in includes:
             cmd.append(f"-I{inc}")
         cmd.append(str(proto_path))
-        res = subprocess.run(cmd, capture_output=True)
+        try:
+            res = subprocess.run(cmd, capture_output=True)
+        except FileNotFoundError as e:
+            raise ConfigError("protobuf codec: protoc binary not found on PATH") from e
         if res.returncode != 0:
             raise ConfigError(f"protoc failed: {res.stderr.decode()[:400]}")
         fds = descriptor_pb2.FileDescriptorSet()

@@ -5,3 +5,4 @@ import arkflow_tpu.plugins.processor.python_proc  # noqa: F401
 import arkflow_tpu.plugins.processor.tpu_inference  # noqa: F401
 import arkflow_tpu.plugins.processor.tpu_generate  # noqa: F401
 import arkflow_tpu.plugins.processor.protobuf_proc  # noqa: F401
+import arkflow_tpu.plugins.processor.remap  # noqa: F401

@@ -1,0 +1,77 @@
+"""Remap processor: declarative per-column transformation.
+
+The reference embeds Vector Remap Language for row transforms
+(ref: crates/arkflow-plugin/src/processor/vrl.rs — compiled per-row resolve,
+which breaks columnar execution). VRL has no Python runtime, so this fills
+that slot the columnar way: each mapping is a SQL expression evaluated
+vectorized over the batch (same expression engine as WHERE clauses and
+``Expr`` config values); arbitrary Python remains available via the
+``python`` processor.
+
+Config:
+
+    type: remap
+    where: "temp IS NOT NULL"            # optional row filter first
+    mappings:
+      fahrenheit: "temp * 1.8 + 32"
+      device: "upper(dev)"
+      source: "__meta_source"
+    drop: [temp]                         # optional columns to remove after
+"""
+
+from __future__ import annotations
+
+import pyarrow.compute as pc
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Processor, Resource, register_processor
+from arkflow_tpu.errors import ConfigError, ProcessError
+from arkflow_tpu.sql.eval import evaluate_expression
+from arkflow_tpu.sql.functions import as_array
+from arkflow_tpu.sql.parser import parse_expression
+
+
+class RemapProcessor(Processor):
+    def __init__(self, mappings: dict[str, str], where: str | None = None,
+                 drop: list[str] | None = None):
+        if not mappings and not where and not drop:
+            raise ConfigError("remap processor needs 'mappings', 'where' or 'drop'")
+        for col, expr in mappings.items():
+            try:
+                parse_expression(expr)  # fail at build, not per batch
+            except Exception as e:
+                raise ConfigError(f"remap: bad expression for {col!r}: {e}") from e
+        if where:
+            parse_expression(where)
+        self.mappings = mappings
+        self.where = where
+        self.drop = drop or []
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []
+        try:
+            if self.where:
+                mask = as_array(evaluate_expression(batch, self.where), batch.num_rows)
+                batch = MessageBatch(batch.record_batch.filter(pc.cast(mask, "bool")))
+                if batch.num_rows == 0:
+                    return []
+            out = batch
+            for col, expr in self.mappings.items():
+                out = out.with_column(col, evaluate_expression(batch, expr))
+            if self.drop:
+                out = out.drop_columns(self.drop)
+        except ProcessError:
+            raise
+        except Exception as e:
+            raise ProcessError(f"remap failed: {e}") from e
+        return [out]
+
+
+@register_processor("remap")
+def _build(config: dict, resource: Resource) -> RemapProcessor:
+    return RemapProcessor(
+        mappings=dict(config.get("mappings") or {}),
+        where=config.get("where"),
+        drop=list(config.get("drop") or []),
+    )

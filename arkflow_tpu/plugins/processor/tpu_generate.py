@@ -54,6 +54,11 @@ class TpuGenerateProcessor(Processor):
         if "decode_step" not in self.family.extras:
             raise ConfigError(f"model {model!r} does not support incremental decoding")
         self.cfg = self.family.make_config(**(model_config or {}))
+        if getattr(self.cfg, "num_experts", 0) > 1:
+            raise ConfigError(
+                "tpu_generate: MoE decoders (num_experts > 1) are not supported "
+                "for incremental decoding yet"
+            )
         self.text_field = text_field
         self.tokenizer = tokenizer
         self.max_input = max_input

@@ -106,6 +106,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         asyncio.run(engine.run())
     except KeyboardInterrupt:
         pass
+    except ConfigError as e:  # component build errors surface cleanly
+        print(f"config error: {e}", file=sys.stderr)
+        return 2
     return 0
 
 

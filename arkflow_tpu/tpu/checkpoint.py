@@ -1,0 +1,31 @@
+"""Model checkpoint save/restore (orbax).
+
+The reference has no state checkpointing (SURVEY.md section 5 — delivery
+relies on broker acks); model parameters are new state this engine owns, so
+they get first-class checkpointing: ``save``/``restore`` wrap orbax's
+StandardCheckpointer and the ``tpu_inference``/``tpu_generate`` processors
+accept a ``checkpoint:`` path at build.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from arkflow_tpu.errors import ConfigError
+
+
+def save(path: str, params) -> None:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(Path(path).absolute(), params)
+    ckptr.wait_until_finished()
+
+
+def restore(path: str, like_params):
+    import orbax.checkpoint as ocp
+
+    p = Path(path).absolute()
+    if not p.exists():
+        raise ConfigError(f"checkpoint path {p} does not exist")
+    return ocp.StandardCheckpointer().restore(p, like_params)

@@ -85,9 +85,24 @@ class ModelRunner:
         self._axes = axes
 
         apply_fn = self.family.apply
+        # thread mesh/axes into families whose apply understands sharded
+        # execution (e.g. decoder ring attention); others get plain calls
+        import inspect
+
+        sig = inspect.signature(apply_fn)
+        extra_kwargs: dict[str, Any] = {}
+        if "axes" in sig.parameters and axes:
+            extra_kwargs["axes"] = axes
+        if "mesh" in sig.parameters and self.mesh is not None:
+            extra_kwargs["mesh"] = self.mesh
+        if getattr(self.cfg, "use_ring_attention", False) and "sp" not in axes:
+            raise ConfigError(
+                "use_ring_attention requires a mesh with an 'sp' axis "
+                "(set mesh: {sp: N} on the processor)"
+            )
 
         def run(params, inputs):
-            return apply_fn(params, self.cfg, **inputs)
+            return apply_fn(params, self.cfg, **inputs, **extra_kwargs)
 
         self._jitted = jax.jit(run)
 
@@ -102,13 +117,14 @@ class ModelRunner:
     # -- checkpoint --------------------------------------------------------
 
     def _restore(self, path: str, like_params):
-        try:
-            import orbax.checkpoint as ocp
+        from arkflow_tpu.tpu.checkpoint import restore
 
-            ckptr = ocp.StandardCheckpointer()
-            restored = ckptr.restore(path, like_params)
+        try:
+            restored = restore(path, like_params)
             logger.info("restored checkpoint from %s", path)
             return restored
+        except ConfigError:
+            raise
         except Exception as e:
             raise ConfigError(f"failed to restore checkpoint {path!r}: {e}") from e
 

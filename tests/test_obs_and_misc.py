@@ -1,0 +1,112 @@
+"""Metrics exposition, remap processor, checkpoint save/restore."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.obs import MetricsRegistry
+
+ensure_plugins_loaded()
+
+
+def test_metrics_exposition_format():
+    reg = MetricsRegistry()
+    c = reg.counter("rows_total", "rows", {"stream": "s1"})
+    c.inc(5)
+    g = reg.gauge("pending", "", {"stream": "s1"})
+    g.set(3)
+    h = reg.histogram("lat_seconds", "latency", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.exposition()
+    assert '# TYPE rows_total counter' in text
+    assert 'rows_total{stream="s1"} 5.0' in text
+    assert 'pending{stream="s1"} 3.0' in text
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1.0"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert 'lat_seconds_count 3' in text
+    # quantiles from the reservoir
+    assert h.quantile(0.5) == 0.5
+
+
+def test_metrics_same_name_same_labels_identity():
+    reg = MetricsRegistry()
+    a = reg.counter("x", labels={"s": "1"})
+    b = reg.counter("x", labels={"s": "1"})
+    c = reg.counter("x", labels={"s": "2"})
+    assert a is b and a is not c
+
+
+def test_remap_processor():
+    proc = build_component(
+        "processor",
+        {
+            "type": "remap",
+            "where": "temp IS NOT NULL",
+            "mappings": {"fahrenheit": "temp * 1.8 + 32", "dev": "upper(dev)"},
+            "drop": ["temp"],
+        },
+        Resource(),
+    )
+    batch = MessageBatch.from_pydict({"temp": [20.0, None, 35.0], "dev": ["a", "b", "c"]})
+
+    async def go():
+        return await proc.process(batch)
+
+    [out] = asyncio.run(go())
+    assert out.column_names == ["dev", "fahrenheit"]
+    assert out.column("dev").to_pylist() == ["A", "C"]
+    assert out.column("fahrenheit").to_pylist() == [68.0, 95.0]
+
+
+def test_remap_bad_expression_fails_at_build():
+    with pytest.raises(ConfigError):
+        build_component(
+            "processor", {"type": "remap", "mappings": {"x": "SELECT nope FROM"}}, Resource()
+        )
+
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    import jax
+
+    from arkflow_tpu.models import get_model
+    from arkflow_tpu.tpu import checkpoint
+
+    fam = get_model("lstm_ae")
+    cfg = fam.make_config(features=2, hidden=4, latent=2, window=4)
+    params = fam.init(jax.random.PRNGKey(0), cfg)
+    path = tmp_path / "ckpt"
+    checkpoint.save(str(path), params)
+    like = fam.init(jax.random.PRNGKey(1), cfg)  # different values, same tree
+    restored = checkpoint.restore(str(path), like)
+    a = jax.tree_util.tree_leaves(params)
+    b = jax.tree_util.tree_leaves(restored)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    with pytest.raises(ConfigError):
+        checkpoint.restore(str(tmp_path / "missing"), like)
+
+
+def test_runner_restores_checkpoint(tmp_path):
+    import jax
+
+    from arkflow_tpu.models import get_model
+    from arkflow_tpu.tpu import checkpoint
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    fam = get_model("lstm_ae")
+    cfg = fam.make_config(features=2, hidden=4, latent=2, window=4)
+    params = fam.init(jax.random.PRNGKey(42), cfg)
+    path = tmp_path / "ck"
+    checkpoint.save(str(path), params)
+    runner = ModelRunner("lstm_ae", {"features": 2, "hidden": 4, "latent": 2, "window": 4},
+                         checkpoint=str(path), seed=7)
+    np.testing.assert_array_equal(
+        np.asarray(runner.params["head"]["w"]), np.asarray(params["head"]["w"])
+    )
