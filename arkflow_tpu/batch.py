@@ -151,16 +151,10 @@ class MessageBatch:
         if not (pa.types.is_binary(col.type) or pa.types.is_large_binary(col.type)
                 or pa.types.is_string(col.type) or pa.types.is_large_string(col.type)):
             raise ArkError(f"column {field!r} is {col.type}, not binary/string")
-        out = []
-        for v in col:
-            pv = v.as_py()
-            if pv is None:
-                out.append(b"")
-            elif isinstance(pv, str):
-                out.append(pv.encode("utf-8"))
-            else:
-                out.append(pv)
-        return out
+        return [
+            b"" if v is None else (v.encode("utf-8") if isinstance(v, str) else v)
+            for v in col.to_pylist()
+        ]
 
     # -- column surgery ----------------------------------------------------
 

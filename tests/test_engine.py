@@ -1,0 +1,87 @@
+"""Engine-level integration: multi-stream run, health + metrics endpoints."""
+
+import asyncio
+import json
+
+import aiohttp
+
+from arkflow_tpu.config import EngineConfig
+from arkflow_tpu.runtime.engine import Engine
+
+
+def test_engine_multi_stream_with_endpoints():
+    cfg = EngineConfig.from_mapping(
+        {
+            "streams": [
+                {
+                    "name": "s1",
+                    "input": {"type": "generate", "payload": '{"a": 1}', "interval": "2ms",
+                              "batch_size": 8},
+                    "pipeline": {"thread_num": 1, "processors": []},
+                    "output": {"type": "drop"},
+                },
+                {
+                    "name": "s2",
+                    "input": {"type": "memory", "messages": ["x", "y", "z"]},
+                    "pipeline": {"thread_num": 1, "processors": []},
+                    "output": {"type": "drop"},
+                },
+            ],
+            "health_check": {"enabled": True, "host": "127.0.0.1", "port": 18099},
+        }
+    )
+
+    async def go():
+        engine = Engine(cfg)
+        run_task = asyncio.create_task(engine.run())
+        try:
+            await asyncio.sleep(0.5)
+            async with aiohttp.ClientSession() as s:
+                async with s.get("http://127.0.0.1:18099/health") as r:
+                    assert r.status == 200
+                    body = json.loads(await r.text())
+                    assert body["streams"] == 2
+                async with s.get("http://127.0.0.1:18099/readiness") as r:
+                    assert r.status == 200
+                async with s.get("http://127.0.0.1:18099/metrics") as r:
+                    text = await r.text()
+                    assert 'arkflow_rows_in_total{stream="s1"}' in text
+                    assert 'arkflow_rows_out_total{stream="s2"} 3.0' in text
+        finally:
+            engine.shutdown()
+            await asyncio.wait_for(run_task, timeout=10)
+
+    asyncio.run(go())
+
+
+def test_engine_survives_crashing_stream():
+    """One stream failing must not take the engine down (ref engine/mod.rs:268-273)."""
+    cfg = EngineConfig.from_mapping(
+        {
+            "streams": [
+                {
+                    "name": "bad",
+                    # file input with a missing path fails at connect -> stream crashes
+                    "input": {"type": "file", "path": "/nonexistent/xyz.parquet"},
+                    "pipeline": {"thread_num": 1, "processors": []},
+                    "output": {"type": "drop"},
+                },
+                {
+                    "name": "good",
+                    "input": {"type": "memory", "messages": ["a", "b"]},
+                    "pipeline": {"thread_num": 1, "processors": []},
+                    "output": {"type": "drop"},
+                },
+            ],
+            "health_check": {"enabled": False},
+        }
+    )
+
+    async def go():
+        engine = Engine(cfg)
+        await asyncio.wait_for(engine.run(), timeout=10)
+        # the good stream completed; rows flowed
+        good = next(s for s in engine.streams if s.name == "good")
+        assert good.m_rows_out.value == 2
+
+    asyncio.run(go())
