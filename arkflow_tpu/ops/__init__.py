@@ -1,3 +1,4 @@
 """Pallas TPU kernels for the ops XLA fusion doesn't already cover."""
 
 from arkflow_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from arkflow_tpu.ops.ragged_attention import ragged_flash_attention  # noqa: F401

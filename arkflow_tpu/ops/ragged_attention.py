@@ -1,0 +1,109 @@
+"""Ragged flash attention: per-row sequence lengths, no wasted tiles.
+
+The streaming engine pads variable-length batches to a bucket; plain attention
+then burns MXU cycles on padding. This kernel (the ragged-attention pattern of
+PAPERS.md "Ragged Paged Attention") takes the true ``lengths`` per row as a
+scalar-prefetch argument and bounds the K/V tile loop per (batch, q-tile)
+program at the row's real length — fully-padded tiles are never touched, and
+padded key positions inside the last tile are masked. Output rows beyond a
+row's length are zeros.
+
+Same VMEM/online-softmax structure as ``flash_attention``; use it when batches
+are bucketed well above their typical fill.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30
+
+
+def _ragged_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref, *, tile_k: int, causal: bool):
+    bi = pl.program_id(0)
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [TQ, D]
+    tq, d = q.shape
+    s = k_ref.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    length = lengths_ref[bi]
+
+    # K tiles that contain any valid key for this row
+    n_k_row = (length + tile_k - 1) // tile_k
+    if causal:
+        n_k_causal = ((qi + 1) * tq + tile_k - 1) // tile_k
+        n_k_row = jnp.minimum(n_k_row, n_k_causal)
+    n_k_row = jnp.minimum(n_k_row, s // tile_k)
+
+    q_pos = qi * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tile_k), 0)
+
+    def body(t, carry):
+        o, m, l = carry
+        k = k_ref[0, 0, pl.ds(t * tile_k, tile_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(t * tile_k, tile_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        k_pos = t * tile_k + jax.lax.broadcasted_iota(jnp.int32, (tq, tile_k), 1)
+        # mask padded keys AND padded queries (pad-query rows emit zeros)
+        valid = jnp.logical_and(k_pos < length, q_pos < length)
+        if causal:
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        scores = jnp.where(valid, scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((tq, d), jnp.float32)
+    m0 = jnp.full((tq,), _NEG, jnp.float32)
+    l0 = jnp.zeros((tq,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, n_k_row, body, (o0, m0, l0))
+    # pad queries (beyond the row's true length) emit zeros; note a fully
+    # masked softmax degenerates to uniform (exp(NEG-NEG)=1), so masking by
+    # the accumulator alone is not sufficient — mask by query position.
+    q_valid = (qi * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, 1), 0)) < length
+    o_ref[0, 0] = jnp.where(
+        q_valid, o / jnp.maximum(l[:, None], 1e-30), 0.0
+    ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "tile_q", "tile_k", "interpret"))
+def ragged_flash_attention(q, k, v, lengths, *, causal: bool = False,
+                           tile_q: int = 128, tile_k: int = 128,
+                           interpret: bool = False):
+    """q/k/v: [B, H, S, D]; lengths: [B] int32 true sequence lengths."""
+    b, h, s, d = q.shape
+    tile_q = min(tile_q, s)
+    tile_k = min(tile_k, s)
+    if s % tile_q or s % tile_k:
+        raise ValueError(f"seq len {s} must divide tiles ({tile_q}, {tile_k})")
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401 (memory spaces default)
+
+    grid = (b, h, s // tile_q)
+    kernel = functools.partial(_ragged_kernel, tile_k=tile_k, causal=causal)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, tile_q, d), lambda bi, hi, qi, *_: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi, *_: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi, *_: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, tile_q, d), lambda bi, hi, qi, *_: (bi, hi, qi, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(lengths, jnp.int32), q, k, v)

@@ -27,6 +27,11 @@ def build_codec(config: Optional[dict], resource: Resource) -> Optional[Codec]:
 def decode_payloads(payloads: list[bytes], codec: Optional[Codec]) -> MessageBatch:
     if codec is None:
         return MessageBatch.new_binary(payloads)
+    if len(payloads) == 1:  # per-message hot path: no batch-reader setup cost
+        return codec.decode(payloads[0])
+    decode_many = getattr(codec, "decode_many", None)
+    if decode_many is not None:  # vectorized path (json/protobuf)
+        return decode_many(payloads)
     batches = [codec.decode(p) for p in payloads]
     batches = [b for b in batches if b.num_rows > 0]
     if not batches:

@@ -21,8 +21,17 @@ from arkflow_tpu.errors import CodecError
 def _rows_to_batch(rows: list[dict[str, Any]]) -> MessageBatch:
     if not rows:
         return MessageBatch.empty()
+    # union of keys across all rows (from_pylist would take row 0's schema);
+    # missing keys become nulls
+    keys: list[str] = []
+    seen: set[str] = set()
+    for r in rows:
+        for k in r:
+            if k not in seen:
+                seen.add(k)
+                keys.append(k)
     try:
-        table = pa.Table.from_pylist(rows)
+        table = pa.Table.from_pydict({k: [r.get(k) for r in rows] for k in keys})
     except (pa.ArrowInvalid, pa.ArrowTypeError) as e:
         raise CodecError(f"cannot infer Arrow schema from JSON: {e}") from e
     return MessageBatch.from_table(table)
@@ -38,6 +47,49 @@ def _cell_to_json(v: Any) -> Any:
 
 
 class JsonCodec(Codec):
+    def decode_many(self, payloads: list[bytes]) -> MessageBatch:
+        """Vectorized decode: line-delimited concat through Arrow's C++ JSON
+        reader; falls back to one unified Python parse (heterogeneous keys
+        merge with nulls) for arrays, multi-line docs, or when the C++ reader
+        infers temporal types (strings must stay strings for round-tripping)."""
+        import io
+
+        import pyarrow.json as pajson
+
+        if len(payloads) == 1:
+            return self.decode(payloads[0])
+        blob = b"\n".join(p.strip() for p in payloads if p.strip())
+        if not blob:
+            return MessageBatch.empty()
+        if not blob.lstrip().startswith(b"["):
+            try:
+                table = pajson.read_json(io.BytesIO(blob))
+                if not any(
+                    pa.types.is_temporal(f.type) for f in table.schema
+                ):  # ISO-looking strings must not silently become timestamps
+                    return MessageBatch.from_table(table)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                pass  # ragged/nested payloads: fall through to the row path
+        rows: list[dict[str, Any]] = []
+        for p in payloads:
+            text = p.decode("utf-8", "replace").strip()
+            if not text:
+                continue
+            try:
+                obj = json.loads(text)
+            except json.JSONDecodeError as e:
+                raise CodecError(f"invalid JSON: {e}") from e
+            if isinstance(obj, list):
+                for r in obj:
+                    if not isinstance(r, dict):
+                        raise CodecError("JSON array payload must contain objects")
+                rows.extend(obj)
+            elif isinstance(obj, dict):
+                rows.append(obj)
+            else:
+                raise CodecError(f"JSON payload must be object/array, got {type(obj).__name__}")
+        return _rows_to_batch(rows)
+
     def decode(self, payload: bytes) -> MessageBatch:
         text = payload.decode("utf-8", "replace").strip()
         if not text:

@@ -9,13 +9,12 @@ column into typed columns; ``arrow_to_json`` serialises rows back into
 
 from __future__ import annotations
 
-import json
 from typing import Optional
 
 from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD, MessageBatch
 from arkflow_tpu.components import Processor, Resource, register_processor
 from arkflow_tpu.errors import ProcessError
-from arkflow_tpu.plugins.codec.json_codec import JsonCodec, _rows_to_batch
+from arkflow_tpu.plugins.codec.json_codec import JsonCodec
 
 
 class JsonToArrowProcessor(Processor):
@@ -28,20 +27,11 @@ class JsonToArrowProcessor(Processor):
             return []
         if not batch.has_column(self.value_field):
             raise ProcessError(f"json_to_arrow: no {self.value_field!r} column")
-        rows = []
-        for payload in batch.to_binary(self.value_field):
-            text = payload.decode("utf-8", "replace").strip()
-            if not text:
-                continue
-            try:
-                obj = json.loads(text)
-            except json.JSONDecodeError as e:
-                raise ProcessError(f"json_to_arrow: invalid JSON: {e}") from e
-            if isinstance(obj, list):
-                rows.extend(obj)
-            else:
-                rows.append(obj)
-        out = _rows_to_batch(rows)
+        payloads = batch.to_binary(self.value_field)
+        try:
+            out = self.codec.decode_many(payloads)  # vectorized C++ JSON path
+        except Exception as e:
+            raise ProcessError(f"json_to_arrow: invalid JSON: {e}") from e
         # carry metadata columns through (same row count only)
         meta = batch.metadata_columns()
         if meta and out.num_rows == batch.num_rows:

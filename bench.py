@@ -6,7 +6,8 @@ for BASELINE.json config 2 (Kafka -> BERT-base classify -> Kafka) with broker
 I/O excluded so the number is rows/sec/chip. Prints ONE JSON line.
 
 Env knobs: BENCH_SECONDS (default 15), BENCH_BATCH (256), BENCH_SEQ (32),
-BENCH_TINY=1 for a CPU-sized smoke run.
+BENCH_TINY=1 for a CPU-sized smoke run, BENCH_MODE=sql for the CPU reference
+anchor (BASELINE.json config 1: generate -> json_to_arrow -> sql filter).
 """
 
 from __future__ import annotations
@@ -15,6 +16,25 @@ import asyncio
 import json
 import os
 import time
+
+
+def build_sql_config(batch: int) -> dict:
+    """BASELINE config 1: the CPU reference anchor (no model)."""
+    payload = '{"sensor": "temperature", "value": 42.5, "station": "eu-1"}'
+    return {
+        "name": "bench-sql",
+        "input": {"type": "generate", "payload": payload, "interval": 0, "batch_size": batch},
+        "pipeline": {
+            "thread_num": 4,
+            "processors": [
+                {"type": "json_to_arrow"},
+                {"type": "sql",
+                 "query": "SELECT sensor, value * 1.8 + 32 AS fahrenheit, station "
+                          "FROM flow WHERE value > 10"},
+            ],
+        },
+        "output": {"type": "drop"},
+    }
 
 
 def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
@@ -53,7 +73,8 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
     }
 
 
-async def run_bench(seconds: float, batch: int, seq: int, tiny: bool) -> dict:
+async def run_bench(seconds: float, batch: int, seq: int, tiny: bool,
+                    mode: str = "bert") -> dict:
     from arkflow_tpu.components import ensure_plugins_loaded
     from arkflow_tpu.config import StreamConfig
     from arkflow_tpu.obs import global_registry
@@ -62,7 +83,8 @@ async def run_bench(seconds: float, batch: int, seq: int, tiny: bool) -> dict:
     import sys
 
     ensure_plugins_loaded()
-    cfg = StreamConfig.from_mapping(build_stream_config(batch, seq, tiny))
+    cfg_map = build_sql_config(batch) if mode == "sql" else build_stream_config(batch, seq, tiny)
+    cfg = StreamConfig.from_mapping(cfg_map)
     print("bench: building model...", file=sys.stderr, flush=True)
     stream = build_stream(cfg, name="bench")
     print("bench: model built; compiling + streaming...", file=sys.stderr, flush=True)
@@ -119,6 +141,39 @@ def main() -> None:
     import sys
 
     tiny = os.environ.get("BENCH_TINY", "0") == "1"
+    mode = os.environ.get("BENCH_MODE", "bert")
+    if mode == "sql":
+        # pure-CPU anchor. The axon sitecustomize makes even jax.devices("cpu")
+        # init the TPU tunnel, so re-exec in a clean env first.
+        if "axon" in os.environ.get("PYTHONPATH", "") and os.environ.get("JAX_PLATFORMS") != "cpu":
+            env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+            env["JAX_PLATFORMS"] = "cpu"
+            res = subprocess.run([sys.executable, __file__], env=env, capture_output=True)
+            sys.stdout.write(res.stdout.decode())
+            sys.stderr.write(res.stderr.decode())
+            sys.exit(res.returncode)
+        import jax
+
+        try:
+            jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        except RuntimeError:
+            pass
+        seconds = float(os.environ.get("BENCH_SECONDS", "15"))
+        batch = int(os.environ.get("BENCH_BATCH", "1024"))
+        res = asyncio.run(run_bench(seconds, batch, 0, True, mode="sql"))
+        print(
+            json.dumps(
+                {
+                    "metric": "sql_filter_rows_per_sec_cpu_ref",
+                    "value": round(res["rows_per_sec"], 1),
+                    "unit": "rows/s",
+                    "vs_baseline": 0.0,
+                    "detail": {"rows": res["rows"], "elapsed_s": round(res["elapsed_s"], 2),
+                               "batch": batch},
+                }
+            )
+        )
+        return
     if not tiny and not _tpu_reachable():
         # Degraded mode: a wedged tunnel would hang this process's jax import
         # uninterruptibly, so re-exec in a clean env (no axon sitecustomize)

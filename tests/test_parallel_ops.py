@@ -137,3 +137,44 @@ def test_decoder_train_step_with_ring_attention():
         batch = {"input_ids": ids, "targets": ids, "mask": jnp.ones((4, 16), jnp.int32)}
         p2, st2, loss = ts(p, st, batch)
         assert np.isfinite(float(loss))
+
+
+def test_ragged_flash_attention_matches_masked_reference():
+    from arkflow_tpu.ops.ragged_attention import ragged_flash_attention
+
+    rng = np.random.RandomState(2)
+    b, h, s, d = 3, 2, 32, 8
+    q, k, v = (jnp.asarray(rng.randn(b, h, s, d), jnp.float32) * 0.5 for _ in range(3))
+    lengths = jnp.array([32, 17, 5], jnp.int32)
+    out = ragged_flash_attention(q, k, v, lengths, tile_q=8, tile_k=8, interpret=True)
+    # reference: mask keys beyond each row's length
+    qt = jnp.einsum("bhsd->bshd", q)
+    kt = jnp.einsum("bhsd->bshd", k)
+    vt = jnp.einsum("bhsd->bshd", v)
+    import math as _m
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qt, kt) / _m.sqrt(d)
+    valid = (jnp.arange(s)[None, :] < lengths[:, None])[:, None, None, :]
+    scores = jnp.where(valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ref = jnp.einsum("bhqk,bkhd->bhqd", probs, vt)
+    for i, ln in enumerate([32, 17, 5]):
+        np.testing.assert_allclose(
+            np.asarray(out[i, :, :ln]), np.asarray(ref[i, :, :ln]), atol=2e-5
+        )
+    # padded query rows emit zeros
+    assert np.allclose(np.asarray(out[2, :, 5:]), 0.0)
+
+
+def test_ragged_flash_attention_causal():
+    from arkflow_tpu.ops.ragged_attention import ragged_flash_attention
+
+    rng = np.random.RandomState(3)
+    b, h, s, d = 2, 2, 16, 8
+    q, k, v = (jnp.asarray(rng.randn(b, h, s, d), jnp.float32) for _ in range(3))
+    lengths = jnp.array([16, 9], jnp.int32)
+    out = ragged_flash_attention(q, k, v, lengths, causal=True, tile_q=4, tile_k=4, interpret=True)
+    full = flash_attention(q, k, v, causal=True, tile_q=4, tile_k=4, interpret=True)
+    # row 0 (full length) must match the plain causal kernel
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(full[0]), atol=2e-5)
+    # row 1: valid prefix matches causal attention restricted to 9 keys
+    np.testing.assert_allclose(np.asarray(out[1, :, :9]), np.asarray(full[1, :, :9]), atol=2e-5)

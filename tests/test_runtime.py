@@ -338,3 +338,58 @@ def test_memory_buffer_timeout_flush_with_waiting_reader():
         return batch.num_rows
 
     assert asyncio.run(go()) == 2
+
+
+def test_disconnection_triggers_reconnect():
+    """Disconnection -> reconnect loop -> stream keeps flowing (ref stream/mod.rs:183-194)."""
+    from arkflow_tpu.errors import Disconnection, EndOfInput
+    from arkflow_tpu.runtime import stream as stream_mod
+
+    class FlakyInput:
+        def __init__(self):
+            self.connects = 0
+            self.reads = 0
+
+        async def connect(self):
+            self.connects += 1
+
+        async def read(self):
+            self.reads += 1
+            if self.reads == 2:
+                raise Disconnection("simulated drop")
+            if self.reads > 4:
+                raise EndOfInput()
+            return MessageBatch.new_binary([b"m%d" % self.reads]), NoopAck()
+
+        async def close(self):
+            pass
+
+    inp = FlakyInput()
+    sink = CollectOutput()
+    stream = Stream(inp, Pipeline([]), sink, thread_num=1, name="flaky")
+    # shrink the reconnect delay for the test
+    orig = stream_mod.RECONNECT_DELAY_S
+    stream_mod.RECONNECT_DELAY_S = 0.01
+    try:
+        asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=10))
+    finally:
+        stream_mod.RECONNECT_DELAY_S = orig
+    assert inp.connects == 2  # initial + one reconnect
+    payloads = [p for b in sink.batches for p in b.to_binary()]
+    assert payloads == [b"m1", b"m3", b"m4"]
+
+
+def test_json_decode_many_preserves_strings_and_merges_keys():
+    """Vectorized JSON decode: ISO strings stay strings; ragged keys merge (review fixes)."""
+    from arkflow_tpu.plugins.codec.json_codec import JsonCodec
+
+    codec = JsonCodec()
+    # timestamp-looking strings must round-trip as strings
+    out = codec.decode_many([b'{"ts": "2026-07-28T10:00:00", "v": 1}'] * 3)
+    assert out.column("ts").to_pylist() == ["2026-07-28T10:00:00"] * 3
+    payloads = codec.encode(out)  # must not raise
+    assert b"2026-07-28T10:00:00" in payloads[0]
+    # heterogeneous key sets merge with nulls (array forces the fallback path)
+    out = codec.decode_many([b'[{"a": 1}]', b'{"a": 2, "b": 9}'])
+    assert out.column("a").to_pylist() == [1, 2]
+    assert out.column("b").to_pylist() == [None, 9]
