@@ -1,0 +1,46 @@
+"""Multi-host distributed runtime (DCN tier).
+
+One-call bootstrap over ``jax.distributed``: every host runs the same engine
+binary, the JAX runtime forms the global device mesh across hosts (ICI within
+a slice, DCN between), and the existing ``MeshSpec``/``shard_params`` path
+works unchanged on the global device list. This is the XLA-collective
+equivalent of a NCCL/MPI communication backend — collectives are compiled
+into the program rather than hand-driven (SURVEY.md section 2.7: the
+reference's only cross-node mechanisms are broker protocols and Ballista).
+
+Environment-variable driven so k8s/slurm launchers need no config changes:
+
+    ARKFLOW_COORDINATOR=host0:1234 ARKFLOW_NUM_PROCESSES=4 ARKFLOW_PROCESS_ID=2
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("arkflow.distributed")
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Initialize jax.distributed from args or ARKFLOW_* env; returns True if
+    multi-process mode was activated (False = single host, no-op)."""
+    coordinator = coordinator or os.environ.get("ARKFLOW_COORDINATOR")
+    if not coordinator:
+        return False
+    import jax  # deferred: single-host pipelines shouldn't touch jax here
+    num_processes = int(num_processes or os.environ.get("ARKFLOW_NUM_PROCESSES", "1"))
+    process_id = int(process_id if process_id is not None
+                     else os.environ.get("ARKFLOW_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "distributed runtime up: process %d/%d, %d global / %d local devices",
+        process_id, num_processes, jax.device_count(), jax.local_device_count(),
+    )
+    return True

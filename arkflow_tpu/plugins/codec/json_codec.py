@@ -46,12 +46,53 @@ def _cell_to_json(v: Any) -> Any:
     return v
 
 
+def _has_temporal(dtype: pa.DataType) -> bool:
+    """True if the type (or any nested child) is temporal — the C++ JSON
+    reader infers ISO-looking strings as timestamps, which must not happen."""
+    if pa.types.is_temporal(dtype):
+        return True
+    for i in range(dtype.num_fields):
+        if _has_temporal(dtype.field(i).type):
+            return True
+    if pa.types.is_list(dtype) or pa.types.is_large_list(dtype) or pa.types.is_fixed_size_list(dtype):
+        return _has_temporal(dtype.value_type)
+    return False
+
+
+def _parse_payload_rows(payload: bytes) -> list[dict[str, Any]]:
+    """One payload -> row dicts: a JSON object, array of objects, or NDJSON."""
+    text = payload.decode("utf-8", "replace").strip()
+    if not text:
+        return []
+    rows: list[dict[str, Any]] = []
+    if text.startswith("["):
+        try:
+            parsed = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise CodecError(f"invalid JSON: {e}") from e
+        if not isinstance(parsed, list) or not all(isinstance(r, dict) for r in parsed):
+            raise CodecError("JSON array payload must contain objects")
+        return parsed
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise CodecError(f"invalid JSON line: {e}") from e
+        if not isinstance(obj, dict):
+            raise CodecError(f"JSON line must be an object, got {type(obj).__name__}")
+        rows.append(obj)
+    return rows
+
+
 class JsonCodec(Codec):
     def decode_many(self, payloads: list[bytes]) -> MessageBatch:
         """Vectorized decode: line-delimited concat through Arrow's C++ JSON
         reader; falls back to one unified Python parse (heterogeneous keys
-        merge with nulls) for arrays, multi-line docs, or when the C++ reader
-        infers temporal types (strings must stay strings for round-tripping)."""
+        merge with nulls, NDJSON handled per line) for arrays, or when the
+        C++ reader infers temporal types anywhere in the schema."""
         import io
 
         import pyarrow.json as pajson
@@ -64,59 +105,17 @@ class JsonCodec(Codec):
         if not blob.lstrip().startswith(b"["):
             try:
                 table = pajson.read_json(io.BytesIO(blob))
-                if not any(
-                    pa.types.is_temporal(f.type) for f in table.schema
-                ):  # ISO-looking strings must not silently become timestamps
+                if not any(_has_temporal(f.type) for f in table.schema):
                     return MessageBatch.from_table(table)
             except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
                 pass  # ragged/nested payloads: fall through to the row path
         rows: list[dict[str, Any]] = []
         for p in payloads:
-            text = p.decode("utf-8", "replace").strip()
-            if not text:
-                continue
-            try:
-                obj = json.loads(text)
-            except json.JSONDecodeError as e:
-                raise CodecError(f"invalid JSON: {e}") from e
-            if isinstance(obj, list):
-                for r in obj:
-                    if not isinstance(r, dict):
-                        raise CodecError("JSON array payload must contain objects")
-                rows.extend(obj)
-            elif isinstance(obj, dict):
-                rows.append(obj)
-            else:
-                raise CodecError(f"JSON payload must be object/array, got {type(obj).__name__}")
+            rows.extend(_parse_payload_rows(p))
         return _rows_to_batch(rows)
 
     def decode(self, payload: bytes) -> MessageBatch:
-        text = payload.decode("utf-8", "replace").strip()
-        if not text:
-            return MessageBatch.empty()
-        rows: list[dict[str, Any]]
-        if text.startswith("["):
-            try:
-                parsed = json.loads(text)
-            except json.JSONDecodeError as e:
-                raise CodecError(f"invalid JSON: {e}") from e
-            if not isinstance(parsed, list) or not all(isinstance(r, dict) for r in parsed):
-                raise CodecError("JSON array payload must contain objects")
-            rows = parsed
-        else:
-            rows = []
-            for line in text.splitlines():
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    obj = json.loads(line)
-                except json.JSONDecodeError as e:
-                    raise CodecError(f"invalid JSON line: {e}") from e
-                if not isinstance(obj, dict):
-                    raise CodecError(f"JSON line must be an object, got {type(obj).__name__}")
-                rows.append(obj)
-        return _rows_to_batch(rows)
+        return _rows_to_batch(_parse_payload_rows(payload))
 
     def encode(self, batch: MessageBatch) -> list[bytes]:
         out = []

@@ -1,10 +1,10 @@
 """Gated connectors: protocols whose clients aren't implementable natively yet.
 
 Pulsar speaks a protobuf-framed binary protocol with its own service
-discovery; Modbus needs device-class testing hardware. Both register builders
-that fail fast with a clear message (the environment forbids installing client
-libraries), so `--validate` reports the gap instead of a stream crashing at
-runtime. (Reference: crates/arkflow-plugin/src/input/{pulsar,modbus}.rs.)
+discovery; its builders fail fast with a clear message (the environment
+forbids installing client libraries), so ``--validate`` reports the gap
+instead of a stream crashing at runtime.
+(Reference: crates/arkflow-plugin/src/input/pulsar.rs.)
 """
 
 from __future__ import annotations
@@ -15,8 +15,8 @@ from arkflow_tpu.errors import ConfigError
 _MSG = (
     "{name} support requires a client library that is not present in this image "
     "and has no native implementation yet; available connectors: kafka, mqtt, "
-    "nats (core), redis, http, websocket, file, sql(sqlite), generate, memory, "
-    "multiple_inputs"
+    "nats (core), redis, http, websocket, file, sql(sqlite), modbus, generate, "
+    "memory, multiple_inputs"
 )
 
 
@@ -28,8 +28,3 @@ def _build_pulsar_in(config: dict, resource: Resource):
 @register_output("pulsar")
 def _build_pulsar_out(config: dict, resource: Resource):
     raise ConfigError(_MSG.format(name="pulsar output"))
-
-
-@register_input("modbus")
-def _build_modbus_in(config: dict, resource: Resource):
-    raise ConfigError(_MSG.format(name="modbus input"))

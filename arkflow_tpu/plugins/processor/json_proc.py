@@ -27,10 +27,14 @@ class JsonToArrowProcessor(Processor):
             return []
         if not batch.has_column(self.value_field):
             raise ProcessError(f"json_to_arrow: no {self.value_field!r} column")
+        import pyarrow as pa
+
+        from arkflow_tpu.errors import CodecError
+
         payloads = batch.to_binary(self.value_field)
         try:
             out = self.codec.decode_many(payloads)  # vectorized C++ JSON path
-        except Exception as e:
+        except (CodecError, pa.ArrowInvalid) as e:
             raise ProcessError(f"json_to_arrow: invalid JSON: {e}") from e
         # carry metadata columns through (same row count only)
         meta = batch.metadata_columns()

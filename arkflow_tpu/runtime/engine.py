@@ -84,6 +84,9 @@ class Engine:
     # -- lifecycle ---------------------------------------------------------
 
     async def run(self) -> None:
+        from arkflow_tpu.parallel.distributed import init_distributed
+
+        init_distributed()  # no-op unless ARKFLOW_COORDINATOR is set
         ensure_plugins_loaded()
         await self._start_health_server()
         self._install_signal_handlers()

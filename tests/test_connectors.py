@@ -617,3 +617,91 @@ def test_token_bucket():
     # immediate third acquire may pass only if refill happened; drain fully first
     tb._tokens = 0.0
     assert not tb.try_acquire()
+
+
+# -- modbus -----------------------------------------------------------------
+
+
+class FakeModbusServer:
+    """MBAP fake: serves fixed coils/registers for read function codes."""
+
+    def __init__(self):
+        self.coils = [True, False, True, True]
+        self.holding = [100, 200, 300, 400]
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        try:
+            await asyncio.wait_for(self.server.wait_closed(), 1.0)
+        except asyncio.TimeoutError:
+            pass
+
+    async def _client(self, reader, writer):
+        import struct
+
+        try:
+            while True:
+                header = await reader.readexactly(7)
+                tid, proto, length, unit = struct.unpack(">HHHB", header)
+                pdu = await reader.readexactly(length - 1)
+                func, addr, count = struct.unpack(">BHH", pdu)
+                if func in (1, 2):
+                    nbytes = (count + 7) // 8
+                    bits = bytearray(nbytes)
+                    for i in range(count):
+                        if self.coils[(addr + i) % len(self.coils)]:
+                            bits[i // 8] |= 1 << (i % 8)
+                    body = struct.pack(">BB", func, nbytes) + bytes(bits)
+                elif func in (3, 4):
+                    regs = [self.holding[(addr + i) % len(self.holding)] for i in range(count)]
+                    body = struct.pack(">BB", func, 2 * count) + struct.pack(f">{count}H", *regs)
+                else:
+                    body = struct.pack(">BB", func | 0x80, 1)
+                writer.write(struct.pack(">HHHB", tid, 0, len(body) + 1, unit) + body)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return
+
+
+def test_modbus_input_polls_points():
+    async def go():
+        srv = FakeModbusServer()
+        await srv.start()
+        try:
+            inp = build("input", {
+                "type": "modbus", "host": "127.0.0.1", "port": srv.port,
+                "interval": "1ms",
+                "points": [
+                    {"name": "pump_on", "kind": "coil", "address": 0},
+                    {"name": "temps", "kind": "holding", "address": 0, "count": 3},
+                ],
+            })
+            await inp.connect()
+            batch, _ = await asyncio.wait_for(inp.read(), timeout=3)
+            assert batch.column("pump_on").to_pylist() == [True]
+            assert batch.column("temps").to_pylist() == [[100, 200, 300]]
+            await inp.close()
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_modbus_config_validation():
+    with pytest.raises(ConfigError):
+        build("input", {"type": "modbus", "host": "h", "points": [{"name": "x", "kind": "bogus", "address": 0}]})
+
+
+def test_modbus_count_validation():
+    with pytest.raises(ConfigError):
+        build("input", {"type": "modbus", "host": "h",
+                        "points": [{"name": "x", "kind": "holding", "address": 0, "count": 0}]})
+    with pytest.raises(ConfigError):
+        build("input", {"type": "modbus", "host": "h",
+                        "points": [{"name": "x", "kind": "holding", "address": 0, "count": 200}]})

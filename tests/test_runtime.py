@@ -393,3 +393,16 @@ def test_json_decode_many_preserves_strings_and_merges_keys():
     out = codec.decode_many([b'[{"a": 1}]', b'{"a": 2, "b": 9}'])
     assert out.column("a").to_pylist() == [1, 2]
     assert out.column("b").to_pylist() == [None, 9]
+
+
+def test_json_decode_many_nested_temporal_and_ndjson():
+    """Nested ISO strings stay strings; NDJSON payloads parse per line (review fixes)."""
+    from arkflow_tpu.plugins.codec.json_codec import JsonCodec
+
+    codec = JsonCodec()
+    out = codec.decode_many([b'{"meta": {"ts": "2026-07-28 10:00:00"}, "v": 1}'] * 2)
+    assert out.column("meta").to_pylist() == [{"ts": "2026-07-28 10:00:00"}] * 2
+    codec.encode(out)  # must not raise
+    # NDJSON payload mixed with a single-object payload
+    out = codec.decode_many([b'{"x": 1}\n{"x": 2}', b'[{"x": 9}]'])
+    assert out.column("x").to_pylist() == [1, 2, 9]
