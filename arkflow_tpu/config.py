@@ -140,6 +140,28 @@ class EngineConfig:
         logging_ = LoggingConfig.from_mapping(m.get("logging", {}) or {})
         return cls(streams=streams, health_check=health, logging=logging_)
 
+    def validate_components(self) -> list[str]:
+        """Check every component's ``type`` tag resolves against the
+        registries (goes beyond the reference's parse-only ``--validate``).
+        Returns human-readable problems; empty = OK."""
+        from arkflow_tpu.components.registry import ensure_plugins_loaded, registered_types
+
+        ensure_plugins_loaded()
+        problems: list[str] = []
+        for i, s in enumerate(self.streams):
+            for family, c in (
+                ("input", s.input),
+                ("output", s.output),
+                *((("output", s.error_output),) if s.error_output else ()),
+                *((("buffer", s.buffer),) if s.buffer else ()),
+                *((("processor", p) for p in s.pipeline.processors)),
+                *((("temporary", t.config) for t in s.temporary)),
+            ):
+                t = c.get("type")
+                if t not in registered_types(family):
+                    problems.append(f"stream[{i}]: unknown {family} type {t!r}")
+        return problems
+
     @classmethod
     def from_file(cls, path: str | Path) -> "EngineConfig":
         p = Path(path)

@@ -34,6 +34,13 @@ class BertConfig:
     type_vocab: int = 2
     num_labels: int = 2
     ln_eps: float = 1e-12
+    #: attention via the ragged Pallas kernel. REQUIRES right-padding:
+    #: attention_mask must be a contiguous prefix of ones (row sums become
+    #: per-row lengths; ModelRunner enforces this outside jit). Fully-padded
+    #: K tiles are skipped on the MXU; pad positions output zeros instead of
+    #: attending (identical [CLS] logits — pad keys are masked either way).
+    use_flash_attention: bool = False
+    flash_interpret: bool = False  # CPU-interpret mode (tests)
 
 
 def init(rng, cfg: BertConfig) -> dict:
@@ -78,6 +85,26 @@ def encode(params: dict, cfg: BertConfig, input_ids, attention_mask):
     )
     x = cm.layer_norm(params["embed"]["ln"], x, cfg.ln_eps)
     mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,Sk]
+    lengths = attention_mask.astype(jnp.int32).sum(axis=1)  # contiguous-prefix masks
+
+    def _attend(q, k, v):
+        if cfg.use_flash_attention:
+            from arkflow_tpu.ops.ragged_attention import ragged_flash_attention
+
+            # largest pow2 tile (<=128) dividing the bucket length, so any
+            # configured seq bucket works
+            tile = 1
+            while tile * 2 <= min(s, 128) and s % (tile * 2) == 0:
+                tile *= 2
+            qh = jnp.einsum("bshd->bhsd", q)
+            kh = jnp.einsum("bshd->bhsd", k)
+            vh = jnp.einsum("bshd->bhsd", v)
+            out = ragged_flash_attention(
+                qh, kh, vh, lengths, tile_q=tile, tile_k=tile,
+                interpret=cfg.flash_interpret,
+            )
+            return jnp.einsum("bhsd->bshd", out)
+        return cm.attention(q, k, v, mask)
 
     def layer(x, lp):
         h = cfg.heads
@@ -85,7 +112,7 @@ def encode(params: dict, cfg: BertConfig, input_ids, attention_mask):
         q = cm.dense(lp["q"], x).reshape(b, s, h, dh)
         k = cm.dense(lp["k"], x).reshape(b, s, h, dh)
         v = cm.dense(lp["v"], x).reshape(b, s, h, dh)
-        attn = cm.attention(q, k, v, mask).reshape(b, s, cfg.hidden)
+        attn = _attend(q, k, v).reshape(b, s, cfg.hidden)
         x = cm.layer_norm(lp["attn_ln"], x + cm.dense(lp["attn_out"], attn), cfg.ln_eps)
         ff = cm.dense(lp["ffn_out"], cm.gelu(cm.dense(lp["ffn_in"], x)))
         x = cm.layer_norm(lp["ffn_ln"], x + ff, cfg.ln_eps)

@@ -20,6 +20,7 @@ Config:
 
 from __future__ import annotations
 
+import asyncio
 from dataclasses import dataclass
 from typing import Optional
 
@@ -63,7 +64,17 @@ class SqlProcessor(Processor):
                 lookup = await binding.temporary.get(keys)
                 ctx.register_batch(binding.table, lookup)
             ctx.register_batch(self.table_name, batch)
-            result = ctx.sql(self.query)
+            # off the event loop: the sqlite fallback tier is blocking, and
+            # Arrow kernels release the GIL (parallels DataFusion's own
+            # thread pool, ref sql.rs:126-129)
+            fut = asyncio.get_running_loop().run_in_executor(None, ctx.sql, self.query)
+            try:
+                result = await asyncio.shield(fut)
+            except asyncio.CancelledError:
+                # the pooled context must not be reclaimed while the worker
+                # thread still queries it: drain the future before releasing
+                await asyncio.wait([fut])
+                raise
         return [result] if result.num_rows > 0 else []
 
 

@@ -75,23 +75,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
 
     if args.validate:
-        # also check every component type resolves (goes beyond the reference's parse-only check)
-        from arkflow_tpu.components.registry import ensure_plugins_loaded, registered_types
-
-        ensure_plugins_loaded()
-        problems = []
-        for i, s in enumerate(cfg.streams):
-            for family, c in (
-                ("input", s.input),
-                ("output", s.output),
-                *((("output", s.error_output),) if s.error_output else ()),
-                *((("buffer", s.buffer),) if s.buffer else ()),
-                *((("processor", p) for p in s.pipeline.processors)),
-                *((("temporary", t.config) for t in s.temporary)),
-            ):
-                t = c.get("type")
-                if t not in registered_types(family):
-                    problems.append(f"stream[{i}]: unknown {family} type {t!r}")
+        problems = cfg.validate_components()
         if problems:
             print("\n".join(problems), file=sys.stderr)
             return 2

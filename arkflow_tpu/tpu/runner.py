@@ -111,6 +111,10 @@ class ModelRunner:
         self.m_infer = reg.histogram("arkflow_tpu_infer_seconds", "device step latency", labels)
         self.m_rows = reg.counter("arkflow_tpu_rows_total", "rows inferred", labels)
         self.m_pad = reg.counter("arkflow_tpu_pad_rows_total", "padding rows (waste)", labels)
+        self.m_fill = reg.histogram(
+            "arkflow_tpu_batch_fill_ratio", "true rows / bucket rows", labels,
+            buckets=[0.125, 0.25, 0.5, 0.75, 0.9, 1.0],
+        )
         self.m_compiles = reg.counter("arkflow_tpu_compiles_total", "bucket compiles", labels)
         self._seen_shapes: set[tuple] = set()
 
@@ -146,6 +150,7 @@ class ModelRunner:
             arr = pad_batch_dim(arr, bb)
             out[name] = arr
         self.m_pad.inc(bb - n)
+        self.m_fill.observe(n / bb)
         return out, n
 
     def _shape_key(self, padded: dict[str, np.ndarray]) -> tuple:
@@ -171,6 +176,17 @@ class ModelRunner:
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
 
         padded, n = self._pad_inputs(inputs)
+        if getattr(self.cfg, "use_flash_attention", False) and "attention_mask" in padded:
+            # the ragged kernel reads row sums as prefix lengths; a
+            # non-contiguous mask (left padding) would silently mis-attend
+            m = padded["attention_mask"]
+            lengths = m.sum(axis=1)
+            prefix = (np.arange(m.shape[1])[None, :] < lengths[:, None]).astype(m.dtype)
+            if not np.array_equal(prefix, m):
+                raise ConfigError(
+                    "use_flash_attention requires right-padded attention masks "
+                    "(contiguous prefix of ones)"
+                )
         key = self._shape_key(padded)
         if key not in self._seen_shapes:
             self._seen_shapes.add(key)

@@ -85,3 +85,12 @@ def test_engine_survives_crashing_stream():
         assert good.m_rows_out.value == 2
 
     asyncio.run(go())
+
+
+def test_all_example_configs_validate():
+    from pathlib import Path
+
+    examples = sorted(Path("examples").glob("*.yaml"))
+    assert len(examples) >= 8
+    for p in examples:
+        assert EngineConfig.from_file(p).validate_components() == [], p

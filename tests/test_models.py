@@ -245,3 +245,19 @@ def test_decoder_moe_forward_and_ep_sharded_train():
         batch = {"input_ids": ids_sh, "targets": ids_sh, "mask": jnp.ones_like(ids_sh)}
         _, _, loss = ts(sp_p, st, batch)
         assert np.isfinite(float(loss))
+
+
+def test_bert_flash_attention_matches_dense_logits():
+    """use_flash_attention (ragged Pallas kernel) must not change [CLS] logits."""
+    fam = get_model("bert_classifier")
+    cfg_d = fam.make_config(**TINY_BERT)
+    cfg_f = fam.make_config(**TINY_BERT, use_flash_attention=True, flash_interpret=True)
+    p = fam.init(jax.random.PRNGKey(0), cfg_d)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(1, 100, (3, 16)), jnp.int32)
+    mask = jnp.asarray([[1] * 16, [1] * 9 + [0] * 7, [1] * 4 + [0] * 12], jnp.int32)
+    dense = fam.apply(p, cfg_d, input_ids=ids, attention_mask=mask)
+    flash = fam.apply(p, cfg_f, input_ids=ids, attention_mask=mask)
+    np.testing.assert_allclose(np.asarray(dense["logits"]), np.asarray(flash["logits"]),
+                               atol=3e-2, rtol=1e-2)
+    np.testing.assert_array_equal(np.asarray(dense["label"]), np.asarray(flash["label"]))
