@@ -41,3 +41,14 @@ from arkflow_tpu.errors import (  # noqa: F401
     WriteError,
 )
 from arkflow_tpu.batch import MessageBatch  # noqa: F401
+
+
+def run(config_path: str) -> None:
+    """Library entry point: run an engine from a config file (blocks until
+    the streams finish or SIGINT/SIGTERM)."""
+    import asyncio
+
+    from arkflow_tpu.config import EngineConfig
+    from arkflow_tpu.runtime.engine import Engine
+
+    asyncio.run(Engine(EngineConfig.from_file(config_path)).run())

@@ -179,14 +179,21 @@ class SlidingWindow(WindowBase):
     """Message-count window with overlap: window k covers messages
     ``[k*slide - window_size, k*slide)`` — deterministic regardless of
     reader/writer interleaving. A message's ack fires with the emission after
-    which it can no longer appear in any future window."""
+    which it can no longer appear in any future window. An optional
+    ``interval`` additionally emits the current window contents on a timer
+    (ref sliding_window.rs exposes window_size/interval/slide_size)."""
 
-    def __init__(self, window_size: int, slide_size: int, **kw):
+    def __init__(self, window_size: int, slide_size: int,
+                 interval_s: float | None = None, **kw):
         super().__init__(**kw)
         if window_size <= 0 or slide_size <= 0:
             raise ConfigError("sliding_window sizes must be positive")
+        if interval_s is not None and interval_s <= 0:
+            raise ConfigError("sliding_window.interval must be positive")
         self.window_size = window_size
         self.slide_size = slide_size
+        self.interval_s = interval_s
+        self._last_interval_emit: float | None = None
         self._messages: deque = deque()  # (input_name, batch, ack, idx)
         self._total = 0
         self._next_boundary = slide_size
@@ -200,11 +207,32 @@ class SlidingWindow(WindowBase):
             self._cond.notify_all()
 
     def _next_deadline(self, now: float) -> Optional[float]:
-        return None  # purely count-driven
+        if self.interval_s is None or not self._messages:
+            return None
+        if self._total <= self._last_emit_end:
+            return None  # nothing new since the last emission: no timer to arm
+        if self._last_interval_emit is None:
+            self._last_interval_emit = now
+        return self._last_interval_emit + self.interval_s
 
     def _take_due_locked(self, now: float, closing: bool):
         if not self._messages:
             return None
+        if (
+            self.interval_s is not None
+            and self._last_interval_emit is not None
+            and now >= self._last_interval_emit + self.interval_s
+            and self._total > self._last_emit_end
+        ):
+            # timer emission: current window = last window_size messages,
+            # nothing expires (count boundaries still govern acks)
+            self._last_interval_emit = now
+            per_input: dict[str, list] = {}
+            for name, b, _, idx in self._messages:
+                if idx >= max(0, self._total - self.window_size):
+                    per_input.setdefault(name, []).append(b)
+            self._last_emit_end = self._total
+            return (per_input, VecAck())
         if self._total >= self._next_boundary:
             k = self._next_boundary
             self._next_boundary += self.slide_size
@@ -288,7 +316,12 @@ def _build_sliding(config: dict, resource: Resource) -> SlidingWindow:
     if ws is None:
         raise ConfigError("sliding_window requires 'window_size'")
     slide = config.get("slide_size", ws)
-    return SlidingWindow(int(ws), int(slide), **_common_kwargs(config, resource))
+    interval = config.get("interval")
+    return SlidingWindow(
+        int(ws), int(slide),
+        interval_s=parse_duration(interval) if interval is not None else None,
+        **_common_kwargs(config, resource),
+    )
 
 
 @register_buffer("session_window")

@@ -34,7 +34,7 @@ from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
 from arkflow_tpu.connect.kafka_client import KafkaClient, KafkaProtocolError
 from arkflow_tpu.errors import ConfigError, Disconnection, EndOfInput
-from arkflow_tpu.plugins.codec.helper import build_codec
+from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
 
 logger = logging.getLogger("arkflow.kafka")
 
@@ -130,9 +130,7 @@ class KafkaInput(Input):
     def _records_to_batch(self, records, partition: int) -> MessageBatch:
         values = [r.value or b"" for r in records]
         if self.codec is not None:
-            batches = [self.codec.decode(v) for v in values]
-            batches = [b for b in batches if b.num_rows]
-            base = MessageBatch.concat(batches) if batches else MessageBatch.empty()
+            base = decode_payloads(values, self.codec)
             per_row = None  # codec may expand rows; per-record meta not aligned
         else:
             base = MessageBatch.new_binary(values)

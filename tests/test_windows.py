@@ -135,3 +135,48 @@ def test_window_config_validation():
         build_component("buffer", {"type": "sliding_window"}, Resource())
     with pytest.raises(ConfigError):
         build_component("buffer", {"type": "session_window"}, Resource())
+
+
+def test_sliding_window_interval_emission():
+    """With 'interval', the current window also emits on a timer (no acks consumed)."""
+
+    async def go():
+        acked: list = []
+        w = SlidingWindow(window_size=10, slide_size=10, interval_s=0.04)
+        for i in range(3):  # below the count boundary
+            await w.write(mb(i), CountingAck(acked))
+        t0 = asyncio.get_running_loop().time()
+        batch, ack = await asyncio.wait_for(w.read(), timeout=2)
+        assert asyncio.get_running_loop().time() - t0 >= 0.03
+        assert batch.column("i").to_pylist() == [0, 1, 2]
+        await ack.ack()
+        assert acked == []  # timer emission holds no acks; count boundaries govern
+
+    asyncio.run(go())
+
+
+def test_sliding_window_timer_does_not_busy_spin():
+    """Idle after a timer emission must block, not spin (review fix)."""
+
+    async def go():
+        w = SlidingWindow(window_size=10, slide_size=10, interval_s=0.02)
+        await w.write(mb(1), NoopAck())
+        await asyncio.wait_for(w.read(), timeout=2)  # timer emission
+        calls = {"n": 0}
+        orig = w._take_due_locked
+
+        def counted(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        w._take_due_locked = counted
+        reader = asyncio.create_task(w.read())
+        await asyncio.sleep(0.3)  # idle: nothing new to emit
+        reader.cancel()
+        try:
+            await reader
+        except asyncio.CancelledError:
+            pass
+        assert calls["n"] < 10, f"busy spin: {calls['n']} wakeups while idle"
+
+    asyncio.run(go())
