@@ -174,13 +174,11 @@ def param_specs(cfg: BertConfig, axes: dict) -> dict:
 
 def from_hf_state_dict(state: dict, cfg: BertConfig) -> dict:
     """Convert a HuggingFace ``BertForSequenceClassification`` state_dict
-    (torch tensors or numpy) into this model's param pytree."""
-    import numpy as np
+    (torch tensors — any dtype including bfloat16 — or numpy) into this
+    model's param pytree."""
 
     def t(name, transpose=False):
-        v = state[name]
-        arr = np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v, dtype=np.float32)
-        return jnp.asarray(arr.T if transpose else arr)
+        return cm.hf_tensor(state, name, transpose)
 
     def lin(prefix):
         return {"w": t(f"{prefix}.weight", transpose=True), "b": t(f"{prefix}.bias")}

@@ -86,3 +86,16 @@ def attention(q, k, v, mask=None, *, softmax_dtype=jnp.float32):
 
 def count_params(params: Params) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def hf_tensor(state: dict, name: str, transpose: bool = False) -> jnp.ndarray:
+    """One HF state_dict entry (torch tensor — any dtype incl. bfloat16 — or
+    numpy array) -> float32 jnp array, optionally transposed ([out,in] ->
+    [in,out] for torch linear weights)."""
+    import numpy as np
+
+    v = state[name]
+    if hasattr(v, "detach"):  # torch tensor; .float() first (numpy lacks bf16)
+        v = v.detach().cpu().float().numpy()
+    arr = np.asarray(v, dtype=np.float32)
+    return jnp.asarray(arr.T if transpose else arr)

@@ -44,6 +44,10 @@ class DecoderConfig:
     #: masked at combine) — correct and GSPMD-shardable; all-to-all token
     #: dispatch is a later optimisation.
     num_experts: int = 0
+    #: rematerialize each layer in the backward pass (jax.checkpoint): trades
+    #: FLOPs for HBM so long-context training fits (activations are O(layers)
+    #: otherwise)
+    remat: bool = False
 
 
 def llama3_8b() -> DecoderConfig:
@@ -182,7 +186,10 @@ def forward(params: dict, cfg: DecoderConfig, input_ids, *, axes=None, mesh=None
             x = x + cm.dense(lp["w_down"], gate * cm.dense(lp["w_up"], y))
         return _shard_act(x, axes), None
 
-    x, _ = jax.lax.scan(layer, x, params["layers"])
+    # prevent_cse=False: scan already isolates iterations, and the default
+    # optimization barriers would block XLA fusion in the backward pass
+    scan_body = jax.checkpoint(layer, prevent_cse=False) if cfg.remat else layer
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = cm.rms_norm(params["norm_out"], x, cfg.norm_eps)
     return cm.dense(params["lm_head"], x).astype(jnp.float32)
 
@@ -254,6 +261,43 @@ def param_specs(cfg: DecoderConfig, axes: dict) -> dict:
         "norm_out": {"scale": P(None)},
         "lm_head": {"w": P(None, tp)},
         "layers": layer,
+    }
+
+
+def from_hf_state_dict(state: dict, cfg: DecoderConfig) -> dict:
+    """Convert a HuggingFace ``LlamaForCausalLM`` state_dict (torch tensors —
+    any dtype including bfloat16 — or numpy arrays) into this model's param
+    pytree. Linear weights transpose from torch's [out, in] to [in, out]."""
+    if cfg.num_experts > 1:
+        raise ValueError("from_hf_state_dict maps dense Llama checkpoints; MoE configs unsupported")
+
+    def t(name, transpose=False):
+        return cm.hf_tensor(state, name, transpose)
+
+    layers = []
+    for i in range(cfg.layers):
+        p = f"model.layers.{i}"
+        layers.append(
+            {
+                "attn_norm": {"scale": t(f"{p}.input_layernorm.weight")},
+                "wq": {"w": t(f"{p}.self_attn.q_proj.weight", transpose=True)},
+                "wk": {"w": t(f"{p}.self_attn.k_proj.weight", transpose=True)},
+                "wv": {"w": t(f"{p}.self_attn.v_proj.weight", transpose=True)},
+                "wo": {"w": t(f"{p}.self_attn.o_proj.weight", transpose=True)},
+                "mlp_norm": {"scale": t(f"{p}.post_attention_layernorm.weight")},
+                "w_gate": {"w": t(f"{p}.mlp.gate_proj.weight", transpose=True)},
+                "w_up": {"w": t(f"{p}.mlp.up_proj.weight", transpose=True)},
+                "w_down": {"w": t(f"{p}.mlp.down_proj.weight", transpose=True)},
+            }
+        )
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+    lm_head = ("lm_head.weight" if "lm_head.weight" in state
+               else "model.embed_tokens.weight")  # tied embeddings
+    return {
+        "embed": {"table": t("model.embed_tokens.weight")},
+        "norm_out": {"scale": t("model.norm.weight")},
+        "lm_head": {"w": t(lm_head, transpose=True)},
+        "layers": stacked,
     }
 
 
@@ -421,6 +465,7 @@ register_model(
             "loss_fn": loss_fn,
             "make_train_step": make_train_step,
             "llama3_8b": llama3_8b,
+            "from_hf_state_dict": from_hf_state_dict,
             "init_kv_cache": init_kv_cache,
             "prefill": prefill,
             "decode_step": decode_step,
