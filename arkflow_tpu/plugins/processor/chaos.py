@@ -1,0 +1,60 @@
+"""Chaos processor: deterministic fault injection for pipeline testing.
+
+The reference has no fault-injection tooling (SURVEY.md section 5: "No fault
+injection"); this fills that gap so at-least-once semantics (error_output
+routing, ack-on-failure, reconnect behavior under load) can be exercised from
+config. Failures are deterministic (seeded) with ``thread_num: 1``; with
+multiple workers the count/rng state is shared across them, so *which* batch
+fails depends on scheduler interleaving (the failure *rate* still holds).
+
+Config:
+
+    type: chaos
+    fail_every: 10          # raise on every Nth batch (0 = never)
+    fail_rate: 0.05         # or: seeded random failure probability
+    latency: 25ms           # added delay per batch
+    seed: 7
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Processor, Resource, register_processor
+from arkflow_tpu.errors import ConfigError, ProcessError
+from arkflow_tpu.utils.duration import parse_duration
+
+
+class ChaosProcessor(Processor):
+    def __init__(self, fail_every: int = 0, fail_rate: float = 0.0,
+                 latency_s: float = 0.0, seed: int = 0):
+        if fail_every < 0 or not (0.0 <= fail_rate <= 1.0) or latency_s < 0:
+            raise ConfigError("chaos: fail_every >= 0, 0 <= fail_rate <= 1, latency >= 0")
+        self.fail_every = fail_every
+        self.fail_rate = fail_rate
+        self.latency_s = latency_s
+        self._rng = random.Random(seed)
+        self._count = 0
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        self._count += 1
+        if self.latency_s > 0:
+            await asyncio.sleep(self.latency_s)
+        if self.fail_every and self._count % self.fail_every == 0:
+            raise ProcessError(f"chaos: injected failure on batch {self._count}")
+        if self.fail_rate and self._rng.random() < self.fail_rate:
+            raise ProcessError(f"chaos: injected random failure on batch {self._count}")
+        return [batch]
+
+
+@register_processor("chaos")
+def _build(config: dict, resource: Resource) -> ChaosProcessor:
+    latency = config.get("latency")
+    return ChaosProcessor(
+        fail_every=int(config.get("fail_every", 0)),
+        fail_rate=float(config.get("fail_rate", 0.0)),
+        latency_s=parse_duration(latency) if latency is not None else 0.0,
+        seed=int(config.get("seed", 0)),
+    )

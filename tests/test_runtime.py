@@ -406,3 +406,18 @@ def test_json_decode_many_nested_temporal_and_ndjson():
     # NDJSON payload mixed with a single-object payload
     out = codec.decode_many([b'{"x": 1}\n{"x": 2}', b'[{"x": 9}]'])
     assert out.column("x").to_pylist() == [1, 2, 9]
+
+
+def test_chaos_processor_routes_to_error_output():
+    """Injected failures exercise the error_output + ack path from config."""
+    sink = run_stream_config(
+        {
+            "input": {"type": "memory", "messages": [f"m{i}".encode() for i in range(6)]},
+            "pipeline": {"thread_num": 1,
+                         "processors": [{"type": "chaos", "fail_every": 3}]},
+            "output": {"type": "drop"},
+            "error_output": {"type": "drop"},
+        }
+    )
+    # batches 3 and 6 fail -> 4 delivered
+    assert sink.dropped_batches == 4
