@@ -3,21 +3,65 @@
 Multi-chip shardings are validated on CPU (the driver separately dry-runs
 ``__graft_entry__.dryrun_multichip`` the same way); real-TPU benches run via
 bench.py outside pytest.
+
+The axon TPU-tunnel sitecustomize (PYTHONPATH=/root/.axon_site) forces
+JAX_PLATFORMS=axon, ignores in-process overrides, and — when the single
+tunnel client is busy or wedged — hangs ANY jax backend init, including
+``jax.devices("cpu")``. Tests are CPU-only by design, so when that hook is
+present we re-exec pytest once in a clean environment.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+_AXON_SITE = ".axon_site"
 
-# The axon TPU plugin ignores JAX_PLATFORMS; pin the default device to CPU so
-# tests never compile over the TPU tunnel (bench.py targets the real chip).
-import jax  # noqa: E402
 
-jax.config.update("jax_default_device", jax.devices("cpu")[0])
+def _pin_cpu_env(env: dict) -> None:
+    """Force the 8-device virtual CPU platform in an env mapping (single
+    source of truth for both the direct path and the re-exec'd child)."""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env.setdefault("JAX_ENABLE_X64", "0")
+
+
+_NEEDS_REEXEC = (
+    _AXON_SITE in os.environ.get("PYTHONPATH", "")
+    and os.environ.get("ARKFLOW_TESTS_REEXEC") != "1"
+)
+
+if not _NEEDS_REEXEC:
+    _pin_cpu_env(os.environ)
+
+    # Belt and braces for non-axon environments: pin the default device to CPU
+    # so tests never compile on an accelerator (bench.py targets the real chip).
+    import jax
+
+    jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+
+def pytest_configure(config):
+    if not _NEEDS_REEXEC:
+        return
+    # restore the real stdout/stderr fds before exec (pytest's fd-level
+    # capture is active by now and the child would inherit the temp files)
+    capman = config.pluginmanager.get_plugin("capturemanager")
+    if capman is not None:
+        capman.suspend_global_capture(in_=True)
+    env = dict(os.environ)
+    # drop only the axon sitecustomize entry; keep other PYTHONPATH entries
+    kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and _AXON_SITE not in p]
+    if kept:
+        env["PYTHONPATH"] = os.pathsep.join(kept)
+    else:
+        env.pop("PYTHONPATH", None)
+    env["ARKFLOW_TESTS_REEXEC"] = "1"
+    _pin_cpu_env(env)
+    args = list(config.invocation_params.args)
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *args], env)
 
 import asyncio
 import inspect
