@@ -60,8 +60,11 @@ def pytest_configure(config):
         env.pop("PYTHONPATH", None)
     env["ARKFLOW_TESTS_REEXEC"] = "1"
     _pin_cpu_env(env)
-    args = list(config.invocation_params.args)
-    os.execve(sys.executable, [sys.executable, "-m", "pytest", *args], env)
+    # sys.orig_argv preserves the full original invocation (coverage wrappers,
+    # -X/-W interpreter flags) instead of reconstructing "python -m pytest"
+    argv = list(getattr(sys, "orig_argv", None) or
+                [sys.executable, "-m", "pytest", *config.invocation_params.args])
+    os.execve(argv[0] if os.path.isabs(argv[0]) else sys.executable, argv, env)
 
 import asyncio
 import inspect
