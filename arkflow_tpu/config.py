@@ -94,6 +94,9 @@ class HealthCheckConfig:
     host: str = "0.0.0.0"
     port: int = 8080
     path: str = "/health"
+    #: opt-in: directory for POST /debug/profile JAX traces (endpoint is
+    #: absent when unset — it adds device overhead and writes to disk)
+    profiling_dir: Optional[str] = None
 
     @classmethod
     def from_mapping(cls, m: Mapping[str, Any]) -> "HealthCheckConfig":
@@ -102,6 +105,7 @@ class HealthCheckConfig:
         c.host = str(m.get("host", c.host))
         c.port = int(m.get("port", c.port))
         c.path = str(m.get("path", c.path))
+        c.profiling_dir = m.get("profiling_dir")
         return c
 
 

@@ -62,10 +62,48 @@ class Engine:
             return web.Response(text=global_registry().exposition(),
                                 content_type="text/plain", charset="utf-8")
 
+        profile_lock = asyncio.Lock()
+
+        async def profile(req):
+            """POST /debug/profile?seconds=5 — capture a JAX device trace
+            under the configured ``profiling_dir`` (view with
+            tensorboard/xprof). The reference has no profiler hooks at all
+            (SURVEY.md section 5). Opt-in via config; duration capped at 60s;
+            one capture at a time."""
+            import time as _time
+
+            import math
+
+            try:
+                seconds = float(req.query.get("seconds", "5"))
+            except ValueError:
+                return web.Response(status=400, text="seconds must be a number")
+            if not math.isfinite(seconds):  # min/max don't clamp NaN
+                return web.Response(status=400, text="seconds must be finite")
+            seconds = min(max(seconds, 0.1), 60.0)
+            if profile_lock.locked():
+                return web.Response(status=409, text="a capture is already running")
+            out_dir = f"{hc.profiling_dir.rstrip('/')}/trace-{int(_time.time())}"
+            async with profile_lock:
+                import jax
+
+                try:
+                    jax.profiler.start_trace(out_dir)
+                    try:
+                        await asyncio.sleep(seconds)
+                    finally:
+                        jax.profiler.stop_trace()  # never leave the profiler on
+                except Exception as e:
+                    return web.Response(status=500, text=f"profile failed: {e}")
+            return web.Response(text=json.dumps({"trace_dir": out_dir, "seconds": seconds}),
+                                content_type="application/json")
+
         app.router.add_get(hc.path, health)
         app.router.add_get("/readiness", readiness)
         app.router.add_get("/liveness", liveness)
         app.router.add_get("/metrics", metrics)
+        if hc.profiling_dir:
+            app.router.add_post("/debug/profile", profile)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
         site = web.TCPSite(runner, hc.host, hc.port)

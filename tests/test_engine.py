@@ -94,3 +94,40 @@ def test_all_example_configs_validate():
     assert len(examples) >= 8
     for p in examples:
         assert EngineConfig.from_file(p).validate_components() == [], p
+
+
+def test_profile_endpoint_captures_trace(tmp_path):
+    cfg = EngineConfig.from_mapping(
+        {
+            "streams": [
+                {"input": {"type": "generate", "payload": "x", "interval": "5ms", "batch_size": 4},
+                 "pipeline": {"thread_num": 1, "processors": []},
+                 "output": {"type": "drop"}}
+            ],
+            "health_check": {"enabled": True, "host": "127.0.0.1", "port": 18098,
+                             "profiling_dir": str(tmp_path)},
+        }
+    )
+
+    async def go():
+        import aiohttp
+
+        engine = Engine(cfg)
+        task = asyncio.create_task(engine.run())
+        try:
+            await asyncio.sleep(0.4)
+            async with aiohttp.ClientSession() as s:
+                url = "http://127.0.0.1:18098/debug/profile?seconds=0.3"
+                async with s.post(url) as r:
+                    assert r.status == 200, await r.text()
+                    body = json.loads(await r.text())
+                    assert body["trace_dir"].startswith(str(tmp_path))
+                    assert body["seconds"] == 0.3
+        finally:
+            engine.shutdown()
+            await asyncio.wait_for(task, timeout=10)
+        import pathlib
+
+        assert any(pathlib.Path(tmp_path).rglob("*.pb"))  # trace files written
+
+    asyncio.run(go())
