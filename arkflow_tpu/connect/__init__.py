@@ -7,3 +7,17 @@ with clear errors. This mirrors the reference's approach of linking native
 client libraries (rdkafka/rumqttc/redis-rs/async-nats) — here the native tier
 is in-repo.
 """
+
+
+def make_ssl_context(tls: dict):
+    """Build an ssl.SSLContext from connector config:
+    ``{ca_file: ..., cert_file: ..., key_file: ..., insecure_skip_verify: false}``."""
+    import ssl
+
+    ctx = ssl.create_default_context(cafile=tls.get("ca_file"))
+    if tls.get("cert_file"):
+        ctx.load_cert_chain(tls["cert_file"], tls.get("key_file"))
+    if tls.get("insecure_skip_verify"):
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    return ctx

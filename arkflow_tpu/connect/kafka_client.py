@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from arkflow_tpu.connect import make_ssl_context
 from arkflow_tpu.errors import ConnectError, Disconnection, ReadError, WriteError
 from arkflow_tpu.native import crc32c
 
@@ -37,6 +38,8 @@ API_METADATA = 3
 API_OFFSET_COMMIT = 8
 API_OFFSET_FETCH = 9
 API_FIND_COORDINATOR = 10
+API_SASL_HANDSHAKE = 17
+API_SASL_AUTHENTICATE = 36
 
 
 class KafkaProtocolError(ReadError):
@@ -246,10 +249,13 @@ def decode_record_batches(data: bytes) -> list[KafkaRecord]:
 
 
 class _BrokerConn:
-    def __init__(self, host: str, port: int, client_id: str):
+    def __init__(self, host: str, port: int, client_id: str,
+                 ssl_context=None, sasl: Optional[dict] = None):
         self.host = host
         self.port = port
         self.client_id = client_id
+        self.ssl_context = ssl_context
+        self.sasl = sasl  # {"mechanism": "PLAIN", "username", "password"}
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._correlation = 0
@@ -258,41 +264,73 @@ class _BrokerConn:
     async def connect(self, timeout: float = 5.0) -> None:
         try:
             self._reader, self._writer = await asyncio.wait_for(
-                asyncio.open_connection(self.host, self.port), timeout
+                asyncio.open_connection(self.host, self.port, ssl=self.ssl_context), timeout
             )
         except (OSError, asyncio.TimeoutError) as e:
             raise ConnectError(f"kafka connect to {self.host}:{self.port} failed: {e}") from e
+        if self.sasl:
+            try:
+                await self._authenticate(timeout)
+            except BaseException:
+                await self.close()  # don't leak the socket on rejected credentials
+                raise
+
+    async def _authenticate(self, timeout: float) -> None:
+        """SASL PLAIN via SaslHandshake v1 + SaslAuthenticate v0."""
+        mech = str(self.sasl.get("mechanism", "PLAIN")).upper()
+        if mech != "PLAIN":
+            raise ConnectError(f"kafka sasl mechanism {mech!r} not supported (PLAIN only)")
+        r = await self._request_unlocked(API_SASL_HANDSHAKE, 1, Writer().string(mech).build(), timeout)
+        err = r.i16()
+        if err != 0:
+            raise ConnectError(f"kafka sasl handshake rejected (error {err})")
+        n = r.i32()
+        for _ in range(max(0, n)):
+            r.string()  # enabled mechanisms
+        user = str(self.sasl.get("username", ""))
+        pw = str(self.sasl.get("password", ""))
+        token = b"\x00" + user.encode() + b"\x00" + pw.encode()
+        r = await self._request_unlocked(API_SASL_AUTHENTICATE, 0, Writer().bytes_(token).build(), timeout)
+        err = r.i16()
+        msg = r.string()
+        r.bytes_()  # server auth bytes
+        if err != 0:
+            raise ConnectError(f"kafka sasl authentication failed: {msg or err}")
+
+    async def _request_unlocked(self, api_key: int, api_version: int, body: bytes,
+                                timeout: float = 30.0) -> Reader:
+        self._correlation += 1
+        corr = self._correlation
+        header = (
+            Writer().i16(api_key).i16(api_version).i32(corr).string(self.client_id).build()
+        )
+        frame = header + body
+        self._writer.write(struct.pack(">i", len(frame)) + frame)
+        try:
+            await self._writer.drain()
+            size_b = await asyncio.wait_for(self._reader.readexactly(4), timeout)
+            (size,) = struct.unpack(">i", size_b)
+            payload = await asyncio.wait_for(self._reader.readexactly(size), timeout)
+        except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+            self._reader = None
+            raise Disconnection(f"kafka broker {self.host}:{self.port} lost: {e}") from e
+        r = Reader(payload)
+        got_corr = r.i32()
+        if got_corr != corr:
+            raise ReadError(f"kafka correlation mismatch {got_corr} != {corr}")
+        return r
 
     async def request(self, api_key: int, api_version: int, body: bytes,
                       timeout: float = 30.0) -> Reader:
         async with self._lock:
             if self._writer is None:
                 await self.connect()
-            self._correlation += 1
-            corr = self._correlation
-            header = (
-                Writer().i16(api_key).i16(api_version).i32(corr).string(self.client_id).build()
-            )
-            frame = header + body
-            self._writer.write(struct.pack(">i", len(frame)) + frame)
-            try:
-                await self._writer.drain()
-                size_b = await asyncio.wait_for(self._reader.readexactly(4), timeout)
-                (size,) = struct.unpack(">i", size_b)
-                payload = await asyncio.wait_for(self._reader.readexactly(size), timeout)
-            except (OSError, asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
-                try:
-                    self._writer.close()
-                except Exception:
-                    pass
-                self._writer = None
-                self._reader = None
-                raise Disconnection(f"kafka broker {self.host}:{self.port} lost: {e}") from e
-            r = Reader(payload)
-            got_corr = r.i32()
-            if got_corr != corr:
-                raise ReadError(f"kafka correlation mismatch {got_corr} != {corr}")
-            return r
+            return await self._request_unlocked(api_key, api_version, body, timeout)
 
     async def close(self) -> None:
         if self._writer is not None:
@@ -316,23 +354,50 @@ class TopicMeta:
     partitions: dict[int, PartitionMeta] = field(default_factory=dict)
 
 
+def client_kwargs_from_config(config: dict) -> dict:
+    """Parse connector-level ``tls``/``sasl`` config into KafkaClient kwargs.
+
+    ``sasl.password`` supports ``${ENV}`` indirection like other secrets.
+    """
+    from arkflow_tpu.utils.auth import resolve_secret
+
+    kwargs: dict = {}
+    tls = config.get("tls")
+    if tls is not None and tls is not False:  # `tls: {}` means system CAs
+        kwargs["ssl_context"] = make_ssl_context({} if tls is True else dict(tls))
+    sasl = config.get("sasl")
+    if sasl:
+        sasl = dict(sasl)
+        if sasl.get("password"):
+            sasl["password"] = resolve_secret(str(sasl["password"]))
+        kwargs["sasl"] = sasl
+    return kwargs
+
+
 class KafkaClient:
-    def __init__(self, bootstrap: str, client_id: str = "arkflow-tpu"):
+    def __init__(self, bootstrap: str, client_id: str = "arkflow-tpu",
+                 ssl_context=None, sasl: Optional[dict] = None):
         # bootstrap: "host:port" or "host:port,host:port"
         self.bootstrap = [
             (h.strip().rsplit(":", 1)[0], int(h.strip().rsplit(":", 1)[1]))
             for h in bootstrap.replace("kafka://", "").split(",")
         ]
         self.client_id = client_id
+        self.ssl_context = ssl_context
+        self.sasl = sasl
         self._brokers: dict[int, tuple[str, int]] = {}
         self._conns: dict[int, _BrokerConn] = {}
         self._bootstrap_conn: Optional[_BrokerConn] = None
         self.topics: dict[str, TopicMeta] = {}
 
+    def _make_conn(self, host: str, port: int) -> _BrokerConn:
+        return _BrokerConn(host, port, self.client_id,
+                           ssl_context=self.ssl_context, sasl=self.sasl)
+
     async def connect(self) -> None:
         last: Optional[Exception] = None
         for host, port in self.bootstrap:
-            conn = _BrokerConn(host, port, self.client_id)
+            conn = self._make_conn(host, port)
             try:
                 await conn.connect()
                 self._bootstrap_conn = conn
@@ -345,7 +410,7 @@ class KafkaClient:
         conn = self._conns.get(node)
         if conn is None:
             host, port = self._brokers[node]
-            conn = _BrokerConn(host, port, self.client_id)
+            conn = self._make_conn(host, port)
             await conn.connect()
             self._conns[node] = conn
         return conn

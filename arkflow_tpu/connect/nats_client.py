@@ -29,13 +29,24 @@ class NatsMessage:
 
 
 class NatsClient:
-    def __init__(self, url: str, name: str = "arkflow-tpu"):
-        # url: nats://host:port or host:port
+    def __init__(self, url: str, name: str = "arkflow-tpu",
+                 username: Optional[str] = None, password: Optional[str] = None,
+                 token: Optional[str] = None, ssl_context=None):
+        # url: nats://host:port or host:port, optionally user:pass@host:port
         addr = url.split("://", 1)[-1]
+        if "@" in addr:
+            cred, addr = addr.rsplit("@", 1)
+            if username is None:
+                username, _, pw = cred.partition(":")
+                password = password if password is not None else (pw or None)
         host, _, port = addr.partition(":")
         self.host = host or "127.0.0.1"
         self.port = int(port or 4222)
         self.name = name
+        self.username = username
+        self.password = password
+        self.token = token
+        self.ssl_context = ssl_context
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._loop_task: Optional[asyncio.Task] = None
@@ -55,6 +66,16 @@ class NatsClient:
         if not line.startswith(b"INFO "):
             raise ConnectError(f"nats: unexpected greeting {line[:64]!r}")
         self.server_info = json.loads(line[5:].decode())
+        if self.ssl_context is not None:
+            # standard NATS: plaintext INFO greeting, then the client upgrades
+            # (implicit handshake_first servers are the rare exception)
+            try:
+                await asyncio.wait_for(
+                    self._writer.start_tls(self.ssl_context, server_hostname=self.host),
+                    timeout,
+                )
+            except (OSError, asyncio.TimeoutError, ValueError) as e:
+                raise ConnectError(f"nats TLS upgrade failed: {e}") from e
         connect_opts = {
             "verbose": False,
             "pedantic": False,
@@ -63,6 +84,11 @@ class NatsClient:
             "version": "0.1.0",
             "protocol": 1,
         }
+        if self.token:
+            connect_opts["auth_token"] = self.token
+        elif self.username is not None:
+            connect_opts["user"] = self.username
+            connect_opts["pass"] = self.password or ""
         self._writer.write(b"CONNECT " + json.dumps(connect_opts).encode() + b"\r\nPING\r\n")
         await self._writer.drain()
         pong = await asyncio.wait_for(self._reader.readline(), timeout)
@@ -138,3 +164,27 @@ class NatsClient:
             except Exception:
                 pass
         self._connected = False
+
+
+def client_kwargs_from_config(config: dict) -> dict:
+    """Parse connector-level auth/TLS config into NatsClient kwargs.
+
+    ``password``/``token`` support ``${ENV}`` indirection like other secrets.
+    """
+    from arkflow_tpu.connect import make_ssl_context
+    from arkflow_tpu.errors import ConfigError
+    from arkflow_tpu.utils.auth import resolve_secret
+
+    kwargs: dict = {}
+    if config.get("password") is not None and config.get("username") is None:
+        raise ConfigError("nats: 'password' requires 'username'")
+    if config.get("username") is not None:
+        kwargs["username"] = str(config["username"])
+        if config.get("password") is not None:
+            kwargs["password"] = resolve_secret(str(config["password"]))
+    if config.get("token") is not None:
+        kwargs["token"] = resolve_secret(str(config["token"]))
+    tls = config.get("tls")
+    if tls is not None and tls is not False:  # `tls: {}` means system CAs
+        kwargs["ssl_context"] = make_ssl_context({} if tls is True else dict(tls))
+    return kwargs

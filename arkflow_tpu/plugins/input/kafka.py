@@ -32,7 +32,11 @@ import pyarrow as pa
 
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
-from arkflow_tpu.connect.kafka_client import KafkaClient, KafkaProtocolError
+from arkflow_tpu.connect.kafka_client import (
+    KafkaClient,
+    KafkaProtocolError,
+    client_kwargs_from_config,
+)
 from arkflow_tpu.errors import ConfigError, Disconnection, EndOfInput
 from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
 
@@ -65,7 +69,8 @@ class KafkaAck(Ack):
 
 class KafkaInput(Input):
     def __init__(self, brokers: str, topic: str, group: str,
-                 partitions: Optional[list[int]], start: str, batch_size: int, codec=None):
+                 partitions: Optional[list[int]], start: str, batch_size: int, codec=None,
+                 client_kwargs: Optional[dict] = None):
         if start not in ("earliest", "latest"):
             raise ConfigError("kafka input 'start' must be earliest|latest")
         self.brokers = brokers
@@ -75,6 +80,7 @@ class KafkaInput(Input):
         self.start = start
         self.batch_size = batch_size
         self.codec = codec
+        self.client_kwargs = client_kwargs or {}
         self._client: Optional[KafkaClient] = None
         self._offsets: dict[int, int] = {}  # next offset to fetch per partition
         self._committed: dict[int, int] = {}
@@ -83,7 +89,7 @@ class KafkaInput(Input):
         self._closed = False
 
     async def connect(self) -> None:
-        self._client = KafkaClient(self.brokers)
+        self._client = KafkaClient(self.brokers, **self.client_kwargs)
         await self._client.connect()
         await self._client.refresh_metadata([self.topic])
         parts = self.configured_partitions or self._client.partitions(self.topic)
@@ -171,4 +177,5 @@ def _build(config: dict, resource: Resource) -> KafkaInput:
         start=str(config.get("start", "earliest")),
         batch_size=int(config.get("batch_size", 500)),
         codec=build_codec(config.get("codec"), resource),
+        client_kwargs=client_kwargs_from_config(config),
     )

@@ -21,23 +21,25 @@ from typing import Optional
 
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
-from arkflow_tpu.connect.nats_client import NatsClient, NatsMessage
+from arkflow_tpu.connect.nats_client import NatsClient, NatsMessage, client_kwargs_from_config
 from arkflow_tpu.errors import ConfigError, Disconnection, EndOfInput
 from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
 
 
 class NatsInput(Input):
-    def __init__(self, url: str, subject: str, queue_group: Optional[str] = None, codec=None):
+    def __init__(self, url: str, subject: str, queue_group: Optional[str] = None, codec=None,
+                 client_kwargs: Optional[dict] = None):
         self.url = url
         self.subject = subject
         self.queue_group = queue_group
         self.codec = codec
+        self.client_kwargs = client_kwargs or {}
         self._client: Optional[NatsClient] = None
         self._queue: Optional[asyncio.Queue] = None
         self._closed = False
 
     async def connect(self) -> None:
-        self._client = NatsClient(self.url)
+        self._client = NatsClient(self.url, **self.client_kwargs)
         await self._client.connect()
         self._queue = asyncio.Queue(maxsize=1000)
 
@@ -87,4 +89,5 @@ def _build(config: dict, resource: Resource) -> NatsInput:
         subject=str(subject),
         queue_group=config.get("queue_group"),
         codec=build_codec(config.get("codec"), resource),
+        client_kwargs=client_kwargs_from_config(config),
     )

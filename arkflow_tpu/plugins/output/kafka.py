@@ -24,7 +24,7 @@ from typing import Optional
 
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Output, Resource, register_output
-from arkflow_tpu.connect.kafka_client import KafkaClient
+from arkflow_tpu.connect.kafka_client import KafkaClient, client_kwargs_from_config
 from arkflow_tpu.errors import ConfigError, WriteError
 from arkflow_tpu.native import crc32c
 from arkflow_tpu.plugins.codec.helper import build_codec, encode_batch
@@ -35,18 +35,20 @@ logger = logging.getLogger("arkflow.kafka")
 
 class KafkaOutput(Output):
     def __init__(self, brokers: str, topic: DynValue, key: Optional[DynValue],
-                 acks: int, retries: int, codec=None):
+                 acks: int, retries: int, codec=None,
+                 client_kwargs: Optional[dict] = None):
         self.brokers = brokers
         self.topic = topic
         self.key = key
         self.acks = acks
         self.retries = retries
         self.codec = codec
+        self.client_kwargs = client_kwargs or {}
         self._client: Optional[KafkaClient] = None
         self._rr = 0
 
     async def connect(self) -> None:
-        self._client = KafkaClient(self.brokers)
+        self._client = KafkaClient(self.brokers, **self.client_kwargs)
         await self._client.connect()
 
     def _partition_for(self, topic: str, key: Optional[bytes]) -> int:
@@ -118,4 +120,5 @@ def _build(config: dict, resource: Resource) -> KafkaOutput:
         acks=int(config.get("acks", -1)),
         retries=int(config.get("retries", 3)),
         codec=build_codec(config.get("codec"), resource),
+        client_kwargs=client_kwargs_from_config(config),
     )

@@ -17,21 +17,23 @@ from typing import Optional
 
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Output, Resource, register_output
-from arkflow_tpu.connect.nats_client import NatsClient
+from arkflow_tpu.connect.nats_client import NatsClient, client_kwargs_from_config
 from arkflow_tpu.errors import ConfigError, WriteError
 from arkflow_tpu.plugins.codec.helper import build_codec, encode_batch
 from arkflow_tpu.utils.expr import DynValue
 
 
 class NatsOutput(Output):
-    def __init__(self, url: str, subject: DynValue, codec=None):
+    def __init__(self, url: str, subject: DynValue, codec=None,
+                 client_kwargs: Optional[dict] = None):
         self.url = url
         self.subject = subject
         self.codec = codec
+        self.client_kwargs = client_kwargs or {}
         self._client: Optional[NatsClient] = None
 
     async def connect(self) -> None:
-        self._client = NatsClient(self.url)
+        self._client = NatsClient(self.url, **self.client_kwargs)
         await self._client.connect()
 
     async def write(self, batch: MessageBatch) -> None:
@@ -73,4 +75,5 @@ def _build(config: dict, resource: Resource) -> NatsOutput:
         url=str(config.get("url", "nats://127.0.0.1:4222")),
         subject=DynValue.from_config(subject, "subject"),
         codec=build_codec(config.get("codec"), resource),
+        client_kwargs=client_kwargs_from_config(config),
     )

@@ -705,3 +705,48 @@ def test_modbus_count_validation():
     with pytest.raises(ConfigError):
         build("input", {"type": "modbus", "host": "h",
                         "points": [{"name": "x", "kind": "holding", "address": 0, "count": 200}]})
+
+
+def test_nats_auth_config_validation():
+    from arkflow_tpu.connect.nats_client import client_kwargs_from_config
+
+    with pytest.raises(ConfigError):
+        client_kwargs_from_config({"password": "pw"})  # password requires username
+    kw = client_kwargs_from_config({"username": "u", "password": "pw"})
+    assert kw == {"username": "u", "password": "pw"}
+    import ssl
+
+    kw = client_kwargs_from_config({"tls": {}})  # empty mapping still enables TLS
+    assert isinstance(kw["ssl_context"], ssl.SSLContext)
+
+
+def test_nats_connect_sends_credentials():
+    async def go():
+        srv = FakeNatsServer()
+        seen = {}
+        orig = srv._client
+
+        async def capture(reader, writer):
+            writer.write(b'INFO {"server_id":"fake","auth_required":true}\r\n')
+            await writer.drain()
+            line = await reader.readline()
+            import json as _json
+
+            seen.update(_json.loads(line[8:].decode()))
+            writer.write(b"PONG\r\n")  # answer the PING that follows CONNECT
+            await writer.drain()
+
+        srv._client = capture
+        await srv.start()
+        try:
+            from arkflow_tpu.connect.nats_client import NatsClient
+
+            c = NatsClient(f"nats://127.0.0.1:{srv.port}", username="svc", password="pw")
+            await c.connect()
+            await c.close()
+            assert seen.get("user") == "svc"
+            assert seen.get("pass") == "pw"
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())

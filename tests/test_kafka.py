@@ -47,10 +47,12 @@ def test_record_batch_crc_uses_castagnoli():
 class FakeKafkaBroker:
     """Single-node fake with in-memory partition logs + group offsets."""
 
-    def __init__(self, topics: dict[str, int]):
-        # topics: name -> partition count
+    def __init__(self, topics: dict[str, int], sasl_plain: tuple | None = None):
+        # topics: name -> partition count; sasl_plain: (user, password) to require
         self.logs = {(t, p): [] for t, n in topics.items() for p in range(n)}
         self.group_offsets = {}
+        self.sasl_plain = sasl_plain
+        self.sasl_attempts = []
         self.server = None
         self.port = None
 
@@ -82,6 +84,20 @@ class FakeKafkaBroker:
             return
 
     def _dispatch(self, api: int, r: Reader) -> bytes:
+        if api == 17:  # SaslHandshake v1
+            mech = r.string()
+            if mech != "PLAIN":
+                return Writer().i16(33).i32(1).string("PLAIN").build()
+            return Writer().i16(0).i32(1).string("PLAIN").build()
+        if api == 36:  # SaslAuthenticate v0
+            token = r.bytes_() or b""
+            parts = token.split(b"\x00")
+            user, pw = parts[1].decode(), parts[2].decode()
+            self.sasl_attempts.append(user)
+            expect = self.sasl_plain or (user, pw)
+            if (user, pw) == expect:
+                return Writer().i16(0).string(None).bytes_(b"").build()
+            return Writer().i16(58).string("bad credentials").bytes_(b"").build()
         if api == 3:  # Metadata v1
             n = r.i32()
             names = [r.string() for _ in range(n)] if n >= 0 else []
@@ -312,3 +328,44 @@ def test_kafka_config_validation():
         build_component("input", {"type": "kafka", "topic": "t", "group": "g"}, Resource())
     with pytest.raises(ConfigError):
         build_component("output", {"type": "kafka", "brokers": "b"}, Resource())
+
+
+def test_kafka_sasl_plain_auth():
+    async def go():
+        broker = FakeKafkaBroker({"t": 1}, sasl_plain=("svc", "hunter2"))
+        await broker.start()
+        try:
+            ok = KafkaClient(f"127.0.0.1:{broker.port}",
+                             sasl={"mechanism": "PLAIN", "username": "svc", "password": "hunter2"})
+            await ok.connect()
+            await ok.refresh_metadata(["t"])
+            assert await ok.produce("t", 0, [(None, b"v")]) == 0
+            await ok.close()
+            assert broker.sasl_attempts and all(u == "svc" for u in broker.sasl_attempts)
+
+            from arkflow_tpu.errors import ConnectError
+
+            bad = KafkaClient(f"127.0.0.1:{broker.port}",
+                              sasl={"mechanism": "PLAIN", "username": "svc", "password": "wrong"})
+            with pytest.raises(ConnectError):
+                await bad.connect()
+            await bad.close()
+        finally:
+            await broker.stop()
+
+    asyncio.run(go())
+
+
+def test_kafka_sasl_config_plumbing(monkeypatch):
+    from arkflow_tpu.connect.kafka_client import client_kwargs_from_config
+
+    monkeypatch.setenv("KPW", "s3cret")
+    kw = client_kwargs_from_config({"sasl": {"mechanism": "PLAIN", "username": "u",
+                                             "password": "${KPW}"}})
+    assert kw["sasl"]["password"] == "s3cret"
+    kw = client_kwargs_from_config({"tls": {"insecure_skip_verify": True}})
+    import ssl
+
+    assert isinstance(kw["ssl_context"], ssl.SSLContext)
+    assert kw["ssl_context"].verify_mode == ssl.CERT_NONE
+    assert client_kwargs_from_config({}) == {}
