@@ -7,10 +7,11 @@ ref: crates/arkflow-plugin/src/input/kafka.rs):
 - Metadata v1 (leader discovery), ListOffsets v1 (earliest/latest)
 - Produce v3 / Fetch v4 with record-batch format v2 (magic 2, crc32c from the
   native tier, no compression)
-- FindCoordinator v0 + OffsetCommit v2 / OffsetFetch v1 using simple-consumer
-  semantics (generation -1, empty member) — consumer-group rebalancing
-  (JoinGroup/SyncGroup/Heartbeat) is not implemented; partitions are assigned
-  statically in config.
+- FindCoordinator v0 (cached per group) + OffsetCommit v2 / OffsetFetch v1
+- Consumer groups: JoinGroup v2 / SyncGroup v1 / Heartbeat v1 / LeaveGroup v1
+  with the 'range' assignor; commits carry generation/member so fenced members
+  fail fast. Static partition lists bypass the group protocol entirely.
+- SASL PLAIN (SaslHandshake v1 + SaslAuthenticate v0) and TLS.
 
 One connection per broker node, requests serialised per connection with
 correlation-id matching.
@@ -38,13 +39,33 @@ API_METADATA = 3
 API_OFFSET_COMMIT = 8
 API_OFFSET_FETCH = 9
 API_FIND_COORDINATOR = 10
+API_JOIN_GROUP = 11
+API_HEARTBEAT = 12
+API_LEAVE_GROUP = 13
+API_SYNC_GROUP = 14
 API_SASL_HANDSHAKE = 17
 API_SASL_AUTHENTICATE = 36
+
+ERR_COORDINATOR_LOAD_IN_PROGRESS = 14
+ERR_COORDINATOR_NOT_AVAILABLE = 15
+ERR_NOT_COORDINATOR = 16
+ERR_ILLEGAL_GENERATION = 22
+ERR_UNKNOWN_MEMBER_ID = 25
+ERR_REBALANCE_IN_PROGRESS = 27
 
 
 class KafkaProtocolError(ReadError):
     def __init__(self, api: str, code: int):
         super().__init__(f"kafka {api} error code {code}")
+        self.code = code
+
+
+class GroupRebalance(ReadError):
+    """The consumer group is rebalancing (or this member was fenced):
+    rejoin with ``join_group``."""
+
+    def __init__(self, code: int):
+        super().__init__(f"kafka group rebalance required (error {code})")
         self.code = code
 
 
@@ -343,6 +364,84 @@ class _BrokerConn:
 
 
 @dataclass
+class JoinResult:
+    generation: int
+    member_id: str
+    leader_id: str
+    protocol: str
+    members: dict[str, list[str]]  # member_id -> subscribed topics (leader only)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.member_id == self.leader_id
+
+
+def encode_subscription(topics: list[str]) -> bytes:
+    """ConsumerProtocolSubscription v0: version, topics, user_data."""
+    return (
+        Writer()
+        .i16(0)
+        .array(sorted(topics), lambda w, t: w.string(t))
+        .bytes_(None)
+        .build()
+    )
+
+
+def decode_subscription(data: bytes) -> list[str]:
+    if not data:
+        return []
+    r = Reader(data)
+    r.i16()  # version
+    n = r.i32()
+    return [r.string() for _ in range(max(0, n))]
+
+
+def encode_assignment(assignment: dict[str, list[int]]) -> bytes:
+    """ConsumerProtocolAssignment v0: version, [topic, [partitions]], user_data."""
+    w = Writer().i16(0)
+    w.array(
+        sorted(assignment.items()),
+        lambda w2, kv: w2.string(kv[0]).array(sorted(kv[1]), lambda w3, p: w3.i32(p)),
+    )
+    w.bytes_(None)
+    return w.build()
+
+
+def decode_assignment(data: bytes) -> dict[str, list[int]]:
+    if not data:
+        return {}
+    r = Reader(data)
+    r.i16()  # version
+    out: dict[str, list[int]] = {}
+    n = r.i32()
+    for _ in range(max(0, n)):
+        topic = r.string()
+        k = r.i32()
+        out[topic] = [r.i32() for _ in range(max(0, k))]
+    return out
+
+
+def range_assign(members: dict[str, list[str]],
+                 topic_partitions: dict[str, list[int]]) -> dict[str, dict[str, list[int]]]:
+    """The 'range' assignor: per topic, contiguous partition ranges to the
+    subscribed members in member-id order (matches the Java client)."""
+    out: dict[str, dict[str, list[int]]] = {mid: {} for mid in members}
+    for topic, parts in sorted(topic_partitions.items()):
+        subs = sorted(mid for mid, topics in members.items() if topic in topics)
+        if not subs:
+            continue
+        parts = sorted(parts)
+        per, extra = divmod(len(parts), len(subs))
+        start = 0
+        for i, mid in enumerate(subs):
+            count = per + (1 if i < extra else 0)
+            if count:
+                out[mid].setdefault(topic, []).extend(parts[start : start + count])
+            start += count
+    return out
+
+
+@dataclass
 class PartitionMeta:
     partition: int
     leader: int
@@ -387,6 +486,7 @@ class KafkaClient:
         self.sasl = sasl
         self._brokers: dict[int, tuple[str, int]] = {}
         self._conns: dict[int, _BrokerConn] = {}
+        self._coordinators: dict[str, int] = {}  # group -> node id
         self._bootstrap_conn: Optional[_BrokerConn] = None
         self.topics: dict[str, TopicMeta] = {}
 
@@ -581,26 +681,123 @@ class KafkaClient:
                     raise KafkaProtocolError("list_offsets", err)
         return offset
 
-    # -- offsets (simple-consumer group semantics) -------------------------
+    # -- consumer groups (dynamic membership) ------------------------------
 
-    async def _coordinator_conn(self, group: str) -> _BrokerConn:
-        body = Writer().string(group).build()
-        r = await self._bootstrap_conn.request(API_FIND_COORDINATOR, 0, body)
-        err = r.i16()
-        node = r.i32()
-        host = r.string()
-        port = r.i32()
-        if err != 0:
-            raise KafkaProtocolError("find_coordinator", err)
-        self._brokers[node] = (host, port)
-        return await self._conn_for_node(node)
-
-    async def offset_commit(self, group: str, topic: str, partition: int, offset: int) -> None:
+    async def join_group(self, group: str, topics: list[str], member_id: str = "",
+                         session_timeout_ms: int = 10000,
+                         rebalance_timeout_ms: int = 30000) -> "JoinResult":
+        """JoinGroup v2 with the 'range' consumer protocol. Returns the
+        coordinator's decision; when this member is the leader,
+        ``members`` holds every member's subscribed topics."""
+        meta = encode_subscription(topics)
         body = (
             Writer()
             .string(group)
-            .i32(-1)  # generation: simple consumer
-            .string("")  # member id
+            .i32(session_timeout_ms)
+            .i32(rebalance_timeout_ms)
+            .string(member_id)
+            .string("consumer")
+            .array([("range", meta)], lambda w, p: w.string(p[0]).bytes_(p[1]))
+            .build()
+        )
+        conn = await self._coordinator_conn(group)
+        r = await conn.request(API_JOIN_GROUP, 2, body,
+                               timeout=rebalance_timeout_ms / 1000.0 + 30.0)
+        r.i32()  # throttle
+        err = r.i16()
+        generation = r.i32()
+        protocol = r.string()
+        leader = r.string()
+        my_id = r.string()
+        members: dict[str, list[str]] = {}
+        n = r.i32()
+        for _ in range(max(0, n)):
+            mid = r.string()
+            mmeta = r.bytes_() or b""
+            members[mid] = decode_subscription(mmeta)
+        if err == ERR_UNKNOWN_MEMBER_ID and member_id:
+            raise GroupRebalance(err)  # retry with a fresh member id
+        if err != 0:
+            raise KafkaProtocolError("join_group", err)
+        return JoinResult(generation=generation, member_id=my_id,
+                          leader_id=leader, protocol=protocol or "range",
+                          members=members)
+
+    async def sync_group(self, group: str, generation: int, member_id: str,
+                         assignments: Optional[dict[str, dict[str, list[int]]]] = None
+                         ) -> dict[str, list[int]]:
+        """SyncGroup v1. The leader passes every member's assignment;
+        followers pass none. Returns this member's topic->partitions."""
+        entries = [
+            (mid, encode_assignment(a)) for mid, a in (assignments or {}).items()
+        ]
+        body = (
+            Writer()
+            .string(group)
+            .i32(generation)
+            .string(member_id)
+            .array(entries, lambda w, p: w.string(p[0]).bytes_(p[1]))
+            .build()
+        )
+        conn = await self._coordinator_conn(group)
+        r = await conn.request(API_SYNC_GROUP, 1, body)
+        r.i32()  # throttle
+        err = r.i16()
+        blob = r.bytes_() or b""
+        if err in (ERR_REBALANCE_IN_PROGRESS, ERR_ILLEGAL_GENERATION, ERR_UNKNOWN_MEMBER_ID):
+            raise GroupRebalance(err)
+        if err != 0:
+            raise KafkaProtocolError("sync_group", err)
+        return decode_assignment(blob)
+
+    async def heartbeat(self, group: str, generation: int, member_id: str) -> None:
+        body = Writer().string(group).i32(generation).string(member_id).build()
+        conn = await self._coordinator_conn(group)
+        r = await conn.request(API_HEARTBEAT, 1, body)
+        r.i32()  # throttle
+        err = r.i16()
+        if err in (ERR_REBALANCE_IN_PROGRESS, ERR_ILLEGAL_GENERATION, ERR_UNKNOWN_MEMBER_ID):
+            raise GroupRebalance(err)
+        if err != 0:
+            raise KafkaProtocolError("heartbeat", err)
+
+    async def leave_group(self, group: str, member_id: str) -> None:
+        body = Writer().string(group).string(member_id).build()
+        conn = await self._coordinator_conn(group)
+        r = await conn.request(API_LEAVE_GROUP, 1, body)
+        r.i32()  # throttle
+        r.i16()  # error ignored on leave
+
+    # -- offsets (simple-consumer group semantics) -------------------------
+
+    async def _coordinator_conn(self, group: str) -> _BrokerConn:
+        node = self._coordinators.get(group)
+        if node is None:
+            body = Writer().string(group).build()
+            r = await self._bootstrap_conn.request(API_FIND_COORDINATOR, 0, body)
+            err = r.i16()
+            node = r.i32()
+            host = r.string()
+            port = r.i32()
+            if err != 0:
+                raise KafkaProtocolError("find_coordinator", err)
+            self._brokers[node] = (host, port)
+            self._coordinators[group] = node
+        return await self._conn_for_node(node)
+
+    def invalidate_coordinator(self, group: str) -> None:
+        """Forget the cached coordinator (NOT_COORDINATOR / disconnect)."""
+        self._coordinators.pop(group, None)
+
+    async def offset_commit(self, group: str, topic: str, partition: int, offset: int,
+                            generation: int = -1, member_id: str = "") -> None:
+        """generation/member default to simple-consumer semantics; dynamic
+        group members pass their join credentials so fenced members fail fast."""
+        body = (
+            Writer()
+            .string(group)
+            .i32(generation)
+            .string(member_id)
             .i64(-1)  # retention
             .array(
                 [(topic, partition, offset)],
@@ -620,6 +817,8 @@ class KafkaClient:
             for _ in range(n_parts):
                 r.i32()
                 err = r.i16()
+                if err in (ERR_REBALANCE_IN_PROGRESS, ERR_ILLEGAL_GENERATION, ERR_UNKNOWN_MEMBER_ID):
+                    raise GroupRebalance(err)
                 if err != 0:
                     raise WriteError(f"kafka offset commit error code {err}")
 

@@ -7,8 +7,12 @@ plus ``__meta_ext_topic``; the ``KafkaAck`` commits ``last_offset + 1`` to the
 group coordinator only after downstream write succeeds — crash replay resumes
 from the committed offset.
 
-Partition assignment is static (config or all partitions at connect);
-consumer-group rebalancing is a documented gap of the native client.
+Partition assignment: when ``partitions`` is configured the consumer is
+static (simple-consumer offsets). Otherwise it joins the consumer group
+dynamically — JoinGroup/SyncGroup with the 'range' assignor, background
+heartbeats, automatic rejoin on rebalance, offset commits fenced by
+generation/member id — so multiple engine instances share the topic the same
+way librdkafka consumers do.
 
 Config:
 
@@ -33,9 +37,15 @@ import pyarrow as pa
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
 from arkflow_tpu.connect.kafka_client import (
+    ERR_COORDINATOR_LOAD_IN_PROGRESS,
+    ERR_COORDINATOR_NOT_AVAILABLE,
+    ERR_NOT_COORDINATOR,
+    ERR_UNKNOWN_MEMBER_ID,
+    GroupRebalance,
     KafkaClient,
     KafkaProtocolError,
     client_kwargs_from_config,
+    range_assign,
 )
 from arkflow_tpu.errors import ConfigError, Disconnection, EndOfInput
 from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
@@ -46,25 +56,37 @@ logger = logging.getLogger("arkflow.kafka")
 class KafkaAck(Ack):
     """Commits the consumed offsets when the batch is fully written downstream."""
 
-    def __init__(self, client: KafkaClient, group: str, topic: str, partition: int,
-                 next_offset: int, tracker: dict):
-        self.client = client
-        self.group = group
-        self.topic = topic
+    def __init__(self, owner: "KafkaInput", partition: int, next_offset: int,
+                 generation: int, member_id: str):
+        self.owner = owner
         self.partition = partition
         self.next_offset = next_offset
-        self.tracker = tracker
+        self.generation = generation
+        self.member_id = member_id
 
     async def ack(self) -> None:
+        o = self.owner
         try:
-            await self.client.offset_commit(self.group, self.topic, self.partition, self.next_offset)
-            self.tracker[self.partition] = max(
-                self.tracker.get(self.partition, -1), self.next_offset
+            await o._client.offset_commit(o.group, o.topic, self.partition,
+                                          self.next_offset, self.generation, self.member_id)
+            o._committed[self.partition] = max(
+                o._committed.get(self.partition, -1), self.next_offset
             )
+        except GroupRebalance:
+            # fenced: this member lost the partition mid-flight; the new owner
+            # replays from the last committed offset (at-least-once)
+            if self.generation == o._generation:
+                o._rejoin_needed.set()  # stale acks from a pre-rejoin generation don't re-trigger
+            logger.warning("kafka offset commit fenced (%s/%d, gen %d)",
+                           o.topic, self.partition, self.generation)
         except Exception as e:
             # at-least-once: a failed commit means replay, never loss
             logger.warning("kafka offset commit failed (%s/%d): %s",
-                           self.topic, self.partition, e)
+                           o.topic, self.partition, e)
+
+
+HEARTBEAT_INTERVAL_S = 3.0
+SESSION_TIMEOUT_MS = 10000
 
 
 class KafkaInput(Input):
@@ -87,15 +109,34 @@ class KafkaInput(Input):
         self._rr: list[int] = []
         self._rr_idx = 0
         self._closed = False
+        # dynamic group membership state
+        self._generation = -1
+        self._member_id = ""
+        self._rejoin_needed = asyncio.Event()
+        self._joined = False
+        self._join_lock = asyncio.Lock()
+        self._heartbeat_task: Optional[asyncio.Task] = None
+
+    @property
+    def dynamic(self) -> bool:
+        return self.configured_partitions is None
 
     async def connect(self) -> None:
         self._client = KafkaClient(self.brokers, **self.client_kwargs)
         await self._client.connect()
         await self._client.refresh_metadata([self.topic])
-        parts = self.configured_partitions or self._client.partitions(self.topic)
-        if not parts:
-            raise ConfigError(f"kafka input: topic {self.topic!r} has no partitions")
-        self._rr = list(parts)
+        if self.dynamic:
+            async with self._join_lock:
+                await self._join_locked()
+            self._heartbeat_task = asyncio.create_task(self._heartbeat_loop())
+        else:
+            parts = self.configured_partitions
+            if not parts:
+                raise ConfigError(f"kafka input: topic {self.topic!r} has no partitions")
+            self._rr = list(parts)
+            await self._load_offsets(parts)
+
+    async def _load_offsets(self, parts: list[int]) -> None:
         for p in parts:
             committed = await self._client.offset_fetch(self.group, self.topic, p)
             if committed >= 0:
@@ -105,15 +146,98 @@ class KafkaInput(Input):
                     self.topic, p, earliest=(self.start == "earliest")
                 )
 
+    async def _join(self) -> None:
+        """Join/rejoin the consumer group and adopt the synced assignment."""
+        async with self._join_lock:
+            if not self._rejoin_needed.is_set() and self._joined:
+                return  # another task already completed this rejoin
+            await self._join_locked()
+
+    async def _join_locked(self) -> None:
+        member = self._member_id
+        while not self._closed:
+            try:
+                res = await self._client.join_group(
+                    self.group, [self.topic], member,
+                    session_timeout_ms=SESSION_TIMEOUT_MS,
+                )
+                if res.is_leader:
+                    union = sorted({t for ts in res.members.values() for t in ts})
+                    await self._client.refresh_metadata(union)
+                    topic_parts = {t: self._client.partitions(t) for t in union}
+                    assignments = range_assign(res.members, topic_parts)
+                    mine = await self._client.sync_group(
+                        self.group, res.generation, res.member_id, assignments
+                    )
+                else:
+                    mine = await self._client.sync_group(
+                        self.group, res.generation, res.member_id
+                    )
+                self._generation = res.generation
+                self._member_id = res.member_id
+                parts = sorted(mine.get(self.topic, []))
+                self._rr = parts
+                self._offsets = {}
+                if parts:
+                    await self._load_offsets(parts)
+                self._rejoin_needed.clear()
+                self._joined = True
+                logger.info("kafka group %s gen %d: member %s assigned %s",
+                            self.group, self._generation, self._member_id, parts)
+                return
+            except GroupRebalance as e:
+                if e.code == ERR_UNKNOWN_MEMBER_ID:
+                    member = self._member_id = ""
+                await asyncio.sleep(0.2)
+            except KafkaProtocolError as e:
+                if e.code not in (ERR_COORDINATOR_LOAD_IN_PROGRESS,
+                                  ERR_COORDINATOR_NOT_AVAILABLE, ERR_NOT_COORDINATOR):
+                    raise
+                # transient coordinator churn (startup, failover): retry
+                self._client.invalidate_coordinator(self.group)
+                await asyncio.sleep(0.3)
+
+    async def _heartbeat_loop(self) -> None:
+        try:
+            while not self._closed:
+                await asyncio.sleep(HEARTBEAT_INTERVAL_S)
+                if self._rejoin_needed.is_set():
+                    continue  # read loop is about to rejoin
+                try:
+                    await self._client.heartbeat(self.group, self._generation, self._member_id)
+                except GroupRebalance:
+                    # rejoin promptly (inside the coordinator's join window),
+                    # like librdkafka — don't wait for the next poll
+                    self._rejoin_needed.set()
+                    try:
+                        await self._join()
+                    except Exception as e:
+                        logger.warning("kafka rejoin failed: %s", e)
+                except Exception as e:
+                    logger.warning("kafka heartbeat failed: %s", e)
+        except asyncio.CancelledError:
+            raise
+
     async def read(self) -> tuple[MessageBatch, Ack]:
         if self._closed:
             raise EndOfInput()
         while True:
+            if self.dynamic and self._rejoin_needed.is_set():
+                await self._join()
+            if not self._rr:
+                # dynamic member with no assigned partitions: idle until rebalance
+                if self._closed:
+                    raise EndOfInput()
+                await asyncio.sleep(0.2)
+                continue
             p = self._rr[self._rr_idx % len(self._rr)]
             self._rr_idx += 1
+            offset = self._offsets.get(p)
+            if offset is None:
+                continue  # assignment changed under us mid-loop
             try:
                 records, _hwm = await self._client.fetch(
-                    self.topic, p, self._offsets[p], max_wait_ms=250
+                    self.topic, p, offset, max_wait_ms=250
                 )
             except KafkaProtocolError as e:
                 if e.code == 1:  # offset out of range: snap to earliest
@@ -129,8 +253,8 @@ class KafkaInput(Input):
             records = records[: self.batch_size]
             self._offsets[p] = records[-1].offset + 1
             batch = self._records_to_batch(records, p)
-            ack = KafkaAck(self._client, self.group, self.topic, p,
-                           records[-1].offset + 1, self._committed)
+            ack = KafkaAck(self, p, records[-1].offset + 1,
+                           self._generation, self._member_id)
             return batch, ack
 
     def _records_to_batch(self, records, partition: int) -> MessageBatch:
@@ -159,7 +283,18 @@ class KafkaInput(Input):
 
     async def close(self) -> None:
         self._closed = True
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            try:
+                await self._heartbeat_task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._client is not None:
+            if self.dynamic and self._member_id:
+                try:
+                    await self._client.leave_group(self.group, self._member_id)
+                except Exception:
+                    pass
             await self._client.close()
 
 

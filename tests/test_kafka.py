@@ -47,14 +47,70 @@ def test_record_batch_crc_uses_castagnoli():
 class FakeKafkaBroker:
     """Single-node fake with in-memory partition logs + group offsets."""
 
+    JOIN_WINDOW_S = 0.25  # rebalance round barrier
+
     def __init__(self, topics: dict[str, int], sasl_plain: tuple | None = None):
         # topics: name -> partition count; sasl_plain: (user, password) to require
         self.logs = {(t, p): [] for t, n in topics.items() for p in range(n)}
         self.group_offsets = {}
         self.sasl_plain = sasl_plain
         self.sasl_attempts = []
+        self.groups = {}  # group -> coordinator state dict
         self.server = None
         self.port = None
+
+    # -- group coordinator (simplified but barrier-correct) -----------------
+
+    def _group(self, name):
+        g = self.groups.get(name)
+        if g is None:
+            g = self.groups[name] = {
+                "generation": 0, "members": {}, "pending": {}, "leader": None,
+                "state": "empty", "join_waiters": [], "assignments": {},
+                "sync_event": asyncio.Event(), "member_seq": 0, "window_task": None,
+            }
+        return g
+
+    async def _coordinator_join(self, group, member_id, meta):
+        g = self._group(group)
+        if not member_id:
+            g["member_seq"] += 1
+            member_id = f"m{g['member_seq']}"
+        g["pending"][member_id] = meta
+        g["state"] = "rebalancing"
+        fut = asyncio.get_running_loop().create_future()
+        g["join_waiters"].append((member_id, fut))
+        if g["window_task"] is None or g["window_task"].done():
+            async def finalize():
+                await asyncio.sleep(self.JOIN_WINDOW_S)
+                g["generation"] += 1
+                g["members"] = dict(g["pending"])
+                g["pending"] = {}
+                g["leader"] = sorted(g["members"])[0]
+                g["assignments"] = {}
+                g["sync_event"] = asyncio.Event()
+                g["state"] = "awaiting_sync"
+                waiters, g["join_waiters"] = g["join_waiters"], []
+                for mid, f in waiters:
+                    if not f.done():
+                        f.set_result((g["generation"], g["leader"], mid, dict(g["members"])))
+            g["window_task"] = asyncio.get_running_loop().create_task(finalize())
+        return await fut
+
+    async def _coordinator_sync(self, group, generation, member_id, assignments):
+        g = self._group(group)
+        if generation != g["generation"] or member_id not in g["members"]:
+            return 22, b""  # ILLEGAL_GENERATION
+        if assignments:  # leader
+            g["assignments"] = assignments
+            g["state"] = "stable"
+            g["sync_event"].set()
+        else:
+            try:
+                await asyncio.wait_for(g["sync_event"].wait(), timeout=5)
+            except asyncio.TimeoutError:
+                return 27, b""
+        return 0, g["assignments"].get(member_id, b"")
 
     async def start(self):
         self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
@@ -76,14 +132,65 @@ class FakeKafkaBroker:
                 r = Reader(payload)
                 api, ver, corr = r.i16(), r.i16(), r.i32()
                 r.string()  # client id
-                body = self._dispatch(api, r)
+                if api in (11, 14):  # group APIs need to await the join barrier
+                    body = await self._dispatch_group(api, r)
+                else:
+                    body = self._dispatch(api, r)
                 frame = Writer().i32(corr).raw(body).build()
                 writer.write(struct.pack(">i", len(frame)) + frame)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError):
             return
 
+    async def _dispatch_group(self, api: int, r: Reader) -> bytes:
+        if api == 11:  # JoinGroup v2
+            group = r.string()
+            r.i32()  # session timeout
+            r.i32()  # rebalance timeout
+            member_id = r.string()
+            r.string()  # protocol type
+            n = r.i32()
+            metas = {}
+            for _ in range(max(0, n)):
+                name = r.string()
+                metas[name] = r.bytes_() or b""
+            gen, leader, mid, members = await self._coordinator_join(
+                group, member_id, metas.get("range", b""))
+            w = Writer().i32(0).i16(0).i32(gen).string("range").string(leader).string(mid)
+            member_list = sorted(members.items()) if mid == leader else []
+            w.array(member_list, lambda w2, kv: w2.string(kv[0]).bytes_(kv[1]))
+            return w.build()
+        if api == 14:  # SyncGroup v1
+            group = r.string()
+            gen = r.i32()
+            member_id = r.string()
+            n = r.i32()
+            assignments = {}
+            for _ in range(max(0, n)):
+                mid = r.string()
+                assignments[mid] = r.bytes_() or b""
+            err, blob = await self._coordinator_sync(group, gen, member_id, assignments)
+            return Writer().i32(0).i16(err).bytes_(blob).build()
+        raise AssertionError(f"unhandled group api {api}")
+
     def _dispatch(self, api: int, r: Reader) -> bytes:
+        if api == 12:  # Heartbeat v1
+            group = r.string()
+            gen = r.i32()
+            member_id = r.string()
+            g = self._group(group)
+            if member_id not in g["members"] and member_id not in g["pending"]:
+                return Writer().i32(0).i16(25).build()  # UNKNOWN_MEMBER_ID
+            if g["state"] == "rebalancing" or gen != g["generation"]:
+                return Writer().i32(0).i16(27).build()  # REBALANCE_IN_PROGRESS
+            return Writer().i32(0).i16(0).build()
+        if api == 13:  # LeaveGroup v1
+            group = r.string()
+            member_id = r.string()
+            g = self._group(group)
+            g["members"].pop(member_id, None)
+            g["state"] = "rebalancing" if g["members"] else "empty"
+            return Writer().i32(0).i16(0).build()
         if api == 17:  # SaslHandshake v1
             mech = r.string()
             if mech != "PLAIN":
@@ -369,3 +476,80 @@ def test_kafka_sasl_config_plumbing(monkeypatch):
     assert isinstance(kw["ssl_context"], ssl.SSLContext)
     assert kw["ssl_context"].verify_mode == ssl.CERT_NONE
     assert client_kwargs_from_config({}) == {}
+
+
+def test_range_assignor():
+    from arkflow_tpu.connect.kafka_client import range_assign
+
+    members = {"m1": ["t"], "m2": ["t"]}
+    out = range_assign(members, {"t": [0, 1, 2]})
+    assert out["m1"]["t"] == [0, 1]  # first member takes the remainder
+    assert out["m2"]["t"] == [2]
+    # member subscribed to a different topic gets nothing from t
+    out = range_assign({"a": ["t"], "b": ["other"]}, {"t": [0, 1]})
+    assert out["a"]["t"] == [0, 1]
+    assert out["b"] == {}
+
+
+def test_kafka_consumer_group_rebalance():
+    """Two dynamic consumers split the topic; leaving hands partitions back."""
+    from arkflow_tpu.plugins.input import kafka as kafka_mod
+
+    async def go():
+        broker = FakeKafkaBroker({"t": 2})
+        broker.JOIN_WINDOW_S = 0.5
+        await broker.start()
+        orig_hb = kafka_mod.HEARTBEAT_INTERVAL_S
+        kafka_mod.HEARTBEAT_INTERVAL_S = 0.05
+        brokers = f"127.0.0.1:{broker.port}"
+        try:
+            # seed both partitions
+            prod = KafkaClient(brokers)
+            await prod.connect()
+            await prod.refresh_metadata(["t"])
+            await prod.produce("t", 0, [(None, b"p0-a"), (None, b"p0-b")])
+            await prod.produce("t", 1, [(None, b"p1-a"), (None, b"p1-b")])
+            await prod.close()
+
+            c1 = build_component("input", {"type": "kafka", "brokers": brokers,
+                                           "topic": "t", "group": "g"}, Resource())
+            await c1.connect()
+            assert c1._rr == [0, 1]  # sole member owns everything
+            gen1 = c1._generation
+
+            c2 = build_component("input", {"type": "kafka", "brokers": brokers,
+                                           "topic": "t", "group": "g"}, Resource())
+            await c2.connect()  # triggers a rebalance round; c1's heartbeat rejoins
+            for _ in range(100):
+                if c1._generation > gen1 and not c1._rejoin_needed.is_set():
+                    break
+                await asyncio.sleep(0.05)
+            assert c1._generation > gen1
+            assert sorted(c1._rr + c2._rr) == [0, 1]
+            assert not (set(c1._rr) & set(c2._rr))  # disjoint split
+
+            # each consumer reads only its partition
+            async def read_one(c):
+                batch, ack = await asyncio.wait_for(c.read(), timeout=5)
+                await ack.ack()
+                return batch.get_meta("__meta_partition")
+
+            p1 = await read_one(c1)
+            p2 = await read_one(c2)
+            assert {p1, p2} == {0, 1}
+
+            # c2 leaves; c1's heartbeat notices and reclaims both partitions
+            await c2.close()
+            for _ in range(100):
+                if c1._rr == [0, 1]:
+                    break
+                await asyncio.sleep(0.05)
+            assert c1._rr == [0, 1]
+            await c1.close()
+            # offsets were committed with real generation/member (accepted)
+            assert broker.group_offsets[("g", "t", p1)] >= 1
+        finally:
+            kafka_mod.HEARTBEAT_INTERVAL_S = orig_hb
+            await broker.stop()
+
+    asyncio.run(go())
