@@ -448,6 +448,48 @@ def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict) -> tup
     return next_ids, new_cache
 
 
+def generate(params: dict, cfg: DecoderConfig, input_ids, lengths,
+             max_new_tokens: int, eos_id: int = 2,
+             n_real=None) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Whole-sequence greedy generation under one jit: prefill + a
+    ``lax.while_loop`` decode with EOS early-exit. One device dispatch per
+    batch instead of one per token — the difference between usable and
+    unusable latency over a remote-TPU link.
+
+    Returns (tokens [B, max_new_tokens] int32 zero-padded after EOS,
+    counts [B] of real tokens per row).
+    """
+    if cfg.num_experts > 1:
+        raise ValueError("incremental decoding does not support MoE layers yet")
+    b, t = input_ids.shape
+    cache = init_kv_cache(cfg, b, t + max_new_tokens)
+    nxt, cache = prefill(params, cfg, input_ids, cache, lengths=lengths)
+    out0 = jnp.zeros((b, max_new_tokens), jnp.int32)
+    # batch-padding rows start done, so they don't gate the EOS early-exit
+    done0 = (jnp.arange(b) >= n_real) if n_real is not None else jnp.zeros((b,), bool)
+    counts0 = jnp.zeros((b,), jnp.int32)
+
+    def cond(state):
+        step, _nxt, done, _counts, _cache, _out = state
+        return jnp.logical_and(step < max_new_tokens, ~jnp.all(done))
+
+    def body(state):
+        step, nxt, done, counts, cache, out = state
+        is_eos = nxt == eos_id
+        keep = jnp.logical_and(~done, ~is_eos)
+        emit = jnp.where(keep, nxt, 0)
+        out = jax.lax.dynamic_update_slice(out, emit[:, None], (0, step))
+        counts = counts + keep.astype(jnp.int32)
+        done = jnp.logical_or(done, is_eos)
+        nxt2, cache = decode_step(params, cfg, nxt[:, None], cache)
+        return step + 1, nxt2, done, counts, cache, out
+
+    _, _, _, counts, _, out = jax.lax.while_loop(
+        cond, body, (0, nxt, done0, counts0, cache, out0)
+    )
+    return out, counts
+
+
 def input_spec(cfg: DecoderConfig) -> dict:
     return {"input_ids": ("int32", ("seq",))}
 
@@ -469,6 +511,7 @@ register_model(
             "init_kv_cache": init_kv_cache,
             "prefill": prefill,
             "decode_step": decode_step,
+            "generate": generate,
         },
     )
 )

@@ -73,6 +73,25 @@ def apply(params: dict, cfg: LstmAeConfig, *, values) -> dict:
     return {"score": err, "reconstruction": recon}
 
 
+def loss_fn(params: dict, cfg: LstmAeConfig, values) -> jnp.ndarray:
+    """Mean reconstruction MSE — anomaly detectors train on normal traffic."""
+    return apply(params, cfg, values=values)["score"].mean()
+
+
+def make_train_step(cfg: LstmAeConfig, optimizer):
+    """``train_step(params, opt_state, batch{"values"}) -> (params, opt_state, loss)``."""
+
+    def train_step(params, opt_state, batch):
+        import optax
+
+        loss, grads = jax.value_and_grad(loss_fn)(params, cfg, batch["values"])
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
 def input_spec(cfg: LstmAeConfig) -> dict:
     return {"values": ("float32", (cfg.window, cfg.features))}
 
@@ -84,5 +103,6 @@ register_model(
         init=init,
         apply=apply,
         input_spec=input_spec,
+        extras={"loss_fn": loss_fn, "make_train_step": make_train_step},
     )
 )

@@ -79,9 +79,14 @@ class TpuGenerateProcessor(Processor):
         self.params = jax.device_put(params, jax.devices()[0])
 
         ex = self.family.extras
-        self._prefill = jax.jit(functools.partial(ex["prefill"], cfg=self.cfg))
-        self._decode = jax.jit(functools.partial(ex["decode_step"], cfg=self.cfg))
-        self._init_cache = ex["init_kv_cache"]
+        # whole-generation jit: one device dispatch per batch (prefill +
+        # while_loop decode with EOS early-exit), not one per token
+        self._generate = jax.jit(
+            functools.partial(
+                ex["generate"], cfg=self.cfg,
+                max_new_tokens=self.max_new_tokens, eos_id=self.eos_id,
+            )
+        )
 
         reg = global_registry()
         self.m_tokens = reg.counter("arkflow_generated_tokens_total", "tokens generated",
@@ -92,26 +97,14 @@ class TpuGenerateProcessor(Processor):
     def _generate_sync(self, ids: np.ndarray, lengths: np.ndarray, n_real: int) -> list[list[int]]:
         import jax.numpy as jnp
 
-        b, t = ids.shape
-        cache = self._init_cache(self.cfg, b, t + self.max_new_tokens)
-        nxt, cache = self._prefill(
-            self.params, input_ids=jnp.asarray(ids), cache=cache,
+        tokens, counts = self._generate(
+            self.params, input_ids=jnp.asarray(ids),
             lengths=jnp.asarray(lengths, jnp.int32),
+            n_real=jnp.asarray(n_real, jnp.int32),
         )
-        outs: list[list[int]] = [[] for _ in range(b)]
-        done = np.zeros(b, bool)
-        done[n_real:] = True  # batch-padding rows don't gate the early exit
-        for _ in range(self.max_new_tokens):
-            tok = np.asarray(nxt)
-            for i in range(b):
-                if not done[i]:
-                    if tok[i] == self.eos_id:
-                        done[i] = True
-                    else:
-                        outs[i].append(int(tok[i]))
-            if done.all():
-                break
-            nxt, cache = self._decode(self.params, token_ids=jnp.asarray(tok)[:, None], cache=cache)
+        tokens = np.asarray(tokens)
+        counts = np.asarray(counts)
+        outs = [tokens[i, : counts[i]].tolist() for i in range(n_real)]
         self.m_tokens.inc(sum(len(o) for o in outs))
         return outs
 
@@ -135,7 +128,7 @@ class TpuGenerateProcessor(Processor):
         outs = await asyncio.get_running_loop().run_in_executor(
             None, self._generate_sync, ids, lengths, n
         )
-        texts_out = [self._detok(o) for o in outs[:n]]
+        texts_out = [self._detok(o) for o in outs]  # already trimmed to n rows
         return [batch.with_column(self.output_field, pa.array(texts_out, pa.string()))]
 
 
