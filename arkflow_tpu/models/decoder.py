@@ -475,14 +475,21 @@ def generate(params: dict, cfg: DecoderConfig, input_ids, lengths,
 
     def body(state):
         step, nxt, done, counts, cache, out = state
+        # decode at the TOP for steps >= 1 (step 0 uses the prefill token), so
+        # the loop never pays a trailing forward pass after the final emission
+        nxt, cache = jax.lax.cond(
+            step > 0,
+            lambda args: decode_step(params, cfg, args[0][:, None], args[1]),
+            lambda args: args,
+            (nxt, cache),
+        )
         is_eos = nxt == eos_id
         keep = jnp.logical_and(~done, ~is_eos)
         emit = jnp.where(keep, nxt, 0)
         out = jax.lax.dynamic_update_slice(out, emit[:, None], (0, step))
         counts = counts + keep.astype(jnp.int32)
         done = jnp.logical_or(done, is_eos)
-        nxt2, cache = decode_step(params, cfg, nxt[:, None], cache)
-        return step + 1, nxt2, done, counts, cache, out
+        return step + 1, nxt, done, counts, cache, out
 
     _, _, _, counts, _, out = jax.lax.while_loop(
         cond, body, (0, nxt, done0, counts0, cache, out0)

@@ -95,6 +95,58 @@ def input_spec(cfg: ViTConfig) -> dict:
     return {"images": ("float32", (cfg.image_size, cfg.image_size, cfg.channels))}
 
 
+def from_hf_state_dict(state: dict, cfg: ViTConfig) -> dict:
+    """Convert a HuggingFace ViT state_dict into this model's params.
+
+    Accepts both ``ViTForImageClassification`` dicts (``vit.``-prefixed keys)
+    and bare ``ViTModel`` dicts (``embeddings.``/``encoder.`` keys).
+    The conv patch-projection maps onto our dense patchify: with our flatten
+    order (row, col, channel), ``dense_w[(i*P + j)*C + c, d] = conv_w[d, c, i, j]``
+    i.e. ``conv_w.transpose(2, 3, 1, 0).reshape(P*P*C, D)``.
+    """
+    prefixed = any(k.startswith("vit.") for k in state)
+
+    def t(name, transpose=False):
+        return cm.hf_tensor(state, name if prefixed else name[len("vit."):], transpose)
+
+    conv_w = t("vit.embeddings.patch_embeddings.projection.weight")
+    patch_w = jnp.transpose(conv_w, (2, 3, 1, 0)).reshape(-1, cfg.hidden)
+    layers = []
+    for i in range(cfg.layers):
+        p = f"vit.encoder.layer.{i}"
+        layers.append(
+            {
+                "ln1": {"scale": t(f"{p}.layernorm_before.weight"),
+                        "bias": t(f"{p}.layernorm_before.bias")},
+                "q": {"w": t(f"{p}.attention.attention.query.weight", True),
+                      "b": t(f"{p}.attention.attention.query.bias")},
+                "k": {"w": t(f"{p}.attention.attention.key.weight", True),
+                      "b": t(f"{p}.attention.attention.key.bias")},
+                "v": {"w": t(f"{p}.attention.attention.value.weight", True),
+                      "b": t(f"{p}.attention.attention.value.bias")},
+                "attn_out": {"w": t(f"{p}.attention.output.dense.weight", True),
+                             "b": t(f"{p}.attention.output.dense.bias")},
+                "ln2": {"scale": t(f"{p}.layernorm_after.weight"),
+                        "bias": t(f"{p}.layernorm_after.bias")},
+                "ffn_in": {"w": t(f"{p}.intermediate.dense.weight", True),
+                           "b": t(f"{p}.intermediate.dense.bias")},
+                "ffn_out": {"w": t(f"{p}.output.dense.weight", True),
+                            "b": t(f"{p}.output.dense.bias")},
+            }
+        )
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+    return {
+        "patch_embed": {
+            "w": jnp.asarray(patch_w),
+            "b": t("vit.embeddings.patch_embeddings.projection.bias"),
+        },
+        "cls": t("vit.embeddings.cls_token"),
+        "pos": t("vit.embeddings.position_embeddings"),
+        "ln_out": {"scale": t("vit.layernorm.weight"), "bias": t("vit.layernorm.bias")},
+        "layers": stacked,
+    }
+
+
 register_model(
     ModelFamily(
         name="vit_embedder",
@@ -102,5 +154,6 @@ register_model(
         init=init,
         apply=apply,
         input_spec=input_spec,
+        extras={"from_hf_state_dict": from_hf_state_dict},
     )
 )

@@ -51,7 +51,7 @@ class TpuGenerateProcessor(Processor):
         from arkflow_tpu.models import get_model
 
         self.family = get_model(model)
-        if "decode_step" not in self.family.extras:
+        if "generate" not in self.family.extras:
             raise ConfigError(f"model {model!r} does not support incremental decoding")
         self.cfg = self.family.make_config(**(model_config or {}))
         if getattr(self.cfg, "num_experts", 0) > 1:

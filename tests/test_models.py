@@ -327,3 +327,83 @@ def test_generate_padding_rows_do_not_gate_early_exit():
     t1, c1 = ex["generate"](p, cfg, prompts[:1], lengths[:1], max_new_tokens=8, eos_id=2)
     np.testing.assert_array_equal(np.asarray(tokens)[0, : int(counts[0])],
                                   np.asarray(t1)[0, : int(c1[0])])
+
+
+def test_vit_hf_state_dict_import():
+    fam = get_model("vit_embedder")
+    cfg = fam.make_config(image_size=32, patch=16, hidden=24, layers=1, heads=2, ffn=32)
+    rng = np.random.RandomState(0)
+
+    def w(*shape):
+        return rng.randn(*shape).astype(np.float32) * 0.05
+
+    d, c, p = cfg.hidden, cfg.channels, cfg.patch
+    state = {
+        "vit.embeddings.cls_token": w(1, 1, d),
+        "vit.embeddings.position_embeddings": w(1, cfg.num_patches + 1, d),
+        "vit.embeddings.patch_embeddings.projection.weight": w(d, c, p, p),
+        "vit.embeddings.patch_embeddings.projection.bias": w(d),
+        "vit.layernorm.weight": np.ones(d, np.float32),
+        "vit.layernorm.bias": np.zeros(d, np.float32),
+    }
+    pfx = "vit.encoder.layer.0"
+    state.update({
+        f"{pfx}.layernorm_before.weight": np.ones(d, np.float32),
+        f"{pfx}.layernorm_before.bias": np.zeros(d, np.float32),
+        f"{pfx}.attention.attention.query.weight": w(d, d),
+        f"{pfx}.attention.attention.query.bias": w(d),
+        f"{pfx}.attention.attention.key.weight": w(d, d),
+        f"{pfx}.attention.attention.key.bias": w(d),
+        f"{pfx}.attention.attention.value.weight": w(d, d),
+        f"{pfx}.attention.attention.value.bias": w(d),
+        f"{pfx}.attention.output.dense.weight": w(d, d),
+        f"{pfx}.attention.output.dense.bias": w(d),
+        f"{pfx}.layernorm_after.weight": np.ones(d, np.float32),
+        f"{pfx}.layernorm_after.bias": np.zeros(d, np.float32),
+        f"{pfx}.intermediate.dense.weight": w(cfg.ffn, d),
+        f"{pfx}.intermediate.dense.bias": w(cfg.ffn),
+        f"{pfx}.output.dense.weight": w(d, cfg.ffn),
+        f"{pfx}.output.dense.bias": w(d),
+    })
+    params = fam.extras["from_hf_state_dict"](state, cfg)
+    out = fam.apply(params, cfg, images=jnp.ones((2, 32, 32, 3), jnp.float32) * 0.5)
+    assert out["embedding"].shape == (2, 24)
+    assert np.all(np.isfinite(np.asarray(out["embedding"])))
+    # conv->dense patchify mapping: check one coefficient
+    conv = state["vit.embeddings.patch_embeddings.projection.weight"]
+    i, j, ch, dd = 3, 7, 1, 5
+    flat_idx = (i * p + j) * c + ch
+    assert params["patch_embed"]["w"][flat_idx, dd] == conv[dd, ch, i, j]
+
+
+def test_vit_hf_import_accepts_unprefixed_keys():
+    """Bare ViTModel state_dicts (no 'vit.' prefix) load too (review fix)."""
+    fam = get_model("vit_embedder")
+    cfg = fam.make_config(image_size=32, patch=16, hidden=8, layers=1, heads=2, ffn=16)
+    rng = np.random.RandomState(2)
+
+    def w(*shape):
+        return rng.randn(*shape).astype(np.float32) * 0.05
+
+    d, c, p = 8, 3, 16
+    state = {
+        "embeddings.cls_token": w(1, 1, d),
+        "embeddings.position_embeddings": w(1, cfg.num_patches + 1, d),
+        "embeddings.patch_embeddings.projection.weight": w(d, c, p, p),
+        "embeddings.patch_embeddings.projection.bias": w(d),
+        "layernorm.weight": np.ones(d, np.float32),
+        "layernorm.bias": np.zeros(d, np.float32),
+    }
+    pfx = "encoder.layer.0"
+    for name, shape in [("layernorm_before.weight", (d,)), ("layernorm_before.bias", (d,)),
+                        ("attention.attention.query.weight", (d, d)), ("attention.attention.query.bias", (d,)),
+                        ("attention.attention.key.weight", (d, d)), ("attention.attention.key.bias", (d,)),
+                        ("attention.attention.value.weight", (d, d)), ("attention.attention.value.bias", (d,)),
+                        ("attention.output.dense.weight", (d, d)), ("attention.output.dense.bias", (d,)),
+                        ("layernorm_after.weight", (d,)), ("layernorm_after.bias", (d,)),
+                        ("intermediate.dense.weight", (16, d)), ("intermediate.dense.bias", (16,)),
+                        ("output.dense.weight", (d, 16)), ("output.dense.bias", (d,))]:
+        state[f"{pfx}.{name}"] = w(*shape)
+    params = fam.extras["from_hf_state_dict"](state, cfg)
+    out = fam.apply(params, cfg, images=jnp.ones((1, 32, 32, 3), jnp.float32))
+    assert out["embedding"].shape == (1, 8)

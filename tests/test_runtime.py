@@ -421,3 +421,29 @@ def test_chaos_processor_routes_to_error_output():
     )
     # batches 3 and 6 fail -> 4 delivered
     assert sink.dropped_batches == 4
+
+
+def test_write_failure_does_not_ack():
+    """Output write failures leave the batch unacked (broker redelivers)."""
+    from arkflow_tpu.plugins.input.memory import MemoryInput
+
+    acked: list = []
+
+    class AckingInput(MemoryInput):
+        async def read(self):
+            batch, _ = await super().read()
+            return batch, CountingAck(acked)
+
+    class FailingSink(CollectOutput):
+        async def write(self, batch):
+            if batch.to_binary()[0] == b"poison":
+                raise RuntimeError("disk full")
+            await super().write(batch)
+
+    inp = AckingInput([b"ok1", b"poison", b"ok2"])
+    sink = FailingSink()
+    stream = Stream(inp, Pipeline([]), sink, thread_num=1, name="wfail")
+    asyncio.run(stream.run(asyncio.Event()))
+    assert sink.dropped_batches == 2  # ok1, ok2 delivered
+    assert len(acked) == 2  # poison batch NOT acked -> would replay
+    assert stream.m_write_errors.value == 1
