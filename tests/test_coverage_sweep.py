@@ -1,0 +1,166 @@
+"""Coverage sweep: SQL dialect corners, config formats, output modes, windows."""
+
+import asyncio
+import json
+
+import pytest
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
+from arkflow_tpu.config import EngineConfig
+from arkflow_tpu.sql import SessionContext
+
+ensure_plugins_loaded()
+
+
+@pytest.fixture()
+def ctx():
+    c = SessionContext()
+    c.register_batch("flow", MessageBatch.from_pydict(
+        {"id": [1, 2, 3, 4], "name": ["ab", "cd", "ae", None], "v": [10.0, 20.0, 30.0, 40.0]}))
+    return c
+
+
+def test_sql_union_fallback(ctx):
+    out = ctx.sql("SELECT id FROM flow WHERE id = 1 UNION ALL SELECT id FROM flow WHERE id = 3 ORDER BY id")
+    assert out.column("id").to_pylist() == [1, 3]
+
+
+def test_sql_case_with_operand(ctx):
+    out = ctx.sql("SELECT id, CASE id WHEN 1 THEN 'one' WHEN 2 THEN 'two' ELSE 'many' END AS w FROM flow ORDER BY id")
+    assert out.column("w").to_pylist() == ["one", "two", "many", "many"]
+
+
+def test_sql_not_like_and_null_name(ctx):
+    out = ctx.sql("SELECT id FROM flow WHERE name NOT LIKE 'a%'")
+    assert out.column("id").to_pylist() == [2]  # NULL name excluded by SQL semantics
+
+
+def test_sql_order_by_source_expression(ctx):
+    out = ctx.sql("SELECT id FROM flow ORDER BY v * -1")
+    assert out.column("id").to_pylist() == [4, 3, 2, 1]
+
+
+def test_sql_limit_zero(ctx):
+    assert ctx.sql("SELECT id FROM flow LIMIT 0").num_rows == 0
+
+
+def test_sql_between_not(ctx):
+    out = ctx.sql("SELECT id FROM flow WHERE v NOT BETWEEN 15 AND 35 ORDER BY id")
+    assert out.column("id").to_pylist() == [1, 4]
+
+
+def test_config_json_and_toml(tmp_path):
+    j = tmp_path / "c.json"
+    j.write_text(json.dumps({"streams": [{"input": {"type": "memory", "messages": []},
+                                          "output": {"type": "drop"}}]}))
+    cfg = EngineConfig.from_file(j)
+    assert cfg.streams[0].input["type"] == "memory"
+
+    t = tmp_path / "c.toml"
+    t.write_text('''
+[[streams]]
+[streams.input]
+type = "memory"
+messages = []
+[streams.output]
+type = "drop"
+[health_check]
+enabled = false
+''')
+    cfg = EngineConfig.from_file(t)
+    assert cfg.streams[0].output["type"] == "drop"
+    assert cfg.health_check.enabled is False
+
+
+def test_http_output_per_payload_mode():
+    from aiohttp import web
+
+    async def go():
+        received = []
+
+        async def handler(req):
+            received.append(await req.read())
+            return web.Response(text="ok")
+
+        app = web.Application()
+        app.router.add_post("/s", handler)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", 18094).start()
+        try:
+            out = build_component("output", {"type": "http", "url": "http://127.0.0.1:18094/s",
+                                             "batch_body": False}, Resource())
+            await out.connect()
+            await out.write(MessageBatch.new_binary([b"a", b"b"]))
+            await out.close()
+        finally:
+            await runner.cleanup()
+        assert received == [b"a", b"b"]  # one request per payload
+
+    asyncio.run(go())
+
+
+def test_tumbling_window_with_join_query():
+    from tests.test_runtime import CollectOutput
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.runtime import build_stream
+
+    cfg = StreamConfig.from_mapping(
+        {
+            "input": {
+                "type": "multiple_inputs",
+                "inputs": [
+                    {"name": "l", "type": "memory", "codec": "json",
+                     "messages": ['{"k": 1, "x": "a"}']},
+                    {"name": "r", "type": "memory", "codec": "json",
+                     "messages": ['{"k": 1, "y": 9}']},
+                ],
+            },
+            "buffer": {"type": "tumbling_window", "interval": "60ms",
+                       "query": "SELECT l.x, r.y FROM l JOIN r ON l.k = r.k"},
+            "pipeline": {"thread_num": 1, "processors": []},
+            "output": {"type": "drop"},
+        }
+    )
+    stream = build_stream(cfg)
+    sink = CollectOutput()
+    stream.output = sink
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=10))
+    rows = [r for b in sink.batches for r in b.record_batch.to_pylist()]
+    assert rows == [{"x": "a", "y": 9}]
+
+
+def test_influx_measurement_expr_and_timestamp():
+    from arkflow_tpu.plugins.output.influxdb import encode_lines
+    from arkflow_tpu.utils.expr import DynValue
+
+    batch = MessageBatch.from_pydict({"station": ["s1"], "value": [2.5], "ts": [42]})
+    m = DynValue.from_config({"expr": "'m-' || station"})
+    lines = encode_lines(batch, str(m.eval_scalar(batch)), {}, {"value": "value"}, "ts")
+    assert lines == ["m-s1 value=2.5 42"]
+
+
+def test_generate_input_object_payload():
+    from tests.test_runtime import run_stream_config
+
+    sink = run_stream_config(
+        {
+            "input": {"type": "generate", "payload": {"a": 1}, "batch_size": 2,
+                      "count": 4, "codec": "json"},
+            "output": {"type": "drop"},
+        }
+    )
+    vals = [v for b in sink.batches for v in b.column("a").to_pylist()]
+    assert vals == [1, 1, 1, 1]
+
+
+def test_split_batch_roundtrip_through_sql():
+    """8192-row default chunking composes with SQL (ref split_batch usage)."""
+    big = MessageBatch.from_pydict({"x": list(range(20000))})
+    ctx = SessionContext()
+    total = 0
+    for chunk in big.split():
+        ctx.register_batch("flow", chunk)
+        total += ctx.sql("SELECT count(*) AS n FROM flow").column("n").to_pylist()[0]
+    assert total == 20000
