@@ -40,10 +40,12 @@ class DecoderConfig:
     #: mesh with an "sp" axis passed to forward/train_step
     use_ring_attention: bool = False
     #: >1 turns the MLP into a switch-style top-1 MoE; experts shard over the
-    #: "ep" mesh axis. Dispatch is dense (every expert computes every token,
-    #: masked at combine) — correct and GSPMD-shardable; all-to-all token
-    #: dispatch is a later optimisation.
+    #: "ep" mesh axis via capacity-based dispatch/combine einsums (GSPMD turns
+    #: the expert dim into true expert parallelism). Tokens beyond an expert's
+    #: capacity are dropped (standard Switch behavior).
     num_experts: int = 0
+    #: expert capacity = ceil(tokens / num_experts * capacity_factor)
+    capacity_factor: float = 1.25
     #: rematerialize each layer in the backward pass (jax.checkpoint): trades
     #: FLOPs for HBM so long-context training fits (activations are O(layers)
     #: otherwise)
@@ -107,22 +109,48 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
 
 
 def _moe_mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
-    """Switch-style top-1 MoE SwiGLU. Experts shard over the "ep" mesh axis
-    (param specs put the leading expert dim on ep); GSPMD turns the masked
-    combine into a psum over expert shards."""
+    """Switch-style top-1 MoE SwiGLU with capacity-based dispatch/combine.
+
+    Each token routes to its top expert; tokens queue into per-expert capacity
+    slots (cumsum position) and overflow drops to zero output. Compute is
+    dispatch -> per-expert SwiGLU on [E, C, D] -> combine, so FLOPs scale with
+    ``tokens * capacity_factor`` regardless of expert count, and GSPMD shards
+    the E dim over the "ep" mesh axis (param specs) — the dispatch/combine
+    einsums become the all-to-all.
+    """
+    import math
+
     ex = lp["experts"]
     dtype = y.dtype
-    router_logits = cm.dense(lp["router"], y, dtype=jnp.float32)  # [B,S,E]
+    b, s, d = y.shape
+    e = ex["w_gate"].shape[0]
+    tokens = b * s
+    capacity = max(1, math.ceil(tokens / e * cfg.capacity_factor))
+
+    yf = y.reshape(tokens, d)
+    router_logits = cm.dense(lp["router"], yf, dtype=jnp.float32)  # [T, E]
     probs = jax.nn.softmax(router_logits, axis=-1)
-    top = jnp.argmax(probs, axis=-1)  # [B,S]
-    onehot = jax.nn.one_hot(top, probs.shape[-1], dtype=jnp.float32)
-    weight = (probs * onehot).sum(-1)  # [B,S] routing prob of chosen expert
-    gate = jnp.einsum("bsd,edf->bsef", y.astype(dtype), ex["w_gate"].astype(dtype))
-    up = jnp.einsum("bsd,edf->bsef", y.astype(dtype), ex["w_up"].astype(dtype))
+    top = jnp.argmax(probs, axis=-1)  # [T]
+    weight = jnp.take_along_axis(probs, top[:, None], axis=-1)[:, 0]  # [T]
+    expert_onehot = jax.nn.one_hot(top, e, dtype=jnp.float32)  # [T, E]
+    # position of each token in its expert's queue: the routed column holds
+    # position+1, others 0; sum over E then subtract 1
+    pos_plus1 = (jnp.cumsum(expert_onehot, axis=0) * expert_onehot).sum(axis=-1)
+    pos_idx = pos_plus1.astype(jnp.int32) - 1  # [T]
+    keep = (pos_idx >= 0) & (pos_idx < capacity)
+    slot_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32) * keep[:, None]
+    dispatch = jnp.einsum("te,tc->tec", expert_onehot, slot_onehot)  # [T, E, C]
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dtype), yf.astype(dtype))
+    gate = jnp.einsum("ecd,edf->ecf", expert_in, ex["w_gate"].astype(dtype))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, ex["w_up"].astype(dtype))
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(dtype) * up
-    out = jnp.einsum("bsef,efd->bsed", act, ex["w_down"].astype(dtype))
-    combined = jnp.einsum("bsed,bse->bsd", out.astype(jnp.float32), onehot)
-    return (combined * weight[..., None]).astype(dtype)
+    expert_out = jnp.einsum("ecf,efd->ecd", act, ex["w_down"].astype(dtype))
+
+    combine = dispatch * weight[:, None, None]  # routing prob folded in
+    out = jnp.einsum("tec,ecd->td", combine.astype(jnp.float32),
+                     expert_out.astype(jnp.float32))
+    return out.reshape(b, s, d).astype(dtype)
 
 
 def _shard_act(x, axes):
