@@ -6,7 +6,7 @@ ref: crates/arkflow-plugin/src/input/kafka.rs):
 
 - Metadata v1 (leader discovery), ListOffsets v1 (earliest/latest)
 - Produce v3 / Fetch v4 with record-batch format v2 (magic 2, crc32c from the
-  native tier, no compression)
+  native tier; gzip compression both ways, snappy/lz4/zstd gated)
 - FindCoordinator v0 (cached per group) + OffsetCommit v2 / OffsetFetch v1
 - Consumer groups: JoinGroup v2 / SyncGroup v1 / Heartbeat v1 / LeaveGroup v1
   with the 'range' assignor; commits carry generation/member so fenced members
@@ -176,8 +176,9 @@ class KafkaRecord:
 
 
 def encode_record_batch(records: list[tuple[Optional[bytes], Optional[bytes]]],
-                        base_ts_ms: Optional[int] = None) -> bytes:
-    """records: [(key, value)] -> record-batch v2 bytes (no compression)."""
+                        base_ts_ms: Optional[int] = None,
+                        compression: Optional[str] = None) -> bytes:
+    """records: [(key, value)] -> record-batch v2 bytes (plain or gzip)."""
     now = base_ts_ms if base_ts_ms is not None else int(time.time() * 1000)
     body = Writer()
     for i, (key, value) in enumerate(records):
@@ -197,11 +198,19 @@ def encode_record_batch(records: list[tuple[Optional[bytes], Optional[bytes]]],
         encoded = rec.build()
         body.varint(len(encoded)).raw(encoded)
     records_bytes = body.build()
+    attrs = 0
+    if compression == "gzip":
+        import gzip as _gzip
+
+        records_bytes = _gzip.compress(records_bytes)
+        attrs = 1
+    elif compression not in (None, "none"):
+        raise WriteError(f"kafka compression {compression!r} not supported (gzip only)")
 
     # fields covered by crc: attributes..records
     crc_body = (
         Writer()
-        .i16(0)  # attributes: no compression
+        .i16(attrs)
         .i32(len(records) - 1)  # lastOffsetDelta
         .i64(now)  # firstTimestamp
         .i64(now)  # maxTimestamp
@@ -236,8 +245,11 @@ def decode_record_batches(data: bytes) -> list[KafkaRecord]:
             continue
         r.u32()  # crc (trusted; validated by broker)
         attrs = r.i16()
-        if attrs & 0x07:
-            raise ReadError("kafka: compressed record batches not supported")
+        codec_id = attrs & 0x07
+        if codec_id not in (0, 1):  # 0=none, 1=gzip (stdlib); snappy/lz4/zstd need libs
+            raise ReadError(
+                f"kafka: compression codec {codec_id} not supported (none/gzip only)"
+            )
         r.i32()  # lastOffsetDelta
         first_ts = r.i64()
         r.i64()  # maxTimestamp
@@ -245,22 +257,30 @@ def decode_record_batches(data: bytes) -> list[KafkaRecord]:
         r.i16()  # producerEpoch
         r.i32()  # baseSequence
         n = r.i32()
+        # parse records from a sub-reader so the outer cursor stays intact
+        # across multi-batch record sets (gzip swaps in decompressed bytes)
+        records_blob = r._take(end - r.pos)
+        if codec_id == 1:
+            import gzip as _gzip
+
+            records_blob = _gzip.decompress(records_blob)
+        rr = Reader(records_blob)
         for _ in range(n):
-            r.varint()  # record length
-            r.i8()  # attributes
-            ts_delta = r.varint()
-            off_delta = r.varint()
-            klen = r.varint()
-            key = bytes(r._take(klen)) if klen >= 0 else None
-            vlen = r.varint()
-            value = bytes(r._take(vlen)) if vlen >= 0 else None
-            hn = r.varint()
+            rr.varint()  # record length
+            rr.i8()  # attributes
+            ts_delta = rr.varint()
+            off_delta = rr.varint()
+            klen = rr.varint()
+            key = bytes(rr._take(klen)) if klen >= 0 else None
+            vlen = rr.varint()
+            value = bytes(rr._take(vlen)) if vlen >= 0 else None
+            hn = rr.varint()
             for _ in range(hn):
-                hk = r.varint()
-                r._take(hk)
-                hv = r.varint()
+                hk = rr.varint()
+                rr._take(hk)
+                hv = rr.varint()
                 if hv >= 0:
-                    r._take(hv)
+                    rr._take(hv)
             out.append(KafkaRecord(base_offset + off_delta, first_ts + ts_delta, key, value))
         r.pos = end
     return out
@@ -567,8 +587,9 @@ class KafkaClient:
 
     async def produce(self, topic: str, partition: int,
                       records: list[tuple[Optional[bytes], Optional[bytes]]],
-                      acks: int = -1, timeout_ms: int = 30000) -> int:
-        batch = encode_record_batch(records)
+                      acks: int = -1, timeout_ms: int = 30000,
+                      compression: Optional[str] = None) -> int:
+        batch = encode_record_batch(records, compression=compression)
         body = (
             Writer()
             .string(None)  # transactional_id

@@ -36,7 +36,8 @@ logger = logging.getLogger("arkflow.kafka")
 class KafkaOutput(Output):
     def __init__(self, brokers: str, topic: DynValue, key: Optional[DynValue],
                  acks: int, retries: int, codec=None,
-                 client_kwargs: Optional[dict] = None):
+                 client_kwargs: Optional[dict] = None,
+                 compression: Optional[str] = None):
         self.brokers = brokers
         self.topic = topic
         self.key = key
@@ -44,6 +45,7 @@ class KafkaOutput(Output):
         self.retries = retries
         self.codec = codec
         self.client_kwargs = client_kwargs or {}
+        self.compression = compression
         self._client: Optional[KafkaClient] = None
         self._rr = 0
 
@@ -95,12 +97,14 @@ class KafkaOutput(Output):
         last: Optional[Exception] = None
         for attempt in range(self.retries + 1):
             try:
-                await self._client.produce(topic, part, records, acks=self.acks)
+                await self._client.produce(topic, part, records, acks=self.acks,
+                                           compression=self.compression)
                 return
             except Exception as e:
                 last = e
                 logger.warning("kafka produce retry %d (%s/%d): %s", attempt, topic, part, e)
-                await asyncio.sleep(min(0.2 * 2**attempt, 2.0))
+                if attempt < self.retries:  # no backoff after the final attempt
+                    await asyncio.sleep(min(0.2 * 2**attempt, 2.0))
         raise WriteError(f"kafka produce failed after {self.retries + 1} attempts: {last}")
 
     async def close(self) -> None:
@@ -112,6 +116,11 @@ class KafkaOutput(Output):
 def _build(config: dict, resource: Resource) -> KafkaOutput:
     if not config.get("brokers") or not config.get("topic"):
         raise ConfigError("kafka output requires 'brokers' and 'topic'")
+    compression = config.get("compression")
+    if compression not in (None, "none", "gzip"):
+        raise ConfigError(
+            f"kafka output compression {compression!r} not supported (gzip only)"
+        )
     key = config.get("key")
     return KafkaOutput(
         brokers=str(config["brokers"]),
@@ -121,4 +130,5 @@ def _build(config: dict, resource: Resource) -> KafkaOutput:
         retries=int(config.get("retries", 3)),
         codec=build_codec(config.get("codec"), resource),
         client_kwargs=client_kwargs_from_config(config),
+        compression=config.get("compression"),
     )

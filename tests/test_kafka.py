@@ -553,3 +553,47 @@ def test_kafka_consumer_group_rebalance():
             await broker.stop()
 
     asyncio.run(go())
+
+
+def test_record_batch_gzip_roundtrip():
+    records = [(b"k", b"v" * 500), (None, b"w" * 500)]
+    plain = encode_record_batch(records, base_ts_ms=7)
+    gz = encode_record_batch(records, base_ts_ms=7, compression="gzip")
+    assert len(gz) < len(plain)  # it actually compressed
+    out = decode_record_batches(gz)
+    assert [(r.key, r.value) for r in out] == records
+    # multi-batch record set: gzip batch followed by a plain batch
+    import struct as _s
+
+    plain2 = encode_record_batch([(None, b"tail")], base_ts_ms=8)
+    plain2 = _s.pack(">q", 2) + plain2[8:]  # base offset after the 2 gz records
+    combined = gz + plain2
+    out = decode_record_batches(combined)
+    assert [r.value for r in out] == [b"v" * 500, b"w" * 500, b"tail"]
+
+
+def test_kafka_output_gzip_end_to_end():
+    async def go():
+        broker = FakeKafkaBroker({"t": 1})
+        await broker.start()
+        try:
+            out = build_component(
+                "output",
+                {"type": "kafka", "brokers": f"127.0.0.1:{broker.port}", "topic": "t",
+                 "compression": "gzip"},
+                Resource(),
+            )
+            await out.connect()
+            await out.write(MessageBatch.new_binary([b"hello compressed"]))
+            await out.close()
+            assert broker.logs[("t", 0)][0][1] == b"hello compressed"
+        finally:
+            await broker.stop()
+
+    asyncio.run(go())
+
+
+def test_kafka_output_compression_validated_at_build():
+    with pytest.raises(ConfigError):
+        build_component("output", {"type": "kafka", "brokers": "b", "topic": "t",
+                                   "compression": "snappy"}, Resource())
