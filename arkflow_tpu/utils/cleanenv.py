@@ -1,0 +1,58 @@
+"""Clean-environment helpers for CPU-only jax runs.
+
+The image's axon TPU-tunnel sitecustomize (``PYTHONPATH=/root/.axon_site``)
+forces ``JAX_PLATFORMS=axon``, ignores in-process overrides, and — when the
+single tunnel client is busy or wedged — hangs ANY jax backend init,
+including ``jax.devices("cpu")``.  Every CPU-only surface (tests, multichip
+dryrun, bench fallbacks) therefore re-execs itself in a scrubbed child env.
+This module is the single source of truth for that scrub, shared by
+``tests/conftest.py``, ``bench.py`` and ``__graft_entry__.py``.
+
+It must stay importable without jax side effects (conftest imports it before
+jax) and with zero third-party imports.
+"""
+
+from __future__ import annotations
+
+import os
+
+AXON_SITE_MARKER = ".axon_site"
+
+
+def axon_hook_present(env: dict | None = None) -> bool:
+    """True when the axon sitecustomize would hijack a fresh jax import."""
+    env = os.environ if env is None else env
+    return AXON_SITE_MARKER in env.get("PYTHONPATH", "")
+
+
+def strip_axon_pythonpath(env: dict) -> None:
+    """Drop only the axon sitecustomize entry; keep other PYTHONPATH entries
+    (e.g. editable installs) intact."""
+    kept = [
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and AXON_SITE_MARKER not in p
+    ]
+    if kept:
+        env["PYTHONPATH"] = os.pathsep.join(kept)
+    else:
+        env.pop("PYTHONPATH", None)
+
+
+def pin_cpu_env(env: dict, n_devices: int = 8) -> None:
+    """Force the n-device virtual CPU platform in an env mapping."""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    env.setdefault("JAX_ENABLE_X64", "0")
+
+
+def cpu_child_env(n_devices: int = 8) -> dict:
+    """A copy of os.environ scrubbed for a CPU-only jax child process."""
+    env = dict(os.environ)
+    strip_axon_pythonpath(env)
+    pin_cpu_env(env, n_devices)
+    return env

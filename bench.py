@@ -142,12 +142,13 @@ def main() -> None:
 
     tiny = os.environ.get("BENCH_TINY", "0") == "1"
     mode = os.environ.get("BENCH_MODE", "bert")
+    from arkflow_tpu.utils.cleanenv import axon_hook_present, cpu_child_env
+
     if mode == "sql":
         # pure-CPU anchor. The axon sitecustomize makes even jax.devices("cpu")
         # init the TPU tunnel, so re-exec in a clean env first.
-        if "axon" in os.environ.get("PYTHONPATH", "") and os.environ.get("JAX_PLATFORMS") != "cpu":
-            env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
-            env["JAX_PLATFORMS"] = "cpu"
+        if axon_hook_present() and os.environ.get("JAX_PLATFORMS") != "cpu":
+            env = cpu_child_env()
             res = subprocess.run([sys.executable, __file__], env=env, capture_output=True)
             sys.stdout.write(res.stdout.decode())
             sys.stderr.write(res.stderr.decode())
@@ -180,8 +181,8 @@ def main() -> None:
         # and record a CPU number rather than hanging the driver.
         print("bench: TPU backend unreachable; falling back to CPU tiny mode",
               file=sys.stderr, flush=True)
-        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
-        env.update({"BENCH_TINY": "1", "JAX_PLATFORMS": "cpu"})
+        env = cpu_child_env()
+        env["BENCH_TINY"] = "1"
         res = subprocess.run([sys.executable, __file__], env=env, capture_output=True)
         sys.stdout.write(res.stdout.decode())
         sys.stderr.write(res.stderr.decode())

@@ -14,23 +14,15 @@ present we re-exec pytest once in a clean environment.
 import os
 import sys
 
-_AXON_SITE = ".axon_site"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def _pin_cpu_env(env: dict) -> None:
-    """Force the 8-device virtual CPU platform in an env mapping (single
-    source of truth for both the direct path and the re-exec'd child)."""
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-    env.setdefault("JAX_ENABLE_X64", "0")
-
-
-_NEEDS_REEXEC = (
-    _AXON_SITE in os.environ.get("PYTHONPATH", "")
-    and os.environ.get("ARKFLOW_TESTS_REEXEC") != "1"
+from arkflow_tpu.utils.cleanenv import (  # noqa: E402
+    axon_hook_present,
+    pin_cpu_env as _pin_cpu_env,
+    strip_axon_pythonpath,
 )
+
+_NEEDS_REEXEC = axon_hook_present() and os.environ.get("ARKFLOW_TESTS_REEXEC") != "1"
 
 if not _NEEDS_REEXEC:
     _pin_cpu_env(os.environ)
@@ -51,13 +43,7 @@ def pytest_configure(config):
     if capman is not None:
         capman.suspend_global_capture(in_=True)
     env = dict(os.environ)
-    # drop only the axon sitecustomize entry; keep other PYTHONPATH entries
-    kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-            if p and _AXON_SITE not in p]
-    if kept:
-        env["PYTHONPATH"] = os.pathsep.join(kept)
-    else:
-        env.pop("PYTHONPATH", None)
+    strip_axon_pythonpath(env)
     env["ARKFLOW_TESTS_REEXEC"] = "1"
     _pin_cpu_env(env)
     # sys.orig_argv preserves the full original invocation (coverage wrappers,
