@@ -228,6 +228,42 @@ def encode_record_batch(records: list[tuple[Optional[bytes], Optional[bytes]]],
     return Writer().i64(0).i32(len(after_length)).raw(after_length).build()
 
 
+def murmur2(data: bytes) -> int:
+    """Murmur2 hash, bit-compatible with the Java client's Utils.murmur2.
+
+    Keyed partition routing must use ``toPositive(murmur2(key)) % n`` to land
+    records on the same partitions as Java/librdkafka producers sharing the
+    topic (librdkafka's ``partitioner=murmur2`` / Java default).
+    """
+    m = 0x5BD1E995
+    length = len(data)
+    h = (0x9747B28C ^ length) & 0xFFFFFFFF
+    for i4 in range(0, length - 3, 4):
+        k = data[i4] | (data[i4 + 1] << 8) | (data[i4 + 2] << 16) | (data[i4 + 3] << 24)
+        k = (k * m) & 0xFFFFFFFF
+        k ^= k >> 24
+        k = (k * m) & 0xFFFFFFFF
+        h = ((h * m) & 0xFFFFFFFF) ^ k
+    tail = length & ~3
+    rem = length - tail
+    if rem == 3:
+        h ^= data[tail + 2] << 16
+    if rem >= 2:
+        h ^= data[tail + 1] << 8
+    if rem >= 1:
+        h ^= data[tail]
+        h = (h * m) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * m) & 0xFFFFFFFF
+    h ^= h >> 15
+    return h
+
+
+def partition_for_key(key: bytes, n_partitions: int) -> int:
+    """Java-client-compatible keyed partition choice."""
+    return (murmur2(key) & 0x7FFFFFFF) % n_partitions
+
+
 def decode_record_batches(data: bytes) -> list[KafkaRecord]:
     """Parse a record set (possibly several v2 batches) into records."""
     out: list[KafkaRecord] = []
@@ -245,6 +281,12 @@ def decode_record_batches(data: bytes) -> list[KafkaRecord]:
             continue
         r.u32()  # crc (trusted; validated by broker)
         attrs = r.i16()
+        if attrs & 0x20:
+            # control batch: transaction COMMIT/ABORT markers written by
+            # transactional producers — not user data (librdkafka filters
+            # these internally; ref input/kafka.rs consumes via librdkafka)
+            r.pos = end
+            continue
         codec_id = attrs & 0x07
         if codec_id not in (0, 1):  # 0=none, 1=gzip (stdlib); snappy/lz4/zstd need libs
             raise ReadError(

@@ -46,6 +46,11 @@ class DecoderConfig:
     num_experts: int = 0
     #: expert capacity = ceil(tokens / num_experts * capacity_factor)
     capacity_factor: float = 1.25
+    #: Switch load-balance aux loss weight (alpha); without it top-1 routing
+    #: collapses onto one expert and capacity overflow zeroes most tokens
+    router_aux_weight: float = 0.01
+    #: router z-loss weight (penalizes large router logits for stability)
+    router_z_weight: float = 1e-3
     #: rematerialize each layer in the backward pass (jax.checkpoint): trades
     #: FLOPs for HBM so long-context training fits (activations are O(layers)
     #: otherwise)
@@ -150,7 +155,15 @@ def _moe_mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
     combine = dispatch * weight[:, None, None]  # routing prob folded in
     out = jnp.einsum("tec,ecd->td", combine.astype(jnp.float32),
                      expert_out.astype(jnp.float32))
-    return out.reshape(b, s, d).astype(dtype)
+
+    # Switch aux stats: f_e = fraction of tokens routed to expert e, P_e =
+    # mean router prob; lb = E * sum(f*P) is minimized by uniform routing.
+    # z = mean(logsumexp(logits)^2) keeps router logits small.
+    frac = expert_onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    lb = e * jnp.sum(frac * mean_prob)
+    z = jnp.mean(jax.scipy.special.logsumexp(router_logits, axis=-1) ** 2)
+    return out.reshape(b, s, d).astype(dtype), (lb, z)
 
 
 def _shard_act(x, axes):
@@ -164,7 +177,8 @@ def _shard_act(x, axes):
         return x  # no mesh in scope (single-chip eager/test path)
 
 
-def forward(params: dict, cfg: DecoderConfig, input_ids, *, axes=None, mesh=None) -> jnp.ndarray:
+def forward(params: dict, cfg: DecoderConfig, input_ids, *, axes=None, mesh=None,
+            return_aux: bool = False):
     """[B, S] ids -> [B, S, vocab] float32 logits (causal).
 
     With ``cfg.use_ring_attention`` and a mesh carrying an ``sp`` axis, the
@@ -208,18 +222,23 @@ def forward(params: dict, cfg: DecoderConfig, input_ids, *, axes=None, mesh=None
         x = _shard_act(x, axes)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
         if cfg.num_experts > 1:
-            x = x + _moe_mlp(lp, y, cfg)
+            moe_out, aux = _moe_mlp(lp, y, cfg)
+            x = x + moe_out
         else:
             gate = jax.nn.silu(cm.dense(lp["w_gate"], y).astype(jnp.float32)).astype(y.dtype)
             x = x + cm.dense(lp["w_down"], gate * cm.dense(lp["w_up"], y))
-        return _shard_act(x, axes), None
+            aux = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+        return _shard_act(x, axes), aux
 
     # prevent_cse=False: scan already isolates iterations, and the default
     # optimization barriers would block XLA fusion in the backward pass
     scan_body = jax.checkpoint(layer, prevent_cse=False) if cfg.remat else layer
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x, (lb_per_layer, z_per_layer) = jax.lax.scan(scan_body, x, params["layers"])
     x = cm.rms_norm(params["norm_out"], x, cfg.norm_eps)
-    return cm.dense(params["lm_head"], x).astype(jnp.float32)
+    logits = cm.dense(params["lm_head"], x).astype(jnp.float32)
+    if return_aux:
+        return logits, {"load_balance": lb_per_layer.mean(), "router_z": z_per_layer.mean()}
+    return logits
 
 
 def apply(params: dict, cfg: DecoderConfig, *, input_ids, axes=None, mesh=None) -> dict:
@@ -228,12 +247,22 @@ def apply(params: dict, cfg: DecoderConfig, *, input_ids, axes=None, mesh=None) 
 
 
 def loss_fn(params: dict, cfg: DecoderConfig, input_ids, targets, mask, *, axes=None, mesh=None):
-    """Causal LM cross-entropy, mean over unmasked target tokens."""
-    logits = forward(params, cfg, input_ids, axes=axes, mesh=mesh)
+    """Causal LM cross-entropy, mean over unmasked target tokens.
+
+    MoE configs additionally carry the Switch load-balance aux loss and
+    router z-loss (weighted by ``router_aux_weight`` / ``router_z_weight``)
+    — without them top-1 routing collapses onto a single expert.
+    """
+    logits, aux = forward(params, cfg, input_ids, axes=axes, mesh=mesh, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     maskf = mask.astype(jnp.float32)
-    return -(ll * maskf).sum() / jnp.maximum(maskf.sum(), 1.0)
+    loss = -(ll * maskf).sum() / jnp.maximum(maskf.sum(), 1.0)
+    if cfg.num_experts > 1:
+        loss = (loss
+                + cfg.router_aux_weight * aux["load_balance"]
+                + cfg.router_z_weight * aux["router_z"])
+    return loss
 
 
 def make_train_step(cfg: DecoderConfig, optimizer, *, axes=None, mesh=None):

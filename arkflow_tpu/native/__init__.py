@@ -2,12 +2,15 @@
 
 Every entry point has a pure-Python fallback, so the engine degrades
 gracefully on machines without a toolchain (``available()`` reports which
-tier is active). The .so is cached next to the source, keyed by source mtime.
+tier is active). The .so is cached next to the source, keyed by a content
+hash of native.cpp — never committed to the repo — so what executes is
+always compiled from the reviewed source.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import logging
 import os
 import subprocess
@@ -26,10 +29,18 @@ _TRIED = False
 
 
 def _build_lib() -> Optional[Path]:
-    so_path = _HERE / "_native.so"
     try:
-        if so_path.exists() and so_path.stat().st_mtime >= _SRC.stat().st_mtime:
+        digest = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+        so_path = _HERE / f"_native-{digest}.so"
+        if so_path.exists():
             return so_path
+        for stale in _HERE.glob("_native*.so"):
+            if stale.name == so_path.name:
+                continue  # a concurrent builder may have just installed it
+            try:
+                stale.unlink()
+            except OSError:
+                pass
         with tempfile.TemporaryDirectory() as td:
             tmp_so = Path(td) / "_native.so"
             cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", str(tmp_so)]

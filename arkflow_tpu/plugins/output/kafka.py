@@ -24,7 +24,11 @@ from typing import Optional
 
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Output, Resource, register_output
-from arkflow_tpu.connect.kafka_client import KafkaClient, client_kwargs_from_config
+from arkflow_tpu.connect.kafka_client import (
+    KafkaClient,
+    client_kwargs_from_config,
+    partition_for_key,
+)
 from arkflow_tpu.errors import ConfigError, WriteError
 from arkflow_tpu.native import crc32c
 from arkflow_tpu.plugins.codec.helper import build_codec, encode_batch
@@ -37,7 +41,8 @@ class KafkaOutput(Output):
     def __init__(self, brokers: str, topic: DynValue, key: Optional[DynValue],
                  acks: int, retries: int, codec=None,
                  client_kwargs: Optional[dict] = None,
-                 compression: Optional[str] = None):
+                 compression: Optional[str] = None,
+                 partitioner: str = "murmur2"):
         self.brokers = brokers
         self.topic = topic
         self.key = key
@@ -46,6 +51,7 @@ class KafkaOutput(Output):
         self.codec = codec
         self.client_kwargs = client_kwargs or {}
         self.compression = compression
+        self.partitioner = partitioner
         self._client: Optional[KafkaClient] = None
         self._rr = 0
 
@@ -57,7 +63,12 @@ class KafkaOutput(Output):
         parts = self._client.partitions(topic)
         if not parts:
             return 0
-        if key:
+        if key is not None:  # empty keys still hash (Java semantics), only absent keys round-robin
+            # murmur2 (default) matches the Java client / librdkafka default,
+            # so keyed records co-partition with other producers on shared
+            # topics; crc32c is kept as an opt-in legacy mode
+            if self.partitioner == "murmur2":
+                return parts[partition_for_key(key, len(parts))]
             return parts[crc32c(key) % len(parts)]
         self._rr += 1
         return parts[self._rr % len(parts)]
@@ -131,4 +142,12 @@ def _build(config: dict, resource: Resource) -> KafkaOutput:
         codec=build_codec(config.get("codec"), resource),
         client_kwargs=client_kwargs_from_config(config),
         compression=config.get("compression"),
+        partitioner=_partitioner(config),
     )
+
+
+def _partitioner(config: dict) -> str:
+    p = str(config.get("partitioner", "murmur2"))
+    if p not in ("murmur2", "crc32c"):
+        raise ConfigError(f"kafka partitioner {p!r} not supported (murmur2/crc32c)")
+    return p

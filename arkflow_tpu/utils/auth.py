@@ -69,20 +69,28 @@ class Authenticator:
         entry = self._failures.get(client)
         if not entry:
             return False
-        count, first = entry
-        if count < LOCKOUT_THRESHOLD:
-            return False
-        if time.monotonic() - first > LOCKOUT_SECONDS:
+        _count, _last, locked_until = entry
+        if locked_until and time.monotonic() < locked_until:
+            return True
+        if locked_until:  # lockout served; start fresh
             del self._failures[client]
-            return False
-        return True
+        return False
 
     def _record_failure(self, client: str) -> None:
+        # entry = [count, last_failure, locked_until]. The count window is
+        # anchored at the LAST failure (ref auth_middleware tracks
+        # last_attempt/locked_until), so attempts paced slower than the
+        # window reset the count, and pacing faster accumulates toward a
+        # hard locked_until deadline — no drip-rate bypass.
+        now = time.monotonic()
         entry = self._failures.get(client)
-        if entry is None:
-            self._failures[client] = [1, time.monotonic()]
-        else:
-            entry[0] += 1
+        if entry is None or now - entry[1] > LOCKOUT_SECONDS:
+            entry = [0, now, 0.0]
+            self._failures[client] = entry
+        entry[0] += 1
+        entry[1] = now
+        if entry[0] >= LOCKOUT_THRESHOLD and not entry[2]:
+            entry[2] = now + LOCKOUT_SECONDS
 
     def check(self, authorization: Optional[str], client: str = "?") -> bool:
         """Validate an Authorization header; tracks lockout per client."""

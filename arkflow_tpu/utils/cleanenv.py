@@ -40,13 +40,19 @@ def strip_axon_pythonpath(env: dict) -> None:
 
 
 def pin_cpu_env(env: dict, n_devices: int = 8) -> None:
-    """Force the n-device virtual CPU platform in an env mapping."""
+    """Force the n-device virtual CPU platform in an env mapping.
+
+    An already-present device-count flag is replaced (not kept), so the
+    caller's requested n always wins."""
+    import re
+
     env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", env.get("XLA_FLAGS", "")
+    ).strip()
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
     env.setdefault("JAX_ENABLE_X64", "0")
 
 

@@ -148,7 +148,9 @@ def main() -> None:
         # pure-CPU anchor. The axon sitecustomize makes even jax.devices("cpu")
         # init the TPU tunnel, so re-exec in a clean env first.
         if axon_hook_present() and os.environ.get("JAX_PLATFORMS") != "cpu":
-            env = cpu_child_env()
+            # n_devices=1: the CPU anchor is a single-host-device number
+            # (comparable across rounds), not a virtual-mesh run
+            env = cpu_child_env(n_devices=1)
             res = subprocess.run([sys.executable, __file__], env=env, capture_output=True)
             sys.stdout.write(res.stdout.decode())
             sys.stderr.write(res.stderr.decode())
@@ -181,7 +183,7 @@ def main() -> None:
         # and record a CPU number rather than hanging the driver.
         print("bench: TPU backend unreachable; falling back to CPU tiny mode",
               file=sys.stderr, flush=True)
-        env = cpu_child_env()
+        env = cpu_child_env(n_devices=1)
         env["BENCH_TINY"] = "1"
         res = subprocess.run([sys.executable, __file__], env=env, capture_output=True)
         sys.stdout.write(res.stdout.decode())

@@ -750,3 +750,29 @@ def test_nats_connect_sends_credentials():
             await srv.stop()
 
     asyncio.run(go())
+
+
+def test_authenticator_lockout_no_drip_bypass(monkeypatch):
+    """Pacing failures slower than the window/threshold must still lock out
+    (the count window anchors at the LAST failure, and crossing the
+    threshold sets a hard locked_until deadline)."""
+    from arkflow_tpu.utils import auth as auth_mod
+
+    now = [1000.0]
+    monkeypatch.setattr(auth_mod.time, "monotonic", lambda: now[0])
+    a = Authenticator(AuthConfig("bearer", token="good"))
+    # drip: one failure every (LOCKOUT_SECONDS/THRESHOLD)+1 sec -> old code
+    # reset the moment count hit threshold; new code locks at the 5th
+    step = auth_mod.LOCKOUT_SECONDS / auth_mod.LOCKOUT_THRESHOLD + 1
+    for _ in range(auth_mod.LOCKOUT_THRESHOLD):
+        assert not a.check("Bearer bad", "drip")
+        now[0] += step
+    assert not a.check("Bearer good", "drip")  # locked despite valid creds
+    # lockout expires LOCKOUT_SECONDS after it was set
+    now[0] += auth_mod.LOCKOUT_SECONDS + 1
+    assert a.check("Bearer good", "drip")
+    # genuinely slow failures (gap > window) never accumulate
+    for _ in range(auth_mod.LOCKOUT_THRESHOLD * 2):
+        assert not a.check("Bearer bad", "slow")
+        now[0] += auth_mod.LOCKOUT_SECONDS + 1
+    assert a.check("Bearer good", "slow")

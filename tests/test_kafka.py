@@ -597,3 +597,42 @@ def test_kafka_output_compression_validated_at_build():
     with pytest.raises(ConfigError):
         build_component("output", {"type": "kafka", "brokers": "b", "topic": "t",
                                    "compression": "snappy"}, Resource())
+
+
+def test_control_batches_skipped():
+    """Transaction COMMIT/ABORT control markers (attrs bit 0x20) must not
+    surface as data records (librdkafka filters them internally)."""
+    from arkflow_tpu.native import crc32c
+
+    control = bytearray(encode_record_batch([(None, b"txn-marker")], base_ts_ms=1))
+    # layout: baseOffset(8) batchLength(4) leaderEpoch(4) magic(1) crc(4) attrs(2)...
+    attrs = struct.unpack_from(">h", control, 21)[0]
+    struct.pack_into(">h", control, 21, attrs | 0x20)
+    struct.pack_into(">I", control, 17, crc32c(bytes(control[21:])))
+    data_batch = encode_record_batch([(b"k", b"real-data")], base_ts_ms=2)
+    out = decode_record_batches(bytes(control) + data_batch)
+    assert [r.value for r in out] == [b"real-data"]
+
+
+def test_murmur2_matches_java_client():
+    """Bit-compat with Java Utils.murmur2 / librdkafka murmur2 partitioner
+    (vectors from librdkafka's rdmurmur2 unittest)."""
+    from arkflow_tpu.connect.kafka_client import murmur2, partition_for_key
+
+    assert murmur2(b"kafka") == 0xD067CF64
+    assert murmur2(b"") == 0x106E08D9
+    assert murmur2(b"1234") == 0x9FC97B14
+    # toPositive(h) % n stays in range and is deterministic
+    for n in (1, 3, 12):
+        p = partition_for_key(b"device-42", n)
+        assert 0 <= p < n
+        assert p == partition_for_key(b"device-42", n)
+
+
+def test_kafka_output_crc32c_partitioner_optin():
+    with pytest.raises(ConfigError):
+        build_component("output", {"type": "kafka", "brokers": "b", "topic": "t",
+                                   "partitioner": "fnv"}, Resource())
+    out = build_component("output", {"type": "kafka", "brokers": "b", "topic": "t",
+                                     "partitioner": "crc32c"}, Resource())
+    assert out.partitioner == "crc32c"

@@ -421,7 +421,10 @@ def test_moe_capacity_dispatch_matches_dense_routing():
     from arkflow_tpu.models.decoder import _moe_mlp
 
     lp = jax.tree_util.tree_map(lambda x: x[0], p["layers"])  # layer 0
-    out = _moe_mlp(lp, y, cfg)
+    out, (lb, z) = _moe_mlp(lp, y, cfg)
+    # load-balance loss is E*sum(f*P): >= 1, minimized by uniform routing
+    assert float(lb) >= 1.0 - 1e-5
+    assert np.isfinite(float(z)) and float(z) >= 0.0
     # dense reference: route each token through its argmax expert, weighted
     ex = lp["experts"]
     logits = y.reshape(-1, 16) @ np.asarray(lp["router"]["w"])
@@ -450,7 +453,31 @@ def test_moe_capacity_drops_overflow_tokens():
 
     lp = jax.tree_util.tree_map(lambda x: x[0], p["layers"])
     y = jnp.asarray(np.random.RandomState(1).randn(1, 16, 16) * 0.2, jnp.float32)
-    out = np.asarray(_moe_mlp(lp, y, cfg)).reshape(16, 16)
+    out = np.asarray(_moe_mlp(lp, y, cfg)[0]).reshape(16, 16)
     zero_rows = (np.abs(out).sum(axis=1) == 0).sum()
     # capacity = ceil(16/2*0.1) = 1 per expert -> at most 2 tokens served
     assert zero_rows >= 14
+
+
+def test_moe_aux_loss_in_training_objective():
+    """MoE loss_fn must include the Switch load-balance + z terms (without
+    them top-1 routing collapses onto one expert); gradients must reach the
+    router through the aux terms."""
+    fam = get_model("decoder_lm")
+    cfg = fam.make_config(vocab_size=64, dim=16, layers=2, heads=2, kv_heads=1,
+                          ffn=24, max_seq=32, num_experts=4)
+    cfg0 = fam.make_config(vocab_size=64, dim=16, layers=2, heads=2, kv_heads=1,
+                           ffn=24, max_seq=32, num_experts=4,
+                           router_aux_weight=0.0, router_z_weight=0.0)
+    p = fam.init(jax.random.PRNGKey(0), cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(1, 64, (2, 8)), jnp.int32)
+    mask = jnp.ones((2, 8), jnp.int32)
+    loss_fn = fam.extras["loss_fn"]
+    with_aux = float(loss_fn(p, cfg, ids, ids, mask))
+    without = float(loss_fn(p, cfg0, ids, ids, mask))
+    assert np.isfinite(with_aux) and np.isfinite(without)
+    assert with_aux > without  # aux terms are strictly positive
+    grads = jax.grad(lambda q: loss_fn(q, cfg, ids, ids, mask))(p)
+    router_g = np.asarray(grads["layers"][0]["router"]["w"]) if isinstance(
+        grads["layers"], list) else np.asarray(grads["layers"]["router"]["w"])
+    assert np.abs(router_g).sum() > 0

@@ -63,3 +63,21 @@ def test_pad_gather():
     np.testing.assert_array_equal(
         out, [[1, 2, 0], [0, 0, 0], [3, 4, 5], [0, 0, 0]]
     )
+
+
+def test_native_so_cache_keyed_by_source_hash():
+    """The executing .so must be derived from the reviewed source: cache file
+    is named by a content hash of native.cpp, and no unhashed _native.so
+    (e.g. a stale or vendored blob) is ever loaded."""
+    import hashlib
+    from pathlib import Path
+
+    from arkflow_tpu import native as nat
+
+    if not nat.available():
+        import pytest
+        pytest.skip("no toolchain")
+    digest = hashlib.sha256((Path(nat.__file__).parent / "native.cpp").read_bytes()).hexdigest()[:16]
+    built = nat._build_lib()
+    assert built is not None and built.name == f"_native-{digest}.so"
+    assert not (Path(nat.__file__).parent / "_native.so").exists()
