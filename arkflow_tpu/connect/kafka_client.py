@@ -266,7 +266,21 @@ def partition_for_key(key: bytes, n_partitions: int) -> int:
 
 def decode_record_batches(data: bytes) -> list[KafkaRecord]:
     """Parse a record set (possibly several v2 batches) into records."""
+    return decode_record_set(data)[0]
+
+
+def decode_record_set(data: bytes) -> tuple[list[KafkaRecord], Optional[int]]:
+    """Parse a record set -> (records, next_offset).
+
+    ``next_offset`` is the fetch position after every *parsed* batch —
+    ``base_offset + lastOffsetDelta + 1`` of the last complete batch — and is
+    what a consumer must advance to even when a batch yields no records
+    (skipped transaction-control batches, compacted-away tails); advancing by
+    ``records[-1].offset + 1`` alone would refetch marker batches forever.
+    None when no complete batch was parsed.
+    """
     out: list[KafkaRecord] = []
+    next_offset: Optional[int] = None
     r = Reader(data)
     while r.remaining() >= 61:  # minimal batch header size
         base_offset = r.i64()
@@ -281,10 +295,13 @@ def decode_record_batches(data: bytes) -> list[KafkaRecord]:
             continue
         r.u32()  # crc (trusted; validated by broker)
         attrs = r.i16()
+        last_delta = r.i32()  # lastOffsetDelta
+        next_offset = base_offset + last_delta + 1
         if attrs & 0x20:
             # control batch: transaction COMMIT/ABORT markers written by
             # transactional producers — not user data (librdkafka filters
-            # these internally; ref input/kafka.rs consumes via librdkafka)
+            # these internally; ref input/kafka.rs consumes via librdkafka).
+            # next_offset still advances past it.
             r.pos = end
             continue
         codec_id = attrs & 0x07
@@ -292,7 +309,6 @@ def decode_record_batches(data: bytes) -> list[KafkaRecord]:
             raise ReadError(
                 f"kafka: compression codec {codec_id} not supported (none/gzip only)"
             )
-        r.i32()  # lastOffsetDelta
         first_ts = r.i64()
         r.i64()  # maxTimestamp
         r.i64()  # producerId
@@ -325,7 +341,7 @@ def decode_record_batches(data: bytes) -> list[KafkaRecord]:
                     rr._take(hv)
             out.append(KafkaRecord(base_offset + off_delta, first_ts + ts_delta, key, value))
         r.pos = end
-    return out
+    return out, next_offset
 
 
 # -- connection -------------------------------------------------------------
@@ -667,8 +683,14 @@ class KafkaClient:
 
     async def fetch(self, topic: str, partition: int, offset: int,
                     max_wait_ms: int = 500, min_bytes: int = 1,
-                    max_bytes: int = 4 << 20) -> tuple[list[KafkaRecord], int]:
-        """Returns (records, high_watermark)."""
+                    max_bytes: int = 4 << 20) -> tuple[list[KafkaRecord], int, int]:
+        """Returns (records, high_watermark, next_offset).
+
+        ``next_offset`` is where the next fetch must start — it advances past
+        batches that yielded no records (control batches, compaction) and is
+        >= ``offset`` always.
+        """
+        next_offset = offset
         body = (
             Writer()
             .i32(-1)  # replica_id
@@ -710,10 +732,11 @@ class KafkaClient:
                     if err in (3, 6, 9):
                         self.topics.pop(topic, None)
                     raise Disconnection(f"kafka fetch error code {err}")
-                records.extend(
-                    rec for rec in decode_record_batches(record_set) if rec.offset >= offset
-                )
-        return records, hwm
+                batch_records, batch_next = decode_record_set(record_set)
+                records.extend(rec for rec in batch_records if rec.offset >= offset)
+                if batch_next is not None:
+                    next_offset = max(next_offset, batch_next)
+        return records, hwm, next_offset
 
     async def list_offsets(self, topic: str, partition: int, earliest: bool) -> int:
         ts = -2 if earliest else -1

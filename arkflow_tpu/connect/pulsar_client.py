@@ -1,0 +1,919 @@
+"""Native Apache Pulsar wire-protocol client (asyncio, no external libs).
+
+Implements the subset of Pulsar's protobuf-framed binary protocol the
+engine's input/output components need — the same capability surface the
+reference gets from the ``pulsar`` crate (ref: crates/arkflow-plugin/src/
+input/pulsar.rs:1-339, output/pulsar.rs:1-208, pulsar/common.rs:28-339):
+
+- CONNECT/CONNECTED handshake with optional token auth
+- topic LOOKUP with redirect-following (Pulsar's own service discovery)
+- consumer: SUBSCRIBE (exclusive/shared/failover/key_shared), FLOW permit
+  management, MESSAGE decode (incl. batched payloads), individual ACK
+- producer: PRODUCER registration, SEND with crc32c-checksummed payload
+  frames, SEND_RECEIPT/SEND_ERROR correlation by sequence id
+- keepalive: PING answered with PONG
+
+The ``PulsarApi`` message subset below is authored from the published
+protocol description (proto2 field numbers are wire-protocol constants,
+exactly like Kafka's api keys in kafka_client.py); it compiles through
+``protoc`` at import time via the same runtime-descriptor machinery as the
+protobuf codec.
+
+Wire framing:
+
+- simple command:  [totalSize u32][commandSize u32][BaseCommand]
+- payload command: [totalSize][commandSize][BaseCommand(SEND|MESSAGE)]
+                   [magic 0x0e01][crc32c u32][metadataSize u32]
+                   [MessageMetadata][payload]
+  with the checksum covering metadataSize..payload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from dataclasses import dataclass, field
+from typing import Optional
+from urllib.parse import urlparse
+
+from arkflow_tpu.errors import ConfigError, ConnectError, Disconnection, ReadError, WriteError
+from arkflow_tpu.native import crc32c
+
+logger = logging.getLogger("arkflow.pulsar")
+
+CLIENT_VERSION = "arkflow-tpu-0.2"
+PROTOCOL_VERSION = 12
+MAGIC = 0x0E01
+
+PULSAR_API_PROTO = r'''
+syntax = "proto2";
+package pulsar.proto;
+
+message KeyValue {
+  required string key = 1;
+  required string value = 2;
+}
+
+message MessageIdData {
+  required uint64 ledgerId = 1;
+  required uint64 entryId = 2;
+  optional int32 partition = 3 [default = -1];
+  optional int32 batch_index = 4 [default = -1];
+}
+
+enum CompressionType {
+  NONE = 0;
+  LZ4 = 1;
+  ZLIB = 2;
+  ZSTD = 3;
+  SNAPPY = 4;
+}
+
+message MessageMetadata {
+  required string producer_name = 1;
+  required uint64 sequence_id = 2;
+  required uint64 publish_time = 3;
+  repeated KeyValue properties = 4;
+  optional string replicated_from = 5;
+  optional string partition_key = 6;
+  repeated string replicate_to = 7;
+  optional CompressionType compression = 8 [default = NONE];
+  optional uint32 uncompressed_size = 9 [default = 0];
+  optional int32 num_messages_in_batch = 11;
+}
+
+message SingleMessageMetadata {
+  repeated KeyValue properties = 1;
+  optional string partition_key = 2;
+  required int32 payload_size = 3;
+}
+
+message CommandConnect {
+  required string client_version = 1;
+  optional bytes auth_data = 3;
+  optional int32 protocol_version = 4 [default = 0];
+  optional string auth_method_name = 5;
+  optional string proxy_to_broker_url = 6;
+}
+
+message CommandConnected {
+  required string server_version = 1;
+  optional int32 protocol_version = 2 [default = 0];
+  optional int32 max_message_size = 3;
+}
+
+message CommandSubscribe {
+  enum SubType {
+    Exclusive = 0;
+    Shared = 1;
+    Failover = 2;
+    Key_Shared = 3;
+  }
+  required string topic = 1;
+  required string subscription = 2;
+  required SubType subType = 3;
+  required uint64 consumer_id = 4;
+  required uint64 request_id = 5;
+  optional string consumer_name = 6;
+  optional int32 priority_level = 7;
+  optional bool durable = 8 [default = true];
+  optional MessageIdData start_message_id = 9;
+  repeated KeyValue metadata = 10;
+  optional bool read_compacted = 11;
+  enum InitialPosition {
+    Latest = 0;
+    Earliest = 1;
+  }
+  optional InitialPosition initialPosition = 13 [default = Latest];
+}
+
+message CommandLookupTopic {
+  required string topic = 1;
+  required uint64 request_id = 2;
+  optional bool authoritative = 3 [default = false];
+}
+
+message CommandLookupTopicResponse {
+  enum LookupType {
+    Redirect = 0;
+    Connect = 1;
+    Failed = 2;
+  }
+  optional string brokerServiceUrl = 1;
+  optional string brokerServiceUrlTls = 2;
+  optional LookupType response = 3;
+  required uint64 request_id = 4;
+  optional bool authoritative = 5 [default = false];
+  optional ServerError error = 6;
+  optional string message = 7;
+  optional bool proxy_through_service_url = 8 [default = false];
+}
+
+message CommandProducer {
+  required string topic = 1;
+  required uint64 producer_id = 2;
+  required uint64 request_id = 3;
+  optional string producer_name = 4;
+  optional bool encrypted = 5 [default = false];
+  repeated KeyValue metadata = 6;
+}
+
+message CommandSend {
+  required uint64 producer_id = 1;
+  required uint64 sequence_id = 2;
+  optional int32 num_messages = 3 [default = 1];
+}
+
+message CommandSendReceipt {
+  required uint64 producer_id = 1;
+  required uint64 sequence_id = 2;
+  optional MessageIdData message_id = 3;
+}
+
+enum ServerError {
+  UnknownError = 0;
+  MetadataError = 1;
+  PersistenceError = 2;
+  AuthenticationError = 3;
+  AuthorizationError = 4;
+  ConsumerBusy = 5;
+  ServiceNotReady = 6;
+  ProducerBlockedQuotaExceededError = 7;
+  ProducerBlockedQuotaExceededException = 8;
+  ChecksumError = 9;
+  UnsupportedVersionError = 10;
+  TopicNotFound = 11;
+  SubscriptionNotFound = 12;
+  ConsumerNotFound = 13;
+  TooManyRequests = 14;
+  TopicTerminatedError = 15;
+  ProducerBusy = 16;
+  InvalidTopicName = 17;
+}
+
+message CommandSendError {
+  required uint64 producer_id = 1;
+  required uint64 sequence_id = 2;
+  required ServerError error = 3;
+  required string message = 4;
+}
+
+message CommandMessage {
+  required uint64 consumer_id = 1;
+  required MessageIdData message_id = 2;
+  optional uint32 redelivery_count = 3 [default = 0];
+}
+
+message CommandAck {
+  enum AckType {
+    Individual = 0;
+    Cumulative = 1;
+  }
+  required uint64 consumer_id = 1;
+  required AckType ack_type = 2;
+  repeated MessageIdData message_id = 3;
+}
+
+message CommandFlow {
+  required uint64 consumer_id = 1;
+  required uint32 messagePermits = 2;
+}
+
+message CommandUnsubscribe {
+  required uint64 consumer_id = 1;
+  required uint64 request_id = 2;
+}
+
+message CommandSuccess {
+  required uint64 request_id = 1;
+}
+
+message CommandError {
+  required uint64 request_id = 1;
+  required ServerError error = 2;
+  required string message = 3;
+}
+
+message CommandCloseProducer {
+  required uint64 producer_id = 1;
+  required uint64 request_id = 2;
+}
+
+message CommandCloseConsumer {
+  required uint64 consumer_id = 1;
+  required uint64 request_id = 2;
+}
+
+message CommandPing {
+}
+
+message CommandPong {
+}
+
+message BaseCommand {
+  enum Type {
+    CONNECT = 2;
+    CONNECTED = 3;
+    SUBSCRIBE = 4;
+    PRODUCER = 5;
+    SEND = 6;
+    SEND_RECEIPT = 7;
+    SEND_ERROR = 8;
+    MESSAGE = 9;
+    ACK = 10;
+    FLOW = 11;
+    UNSUBSCRIBE = 12;
+    SUCCESS = 13;
+    ERROR = 14;
+    CLOSE_PRODUCER = 15;
+    CLOSE_CONSUMER = 16;
+    PRODUCER_SUCCESS = 17;
+    PING = 18;
+    PONG = 19;
+    LOOKUP = 23;
+    LOOKUP_RESPONSE = 24;
+  }
+  required Type type = 1;
+  optional CommandConnect connect = 2;
+  optional CommandConnected connected = 3;
+  optional CommandSubscribe subscribe = 4;
+  optional CommandProducer producer = 5;
+  optional CommandSend send = 6;
+  optional CommandSendReceipt send_receipt = 7;
+  optional CommandSendError send_error = 8;
+  optional CommandMessage message = 9;
+  optional CommandAck ack = 10;
+  optional CommandFlow flow = 11;
+  optional CommandUnsubscribe unsubscribe = 12;
+  optional CommandSuccess success = 13;
+  optional CommandError error = 14;
+  optional CommandCloseProducer close_producer = 15;
+  optional CommandCloseConsumer close_consumer = 16;
+  optional CommandProducerSuccess producer_success = 17;
+  optional CommandPing ping = 18;
+  optional CommandPong pong = 19;
+  optional CommandLookupTopic lookupTopic = 23;
+  optional CommandLookupTopicResponse lookupTopicResponse = 24;
+}
+
+message CommandProducerSuccess {
+  required uint64 request_id = 1;
+  required string producer_name = 2;
+  optional int64 last_sequence_id = 3 [default = -1];
+}
+'''
+
+_PROTO_CACHE: dict = {}
+
+
+def proto() -> dict:
+    """Compile the PulsarApi subset once; return {name: message class}."""
+    if _PROTO_CACHE:
+        return _PROTO_CACHE
+    from google.protobuf import message_factory
+
+    from arkflow_tpu.plugins.codec.protobuf_codec import compile_proto
+
+    pool = compile_proto(PULSAR_API_PROTO, None)
+    for name in (
+        "BaseCommand", "MessageMetadata", "SingleMessageMetadata", "MessageIdData",
+    ):
+        desc = pool.FindMessageTypeByName(f"pulsar.proto.{name}")
+        _PROTO_CACHE[name] = message_factory.GetMessageClass(desc)
+    _PROTO_CACHE["pool"] = pool
+    return _PROTO_CACHE
+
+
+def encode_simple(cmd) -> bytes:
+    body = cmd.SerializeToString()
+    return struct.pack(">II", 4 + len(body), len(body)) + body
+
+
+def encode_payload_cmd(cmd, metadata, payload: bytes) -> bytes:
+    body = cmd.SerializeToString()
+    meta = metadata.SerializeToString()
+    checked = struct.pack(">I", len(meta)) + meta + payload
+    crc = crc32c(checked)
+    frame = (
+        struct.pack(">I", len(body)) + body
+        + struct.pack(">HI", MAGIC, crc) + checked
+    )
+    return struct.pack(">I", len(frame)) + frame
+
+
+@dataclass
+class PulsarMessage:
+    message_id: "object"            # MessageIdData proto
+    payload: bytes
+    properties: dict
+    partition_key: Optional[str]
+    redelivery_count: int = 0
+    batch_index: int = -1
+
+
+def decode_payload_section(data: bytes) -> tuple["object", list[PulsarMessage]]:
+    """[magic][crc][metaSize][metadata][payload] -> (metadata, single payloads).
+
+    Batched payloads (num_messages_in_batch set) split on
+    SingleMessageMetadata framing; message ids are filled by the caller.
+    """
+    P = proto()
+    magic, crc = struct.unpack_from(">HI", data, 0)
+    if magic != MAGIC:
+        raise ReadError(f"pulsar: bad payload magic 0x{magic:04x}")
+    checked = data[6:]
+    actual = crc32c(checked)
+    if actual != crc:
+        raise ReadError(f"pulsar: payload checksum mismatch ({actual:#x} != {crc:#x})")
+    (meta_size,) = struct.unpack_from(">I", checked, 0)
+    metadata = P["MessageMetadata"]()
+    metadata.ParseFromString(checked[4:4 + meta_size])
+    payload = checked[4 + meta_size:]
+    if metadata.compression == 2:  # ZLIB (stdlib); LZ4/ZSTD/SNAPPY need libs
+        import zlib
+
+        payload = zlib.decompress(payload)
+    elif metadata.compression != 0:
+        raise ReadError(
+            f"pulsar: compression type {metadata.compression} not supported (none/zlib)"
+        )
+    out: list[PulsarMessage] = []
+    if metadata.HasField("num_messages_in_batch"):
+        pos = 0
+        for i in range(metadata.num_messages_in_batch):
+            (smm_size,) = struct.unpack_from(">I", payload, pos)
+            pos += 4
+            smm = P["SingleMessageMetadata"]()
+            smm.ParseFromString(payload[pos:pos + smm_size])
+            pos += smm_size
+            body = payload[pos:pos + smm.payload_size]
+            pos += smm.payload_size
+            out.append(PulsarMessage(
+                message_id=None, payload=bytes(body),
+                properties={kv.key: kv.value for kv in smm.properties},
+                partition_key=smm.partition_key if smm.HasField("partition_key") else None,
+                batch_index=i,
+            ))
+    else:
+        out.append(PulsarMessage(
+            message_id=None, payload=bytes(payload),
+            properties={kv.key: kv.value for kv in metadata.properties},
+            partition_key=metadata.partition_key if metadata.HasField("partition_key") else None,
+        ))
+    return metadata, out
+
+
+def parse_service_url(service_url: str) -> tuple[str, int, bool]:
+    u = urlparse(service_url)
+    if u.scheme not in ("pulsar", "pulsar+ssl"):
+        raise ConfigError(
+            f"pulsar service_url must be pulsar:// or pulsar+ssl:// (got {service_url!r})"
+        )
+    if not u.hostname:
+        raise ConfigError(f"pulsar service_url missing host: {service_url!r}")
+    return u.hostname, u.port or 6650, u.scheme == "pulsar+ssl"
+
+
+def validate_topic(topic: str) -> str:
+    """Mirror of the reference's topic validator (ref pulsar/common.rs:204-235):
+    accepts short names and full persistent://tenant/namespace/topic forms."""
+    if not topic or not topic.strip():
+        raise ConfigError("pulsar topic must not be empty")
+    if "://" in topic:
+        scheme, rest = topic.split("://", 1)
+        if scheme not in ("persistent", "non-persistent"):
+            raise ConfigError(f"pulsar topic scheme must be persistent/non-persistent: {topic!r}")
+        if len([p for p in rest.split("/") if p]) != 3:
+            raise ConfigError(
+                f"pulsar topic must be scheme://tenant/namespace/topic: {topic!r}"
+            )
+        return topic
+    if "/" in topic:
+        raise ConfigError(
+            f"pulsar topic with slashes must use the full persistent:// form: {topic!r}"
+        )
+    return f"persistent://public/default/{topic}"
+
+
+SUB_TYPES = {"exclusive": 0, "shared": 1, "failover": 2, "key_shared": 3}
+
+
+class _Conn:
+    """One broker TCP connection: handshake, frame reader, request correlation."""
+
+    def __init__(self, host: str, port: int, *, tls: bool = False,
+                 auth_method: Optional[str] = None, auth_data: Optional[bytes] = None,
+                 timeout: float = 10.0, proxy_to_broker_url: Optional[str] = None):
+        self.host, self.port, self.tls = host, port, tls
+        self.auth_method, self.auth_data = auth_method, auth_data
+        self.timeout = timeout
+        self.proxy_to_broker_url = proxy_to_broker_url
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.max_message_size = 5 * 1024 * 1024
+        self._pending: dict[int, asyncio.Future] = {}       # request_id -> fut
+        self._send_waiters: dict[tuple[int, int], asyncio.Future] = {}
+        self._consumers: dict[int, "PulsarConsumer"] = {}
+        self._producers: dict[int, "PulsarProducer"] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._req_id = 0
+        self._lock = asyncio.Lock()
+
+    def next_request_id(self) -> int:
+        self._req_id += 1
+        return self._req_id
+
+    async def connect(self) -> None:
+        import ssl as _ssl
+
+        ctx = _ssl.create_default_context() if self.tls else None
+        try:
+            self.reader, self.writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port, ssl=ctx), self.timeout
+            )
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ConnectError(f"pulsar: cannot reach {self.host}:{self.port}: {e}") from e
+        P = proto()
+        cmd = P["BaseCommand"]()
+        cmd.type = 2  # CONNECT
+        cmd.connect.client_version = CLIENT_VERSION
+        cmd.connect.protocol_version = PROTOCOL_VERSION
+        if self.auth_method:
+            cmd.connect.auth_method_name = self.auth_method
+            cmd.connect.auth_data = self.auth_data or b""
+        if self.proxy_to_broker_url:
+            # physical target is a pulsar-proxy; tell it which broker to
+            # tunnel this connection to
+            cmd.connect.proxy_to_broker_url = self.proxy_to_broker_url
+        self.writer.write(encode_simple(cmd))
+        await self.writer.drain()
+        resp, _ = await asyncio.wait_for(self._read_frame(), self.timeout)
+        if resp.type == 14:  # ERROR
+            raise ConnectError(f"pulsar connect rejected: {resp.error.message}")
+        if resp.type != 3:  # CONNECTED
+            raise ConnectError(f"pulsar: expected CONNECTED, got type {resp.type}")
+        if resp.connected.HasField("max_message_size"):
+            self.max_message_size = resp.connected.max_message_size
+        self._reader_task = asyncio.create_task(self._read_loop())
+
+    async def _read_frame(self):
+        """Read one frame -> (BaseCommand, payload section or None), where the
+        payload section is the raw magic..payload bytes of SEND/MESSAGE."""
+        hdr = await self.reader.readexactly(4)
+        (total,) = struct.unpack(">I", hdr)
+        frame = await self.reader.readexactly(total)
+        (cmd_size,) = struct.unpack_from(">I", frame, 0)
+        cmd = proto()["BaseCommand"]()
+        cmd.ParseFromString(frame[4:4 + cmd_size])
+        payload_part = frame[4 + cmd_size:]
+        return cmd, (payload_part if payload_part else None)
+
+    async def _read_loop(self) -> None:
+        try:
+            while not self._closed:
+                cmd, payload = await self._read_frame()
+                await self._dispatch(cmd, payload)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except asyncio.CancelledError:
+            return
+        except Exception as e:  # malformed frame — fail everything waiting
+            logger.warning("pulsar reader error: %s", e)
+        self._fail_all(Disconnection("pulsar connection lost"))
+
+    def _fail_all(self, err: Exception) -> None:
+        self._closed = True
+        for fut in list(self._pending.values()) + list(self._send_waiters.values()):
+            if not fut.done():
+                fut.set_exception(err)
+        self._pending.clear()
+        self._send_waiters.clear()
+        for cons in self._consumers.values():
+            cons._on_disconnect()
+
+    async def _dispatch(self, cmd, payload: Optional[bytes]) -> None:
+        t = cmd.type
+        if t == 18:  # PING -> PONG
+            pong = proto()["BaseCommand"]()
+            pong.type = 19
+            self.writer.write(encode_simple(pong))
+            await self.writer.drain()
+            return
+        if t == 9:  # MESSAGE -> route to consumer queue
+            cons = self._consumers.get(cmd.message.consumer_id)
+            if cons is not None:
+                cons._on_message(cmd.message, payload)
+            return
+        if t == 7:  # SEND_RECEIPT
+            key = (cmd.send_receipt.producer_id, cmd.send_receipt.sequence_id)
+            fut = self._send_waiters.pop(key, None)
+            if fut and not fut.done():
+                fut.set_result(cmd.send_receipt)
+            return
+        if t == 8:  # SEND_ERROR
+            key = (cmd.send_error.producer_id, cmd.send_error.sequence_id)
+            fut = self._send_waiters.pop(key, None)
+            if fut and not fut.done():
+                fut.set_exception(WriteError(
+                    f"pulsar send error {cmd.send_error.error}: {cmd.send_error.message}"))
+            return
+        if t == 16:  # broker-initiated CLOSE_CONSUMER (topic unload/failover)
+            cons = self._consumers.pop(cmd.close_consumer.consumer_id, None)
+            if cons is not None:
+                # surface as Disconnection so the stream's reconnect loop
+                # re-subscribes (same semantics as a dropped connection)
+                cons._on_disconnect()
+                return
+        if t == 15:  # broker-initiated CLOSE_PRODUCER
+            prod = self._producers.pop(cmd.close_producer.producer_id, None)
+            if prod is not None:
+                prod.server_closed = True
+                for key, fut in list(self._send_waiters.items()):
+                    if key[0] == prod.producer_id and not fut.done():
+                        fut.set_exception(Disconnection("pulsar producer closed by broker"))
+                        self._send_waiters.pop(key, None)
+                return
+        req_id = _request_id_of(cmd)
+        if req_id is not None:
+            fut = self._pending.pop(req_id, None)
+            if fut and not fut.done():
+                if t == 14:  # ERROR
+                    fut.set_exception(ReadError(
+                        f"pulsar error {cmd.error.error}: {cmd.error.message}"))
+                else:
+                    fut.set_result(cmd)
+            return
+        logger.debug("pulsar: unhandled command type %d", t)
+
+    async def request(self, cmd) -> "object":
+        """Send a command carrying a request_id and await its response."""
+        req_id = _outgoing_request_id(cmd)
+        assert req_id is not None
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._lock:
+            self.writer.write(encode_simple(cmd))
+            await self.writer.drain()
+        return await asyncio.wait_for(fut, self.timeout)
+
+    async def send_frame(self, raw: bytes) -> None:
+        async with self._lock:
+            self.writer.write(raw)
+            await self.writer.drain()
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._reader_task:
+            self._reader_task.cancel()
+            try:
+                await self._reader_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self.writer:
+            try:
+                self.writer.close()
+                await self.writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+
+def _request_id_of(cmd) -> Optional[int]:
+    """request_id of an incoming response command."""
+    for f in ("success", "error", "producer_success", "lookupTopicResponse"):
+        if cmd.HasField(f):
+            return getattr(cmd, f).request_id
+    return None
+
+
+def _outgoing_request_id(cmd) -> Optional[int]:
+    """request_id of an outgoing request command."""
+    for f in ("lookupTopic", "subscribe", "producer", "unsubscribe",
+              "close_producer", "close_consumer"):
+        if cmd.HasField(f):
+            return getattr(cmd, f).request_id
+    return None
+
+
+class PulsarClient:
+    """Client entry: lookup + consumer/producer factories over broker conns."""
+
+    def __init__(self, service_url: str, *, auth_method: Optional[str] = None,
+                 auth_data: Optional[bytes] = None, timeout: float = 10.0,
+                 max_lookup_redirects: int = 3):
+        self.service_url = service_url
+        self.host, self.port, self.tls = parse_service_url(service_url)
+        self.auth_method, self.auth_data = auth_method, auth_data
+        self.timeout = timeout
+        self.max_lookup_redirects = max_lookup_redirects
+        self._conns: dict[tuple[str, int], _Conn] = {}
+        self._ids = 0
+
+    def _next_id(self) -> int:
+        self._ids += 1
+        return self._ids
+
+    async def _get_conn(self, host: str, port: int,
+                        proxy_to_broker_url: Optional[str] = None) -> _Conn:
+        key = (host, port, proxy_to_broker_url)
+        conn = self._conns.get(key)
+        if conn is not None and not conn._closed:
+            return conn
+        conn = _Conn(host, port, tls=self.tls, auth_method=self.auth_method,
+                     auth_data=self.auth_data, timeout=self.timeout,
+                     proxy_to_broker_url=proxy_to_broker_url)
+        await conn.connect()
+        self._conns[key] = conn
+        return conn
+
+    async def lookup(self, topic: str) -> _Conn:
+        """Resolve the broker owning `topic`, following redirects."""
+        P = proto()
+        host, port = self.host, self.port
+        for _ in range(self.max_lookup_redirects + 1):
+            conn = await self._get_conn(host, port)
+            cmd = P["BaseCommand"]()
+            cmd.type = 23  # LOOKUP
+            cmd.lookupTopic.topic = topic
+            cmd.lookupTopic.request_id = conn.next_request_id()
+            resp = await conn.request(cmd)
+            lr = resp.lookupTopicResponse
+            if lr.response == 2:  # Failed
+                raise ConnectError(f"pulsar lookup failed for {topic!r}: {lr.message}")
+            if lr.proxy_through_service_url and lr.response == 1:
+                # broker sits behind a pulsar-proxy: keep the TCP target on
+                # the original service address and tunnel via the proxy
+                broker_url = lr.brokerServiceUrl or None
+                return await self._get_conn(self.host, self.port,
+                                            proxy_to_broker_url=broker_url)
+            if lr.HasField("brokerServiceUrl") and lr.brokerServiceUrl:
+                host, port, _tls = parse_service_url(lr.brokerServiceUrl)
+            if lr.response == 1:  # Connect
+                return await self._get_conn(host, port)
+        raise ConnectError(f"pulsar lookup for {topic!r} exceeded redirect limit")
+
+    async def subscribe(self, topic: str, subscription: str, *,
+                        sub_type: str = "exclusive",
+                        initial_position: str = "latest",
+                        receive_queue: int = 1000) -> "PulsarConsumer":
+        topic = validate_topic(topic)
+        if sub_type not in SUB_TYPES:
+            raise ConfigError(
+                f"pulsar subscription_type {sub_type!r} not in {sorted(SUB_TYPES)}")
+        if not subscription:
+            raise ConfigError("pulsar subscription_name must not be empty")
+        conn = await self.lookup(topic)
+        P = proto()
+        consumer_id = self._next_id()
+        cmd = P["BaseCommand"]()
+        cmd.type = 4  # SUBSCRIBE
+        sub = cmd.subscribe
+        sub.topic = topic
+        sub.subscription = subscription
+        sub.subType = SUB_TYPES[sub_type]
+        sub.consumer_id = consumer_id
+        sub.request_id = conn.next_request_id()
+        sub.consumer_name = f"arkflow-{consumer_id}"
+        sub.initialPosition = 1 if initial_position == "earliest" else 0
+        cons = PulsarConsumer(conn, consumer_id, receive_queue)
+        conn._consumers[consumer_id] = cons
+        await conn.request(cmd)
+        await cons._grant(receive_queue)
+        return cons
+
+    async def create_producer(self, topic: str) -> "PulsarProducer":
+        topic = validate_topic(topic)
+        conn = await self.lookup(topic)
+        P = proto()
+        producer_id = self._next_id()
+        cmd = P["BaseCommand"]()
+        cmd.type = 5  # PRODUCER
+        cmd.producer.topic = topic
+        cmd.producer.producer_id = producer_id
+        cmd.producer.request_id = conn.next_request_id()
+        resp = await conn.request(cmd)
+        name = resp.producer_success.producer_name
+        prod = PulsarProducer(conn, producer_id, name)
+        conn._producers[producer_id] = prod
+        return prod
+
+    async def close(self) -> None:
+        for conn in self._conns.values():
+            await conn.close()
+        self._conns.clear()
+
+
+class PulsarConsumer:
+    def __init__(self, conn: _Conn, consumer_id: int, receive_queue: int):
+        self.conn = conn
+        self.consumer_id = consumer_id
+        self.receive_queue = receive_queue
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._permits_used = 0
+        #: (ledgerId, entryId) -> batch indexes not yet acked. The broker acks
+        #: whole entries, so a batched entry's ACK is held until every sibling
+        #: message is acked (same semantics as the Java client's batch acker).
+        self._batch_pending: dict[tuple[int, int], set[int]] = {}
+
+    def _on_message(self, msg_cmd, payload_section: Optional[bytes]) -> None:
+        try:
+            if payload_section is None:
+                raise ReadError("pulsar MESSAGE without payload section")
+            _meta, messages = decode_payload_section(payload_section)
+            if len(messages) > 1 or (messages and messages[0].batch_index >= 0):
+                key = (msg_cmd.message_id.ledgerId, msg_cmd.message_id.entryId)
+                self._batch_pending[key] = {m.batch_index for m in messages}
+            for m in messages:
+                mid = proto()["MessageIdData"]()
+                mid.CopyFrom(msg_cmd.message_id)
+                if m.batch_index >= 0:
+                    mid.batch_index = m.batch_index
+                m.message_id = mid
+                m.redelivery_count = msg_cmd.redelivery_count
+                self._queue.put_nowait(m)
+        except Exception as e:
+            self._queue.put_nowait(e)
+
+    def _on_disconnect(self) -> None:
+        self._queue.put_nowait(Disconnection("pulsar connection lost"))
+
+    async def _grant(self, permits: int) -> None:
+        cmd = proto()["BaseCommand"]()
+        cmd.type = 11  # FLOW
+        cmd.flow.consumer_id = self.consumer_id
+        cmd.flow.messagePermits = permits
+        await self.conn.send_frame(encode_simple(cmd))
+
+    async def receive(self) -> PulsarMessage:
+        """Next message; re-grants flow permits at the half-way mark."""
+        item = await self._queue.get()
+        if isinstance(item, Exception):
+            raise item
+        self._permits_used += 1
+        if self._permits_used >= max(1, self.receive_queue // 2):
+            used, self._permits_used = self._permits_used, 0
+            await self._grant(used)
+        return item
+
+    async def ack(self, message_id) -> None:
+        """Individual ack. For one message of a batched entry, the broker-side
+        ACK is deferred until all sibling batch indexes have been acked (the
+        broker acks whole entries; acking early would drop unprocessed
+        siblings on redelivery)."""
+        entry = proto()["MessageIdData"]()
+        entry.CopyFrom(message_id)
+        if message_id.batch_index >= 0:
+            key = (message_id.ledgerId, message_id.entryId)
+            pending = self._batch_pending.get(key)
+            if pending is not None:
+                pending.discard(message_id.batch_index)
+                if pending:
+                    return  # siblings still unacked -> hold the entry ack
+                del self._batch_pending[key]
+            entry.ClearField("batch_index")
+        cmd = proto()["BaseCommand"]()
+        cmd.type = 10  # ACK
+        cmd.ack.consumer_id = self.consumer_id
+        cmd.ack.ack_type = 0  # Individual
+        cmd.ack.message_id.add().CopyFrom(entry)
+        await self.conn.send_frame(encode_simple(cmd))
+
+    async def close(self) -> None:
+        if self.conn._closed:
+            return
+        cmd = proto()["BaseCommand"]()
+        cmd.type = 16  # CLOSE_CONSUMER
+        cmd.close_consumer.consumer_id = self.consumer_id
+        cmd.close_consumer.request_id = self.conn.next_request_id()
+        try:
+            await self.conn.request(cmd)
+        except Exception:
+            pass
+        self.conn._consumers.pop(self.consumer_id, None)
+
+
+class PulsarProducer:
+    def __init__(self, conn: _Conn, producer_id: int, producer_name: str):
+        self.conn = conn
+        self.producer_id = producer_id
+        self.producer_name = producer_name
+        self.server_closed = False  # set when the broker sends CLOSE_PRODUCER
+        self._seq = 0
+
+    async def send(self, payload: bytes, *, key: Optional[str] = None,
+                   properties: Optional[dict] = None,
+                   event_time_ms: Optional[int] = None) -> "object":
+        """Publish one message and await the broker receipt (MessageIdData)."""
+        import time
+
+        if self.conn._closed:
+            raise Disconnection("pulsar connection lost")
+        if self.server_closed:
+            raise Disconnection("pulsar producer closed by broker")
+        P = proto()
+        self._seq += 1
+        seq = self._seq
+        cmd = P["BaseCommand"]()
+        cmd.type = 6  # SEND
+        cmd.send.producer_id = self.producer_id
+        cmd.send.sequence_id = seq
+        meta = P["MessageMetadata"]()
+        meta.producer_name = self.producer_name
+        meta.sequence_id = seq
+        meta.publish_time = event_time_ms or int(time.time() * 1000)
+        if key is not None:
+            meta.partition_key = key
+        for k, v in (properties or {}).items():
+            kv = meta.properties.add()
+            kv.key, kv.value = str(k), str(v)
+        frame = encode_payload_cmd(cmd, meta, payload)
+        if len(frame) > self.conn.max_message_size:
+            raise WriteError(
+                f"pulsar message of {len(frame)}B exceeds broker max "
+                f"{self.conn.max_message_size}B")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.conn._send_waiters[(self.producer_id, seq)] = fut
+        await self.conn.send_frame(frame)
+        receipt = await asyncio.wait_for(fut, self.conn.timeout)
+        return receipt.message_id
+
+    async def close(self) -> None:
+        if self.conn._closed:
+            return
+        cmd = proto()["BaseCommand"]()
+        cmd.type = 15  # CLOSE_PRODUCER
+        cmd.close_producer.producer_id = self.producer_id
+        cmd.close_producer.request_id = self.conn.next_request_id()
+        try:
+            await self.conn.request(cmd)
+        except Exception:
+            pass
+
+
+def auth_from_config(auth: Optional[dict]) -> tuple[Optional[str], Optional[bytes]]:
+    """Mirror of the reference's PulsarAuth enum (token | oauth2).
+
+    OAuth2 requires a token-endpoint round trip at connect time; in this
+    zero-egress image it is validated but rejected at build with a clear
+    message (same fail-fast stance the reference's validator takes for
+    malformed auth, ref pulsar/common.rs:286-325).
+    """
+    if not auth:
+        return None, None
+    kind = str(auth.get("type", "")).lower()
+    if kind == "token":
+        token = auth.get("token")
+        if not token:
+            raise ConfigError("pulsar token auth requires 'token'")
+        from arkflow_tpu.utils.auth import resolve_secret
+
+        return "token", resolve_secret(str(token)).encode()
+    if kind == "oauth2":
+        for req in ("issuer_url", "credentials_url", "audience"):
+            if not auth.get(req):
+                raise ConfigError(f"pulsar oauth2 auth requires {req!r}")
+        raise ConfigError(
+            "pulsar oauth2 auth needs an external token endpoint, which this "
+            "environment cannot reach; use token auth")
+    raise ConfigError(f"pulsar auth type {kind!r} not supported (token/oauth2)")

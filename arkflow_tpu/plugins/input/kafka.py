@@ -236,7 +236,7 @@ class KafkaInput(Input):
             if offset is None:
                 continue  # assignment changed under us mid-loop
             try:
-                records, _hwm = await self._client.fetch(
+                records, _hwm, next_offset = await self._client.fetch(
                     self.topic, p, offset, max_wait_ms=250
                 )
             except KafkaProtocolError as e:
@@ -247,6 +247,9 @@ class KafkaInput(Input):
             if self._closed:
                 raise EndOfInput()
             if not records:
+                # advance past record-less batches (transaction control
+                # markers, compacted tails) or we refetch them forever
+                self._offsets[p] = max(offset, next_offset)
                 if self._rr_idx % len(self._rr) == 0:
                     await asyncio.sleep(0.05)
                 continue

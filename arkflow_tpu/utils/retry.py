@@ -1,0 +1,68 @@
+"""Generic retry-with-exponential-backoff for connector operations.
+
+Mirrors the reference's shared RetryUtils/RetryConfig (ref:
+crates/arkflow-plugin/src/pulsar/common.rs:99-175): bounded attempts,
+exponential delay with a cap, and config validation shared by any
+connector that opts in.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+
+from arkflow_tpu.errors import ConfigError
+
+logger = logging.getLogger("arkflow.retry")
+
+
+@dataclass(frozen=True)
+class RetryConfig:
+    max_attempts: int = 3
+    initial_delay_ms: int = 100
+    max_delay_ms: int = 5000
+    backoff_multiplier: float = 2.0
+
+    @classmethod
+    def from_config(cls, cfg: dict | None) -> "RetryConfig":
+        if not cfg:
+            return cls()
+        rc = cls(
+            max_attempts=int(cfg.get("max_attempts", 3)),
+            initial_delay_ms=int(cfg.get("initial_delay_ms", 100)),
+            max_delay_ms=int(cfg.get("max_delay_ms", 5000)),
+            backoff_multiplier=float(cfg.get("backoff_multiplier", 2.0)),
+        )
+        rc.validate()
+        return rc
+
+    def validate(self) -> None:
+        if self.max_attempts < 1:
+            raise ConfigError("retry max_attempts must be >= 1")
+        if self.initial_delay_ms < 0 or self.max_delay_ms < self.initial_delay_ms:
+            raise ConfigError("retry delays must satisfy 0 <= initial <= max")
+        if self.backoff_multiplier < 1.0:
+            raise ConfigError("retry backoff_multiplier must be >= 1.0")
+
+    def delay_s(self, attempt: int) -> float:
+        """Delay before retry #attempt (0-based)."""
+        d = self.initial_delay_ms * (self.backoff_multiplier ** attempt)
+        return min(d, self.max_delay_ms) / 1000.0
+
+
+async def retry_with_backoff(op, config: RetryConfig, *, what: str = "operation",
+                             retry_on: tuple = (Exception,)):
+    """Run ``await op()`` with up to config.max_attempts tries."""
+    last: Exception | None = None
+    for attempt in range(config.max_attempts):
+        try:
+            return await op()
+        except retry_on as e:
+            last = e
+            if attempt < config.max_attempts - 1:
+                delay = config.delay_s(attempt)
+                logger.warning("%s failed (attempt %d/%d): %s; retrying in %.2fs",
+                               what, attempt + 1, config.max_attempts, e, delay)
+                await asyncio.sleep(delay)
+    raise last

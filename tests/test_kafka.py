@@ -337,9 +337,10 @@ def test_kafka_client_produce_fetch_commit():
             assert client.partitions("events") == [0, 1]
             base = await client.produce("events", 0, [(b"k", b"v1"), (None, b"v2")])
             assert base == 0
-            records, hwm = await client.fetch("events", 0, 0)
+            records, hwm, next_offset = await client.fetch("events", 0, 0)
             assert [(r.key, r.value) for r in records] == [(b"k", b"v1"), (None, b"v2")]
             assert hwm == 2
+            assert next_offset == 2
             # offsets
             assert await client.list_offsets("events", 0, earliest=True) == 0
             assert await client.list_offsets("events", 0, earliest=False) == 2
@@ -636,3 +637,19 @@ def test_kafka_output_crc32c_partitioner_optin():
     out = build_component("output", {"type": "kafka", "brokers": "b", "topic": "t",
                                      "partitioner": "crc32c"}, Resource())
     assert out.partitioner == "crc32c"
+
+
+def test_control_batch_advances_next_offset():
+    """A record set that is ONLY a control batch yields no records but must
+    advance the fetch position past it (else the consumer refetches the
+    transaction marker forever)."""
+    from arkflow_tpu.connect.kafka_client import decode_record_set
+    from arkflow_tpu.native import crc32c
+
+    control = bytearray(encode_record_batch([(None, b"txn-marker")], base_ts_ms=1))
+    attrs = struct.unpack_from(">h", control, 21)[0]
+    struct.pack_into(">h", control, 21, attrs | 0x20)
+    struct.pack_into(">I", control, 17, crc32c(bytes(control[21:])))
+    records, next_offset = decode_record_set(bytes(control))
+    assert records == []
+    assert next_offset == 1  # base_offset 0 + lastOffsetDelta 0 + 1
