@@ -475,6 +475,18 @@ class _Conn:
             )
         except (OSError, asyncio.TimeoutError) as e:
             raise ConnectError(f"pulsar: cannot reach {self.host}:{self.port}: {e}") from e
+        try:
+            await self._handshake()
+        except BaseException:
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+            self.writer = None
+            self.reader = None
+            raise
+
+    async def _handshake(self) -> None:
         P = proto()
         cmd = P["BaseCommand"]()
         cmd.type = 2  # CONNECT
@@ -611,6 +623,9 @@ class _Conn:
                 await self._reader_task
             except (asyncio.CancelledError, Exception):
                 pass
+        # wake anything still blocked on this connection (receive() has no
+        # timeout; the cancelled read loop returns before its own _fail_all)
+        self._fail_all(Disconnection("pulsar connection closed"))
         if self.writer:
             try:
                 self.writer.close()
@@ -655,12 +670,15 @@ class PulsarClient:
         return self._ids
 
     async def _get_conn(self, host: str, port: int,
-                        proxy_to_broker_url: Optional[str] = None) -> _Conn:
+                        proxy_to_broker_url: Optional[str] = None,
+                        tls: Optional[bool] = None) -> _Conn:
         key = (host, port, proxy_to_broker_url)
         conn = self._conns.get(key)
         if conn is not None and not conn._closed:
             return conn
-        conn = _Conn(host, port, tls=self.tls, auth_method=self.auth_method,
+        conn = _Conn(host, port,
+                     tls=self.tls if tls is None else tls,
+                     auth_method=self.auth_method,
                      auth_data=self.auth_data, timeout=self.timeout,
                      proxy_to_broker_url=proxy_to_broker_url)
         await conn.connect()
@@ -670,9 +688,9 @@ class PulsarClient:
     async def lookup(self, topic: str) -> _Conn:
         """Resolve the broker owning `topic`, following redirects."""
         P = proto()
-        host, port = self.host, self.port
+        host, port, tls = self.host, self.port, self.tls
         for _ in range(self.max_lookup_redirects + 1):
-            conn = await self._get_conn(host, port)
+            conn = await self._get_conn(host, port, tls=tls)
             cmd = P["BaseCommand"]()
             cmd.type = 23  # LOOKUP
             cmd.lookupTopic.topic = topic
@@ -687,10 +705,15 @@ class PulsarClient:
                 broker_url = lr.brokerServiceUrl or None
                 return await self._get_conn(self.host, self.port,
                                             proxy_to_broker_url=broker_url)
-            if lr.HasField("brokerServiceUrl") and lr.brokerServiceUrl:
-                host, port, _tls = parse_service_url(lr.brokerServiceUrl)
+            # a TLS client follows the TLS address; falling back to the
+            # plaintext URL's host:port with TLS would hit the wrong listener
+            url = (lr.brokerServiceUrlTls
+                   if self.tls and lr.HasField("brokerServiceUrlTls")
+                   and lr.brokerServiceUrlTls else lr.brokerServiceUrl)
+            if url:
+                host, port, tls = parse_service_url(url)
             if lr.response == 1:  # Connect
-                return await self._get_conn(host, port)
+                return await self._get_conn(host, port, tls=tls)
         raise ConnectError(f"pulsar lookup for {topic!r} exceeded redirect limit")
 
     async def subscribe(self, topic: str, subscription: str, *,
@@ -717,8 +740,10 @@ class PulsarClient:
         sub.consumer_name = f"arkflow-{consumer_id}"
         sub.initialPosition = 1 if initial_position == "earliest" else 0
         cons = PulsarConsumer(conn, consumer_id, receive_queue)
-        conn._consumers[consumer_id] = cons
         await conn.request(cmd)
+        # register only after SUBSCRIBE succeeds (a failed attempt must not
+        # leave a dead consumer entry); delivery starts with the FLOW below
+        conn._consumers[consumer_id] = cons
         await cons._grant(receive_queue)
         return cons
 
