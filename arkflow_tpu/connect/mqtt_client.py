@@ -1,9 +1,10 @@
-"""Minimal MQTT 3.1.1 client (QoS 0/1) on asyncio.
+"""Minimal MQTT 3.1.1 client (QoS 0/1/2) on asyncio.
 
-Implements the packet subset the engine needs (the reference links rumqttc:
-crates/arkflow-plugin/src/input/mqtt.rs): CONNECT/CONNACK,
-SUBSCRIBE/SUBACK, PUBLISH both directions (QoS 0 and 1 with PUBACK),
-PINGREQ/PINGRESP keepalive, DISCONNECT. QoS 2 is gated.
+Implements the packet subset the engine needs (the reference links rumqttc
+with QoS 0/1/2: crates/arkflow-plugin/src/input/mqtt.rs): CONNECT/CONNACK,
+SUBSCRIBE/SUBACK, PUBLISH both directions — QoS 1 with PUBACK, QoS 2 with
+the full PUBREC/PUBREL/PUBCOMP exactly-once handshake in both roles —
+PINGREQ/PINGRESP keepalive, DISCONNECT.
 """
 
 from __future__ import annotations
@@ -19,6 +20,7 @@ logger = logging.getLogger("arkflow.mqtt")
 
 # packet types (<<4)
 CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
+PUBREC, PUBREL, PUBCOMP = 5, 6, 7
 SUBSCRIBE, SUBACK = 8, 9
 PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
 
@@ -67,6 +69,9 @@ class MqttClient:
         self._on_message: Optional[Callable[[MqttMessage], None]] = None
         self._next_packet_id = 1
         self._pending: dict[int, asyncio.Future] = {}
+        #: inbound QoS-2 packet ids whose message was already delivered
+        #: (exactly-once: a DUP re-PUBLISH must not redeliver)
+        self._inbound_qos2: set[int] = set()
         self._connected = False
 
     # -- wire helpers --------------------------------------------------------
@@ -146,11 +151,27 @@ class MqttClient:
                         pid = int.from_bytes(body[pos : pos + 2], "big")
                         pos += 2
                     payload = body[pos:]
+                    deliver = True
                     if qos == 1 and pid is not None:
                         await self._send_packet(PUBACK, 0, pid.to_bytes(2, "big"))
-                    if self._on_message is not None:
+                    elif qos == 2 and pid is not None:
+                        # exactly-once receive: deliver on first sight of the
+                        # pid, suppress DUP retransmits until PUBREL clears it
+                        deliver = pid not in self._inbound_qos2
+                        self._inbound_qos2.add(pid)
+                        await self._send_packet(PUBREC, 0, pid.to_bytes(2, "big"))
+                    if deliver and self._on_message is not None:
                         self._on_message(MqttMessage(topic, payload, qos, retain, pid))
-                elif ptype in (PUBACK, SUBACK):
+                elif ptype == PUBREL:
+                    pid = int.from_bytes(body[:2], "big")
+                    self._inbound_qos2.discard(pid)
+                    await self._send_packet(PUBCOMP, 0, pid.to_bytes(2, "big"))
+                elif ptype == PUBREC:
+                    # outbound QoS 2 stage 1: release; the pending future
+                    # resolves at PUBCOMP
+                    pid = int.from_bytes(body[:2], "big")
+                    await self._send_packet(PUBREL, 0x02, pid.to_bytes(2, "big"))
+                elif ptype in (PUBACK, PUBCOMP, SUBACK):
                     pid = int.from_bytes(body[:2], "big")
                     fut = self._pending.pop(pid, None)
                     if fut is not None and not fut.done():
@@ -175,8 +196,8 @@ class MqttClient:
         self._on_message = cb
 
     async def subscribe(self, topic: str, qos: int = 0, timeout: float = 5.0) -> None:
-        if qos > 1:
-            raise ConnectError("mqtt QoS 2 is not supported by the native client")
+        if qos not in (0, 1, 2):
+            raise ConnectError(f"mqtt QoS must be 0/1/2, got {qos}")
         pid = self._packet_id()
         fut = asyncio.get_running_loop().create_future()
         self._pending[pid] = fut
@@ -188,12 +209,12 @@ class MqttClient:
                       retain: bool = False, timeout: float = 5.0) -> None:
         if not self._connected:
             raise Disconnection("mqtt connection lost")
-        if qos > 1:
-            raise ConnectError("mqtt QoS 2 is not supported by the native client")
+        if qos not in (0, 1, 2):
+            raise ConnectError(f"mqtt QoS must be 0/1/2, got {qos}")
         flags = (qos << 1) | (1 if retain else 0)
         body = _utf8(topic)
         fut = None
-        if qos == 1:
+        if qos > 0:
             pid = self._packet_id()
             fut = asyncio.get_running_loop().create_future()
             self._pending[pid] = fut
@@ -201,6 +222,8 @@ class MqttClient:
         body += payload
         await self._send_packet(PUBLISH, flags, body)
         if fut is not None:
+            # QoS 1 resolves at PUBACK; QoS 2 at PUBCOMP (PUBREC->PUBREL
+            # happens inside the dispatch loop)
             await asyncio.wait_for(fut, timeout)
 
     async def close(self) -> None:

@@ -1,4 +1,4 @@
-"""MQTT input: subscribe to topics, QoS 0/1.
+"""MQTT input: subscribe to topics, QoS 0/1/2.
 
 Mirrors the reference's mqtt input (ref: crates/arkflow-plugin/src/input/
 mqtt.rs:97-175): background dispatch into a bounded queue; connection loss
@@ -103,8 +103,8 @@ def _build(config: dict, resource: Resource) -> MqttInput:
         host, _, p = host.partition(":")
         port = int(p)
     qos = int(config.get("qos", 0))
-    if qos > 1:
-        raise ConfigError("mqtt QoS 2 is not supported by the native client yet")
+    if qos not in (0, 1, 2):
+        raise ConfigError(f"mqtt qos must be 0/1/2, got {qos}")
     pw = config.get("password")
     return MqttInput(
         host=host,

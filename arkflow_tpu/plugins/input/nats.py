@@ -1,17 +1,22 @@
-"""NATS input: core subject subscription (+ queue group).
+"""NATS input: core subject subscription (+ queue group) or JetStream pull.
 
-Mirrors the reference's nats input core mode (ref: crates/arkflow-plugin/src/
-input/nats.rs:48-76). JetStream pull-consumer mode (durable acks) is gated —
-the native client speaks core NATS only for now; configs asking for JetStream
-get a clear error rather than silent at-most-once.
+Mirrors the reference's nats input (ref: crates/arkflow-plugin/src/
+input/nats.rs:48-76): core mode subscribes a subject (at-most-once), and
+JetStream mode pulls from a durable consumer with explicit per-batch acks
+(at-least-once — unacked messages redeliver after a crash).
 
 Config:
 
     type: nats
     url: nats://127.0.0.1:4222
     subject: events.>
-    queue_group: workers     # optional
+    queue_group: workers     # optional (core mode)
     codec: json
+    # -- JetStream pull mode --
+    # mode: jetstream        # (or jetstream: true)
+    # stream: EVENTS
+    # durable: arkflow       # durable consumer name (created if missing)
+    # batch_size: 64
 """
 
 from __future__ import annotations
@@ -21,7 +26,12 @@ from typing import Optional
 
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
-from arkflow_tpu.connect.nats_client import NatsClient, NatsMessage, client_kwargs_from_config
+from arkflow_tpu.connect.nats_client import (
+    JetStream,
+    NatsClient,
+    NatsMessage,
+    client_kwargs_from_config,
+)
 from arkflow_tpu.errors import ConfigError, Disconnection, EndOfInput
 from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
 
@@ -75,17 +85,102 @@ class NatsInput(Input):
             await self._client.close()
 
 
+class JetStreamAck(Ack):
+    """Explicit +ACK of every message in a fetched batch, fired only after
+    the batch was written downstream (at-least-once)."""
+
+    def __init__(self, js: JetStream, messages: list[NatsMessage]):
+        self._js = js
+        self._messages = messages
+
+    async def ack(self) -> None:
+        for m in self._messages:
+            try:
+                await self._js.ack(m)
+            except Exception:
+                # connection gone: the consumer's ack-wait redelivers
+                return
+
+
+class NatsJetStreamInput(Input):
+    """Durable pull consumer: fetch batches, ack after downstream write."""
+
+    def __init__(self, url: str, stream: str, durable: str, batch_size: int,
+                 deliver_policy: str = "all", filter_subject: Optional[str] = None,
+                 codec=None, client_kwargs: Optional[dict] = None):
+        self.url = url
+        self.stream = stream
+        self.durable = durable
+        self.batch_size = batch_size
+        self.deliver_policy = deliver_policy
+        self.filter_subject = filter_subject
+        self.codec = codec
+        self.client_kwargs = client_kwargs or {}
+        self._client: Optional[NatsClient] = None
+        self._js: Optional[JetStream] = None
+        self._closed = False
+
+    async def connect(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+        self._client = NatsClient(self.url, **self.client_kwargs)
+        await self._client.connect()
+        self._js = JetStream(self._client)
+        await self._js.ensure_pull_consumer(self.stream, self.durable,
+                                            self.deliver_policy,
+                                            filter_subject=self.filter_subject)
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        if self._closed:
+            raise EndOfInput()
+        while True:
+            if self._client is None or not self._client.connected:
+                raise Disconnection("nats connection lost")
+            msgs = await self._js.fetch(self.stream, self.durable,
+                                        batch=self.batch_size, expires_s=0.5)
+            if self._closed:
+                raise EndOfInput()
+            if msgs:
+                break
+        batch = decode_payloads([m.payload for m in msgs], self.codec)
+        batch = (
+            batch.with_source("nats")
+            .with_ext_metadata({"stream": self.stream, "durable": self.durable})
+            .with_ingest_time()
+        )
+        return batch, JetStreamAck(self._js, msgs)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._client is not None:
+            await self._client.close()
+
+
 @register_input("nats")
-def _build(config: dict, resource: Resource) -> NatsInput:
+def _build(config: dict, resource: Resource) -> Input:
+    jetstream = bool(config.get("jetstream")) or config.get("mode") == "jetstream"
+    url = str(config.get("url", "nats://127.0.0.1:4222"))
+    if jetstream:
+        stream, durable = config.get("stream"), config.get("durable")
+        if not stream or not durable:
+            raise ConfigError("nats jetstream input requires 'stream' and 'durable'")
+        policy = str(config.get("deliver_policy", "all"))
+        if policy not in ("all", "last", "new"):
+            raise ConfigError(f"nats deliver_policy {policy!r} invalid (all/last/new)")
+        subject = config.get("subject")  # becomes the consumer's filter_subject
+        return NatsJetStreamInput(
+            url=url, stream=str(stream), durable=str(durable),
+            batch_size=int(config.get("batch_size", 64)),
+            deliver_policy=policy,
+            filter_subject=str(subject) if subject else None,
+            codec=build_codec(config.get("codec"), resource),
+            client_kwargs=client_kwargs_from_config(config),
+        )
     subject = config.get("subject")
     if not subject:
         raise ConfigError("nats input requires 'subject'")
-    if config.get("jetstream") or config.get("mode") == "jetstream":
-        raise ConfigError(
-            "nats JetStream mode is not supported by the native client yet; core mode only"
-        )
     return NatsInput(
-        url=str(config.get("url", "nats://127.0.0.1:4222")),
+        url=url,
         subject=str(subject),
         queue_group=config.get("queue_group"),
         codec=build_codec(config.get("codec"), resource),

@@ -4,7 +4,8 @@ Mirrors the reference's redis input (ref: crates/arkflow-plugin/src/input/
 redis.rs:45-63,193-245): subscribe mode pumps a background task into a bounded
 queue; list mode BLPOPs. Connection loss raises ``Disconnection`` for the
 runtime's reconnect loop (temporary-vs-permanent triage, redis.rs:85+).
-Cluster mode is gated (single node native).
+Cluster mode: `cluster: true` + `urls: [...]` routes keyed commands by
+slot with MOVED/ASK redirection.
 
 Config:
 
@@ -25,13 +26,14 @@ from typing import Optional
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
 from arkflow_tpu.errors import ConfigError, Disconnection, EndOfInput
-from arkflow_tpu.connect.redis_client import RedisClient
+from arkflow_tpu.connect.redis_client import RedisClient, make_redis_client
 from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
 
 
 class RedisInput(Input):
     def __init__(self, url: str, mode: str, channels: list, patterns: list,
-                 keys: list, codec=None, password: Optional[str] = None):
+                 keys: list, codec=None, password: Optional[str] = None,
+                 client_config: Optional[dict] = None):
         if mode not in ("subscribe", "list"):
             raise ConfigError(f"redis input mode must be subscribe|list, got {mode!r}")
         if mode == "subscribe" and not (channels or patterns):
@@ -44,14 +46,16 @@ class RedisInput(Input):
         self.patterns = patterns
         self.keys = keys
         self.codec = codec
-        self.password = password
+        # client_config is the single source of connection truth (url/
+        # password/cluster/urls); the bare params exist for direct construction
+        self.client_config = client_config or {"url": url, "password": password}
         self._client: Optional[RedisClient] = None
         self._queue: Optional[asyncio.Queue] = None
         self._task: Optional[asyncio.Task] = None
         self._closed = False
 
     async def connect(self) -> None:
-        self._client = RedisClient(self.url, password=self.password)
+        self._client = make_redis_client(self.client_config)
         await self._client.connect()
         if self.mode == "subscribe":
             self._queue = asyncio.Queue(maxsize=1000)
@@ -126,8 +130,6 @@ class RedisInput(Input):
 
 @register_input("redis")
 def _build(config: dict, resource: Resource) -> RedisInput:
-    if config.get("cluster"):
-        raise ConfigError("redis cluster mode is not supported by the native client yet")
     return RedisInput(
         url=str(config.get("url", "redis://127.0.0.1:6379")),
         mode=str(config.get("mode", "subscribe")),
@@ -136,4 +138,5 @@ def _build(config: dict, resource: Resource) -> RedisInput:
         keys=list(config.get("keys") or []),
         codec=build_codec(config.get("codec"), resource),
         password=config.get("password"),
+        client_config=config,
     )

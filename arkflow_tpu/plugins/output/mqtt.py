@@ -78,8 +78,8 @@ def _build(config: dict, resource: Resource) -> MqttOutput:
         host, _, p = host.partition(":")
         port = int(p)
     qos = int(config.get("qos", 0))
-    if qos > 1:
-        raise ConfigError("mqtt QoS 2 is not supported by the native client yet")
+    if qos not in (0, 1, 2):
+        raise ConfigError(f"mqtt qos must be 0/1/2, got {qos}")
     pw = config.get("password")
     return MqttOutput(
         host=host,

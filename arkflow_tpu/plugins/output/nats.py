@@ -25,16 +25,30 @@ from arkflow_tpu.utils.expr import DynValue
 
 class NatsOutput(Output):
     def __init__(self, url: str, subject: DynValue, codec=None,
-                 client_kwargs: Optional[dict] = None):
+                 client_kwargs: Optional[dict] = None, jetstream: bool = False):
         self.url = url
         self.subject = subject
         self.codec = codec
         self.client_kwargs = client_kwargs or {}
+        #: JetStream publish: await the server PubAck per message (persisted
+        #: before write() returns) instead of fire-and-forget core publish
+        self.jetstream = jetstream
         self._client: Optional[NatsClient] = None
 
     async def connect(self) -> None:
         self._client = NatsClient(self.url, **self.client_kwargs)
         await self._client.connect()
+
+    async def _publish(self, subject: str, payload: bytes) -> None:
+        if not self.jetstream:
+            await self._client.publish(subject, payload)
+            return
+        import json
+
+        resp = await self._client.request(subject, payload)
+        ack = json.loads(resp.payload.decode() or "{}")
+        if "error" in ack:
+            raise WriteError(f"jetstream publish rejected: {ack['error']}")
 
     async def write(self, batch: MessageBatch) -> None:
         if self._client is None:
@@ -48,14 +62,14 @@ class NatsOutput(Output):
                 subjects = [subjects[0]] * len(payloads)
             try:
                 for subj, p in zip(subjects, payloads):
-                    await self._client.publish(str(subj), p)
+                    await self._publish(str(subj), p)
             except Exception as e:
                 raise WriteError(f"nats publish failed: {e}") from e
             return
         subj = str(self.subject.eval_scalar(batch))
         try:
             for p in encode_batch(batch.strip_metadata(), self.codec):
-                await self._client.publish(subj, p)
+                await self._publish(subj, p)
         except Exception as e:
             raise WriteError(f"nats publish failed: {e}") from e
 
@@ -69,11 +83,10 @@ def _build(config: dict, resource: Resource) -> NatsOutput:
     subject = config.get("subject")
     if not subject:
         raise ConfigError("nats output requires 'subject'")
-    if config.get("jetstream"):
-        raise ConfigError("nats JetStream publish is not supported by the native client yet")
     return NatsOutput(
         url=str(config.get("url", "nats://127.0.0.1:4222")),
         subject=DynValue.from_config(subject, "subject"),
         codec=build_codec(config.get("codec"), resource),
         client_kwargs=client_kwargs_from_config(config),
+        jetstream=bool(config.get("jetstream")),
     )

@@ -18,7 +18,7 @@ from typing import Optional
 
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Output, Resource, register_output
-from arkflow_tpu.connect.redis_client import RedisClient
+from arkflow_tpu.connect.redis_client import RedisClient, make_redis_client
 from arkflow_tpu.errors import ConfigError, WriteError
 from arkflow_tpu.plugins.codec.helper import build_codec, encode_batch
 from arkflow_tpu.utils.expr import DynValue
@@ -26,18 +26,21 @@ from arkflow_tpu.utils.expr import DynValue
 
 class RedisOutput(Output):
     def __init__(self, url: str, mode: str, target: DynValue, codec=None,
-                 password: Optional[str] = None):
+                 password: Optional[str] = None,
+                 client_config: Optional[dict] = None):
         if mode not in ("publish", "lpush", "rpush"):
             raise ConfigError(f"redis output mode must be publish|lpush|rpush, got {mode!r}")
         self.url = url
         self.mode = mode
         self.target = target
         self.codec = codec
-        self.password = password
+        # client_config is the single source of connection truth (url/
+        # password/cluster/urls); the bare params exist for direct construction
+        self.client_config = client_config or {"url": url, "password": password}
         self._client: Optional[RedisClient] = None
 
     async def connect(self) -> None:
-        self._client = RedisClient(self.url, password=self.password)
+        self._client = make_redis_client(self.client_config)
         await self._client.connect()
 
     async def write(self, batch: MessageBatch) -> None:
@@ -72,4 +75,5 @@ def _build(config: dict, resource: Resource) -> RedisOutput:
         target=DynValue.from_config(target, "target"),
         codec=build_codec(config.get("codec"), resource),
         password=config.get("password"),
+        client_config=config,
     )

@@ -20,25 +20,28 @@ from typing import Optional, Sequence
 
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Resource, Temporary, register_temporary
-from arkflow_tpu.connect.redis_client import RedisClient
+from arkflow_tpu.connect.redis_client import RedisClient, make_redis_client
 from arkflow_tpu.errors import ConfigError, ReadError
 from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
 
 
 class RedisTemporary(Temporary):
     def __init__(self, url: str, mode: str, key_prefix: str = "", codec=None,
-                 password: Optional[str] = None):
+                 password: Optional[str] = None,
+                 client_config: Optional[dict] = None):
         if mode not in ("get", "list"):
             raise ConfigError(f"redis temporary mode must be get|list, got {mode!r}")
         self.url = url
         self.mode = mode
         self.key_prefix = key_prefix
         self.codec = codec
-        self.password = password
+        # client_config is the single source of connection truth (url/
+        # password/cluster/urls); the bare params exist for direct construction
+        self.client_config = client_config or {"url": url, "password": password}
         self._client: Optional[RedisClient] = None
 
     async def connect(self) -> None:
-        self._client = RedisClient(self.url, password=self.password)
+        self._client = make_redis_client(self.client_config)
         await self._client.connect()
 
     async def get(self, keys: Sequence[object]) -> MessageBatch:
@@ -71,4 +74,5 @@ def _build(config: dict, resource: Resource) -> RedisTemporary:
         key_prefix=str(config.get("key_prefix", "")),
         codec=build_codec(config.get("codec"), resource),
         password=config.get("password"),
+        client_config=config,
     )

@@ -340,14 +340,34 @@ def test_redis_temporary_mget():
 class FakeMqttBroker:
     """3.1.1 fake: CONNACK, SUBACK, PUBACK, routes PUBLISH to subscribers."""
 
-    def __init__(self):
-        self.subs = []  # (writer, topic_filter)
+    def __init__(self, duplicate_qos2_delivery: bool = False):
+        self.subs = []  # (writer, topic_filter, qos)
+        self.held = {}  # inbound qos2 messages awaiting PUBREL
+        self.duplicate_qos2_delivery = duplicate_qos2_delivery
+        self._deliver_pid = 100
         self.server = None
         self.port = None
 
     async def start(self):
         self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
         self.port = self.server.sockets[0].getsockname()[1]
+
+    def _route(self, topic: str, payload: bytes) -> None:
+        t = topic.encode()
+        for w, filt, sub_qos in self.subs:
+            if not self._match(filt, topic):
+                continue
+            if sub_qos == 2:
+                self._deliver_pid += 1
+                pid = self._deliver_pid.to_bytes(2, "big")
+                body = len(t).to_bytes(2, "big") + t + pid + payload
+                frame = bytes([0x34]) + bytes([len(body)]) + body
+                w.write(frame)
+                if self.duplicate_qos2_delivery:  # DUP retransmit
+                    w.write(bytes([0x3C]) + bytes([len(body)]) + body)
+            else:
+                body = len(t).to_bytes(2, "big") + t + payload
+                w.write(bytes([0x30]) + bytes([len(body)]) + body)
 
     @staticmethod
     def _match(filt: str, topic: str) -> bool:
@@ -383,28 +403,37 @@ class FakeMqttBroker:
                     pid = body[:2]
                     tlen = int.from_bytes(body[2:4], "big")
                     topic = body[4 : 4 + tlen].decode()
-                    self.subs.append((writer, topic))
-                    writer.write(bytes([0x90, 3]) + pid + bytes([0]))
+                    sub_qos = body[4 + tlen] if len(body) > 4 + tlen else 0
+                    self.subs.append((writer, topic, sub_qos))
+                    writer.write(bytes([0x90, 3]) + pid + bytes([sub_qos]))
                 elif ptype == 3:  # PUBLISH
                     qos = (flags >> 1) & 3
                     tlen = int.from_bytes(body[:2], "big")
                     topic = body[2 : 2 + tlen].decode()
                     pos = 2 + tlen
+                    pid = b""
                     if qos:
                         pid = body[pos : pos + 2]
                         pos += 2
-                        writer.write(bytes([0x40, 2]) + pid)
                     payload = body[pos:]
-                    frame = (
-                        bytes([0x30])
-                        + bytes([len(topic.encode()) + 2 + len(payload)])
-                        + len(topic.encode()).to_bytes(2, "big")
-                        + topic.encode()
-                        + payload
-                    )
-                    for w, filt in self.subs:
-                        if self._match(filt, topic):
-                            w.write(frame)
+                    if qos == 1:
+                        writer.write(bytes([0x40, 2]) + pid)
+                        self._route(topic, payload)
+                    elif qos == 2:  # exactly-once inbound: PUBREC, hold
+                        writer.write(bytes([0x50, 2]) + pid)
+                        self.held[pid] = (topic, payload)
+                    else:
+                        self._route(topic, payload)
+                elif ptype == 6:  # PUBREL from publisher: complete + route
+                    pid = body[:2]
+                    writer.write(bytes([0x70, 2]) + pid)  # PUBCOMP
+                    held = self.held.pop(pid, None)
+                    if held is not None:
+                        self._route(*held)
+                elif ptype == 5:  # PUBREC from a qos2 subscriber: release
+                    writer.write(bytes([0x62, 2]) + body[:2])  # PUBREL
+                elif ptype == 7:  # PUBCOMP from subscriber: flow done
+                    pass
                 elif ptype == 12:  # PINGREQ
                     writer.write(bytes([0xD0, 0]))
                 elif ptype == 14:  # DISCONNECT
@@ -445,9 +474,39 @@ def test_mqtt_roundtrip_qos1():
     asyncio.run(go())
 
 
-def test_mqtt_qos2_gated():
+def test_mqtt_qos2_exactly_once_roundtrip():
+    """Full QoS 2 both ways: publisher PUBLISH->PUBREC->PUBREL->PUBCOMP,
+    subscriber receives with PUBREC/PUBCOMP, and a DUP retransmit of the
+    same packet id is delivered exactly once."""
+    async def go():
+        broker = FakeMqttBroker(duplicate_qos2_delivery=True)
+        await broker.start()
+        try:
+            inp = build("input", {"type": "mqtt", "host": "127.0.0.1",
+                                  "port": broker.port, "topics": ["exact"],
+                                  "qos": 2})
+            out = build("output", {"type": "mqtt", "host": "127.0.0.1",
+                                   "port": broker.port, "topic": "exact",
+                                   "qos": 2})
+            await inp.connect()
+            await out.connect()
+            await out.write(MessageBatch.new_binary([b"once-only"]))
+            batch, _ = await asyncio.wait_for(inp.read(), timeout=3)
+            assert batch.to_binary() == [b"once-only"]
+            # the DUP retransmit must NOT surface a second message
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(inp.read(), timeout=0.5)
+            await inp.close()
+            await out.close()
+        finally:
+            await broker.stop()
+
+    asyncio.run(go())
+
+
+def test_mqtt_qos_validation():
     with pytest.raises(ConfigError):
-        build("input", {"type": "mqtt", "host": "h", "topics": ["t"], "qos": 2})
+        build("input", {"type": "mqtt", "host": "h", "topics": ["t"], "qos": 3})
 
 
 # -- file / sqlite ----------------------------------------------------------

@@ -1,0 +1,294 @@
+"""Redis cluster-mode tests against in-process fake nodes.
+
+Two fake nodes split the 16384 slots; keyed commands must route by
+CRC16 slot, follow MOVED (with a slot-map refresh) and ASK (one-shot
+with ASKING), and cross-slot MGETs must split per slot. Mirrors the
+reference's cluster connection mode (ref component/redis.rs:23-90,
+input/redis.rs:45-63).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+import pytest
+
+sys.path.insert(0, "tests")
+
+from test_connectors import FakeRedisServer  # noqa: E402
+
+from arkflow_tpu.batch import MessageBatch  # noqa: E402
+from arkflow_tpu.components import Resource, build_component  # noqa: E402
+from arkflow_tpu.connect.redis_client import (  # noqa: E402
+    RedisClusterClient,
+    crc16_xmodem,
+    key_slot,
+)
+from arkflow_tpu.errors import ConnectError  # noqa: E402
+
+
+class FakeClusterNode(FakeRedisServer):
+    """FakeRedisServer + CLUSTER SLOTS + slot-ownership MOVED/ASK."""
+
+    def __init__(self, cluster: "FakeCluster", index: int):
+        super().__init__()
+        self.cluster = cluster
+        self.index = index
+        self.asking: set = set()       # writers granted one ASK exception
+        self.ask_slots: set[int] = set()  # slots this node serves only via ASK
+
+    def owns(self, slot: int) -> bool:
+        return self.cluster.owner_index(slot) == self.index
+
+    async def _client(self, reader, writer):
+        try:
+            while True:
+                args = await self._read_command(reader)
+                if args is None:
+                    return
+                cmd = args[0].upper()
+                if cmd == b"CLUSTER" and args[1].upper() == b"SLOTS":
+                    writer.write(self.cluster.slots_reply())
+                    await writer.drain()
+                    continue
+                if cmd == b"ASKING":
+                    self.asking.add(id(writer))
+                    writer.write(b"+OK\r\n")
+                    await writer.drain()
+                    continue
+                keyed = cmd in (b"LPUSH", b"RPUSH", b"BLPOP", b"MGET", b"LRANGE")
+                if keyed:
+                    slot = key_slot(args[1])
+                    if slot in self.ask_slots:
+                        if id(writer) not in self.asking:
+                            target = self.cluster.nodes[self.cluster.owner_index(slot)]
+                            writer.write(
+                                f"-ASK {slot} 127.0.0.1:{target.port}\r\n".encode())
+                            await writer.drain()
+                            continue
+                        self.asking.discard(id(writer))
+                    elif not self.owns(slot):
+                        target = self.cluster.nodes[self.cluster.owner_index(slot)]
+                        writer.write(
+                            f"-MOVED {slot} 127.0.0.1:{target.port}\r\n".encode())
+                        await writer.drain()
+                        continue
+                await self._handle_one(args, writer)
+        except (asyncio.IncompleteReadError, ConnectionError, AssertionError):
+            return
+
+    async def _handle_one(self, args, writer) -> None:
+        """One command via the parent dispatch (single-shot refactor)."""
+        cmd = args[0].upper()
+        if cmd in (b"AUTH", b"SELECT"):
+            writer.write(b"+OK\r\n")
+        elif cmd in (b"LPUSH", b"RPUSH"):
+            lst = self.lists.setdefault(args[1], [])
+            if cmd == b"LPUSH":
+                lst.insert(0, args[2])
+            else:
+                lst.append(args[2])
+            writer.write(b":%d\r\n" % len(lst))
+        elif cmd == b"BLPOP":
+            popped = None
+            for k in args[1:-1]:
+                if self.lists.get(k):
+                    popped = (k, self.lists[k].pop(0))
+                    break
+            if popped:
+                writer.write(b"*2\r\n" + self._bulk(popped[0]) + self._bulk(popped[1]))
+            else:
+                await asyncio.sleep(0.05)
+                writer.write(b"*-1\r\n")
+        elif cmd == b"MGET":
+            writer.write(b"*%d\r\n" % (len(args) - 1))
+            for k in args[1:]:
+                writer.write(self._bulk(self.kv.get(k)))
+        elif cmd == b"LRANGE":
+            vals = self.lists.get(args[1], [])
+            writer.write(b"*%d\r\n" % len(vals))
+            for v in vals:
+                writer.write(self._bulk(v))
+        elif cmd == b"SUBSCRIBE":
+            for ch in args[1:]:
+                writer.write(b"*3\r\n" + self._bulk(b"subscribe")
+                             + self._bulk(ch) + b":1\r\n")
+                self.subscribers.append((writer, ch))
+        elif cmd == b"PUBLISH":
+            ch, payload = args[1], args[2]
+            n = 0
+            for node in self.cluster.nodes:  # cluster bus: all nodes' subscribers
+                for w, sub in node.subscribers:
+                    if sub == ch:
+                        w.write(b"*3\r\n" + self._bulk(b"message")
+                                + self._bulk(ch) + self._bulk(payload))
+                        n += 1
+
+            writer.write(b":%d\r\n" % n)
+        else:
+            writer.write(b"-ERR unknown command\r\n")
+        await writer.drain()
+
+
+class FakeCluster:
+    """Two-node cluster splitting the slot space in half."""
+
+    def __init__(self):
+        self.nodes = [FakeClusterNode(self, 0), FakeClusterNode(self, 1)]
+
+    def owner_index(self, slot: int) -> int:
+        return 0 if slot < 8192 else 1
+
+    def slots_reply(self) -> bytes:
+        def entry(start, end, port):
+            return (b"*3\r\n" + b":%d\r\n" % start + b":%d\r\n" % end
+                    + b"*2\r\n" + FakeRedisServer._bulk(b"127.0.0.1") + b":%d\r\n" % port)
+
+        return (b"*2\r\n"
+                + entry(0, 8191, self.nodes[0].port)
+                + entry(8192, 16383, self.nodes[1].port))
+
+    async def start(self):
+        for n in self.nodes:
+            await n.start()
+
+    async def stop(self):
+        for n in self.nodes:
+            await n.stop()
+
+    def urls(self) -> list[str]:
+        return [f"redis://127.0.0.1:{n.port}" for n in self.nodes]
+
+
+def _keys_for_both_nodes() -> tuple[str, str]:
+    """One key per half of the slot space."""
+    low = high = None
+    i = 0
+    while low is None or high is None:
+        k = f"k{i}"
+        if key_slot(k) < 8192:
+            low = low or k
+        else:
+            high = high or k
+        i += 1
+    return low, high
+
+
+def test_crc16_spec_vector_and_hash_tags():
+    assert crc16_xmodem(b"123456789") == 0x31C3  # redis cluster spec vector
+    assert key_slot("foo") == 12182
+    assert key_slot("{user1000}.following") == key_slot("{user1000}.followers")
+
+
+def test_slot_routing_and_cross_slot_mget():
+    async def go():
+        cluster = FakeCluster()
+        await cluster.start()
+        try:
+            low, high = _keys_for_both_nodes()
+            client = RedisClusterClient(cluster.urls())
+            await client.connect()
+            await client.rpush(low, b"lo")
+            await client.rpush(high, b"hi")
+            # each landed on its slot owner, not the seed node
+            assert cluster.nodes[0].lists.get(low.encode()) == [b"lo"]
+            assert cluster.nodes[1].lists.get(high.encode()) == [b"hi"]
+            cluster.nodes[0].kv[low.encode()] = b"v-lo"
+            cluster.nodes[1].kv[high.encode()] = b"v-hi"
+            # cross-slot MGET splits per node and preserves order
+            assert await client.mget([high, low, "missing"]) == [b"v-hi", b"v-lo", None]
+            await client.close()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
+
+
+def test_moved_redirection_refreshes_and_retries():
+    async def go():
+        cluster = FakeCluster()
+        await cluster.start()
+        try:
+            low, high = _keys_for_both_nodes()
+            # connect with ONLY node 0 as seed; writing `high` must follow
+            # the MOVED redirect to node 1
+            client = RedisClusterClient([cluster.urls()[0]])
+            await client.connect()
+            # sabotage the local slot map so the first try hits node 0
+            client._slots = [(0, 16383, ("127.0.0.1", cluster.nodes[0].port))]
+            await client.rpush(high, b"redirected")
+            assert cluster.nodes[1].lists.get(high.encode()) == [b"redirected"]
+            # the MOVED handler refreshed the map
+            assert len(client._slots) == 2
+            await client.close()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
+
+
+def test_ask_redirection_one_shot():
+    async def go():
+        cluster = FakeCluster()
+        await cluster.start()
+        try:
+            low, high = _keys_for_both_nodes()
+            slot = key_slot(low)
+            # node 0 is migrating `low`'s slot: serve only via ASK on node 1
+            cluster.nodes[0].ask_slots.add(slot)
+            cluster.nodes[1].ask_slots.add(slot)  # node 1 wants ASKING first
+            def owner_index(s, _orig=cluster.owner_index):
+                return 1 if s == slot else _orig(s)
+            cluster.owner_index = owner_index
+            client = RedisClusterClient(cluster.urls())
+            await client.connect()
+            await client.rpush(low, b"asked")
+            assert cluster.nodes[1].lists.get(low.encode()) == [b"asked"]
+            await client.close()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
+
+
+def test_cluster_components_end_to_end():
+    async def go():
+        cluster = FakeCluster()
+        await cluster.start()
+        try:
+            low, _high = _keys_for_both_nodes()
+            out = build_component(
+                "output",
+                {"type": "redis", "cluster": True, "urls": cluster.urls(),
+                 "mode": "rpush", "target": low},
+                Resource(),
+            )
+            inp = build_component(
+                "input",
+                {"type": "redis", "cluster": True, "urls": cluster.urls(),
+                 "mode": "list", "keys": [low]},
+                Resource(),
+            )
+            await out.connect()
+            await inp.connect()
+            await out.write(MessageBatch.new_binary([b"cluster-payload"]))
+            batch, _ = await asyncio.wait_for(inp.read(), 5)
+            assert batch.to_binary() == [b"cluster-payload"]
+            await inp.close()
+            await out.close()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
+
+
+def test_cluster_connect_failures():
+    async def go():
+        with pytest.raises(ConnectError):
+            c = RedisClusterClient(["redis://127.0.0.1:1"])  # closed port
+            await c.connect(timeout=0.5)
+        with pytest.raises(ConnectError):
+            RedisClusterClient([])
+
+    asyncio.run(go())
