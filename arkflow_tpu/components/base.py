@@ -104,6 +104,11 @@ class Output(abc.ABC):
 
 
 class Processor(abc.ABC):
+    async def connect(self) -> None:
+        """Optional pre-flight hook, run before the input starts producing
+        (model warmup compiles, pool creation, ...). Default: no-op."""
+        return None
+
     @abc.abstractmethod
     async def process(self, batch: MessageBatch) -> list[MessageBatch]:
         """Transform one batch into zero or more batches."""

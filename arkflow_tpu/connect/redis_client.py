@@ -207,6 +207,7 @@ class RedisClusterClient:
         self.password = password
         self._nodes: dict[tuple[str, int], RedisClient] = {}
         self._pubsub_clients: list[RedisClient] = []
+        self._connect_lock: Optional[asyncio.Lock] = None
         #: sorted [(start_slot, end_slot, (host, port))]
         self._slots: list[tuple[int, int, tuple[str, int]]] = []
 
@@ -238,11 +239,20 @@ class RedisClusterClient:
 
     async def _node(self, addr: tuple[str, int]) -> RedisClient:
         client = self._nodes.get(addr)
-        if client is None or client._writer is None:
+        if client is not None and client._writer is not None:
+            return client
+        # serialize new-node connects: concurrent per-slot fans (mget) must
+        # not both open and one leak a connection to the same address
+        if self._connect_lock is None:
+            self._connect_lock = asyncio.Lock()
+        async with self._connect_lock:
+            client = self._nodes.get(addr)
+            if client is not None and client._writer is not None:
+                return client
             client = RedisClient(f"redis://{addr[0]}:{addr[1]}", password=self.password)
             await client.connect()
             self._nodes[addr] = client
-        return client
+            return client
 
     def _addr_for_slot(self, slot: int) -> tuple[str, int]:
         for start, end, addr in self._slots:
@@ -315,7 +325,7 @@ class RedisClusterClient:
         return await self.command_key(key, "RPUSH", key, payload)
 
     async def blpop(self, keys: list, timeout_s: float = 1.0) -> Optional[tuple[bytes, bytes]]:
-        # cluster BLPOP requires same-slot keys; route by the first
+        check_same_slot(keys, what="cluster BLPOP")
         res = await self.command_key(keys[0], "BLPOP", *keys, int(max(1, timeout_s)))
         if res is None:
             return None
@@ -335,6 +345,18 @@ class RedisClusterClient:
             await client.close()
         self._nodes.clear()
         self._pubsub_clients.clear()
+
+
+def check_same_slot(keys: list, what: str = "multi-key command") -> None:
+    """Multi-key ops must hash to ONE cluster slot; diagnose early with a
+    hash-tag hint instead of a raw server-side CROSSSLOT error."""
+    from arkflow_tpu.errors import ConfigError
+
+    slots = {key_slot(k) for k in keys}
+    if len(slots) > 1:
+        raise ConfigError(
+            f"{what} requires all keys in one cluster slot; got slots "
+            f"{sorted(slots)} for {list(keys)!r} — use a shared {{hash-tag}}")
 
 
 def make_redis_client(config: dict):

@@ -130,6 +130,11 @@ class RedisInput(Input):
 
 @register_input("redis")
 def _build(config: dict, resource: Resource) -> RedisInput:
+    keys = list(config.get("keys") or [])
+    if config.get("cluster") and config.get("mode") == "list" and len(keys) > 1:
+        from arkflow_tpu.connect.redis_client import check_same_slot
+
+        check_same_slot(keys, what="redis cluster list input (BLPOP)")
     return RedisInput(
         url=str(config.get("url", "redis://127.0.0.1:6379")),
         mode=str(config.get("mode", "subscribe")),

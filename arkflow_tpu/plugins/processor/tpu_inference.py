@@ -139,12 +139,18 @@ class TpuInferenceProcessor(Processor):
 
     # -- Processor ---------------------------------------------------------
 
-    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
-        if batch.num_rows == 0:
-            return []
+    async def connect(self) -> None:
+        """Precompile the bucket grid before the input starts producing, so
+        no in-flight batch ever waits behind a compile."""
         if not self._warmed:
             self._warmed = True
             await asyncio.get_running_loop().run_in_executor(None, self.runner.warmup)
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []
+        if not self._warmed:  # direct use without a stream (tests, tools)
+            await self.connect()
         inputs = self._extract(batch)
         outputs = await self.runner.infer(inputs)
         return [self._attach(batch, outputs)]

@@ -19,6 +19,15 @@ class Pipeline:
     def __init__(self, processors: Sequence[Processor]):
         self.processors = list(processors)
 
+    async def connect(self) -> None:
+        """Pre-flight every processor (e.g. model warmup) before data flows.
+
+        Tolerates duck-typed processors without the optional hook."""
+        for proc in self.processors:
+            hook = getattr(proc, "connect", None)
+            if hook is not None:
+                await hook()
+
     async def process(self, batch: MessageBatch) -> list[MessageBatch]:
         current = [batch]
         for proc in self.processors:

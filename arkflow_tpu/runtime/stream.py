@@ -96,6 +96,10 @@ class Stream:
 
     async def run(self, cancel: asyncio.Event) -> None:
         """Run until the input ends or ``cancel`` is set; drains before returning."""
+        # processors first: model warmup compiles must finish before the
+        # input starts producing, or the first batches queue behind a
+        # multi-second compile and pollute e2e latency
+        await self.pipeline.connect()
         await self.input.connect()
         await self.output.connect()
         if self.error_output is not None:

@@ -12,8 +12,12 @@ Responsibilities:
   owns the cache, ``warmup()`` precompiles the bucket grid so steady-state
   never hits a compile.
 - Run inference off the event loop (``asyncio`` executor) so device sync
-  never stalls the stream's other stages; JAX's async dispatch overlaps the
-  host->device infeed of step n+1 with step n's compute.
+  never stalls the stream's other stages.
+- Keep the device pipeline full (SURVEY.md section 7.5): ``infer()`` splits
+  host prep (pad, off-loop) from the non-blocking XLA dispatch, and bounds
+  in-flight device steps with a semaphore — with >=2 stream workers, step
+  n+1's infeed/dispatch overlaps step n's compute (double buffering), and
+  duty-cycle / infeed-stall metrics report how full the device stayed.
 """
 
 from __future__ import annotations
@@ -116,7 +120,23 @@ class ModelRunner:
             buckets=[0.125, 0.25, 0.5, 0.75, 0.9, 1.0],
         )
         self.m_compiles = reg.counter("arkflow_tpu_compiles_total", "bucket compiles", labels)
+        self.m_inflight = reg.gauge(
+            "arkflow_tpu_steps_inflight", "device steps dispatched, not yet complete", labels)
+        self.m_busy_s = reg.counter(
+            "arkflow_tpu_device_busy_seconds_total",
+            "wall seconds with >=1 step in flight (duty-cycle numerator)", labels)
+        self.m_stall_s = reg.counter(
+            "arkflow_tpu_infeed_stall_seconds_total",
+            "wall seconds the device sat idle between steps (host-bound)", labels)
         self._seen_shapes: set[tuple] = set()
+        #: device queue depth: 2 = double buffering (prep/dispatch n+1
+        #: overlaps compute of n); more just adds latency
+        self.max_in_flight = 2
+        self._inflight_sem: Optional[asyncio.Semaphore] = None
+        self._compiling: dict[tuple, asyncio.Event] = {}
+        self._inflight = 0
+        self._busy_start = 0.0
+        self._last_idle_start: Optional[float] = None
 
     # -- checkpoint --------------------------------------------------------
 
@@ -175,6 +195,19 @@ class ModelRunner:
             ]
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
 
+        padded, n = self._prep(inputs)
+        key = self._shape_key(padded)
+        if key not in self._seen_shapes:
+            self._seen_shapes.add(key)
+            self.m_compiles.inc()
+        t0 = time.perf_counter()
+        out = jax.device_get(self._dispatch(padded))
+        self.m_infer.observe(time.perf_counter() - t0)
+        self.m_rows.inc(n)
+        return {k: np.asarray(v)[:n] for k, v in out.items()}
+
+    def _prep(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
+        """Host-side stage: pad to buckets + validate masks (CPU only)."""
         padded, n = self._pad_inputs(inputs)
         if getattr(self.cfg, "use_flash_attention", False) and "attention_mask" in padded:
             # the ragged kernel reads row sums as prefix lengths; a
@@ -187,24 +220,94 @@ class ModelRunner:
                     "use_flash_attention requires right-padded attention masks "
                     "(contiguous prefix of ones)"
                 )
-        key = self._shape_key(padded)
-        if key not in self._seen_shapes:
-            self._seen_shapes.add(key)
-            self.m_compiles.inc()
-        t0 = time.perf_counter()
+        return padded, n
+
+    def _dispatch(self, padded: dict[str, Any]):
+        """Non-blocking XLA dispatch (async device futures)."""
         if self.mesh is not None:
             with self.mesh:
-                out = self._jitted(self.params, padded)
-        else:
-            out = self._jitted(self.params, padded)
-        out = jax.device_get(out)
-        self.m_infer.observe(time.perf_counter() - t0)
-        self.m_rows.inc(n)
-        return {k: np.asarray(v)[:n] for k, v in out.items()}
+                return self._jitted(self.params, padded)
+        return self._jitted(self.params, padded)
+
+    # -- in-flight accounting (duty cycle / infeed stall) -------------------
+
+    def _track_dispatch(self, now: float) -> None:
+        if self._inflight == 0:
+            if self._last_idle_start is not None:
+                self.m_stall_s.inc(now - self._last_idle_start)
+            self._busy_start = now
+        self._inflight += 1
+        self.m_inflight.set(self._inflight)
+
+    def _track_complete(self, now: float) -> None:
+        self._inflight -= 1
+        self.m_inflight.set(self._inflight)
+        if self._inflight == 0:
+            self.m_busy_s.inc(now - self._busy_start)
+            self._last_idle_start = now
+
+    def duty_cycle(self) -> float:
+        """Busy fraction since the first dispatch (1.0 = device never idle)."""
+        busy, stall = self.m_busy_s.value, self.m_stall_s.value
+        total = busy + stall
+        return busy / total if total > 0 else 0.0
 
     async def infer(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        """Pipelined inference: host prep off-loop, bounded async dispatch.
+
+        Concurrent callers (the stream's processor workers) keep up to
+        ``max_in_flight`` steps queued on the device, so step n+1's host
+        prep + infeed overlaps step n's compute instead of serializing
+        pad -> dispatch -> device_get per batch.
+        """
+        import time
+
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, self.infer_sync, inputs)
+        n_total = next(iter(inputs.values())).shape[0]
+        mb = self.buckets.max_batch()
+        if n_total > mb:
+            chunks = []
+            for i in range(0, n_total, mb):
+                chunks.append(await self.infer(
+                    {k: v[i:i + mb] for k, v in inputs.items()}))
+            return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
+        padded, n = await loop.run_in_executor(None, self._prep, inputs)
+        key = self._shape_key(padded)
+        first = key not in self._seen_shapes
+        if first:
+            self._seen_shapes.add(key)
+            self.m_compiles.inc()
+        if self._inflight_sem is None:
+            self._inflight_sem = asyncio.Semaphore(self.max_in_flight)
+        async with self._inflight_sem:
+            t0 = time.perf_counter()
+            self._track_dispatch(t0)
+            try:
+                compiling = self._compiling.get(key)
+                if first:
+                    # a cold shape compiles inside dispatch — keep that off
+                    # the event loop; warm shapes dispatch in sub-ms
+                    ev = asyncio.Event()
+                    self._compiling[key] = ev
+                    try:
+                        out = await loop.run_in_executor(None, self._dispatch, padded)
+                    finally:
+                        ev.set()
+                        self._compiling.pop(key, None)
+                elif compiling is not None and not compiling.is_set():
+                    # same shape, compile still in progress elsewhere: this
+                    # dispatch would block inside the compile — keep it off
+                    # the loop too
+                    out = await loop.run_in_executor(None, self._dispatch, padded)
+                else:
+                    out = self._dispatch(padded)
+                out = await loop.run_in_executor(None, jax.device_get, out)
+            finally:
+                t1 = time.perf_counter()
+                self._track_complete(t1)
+            self.m_infer.observe(t1 - t0)
+        self.m_rows.inc(n)
+        return {k: np.asarray(v)[:n] for k, v in out.items()}
 
     def warmup(self, seq_lens: Optional[list[int]] = None) -> int:
         """Precompile the bucket grid; returns number of executables built."""

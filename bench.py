@@ -73,6 +73,46 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
     }
 
 
+def build_latency_config(seq: int, tiny: bool) -> dict:
+    """Latency mode: bounded input rate + small buckets + buffer-timeout
+    micro-batching, so p50/p99 measure end-to-end latency rather than
+    queueing under saturation (VERDICT r1 weak-point 3; target p99<50ms)."""
+    model_config = (
+        {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4, "ffn": 64,
+         "max_positions": 64, "num_labels": 2}
+        if tiny
+        else {}
+    )
+    payload = "stream processing on tpu: sensor reading nominal, no anomaly detected"
+    return {
+        "name": "bench-lat",
+        "input": {
+            "type": "generate",
+            "payload": payload,
+            "interval": "5ms",     # ~1.6k rows/s offered load, far below saturation
+            "batch_size": 8,
+        },
+        # timeout-driven micro-batching: emit whatever arrived every 10ms
+        "buffer": {"type": "memory", "capacity": 64, "timeout": "10ms"},
+        "pipeline": {
+            "thread_num": 2,
+            "processors": [
+                {
+                    "type": "tpu_inference",
+                    "model": "bert_classifier",
+                    "model_config": model_config,
+                    "max_seq": seq,
+                    "batch_buckets": [8, 16, 32, 64],
+                    "seq_buckets": [seq],
+                    "outputs": ["label", "score"],
+                    "warmup": True,
+                }
+            ],
+        },
+        "output": {"type": "drop"},
+    }
+
+
 async def run_bench(seconds: float, batch: int, seq: int, tiny: bool,
                     mode: str = "bert") -> dict:
     from arkflow_tpu.components import ensure_plugins_loaded
@@ -83,7 +123,12 @@ async def run_bench(seconds: float, batch: int, seq: int, tiny: bool,
     import sys
 
     ensure_plugins_loaded()
-    cfg_map = build_sql_config(batch) if mode == "sql" else build_stream_config(batch, seq, tiny)
+    if mode == "sql":
+        cfg_map = build_sql_config(batch)
+    elif mode == "latency":
+        cfg_map = build_latency_config(seq, tiny)
+    else:
+        cfg_map = build_stream_config(batch, seq, tiny)
     cfg = StreamConfig.from_mapping(cfg_map)
     print("bench: building model...", file=sys.stderr, flush=True)
     stream = build_stream(cfg, name="bench")
@@ -199,7 +244,44 @@ def main() -> None:
     seconds = float(os.environ.get("BENCH_SECONDS", "15"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     seq = int(os.environ.get("BENCH_SEQ", "32"))
+
+    # phase 1: latency mode (bounded rate, micro-batching) — own JSON line
+    lat_detail = {}
+    if os.environ.get("BENCH_SKIP_LATENCY", "0") != "1":
+        lat_seconds = float(os.environ.get("BENCH_LAT_SECONDS", "10"))
+        lat = asyncio.run(run_bench(lat_seconds, 8, seq, tiny, mode="latency"))
+        lat_detail = {"latency_p50_ms": round(lat["p50_ms"], 2),
+                      "latency_p99_ms": round(lat["p99_ms"], 2)}
+        print(
+            json.dumps(
+                {
+                    "metric": "bert_e2e_latency_p99_ms"
+                    + ("" if not tiny else "_cpu"),
+                    "value": round(lat["p99_ms"], 2),
+                    "unit": "ms",
+                    # target: p99 < 50ms (BASELINE.json); >1.0 beats it
+                    "vs_baseline": round(50.0 / lat["p99_ms"], 4) if lat["p99_ms"] > 0 else 0.0,
+                    "detail": {
+                        "p50_ms": round(lat["p50_ms"], 2),
+                        "p99_ms": round(lat["p99_ms"], 2),
+                        "offered_rows_per_sec": 1600,
+                        "achieved_rows_per_sec": round(lat["rows_per_sec"], 1),
+                        "buffer_timeout_ms": 10,
+                        "seq": seq,
+                    },
+                }
+            ),
+            flush=True,
+        )
+
+    # phase 2: saturated throughput — the headline metric, printed LAST so
+    # last-JSON-line parsers pick it up (latency numbers ride in detail too).
+    # duty cycle is the phase-2 DELTA (the latency phase idles on purpose)
+    busy0, stall0 = _busy_stall_from_registry()
     res = asyncio.run(run_bench(seconds, batch, seq, tiny))
+    busy1, stall1 = _busy_stall_from_registry()
+    d_busy, d_stall = busy1 - busy0, stall1 - stall0
+    duty = round(d_busy / (d_busy + d_stall), 4) if (d_busy + d_stall) > 0 else 0.0
     baseline = 100_000.0  # BASELINE.json north-star rows/sec/chip
     print(
         json.dumps(
@@ -217,10 +299,26 @@ def main() -> None:
                     "elapsed_s": round(res["elapsed_s"], 2),
                     "batch": batch,
                     "seq": seq,
+                    "device_duty_cycle": duty,
+                    **lat_detail,
                 },
             }
         )
     )
+
+
+def _busy_stall_from_registry() -> tuple[float, float]:
+    """(busy_s, stall_s) totals across all runners this process ran."""
+    from arkflow_tpu.obs import global_registry
+
+    busy = stall = 0.0
+    for m in global_registry().collect():
+        name = getattr(m, "name", "")
+        if name == "arkflow_tpu_device_busy_seconds_total":
+            busy += m.value
+        elif name == "arkflow_tpu_infeed_stall_seconds_total":
+            stall += m.value
+    return busy, stall
 
 
 if __name__ == "__main__":

@@ -287,3 +287,32 @@ def test_e2e_tensor_parallel_serving_through_stream():
     asyncio.run(stream.run(asyncio.Event()))
     labels = [v for b in sink.batches for v in b.column("label").to_pylist()]
     assert len(labels) == 6 and all(l in (0, 1) for l in labels)
+
+
+def test_async_infer_pipelines_and_tracks_duty_cycle():
+    """Concurrent infer() calls keep up to max_in_flight device steps queued;
+    busy/stall accounting yields a duty-cycle in (0, 1]."""
+    import asyncio
+
+    from arkflow_tpu.tpu.runner import ModelRunner
+    from arkflow_tpu.tpu.bucketing import BucketPolicy
+
+    runner = ModelRunner(
+        "bert_classifier", TINY_BERT,
+        buckets=BucketPolicy(batch_buckets=[4], seq_buckets=[16]),
+    )
+    runner.warmup()
+
+    async def go():
+        ids = np.ones((4, 16), np.int32)
+        mask = np.ones((4, 16), np.int32)
+        outs = await asyncio.gather(*[
+            runner.infer({"input_ids": ids, "attention_mask": mask})
+            for _ in range(6)
+        ])
+        assert all(o["label"].shape == (4,) for o in outs)
+
+    asyncio.run(go())
+    assert runner.m_busy_s.value > 0
+    assert 0.0 < runner.duty_cycle() <= 1.0
+    assert runner.m_inflight.value == 0  # all steps drained
