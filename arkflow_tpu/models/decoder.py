@@ -166,6 +166,17 @@ def _moe_mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
     return out.reshape(b, s, d).astype(dtype), (lb, z)
 
 
+def _mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
+    """Dense SwiGLU or Switch MoE, depending on cfg (aux stats dropped) —
+    the shared MLP for the incremental-decode paths, where the aux loss is
+    irrelevant."""
+    if cfg.num_experts > 1:
+        out, _aux = _moe_mlp(lp, y, cfg)
+        return out
+    gate = jax.nn.silu(cm.dense(lp["w_gate"], y).astype(jnp.float32)).astype(y.dtype)
+    return cm.dense(lp["w_down"], gate * cm.dense(lp["w_up"], y))
+
+
 def _shard_act(x, axes):
     """Constrain [B, S, ...] activations to (dp, sp) when a mesh is active."""
     if not axes:
@@ -390,8 +401,6 @@ def prefill(params: dict, cfg: DecoderConfig, input_ids, cache: dict,
     condition only on real tokens. The cache write cursor lands at T;
     continuing from a non-empty cache is not supported (cursor must be 0).
     """
-    if cfg.num_experts > 1:
-        raise ValueError("incremental decoding does not support MoE layers yet")
     b, t = input_ids.shape
     dh = cfg.dim // cfg.heads
     group = cfg.heads // cfg.kv_heads
@@ -422,8 +431,7 @@ def prefill(params: dict, cfg: DecoderConfig, input_ids, cache: dict,
         attn = cm.attention(q, kk, vv, mask).reshape(b, t, cfg.heads * dh)
         x = x + cm.dense(lp["wo"], attn)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
-        gate = jax.nn.silu(cm.dense(lp["w_gate"], y).astype(jnp.float32)).astype(y.dtype)
-        x = x + cm.dense(lp["w_down"], gate * cm.dense(lp["w_up"], y))
+        x = x + _mlp(lp, y, cfg)
         return (x, li + 1), (k_cache, v_cache)
 
     (x, _), (ks, vs) = jax.lax.scan(layer, (x, 0), params["layers"])
@@ -448,8 +456,6 @@ def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict) -> tup
     Jittable with a static cache size; the python generation loop lives in
     the summarization processor.
     """
-    if cfg.num_experts > 1:
-        raise ValueError("incremental decoding does not support MoE layers yet")
     b = token_ids.shape[0]
     dh = cfg.dim // cfg.heads
     group = cfg.heads // cfg.kv_heads
@@ -488,8 +494,7 @@ def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict) -> tup
         attn = cm.attention(q, kk, vv, valid).reshape(b, 1, cfg.heads * dh)
         x = x + cm.dense(lp["wo"], attn)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
-        gate = jax.nn.silu(cm.dense(lp["w_gate"], y).astype(jnp.float32)).astype(y.dtype)
-        x = x + cm.dense(lp["w_down"], gate * cm.dense(lp["w_up"], y))
+        x = x + _mlp(lp, y, cfg)
         return (x, li + 1), (k_cache, v_cache)
 
     (x, _), (ks, vs) = jax.lax.scan(layer, (x, 0), params["layers"])
@@ -516,8 +521,6 @@ def generate(params: dict, cfg: DecoderConfig, input_ids, lengths,
     Returns (tokens [B, max_new_tokens] int32 zero-padded after EOS,
     counts [B] of real tokens per row).
     """
-    if cfg.num_experts > 1:
-        raise ValueError("incremental decoding does not support MoE layers yet")
     b, t = input_ids.shape
     cache = init_kv_cache(cfg, b, t + max_new_tokens)
     nxt, cache = prefill(params, cfg, input_ids, cache, lengths=lengths)

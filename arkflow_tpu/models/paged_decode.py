@@ -1,0 +1,154 @@
+"""Paged KV-cache decode for the decoder LM (vLLM-style, TPU-native).
+
+The contiguous cache in ``decoder.py`` preallocates ``[B, max_len]`` per
+sequence; mixed-length workloads waste most of it. Here KV lives in a pool
+of fixed-size pages — ``[layers, num_pages, page, kv_heads, dh]`` — and each
+serving slot owns an int32 page table. Pages are allocated/freed by the
+host-side scheduler (``arkflow_tpu.tpu.serving``) BETWEEN steps; device code
+only ever reads/writes through static-shaped gathers and scatters, so every
+step jits once and replays (no dynamic shapes, XLA-friendly).
+
+Page 0 is a reserved scratch page: inactive slots and masked prompt padding
+write there, which keeps the scatter free of conditionals.
+
+The reference has no serving layer at all (its python processor is
+user-code); this implements the engine the `tpu_generate` processor's
+continuous-batching mode runs on. Design follows the public PagedAttention
+idea (Kwon et al., SOSP'23) re-expressed for XLA: page-table gather +
+masked attention instead of custom CUDA paging.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from arkflow_tpu.models import common as cm
+from arkflow_tpu.models.decoder import DecoderConfig, _mlp, _rope
+
+
+def init_page_pool(cfg: DecoderConfig, num_pages: int, page_size: int):
+    """KV page pools: [layers, num_pages, page, kv_heads, dh] bf16."""
+    dh = cfg.dim // cfg.heads
+    shape = (cfg.layers, num_pages, page_size, cfg.kv_heads, dh)
+    return jnp.zeros(shape, jnp.bfloat16), jnp.zeros(shape, jnp.bfloat16)
+
+
+def paged_prefill(params: dict, cfg: DecoderConfig, input_ids, lengths,
+                  page_table, k_pages, v_pages):
+    """Prefill prompts and scatter their K/V into pages.
+
+    input_ids: [B, T] right-padded; lengths: [B]; page_table: [B, P].
+    Returns (next_ids [B], k_pages, v_pages) — pools updated for all
+    positions < lengths (padding scatters to scratch page 0).
+    """
+    b, t = input_ids.shape
+    page = k_pages.shape[2]
+    dh = cfg.dim // cfg.heads
+    group = cfg.heads // cfg.kv_heads
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    causal = jnp.tril(jnp.ones((t, t), bool))[None, None]
+    key_valid = (jnp.arange(t)[None, :] < lengths[:, None])[:, None, None, :]
+    mask = jnp.logical_and(causal, key_valid)
+    x = cm.embedding(params["embed"], input_ids)
+
+    # scatter coordinates for every (row, position): valid positions route
+    # through the page table, padding goes to scratch page 0
+    pos_valid = positions < lengths[:, None]                     # [B, T]
+    logical_page = positions // page                             # [B, T]
+    page_idx = jnp.where(
+        pos_valid,
+        jnp.take_along_axis(page_table, logical_page, axis=1),
+        0,
+    )                                                            # [B, T]
+    offset = jnp.where(pos_valid, positions % page, 0)           # [B, T]
+
+    def layer(carry, lp_and_pools):
+        x, = carry
+        lp, kp, vp = lp_and_pools
+        y = cm.rms_norm(lp["attn_norm"], x, cfg.norm_eps)
+        q = cm.dense(lp["wq"], y).reshape(b, t, cfg.heads, dh)
+        k = cm.dense(lp["wk"], y).reshape(b, t, cfg.kv_heads, dh)
+        v = cm.dense(lp["wv"], y).reshape(b, t, cfg.kv_heads, dh)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        kp = kp.at[page_idx, offset].set(k.astype(jnp.bfloat16))
+        vp = vp.at[page_idx, offset].set(v.astype(jnp.bfloat16))
+        kk = jnp.repeat(k, group, axis=2)
+        vv = jnp.repeat(v, group, axis=2)
+        attn = cm.attention(q, kk, vv, mask).reshape(b, t, cfg.heads * dh)
+        x = x + cm.dense(lp["wo"], attn)
+        y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
+        x = x + _mlp(lp, y, cfg)
+        return (x,), (kp, vp)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        layer, (x,), (params["layers"], k_pages, v_pages))
+    x = cm.rms_norm(params["norm_out"], x, cfg.norm_eps)
+    logits = cm.dense(params["lm_head"], x).astype(jnp.float32)
+    last = jnp.clip(lengths - 1, 0, t - 1)
+    last_logits = jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0, :]
+    next_ids = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    return next_ids, new_k, new_v
+
+
+def paged_decode_step(params: dict, cfg: DecoderConfig, token_ids, lengths,
+                      active, page_table, k_pages, v_pages):
+    """One decode step over all serving slots.
+
+    token_ids: [S] current token per slot; lengths: [S] tokens already in
+    cache (the new token writes at position lengths[s]); active: [S] bool;
+    page_table: [S, P]. Returns (next_ids [S], k_pages, v_pages).
+
+    Attention gathers each slot's pages — [S, P*page] context — and masks
+    positions >= lengths+1, so scratch-page garbage never contributes.
+    """
+    s = token_ids.shape[0]
+    p_slots = page_table.shape[1]
+    page = k_pages.shape[2]
+    ctx = p_slots * page
+    dh = cfg.dim // cfg.heads
+    group = cfg.heads // cfg.kv_heads
+
+    positions = lengths[:, None]                                  # [S, 1]
+    x = cm.embedding(params["embed"], token_ids[:, None])         # [S, 1, D]
+
+    write_logical = lengths // page
+    write_page = jnp.where(
+        active,
+        jnp.take_along_axis(page_table, write_logical[:, None], axis=1)[:, 0],
+        0,
+    )                                                             # [S]
+    write_off = jnp.where(active, lengths % page, 0)              # [S]
+    # keys valid after the write: positions 0..lengths (inclusive)
+    key_pos = jnp.arange(ctx)[None, :]                            # [1, ctx]
+    valid = (key_pos <= lengths[:, None])[:, None, None, :]       # [S,1,1,ctx]
+
+    def layer(carry, lp_and_pools):
+        x, = carry
+        lp, kp, vp = lp_and_pools
+        y = cm.rms_norm(lp["attn_norm"], x, cfg.norm_eps)
+        q = cm.dense(lp["wq"], y).reshape(s, 1, cfg.heads, dh)
+        k = cm.dense(lp["wk"], y).reshape(s, 1, cfg.kv_heads, dh)
+        v = cm.dense(lp["wv"], y).reshape(s, 1, cfg.kv_heads, dh)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        kp = kp.at[write_page, write_off].set(k[:, 0].astype(jnp.bfloat16))
+        vp = vp.at[write_page, write_off].set(v[:, 0].astype(jnp.bfloat16))
+        # gather each slot's context from the pool: [S, P, page, kh, dh]
+        kk = kp[page_table].reshape(s, ctx, cfg.kv_heads, dh).astype(x.dtype)
+        vv = vp[page_table].reshape(s, ctx, cfg.kv_heads, dh).astype(x.dtype)
+        kk = jnp.repeat(kk, group, axis=2)
+        vv = jnp.repeat(vv, group, axis=2)
+        attn = cm.attention(q, kk, vv, valid).reshape(s, 1, cfg.heads * dh)
+        x = x + cm.dense(lp["wo"], attn)
+        y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
+        x = x + _mlp(lp, y, cfg)
+        return (x,), (kp, vp)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        layer, (x,), (params["layers"], k_pages, v_pages))
+    x = cm.rms_norm(params["norm_out"], x, cfg.norm_eps)
+    logits = cm.dense(params["lm_head"], x).astype(jnp.float32)
+    next_ids = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    return next_ids, new_k, new_v

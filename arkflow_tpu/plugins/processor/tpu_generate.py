@@ -45,7 +45,8 @@ from arkflow_tpu.tpu.tokenizer import build_tokenizer
 class TpuGenerateProcessor(Processor):
     def __init__(self, model: str, model_config: Optional[dict], *, text_field: str,
                  tokenizer, max_input: int, max_new_tokens: int, eos_id: int,
-                 output_field: str, buckets: BucketPolicy, seed: int = 0):
+                 output_field: str, buckets: BucketPolicy, seed: int = 0,
+                 serving: str = "batch", slots: int = 8, page_size: int = 16):
         import jax
 
         from arkflow_tpu.models import get_model
@@ -54,11 +55,6 @@ class TpuGenerateProcessor(Processor):
         if "generate" not in self.family.extras:
             raise ConfigError(f"model {model!r} does not support incremental decoding")
         self.cfg = self.family.make_config(**(model_config or {}))
-        if getattr(self.cfg, "num_experts", 0) > 1:
-            raise ConfigError(
-                "tpu_generate: MoE decoders (num_experts > 1) are not supported "
-                "for incremental decoding yet"
-            )
         self.text_field = text_field
         self.tokenizer = tokenizer
         self.max_input = max_input
@@ -87,6 +83,20 @@ class TpuGenerateProcessor(Processor):
                 max_new_tokens=self.max_new_tokens, eos_id=self.eos_id,
             )
         )
+
+        # continuous mode: paged-KV lockstep server (vLLM-style); requests
+        # from every stream worker share the slot grid, so long generations
+        # never hold short ones hostage (per-row completion, not per-batch)
+        self.serving = serving
+        self._server = None
+        if serving == "continuous":
+            from arkflow_tpu.tpu.serving import GenerationServer
+
+            self._server = GenerationServer(
+                self.params, self.cfg, slots=slots, page_size=page_size,
+                max_seq=self.max_input + self.max_new_tokens, eos_id=eos_id,
+                prompt_buckets=list(buckets.seq_buckets),
+            )
 
         reg = global_registry()
         self.m_tokens = reg.counter("arkflow_generated_tokens_total", "tokens generated",
@@ -117,6 +127,15 @@ class TpuGenerateProcessor(Processor):
         texts = batch.to_binary(self.text_field)
         ids, mask = self.tokenizer.encode_batch(texts, self.max_input)
         lengths = mask.sum(axis=1).astype(np.int32)
+        if self._server is not None:
+            outs = await asyncio.gather(*[
+                self._server.generate(ids[i, :lengths[i]].tolist(),
+                                      max_new_tokens=self.max_new_tokens)
+                for i in range(ids.shape[0])
+            ])
+            self.m_tokens.inc(sum(len(o) for o in outs))
+            texts_out = [self._detok(list(o)) for o in outs]
+            return [batch.with_column(self.output_field, pa.array(texts_out, pa.string()))]
         used = int(lengths.max()) if lengths.size else 1
         sb = self.buckets.seq_bucket(used)
         ids = ids[:, :sb]
@@ -151,4 +170,14 @@ def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
         output_field=str(config.get("output_field", "generated")),
         buckets=buckets,
         seed=int(config.get("seed", 0)),
+        serving=_serving_mode(config),
+        slots=int(config.get("slots", 8)),
+        page_size=int(config.get("page_size", 16)),
     )
+
+
+def _serving_mode(config: dict) -> str:
+    mode = str(config.get("serving", "batch"))
+    if mode not in ("batch", "continuous"):
+        raise ConfigError(f"tpu_generate serving must be batch|continuous, got {mode!r}")
+    return mode

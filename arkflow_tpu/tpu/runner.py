@@ -266,10 +266,13 @@ class ModelRunner:
         n_total = next(iter(inputs.values())).shape[0]
         mb = self.buckets.max_batch()
         if n_total > mb:
-            chunks = []
-            for i in range(0, n_total, mb):
-                chunks.append(await self.infer(
-                    {k: v[i:i + mb] for k, v in inputs.items()}))
+            # concurrent chunks: the in-flight semaphore bounds device queue
+            # depth, so chunk n+1 preps/dispatches while chunk n computes
+            # (serial awaits would idle the device between chunks)
+            chunks = await asyncio.gather(*[
+                self.infer({k: v[i:i + mb] for k, v in inputs.items()})
+                for i in range(0, n_total, mb)
+            ])
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
         padded, n = await loop.run_in_executor(None, self._prep, inputs)
         key = self._shape_key(padded)

@@ -1,0 +1,280 @@
+"""Continuous-batching generation server over the paged KV cache.
+
+The serving pattern the reference cannot express (its processors are
+stateless user code): a fixed grid of decode slots steps in lockstep under
+one jitted ``paged_decode_step``; requests are admitted into free slots the
+moment pages are available, finished sequences free their pages immediately,
+and new work rides along mid-flight — the device never waits for the
+longest sequence in a batch (continuous batching, as in vLLM/Orca).
+
+Split of responsibilities (TPU-first):
+- device: static-shaped jitted prefill/decode (models/paged_decode.py);
+  compiled once per (slot-count, page-table-width) + per prompt bucket.
+- host (this module): page allocation, slot bookkeeping, EOS/max-token
+  tracking, admission — cheap numpy/python between steps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.models.decoder import DecoderConfig
+from arkflow_tpu.models.paged_decode import (
+    init_page_pool,
+    paged_decode_step,
+    paged_prefill,
+)
+from arkflow_tpu.obs import global_registry
+
+logger = logging.getLogger("arkflow.serving")
+
+
+@dataclass
+class _Request:
+    prompt: list[int]
+    max_new_tokens: int
+    future: asyncio.Future
+    tokens: list[int] = field(default_factory=list)
+
+
+class GenerationServer:
+    """Greedy continuous-batching decode over ``slots`` lockstep lanes."""
+
+    def __init__(self, params, cfg: DecoderConfig, *, slots: int = 8,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 max_seq: int = 512, eos_id: int = 2,
+                 prompt_buckets: Optional[list[int]] = None):
+        if cfg.use_ring_attention:
+            raise ConfigError("paged serving does not support ring attention")
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.page_size = page_size
+        self.max_seq = max_seq
+        self.eos_id = eos_id
+        self.pages_per_slot = -(-max_seq // page_size)
+        # page 0 is scratch; default pool fits every slot at max_seq
+        self.num_pages = num_pages or (1 + self.slots * self.pages_per_slot)
+        if self.num_pages < 1 + self.pages_per_slot:
+            raise ConfigError(
+                f"num_pages={self.num_pages} cannot hold one sequence "
+                f"({self.pages_per_slot} pages + scratch)")
+        # always top out at max_seq so every admissible prompt has a bucket
+        # (generate() rejects prompts longer than max_seq up front)
+        self.prompt_buckets = sorted(
+            {b for b in (prompt_buckets or [32, 128]) if b <= max_seq} | {max_seq})
+        self.k_pages, self.v_pages = init_page_pool(cfg, self.num_pages, page_size)
+
+        # host-side state
+        self._free_pages: list[int] = list(range(1, self.num_pages))
+        self._slot_req: list[Optional[_Request]] = [None] * slots
+        self._slot_pages: list[list[int]] = [[] for _ in range(slots)]
+        self._lengths = np.zeros(slots, np.int32)
+        self._cur_tokens = np.zeros(slots, np.int32)
+        # plain deque: admission needs FIFO peek, which asyncio.Queue only
+        # offers via private internals
+        self._pending: deque[_Request] = deque()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+        self._decode = jax.jit(
+            lambda tok, lens, act, table, kp, vp: paged_decode_step(
+                self.params, cfg, tok, lens, act, table, kp, vp))
+        self._prefill = jax.jit(
+            lambda ids, lens, table, kp, vp: paged_prefill(
+                self.params, cfg, ids, lens, table, kp, vp))
+
+        reg = global_registry()
+        self.m_steps = reg.counter("arkflow_gen_decode_steps_total", "lockstep decode steps")
+        self.m_tokens = reg.counter("arkflow_gen_tokens_total", "tokens generated")
+        self.m_active = reg.gauge("arkflow_gen_active_slots", "busy decode slots")
+        self.m_waiting = reg.gauge("arkflow_gen_waiting_requests", "admission queue depth")
+
+    # -- public API --------------------------------------------------------
+
+    async def generate(self, prompt_ids: list[int],
+                       max_new_tokens: int = 64) -> list[int]:
+        """Submit one request; resolves with generated token ids (no EOS)."""
+        if self._closed:
+            raise ConfigError("generation server is closed")
+        if len(prompt_ids) == 0:
+            return []
+        if len(prompt_ids) + max_new_tokens > self.max_seq:
+            raise ConfigError(
+                f"prompt({len(prompt_ids)}) + max_new({max_new_tokens}) exceeds "
+                f"max_seq={self.max_seq}")
+        req = _Request(list(prompt_ids), max_new_tokens,
+                       asyncio.get_running_loop().create_future())
+        self._pending.append(req)
+        self.m_waiting.set(len(self._pending))
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.create_task(self._serve_loop())
+        return await req.future
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._loop_task is not None:
+            await self._loop_task
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def _table_array(self) -> jnp.ndarray:
+        table = np.zeros((self.slots, self.pages_per_slot), np.int32)
+        for s, pages in enumerate(self._slot_pages):
+            table[s, :len(pages)] = pages
+        return jnp.asarray(table)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        return self.prompt_buckets[-1]
+
+    async def _admit_one(self, slot: int, req: _Request) -> None:
+        """Allocate pages, prefill the prompt, seed the slot."""
+        # register FIRST: if anything below throws, the loop's crash handler
+        # fails this future instead of leaving its caller hanging
+        self._slot_req[slot] = req
+        n = len(req.prompt)
+        # pages for the whole prompt plus the next write position
+        need = self._pages_needed(n + 1)
+        pages = [self._free_pages.pop() for _ in range(need)]
+        self._slot_pages[slot] = pages
+        bucket = self._bucket(n)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = req.prompt
+        # single-row table padded to the slot width
+        table = np.zeros((1, self.pages_per_slot), np.int32)
+        table[0, :len(pages)] = pages
+        loop = asyncio.get_running_loop()
+        # off-loop: first call per bucket compiles (seconds on TPU)
+        nxt, self.k_pages, self.v_pages = await loop.run_in_executor(
+            None, lambda: jax.block_until_ready(self._prefill(
+                jnp.asarray(ids), jnp.asarray([n], jnp.int32), jnp.asarray(table),
+                self.k_pages, self.v_pages)))
+        self._lengths[slot] = n
+        self._cur_tokens[slot] = int(nxt[0])
+        self._handle_token(slot, int(nxt[0]))
+
+    def _handle_token(self, slot: int, token: int) -> None:
+        """Record one generated token; completes the request on EOS/limit."""
+        req = self._slot_req[slot]
+        if req is None:
+            return
+        if token == self.eos_id:
+            self._finish(slot)
+            return
+        req.tokens.append(token)
+        self.m_tokens.inc()
+        if len(req.tokens) >= req.max_new_tokens:
+            self._finish(slot)
+
+    def _finish(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        self._slot_req[slot] = None
+        self._free_pages.extend(self._slot_pages[slot])
+        self._slot_pages[slot] = []
+        self._lengths[slot] = 0
+        self._cur_tokens[slot] = 0
+        if req is not None and not req.future.done():
+            req.future.set_result(req.tokens)
+
+    def _ensure_page_capacity(self, slot: int) -> bool:
+        """Grow the slot's page list to cover position lengths[slot]."""
+        need = self._pages_needed(int(self._lengths[slot]) + 1)
+        while len(self._slot_pages[slot]) < need:
+            if not self._free_pages:
+                return False
+            self._slot_pages[slot].append(self._free_pages.pop())
+        return True
+
+    async def _serve_loop(self) -> None:
+        try:
+            while not self._closed:
+                admitted = await self._admit_pending()
+                active = [s for s in range(self.slots) if self._slot_req[s]]
+                self.m_active.set(len(active))
+                self.m_waiting.set(len(self._pending))
+                if not active:
+                    if not self._pending:
+                        return  # drained; next generate() restarts the loop
+                    if not admitted:
+                        await asyncio.sleep(0.01)  # waiting on pages
+                    continue
+                await self._step(active)
+            # closed with work in flight: fail it rather than hang awaiters
+            self._fail_all(ConfigError("generation server closed"))
+        except Exception as e:  # fail all in-flight requests, don't hang them
+            logger.exception("generation serve loop failed")
+            self._fail_all(e)
+
+    def _fail_all(self, err: Exception) -> None:
+        for s in range(self.slots):
+            req = self._slot_req[s]
+            if req is not None and not req.future.done():
+                req.future.set_exception(err)
+            self._slot_req[s] = None
+        while self._pending:
+            req = self._pending.popleft()
+            if not req.future.done():
+                req.future.set_exception(err)
+
+    async def _admit_pending(self) -> bool:
+        admitted = False
+        for slot in range(self.slots):
+            if self._slot_req[slot] is not None or not self._pending:
+                continue
+            req = self._pending[0]  # peek
+            if len(self._free_pages) < self._pages_needed(len(req.prompt) + 1):
+                break  # head-of-line waits for pages (FIFO fairness)
+            self._pending.popleft()
+            await self._admit_one(slot, req)
+            admitted = True
+        return admitted
+
+    async def _step(self, active: list[int]) -> None:
+        """One lockstep decode over all slots (inactive lanes masked)."""
+        act = np.zeros(self.slots, bool)
+        act[active] = True
+        # every active slot needs a page for its next write position; when
+        # the pool is dry, finish the longest active sequence (its tokens so
+        # far are its result) and RETRY, so the starved slot never scatters
+        # into the scratch page and silently corrupts its context
+        for s in active:
+            while act[s] and not self._ensure_page_capacity(s):
+                candidates = [i for i in range(self.slots)
+                              if act[i] and self._slot_req[i] is not None]
+                if not candidates:
+                    break
+                longest = max(candidates, key=lambda i: int(self._lengths[i]))
+                self._finish(longest)
+                act[longest] = False
+        loop = asyncio.get_running_loop()
+        cur = jnp.asarray(self._cur_tokens)
+        lens = jnp.asarray(self._lengths)
+        act_dev = jnp.asarray(act)
+        table = self._table_array()
+        # off-loop: one device-step of wall time (plus the first-call compile)
+        nxt, self.k_pages, self.v_pages = await loop.run_in_executor(
+            None, lambda: jax.block_until_ready(self._decode(
+                cur, lens, act_dev, table, self.k_pages, self.v_pages)))
+        self.m_steps.inc()
+        nxt_host = np.asarray(nxt)
+        for s in range(self.slots):
+            if not act[s] or self._slot_req[s] is None:
+                continue
+            self._lengths[s] += 1
+            self._cur_tokens[s] = nxt_host[s]
+            self._handle_token(s, int(nxt_host[s]))

@@ -189,6 +189,21 @@ def main() -> None:
     mode = os.environ.get("BENCH_MODE", "bert")
     from arkflow_tpu.utils.cleanenv import axon_hook_present, cpu_child_env
 
+    if mode == "generate":
+        if tiny or (axon_hook_present() and os.environ.get("JAX_PLATFORMS") != "cpu"
+                    and not _tpu_reachable()):
+            if os.environ.get("JAX_PLATFORMS") != "cpu":
+                env = cpu_child_env(n_devices=1)
+                env["BENCH_TINY"] = "1"
+                res = subprocess.run([sys.executable, __file__], env=env,
+                                     capture_output=True)
+                sys.stdout.write(res.stdout.decode())
+                sys.stderr.write(res.stderr.decode())
+                sys.exit(res.returncode)
+            _run_generate_bench(tiny=True)
+            return
+        _run_generate_bench(tiny=False)
+        return
     if mode == "sql":
         # pure-CPU anchor. The axon sitecustomize makes even jax.devices("cpu")
         # init the TPU tunnel, so re-exec in a clean env first.
@@ -305,6 +320,52 @@ def main() -> None:
             }
         )
     )
+
+
+def _run_generate_bench(tiny: bool) -> None:
+    """BENCH_MODE=generate: continuous-batching generation throughput
+    (tokens/sec) through the tpu_generate processor's paged-KV server."""
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
+
+    ensure_plugins_loaded()
+    model_config = (
+        {"vocab_size": 512, "dim": 64, "layers": 2, "heads": 4, "kv_heads": 2,
+         "ffn": 96, "max_seq": 256}
+        if tiny else {"max_seq": 2048}
+    )
+    max_new = int(os.environ.get("BENCH_GEN_TOKENS", "32"))
+    rows = int(os.environ.get("BENCH_GEN_ROWS", "64"))
+    proc = build_component(
+        "processor",
+        {"type": "tpu_generate", "model": "decoder_lm", "model_config": model_config,
+         "serving": "continuous", "slots": 8, "page_size": 16,
+         "max_input": 64, "max_new_tokens": max_new, "eos_id": -1,
+         "batch_buckets": [8], "seq_buckets": [64]},
+        Resource(),
+    )
+
+    async def go() -> tuple[float, float]:
+        batch = MessageBatch.new_binary(
+            [f"sensor event {i} nominal reading".encode() for i in range(rows)])
+        t_warm = time.perf_counter()
+        await proc.process(MessageBatch.new_binary([b"warmup prompt"]))
+        warm_s = time.perf_counter() - t_warm
+        t0 = time.perf_counter()
+        await proc.process(batch)
+        return time.perf_counter() - t0, warm_s
+
+    elapsed, warm_s = asyncio.run(go())
+    total_tokens = rows * max_new
+    print(json.dumps({
+        "metric": "decoder_generate_tokens_per_sec" + ("_cpu" if tiny else ""),
+        "value": round(total_tokens / elapsed, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # no reference number exists (ref has no LLM serving)
+        "detail": {"rows": rows, "max_new_tokens": max_new,
+                   "elapsed_s": round(elapsed, 2), "warmup_s": round(warm_s, 2),
+                   "serving": "continuous", "slots": 8},
+    }))
 
 
 def _busy_stall_from_registry() -> tuple[float, float]:
