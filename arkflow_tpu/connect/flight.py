@@ -1,0 +1,332 @@
+"""Remote scan/query execution over Arrow IPC — the Ballista-analog tier.
+
+The reference lets file/DB scans execute on a remote DataFusion cluster
+via Ballista (Arrow Flight under the hood; ref input/file.rs:396-397,
+input/sql.rs:313-315: ``SessionContext::remote(url)``). This module is the
+same capability re-built on the engine's own pieces: a worker process runs
+the scan + SQL where the data lives and streams Arrow record batches back;
+only filtered/projected results cross the network.
+
+Wire protocol (``arkflow://host:port``):
+
+- request:  [u32 len][JSON] — {"action": "scan", "path": ..., "format": ...,
+            "query": "SELECT ... FROM flow", "batch_rows": N}
+            or {"action": "query", "sql": ..., "tables": {name: <ipc bytes b64>}}
+- response: [u32 len][JSON status] — {"ok": true} | {"ok": false, "error": ...}
+            then, when ok, a sequence of tagged frames
+            [u32 len][tag u8][payload]: tag 0x00 = Arrow IPC stream chunk
+            (schema + one batch, self-contained), tag 0x01 = mid-stream
+            error JSON; a zero-length frame ends the stream. Tagging means
+            an error after streaming began is still unambiguous, and the
+            worker never buffers the whole result.
+
+Run a worker with ``python -m arkflow_tpu --worker --port 50051``; point a
+file/sql input at it with ``remote_url: arkflow://host:50051``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import struct
+from typing import AsyncIterator, Optional
+
+import pyarrow as pa
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.errors import ConfigError, ConnectError, ReadError
+
+logger = logging.getLogger("arkflow.flight")
+
+
+def batch_to_ipc(rb: pa.RecordBatch) -> bytes:
+    """One record batch as a self-contained IPC stream."""
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return sink.getvalue().to_pybytes()
+
+
+def ipc_to_batches(data: bytes) -> list[pa.RecordBatch]:
+    with pa.ipc.open_stream(pa.BufferReader(data)) as r:
+        return list(r)
+
+
+async def _send_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(struct.pack(">I", len(payload)) + payload)
+    await writer.drain()
+
+
+DATA_TAG = b"\x00"
+ERROR_TAG = b"\x01"
+
+
+async def _send_data(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    await _send_frame(writer, DATA_TAG + payload)
+
+
+async def _send_stream_error(writer: asyncio.StreamWriter, err: str) -> None:
+    await _send_frame(writer, ERROR_TAG + json.dumps({"error": err}).encode())
+
+
+async def _end_stream(writer: asyncio.StreamWriter) -> None:
+    writer.write(struct.pack(">I", 0))
+    await writer.drain()
+
+
+async def _read_frame(reader: asyncio.StreamReader,
+                      limit: int = 1 << 30) -> Optional[bytes]:
+    hdr = await reader.readexactly(4)
+    (n,) = struct.unpack(">I", hdr)
+    if n == 0:
+        return None
+    if n > limit:
+        raise ReadError(f"flight frame of {n} bytes exceeds limit")
+    return await reader.readexactly(n)
+
+
+def parse_remote_url(url: str) -> tuple[str, int]:
+    if not url.startswith("arkflow://"):
+        raise ConfigError(f"remote_url must be arkflow://host:port (got {url!r})")
+    rest = url[len("arkflow://"):]
+    host, _, port = rest.partition(":")
+    if not host or not port:
+        raise ConfigError(f"remote_url must be arkflow://host:port (got {url!r})")
+    return host, int(port)
+
+
+class FlightWorker:
+    """The remote executor: scans files / runs SQL next to the data."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 50051,
+                 allow_paths: Optional[list[str]] = None):
+        self.host = host
+        self.port = port
+        #: optional allowlist of path prefixes workers may scan
+        self.allow_paths = allow_paths
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("flight worker listening on %s:%d", self.host, self.port)
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 1.0)
+            except asyncio.TimeoutError:
+                pass
+
+    def _check_path(self, path: str) -> None:
+        if self.allow_paths is None:
+            return
+        from pathlib import Path
+
+        resolved = Path(path).resolve()
+        # component-wise containment: /database must NOT match --allow-path /data
+        ok = any(resolved.is_relative_to(Path(p).resolve()) for p in self.allow_paths)
+        if not ok:
+            raise ConfigError(f"path {path!r} outside worker allow_paths")
+
+    async def _serve(self, reader, writer) -> None:
+        try:
+            raw = await _read_frame(reader)
+            req = json.loads(raw.decode())
+            action = req.get("action")
+            if action == "scan":
+                await self._do_scan(req, writer)
+            elif action == "query":
+                await self._do_query(req, writer)
+            elif action == "sqlite":
+                await self._do_sqlite(req, writer)
+            else:
+                await _send_frame(writer, json.dumps(
+                    {"ok": False, "error": f"unknown action {action!r}"}).encode())
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception as e:
+            try:
+                if getattr(writer, "_arkflow_streaming", False):
+                    await _send_stream_error(writer, repr(e)[:500])
+                    await _end_stream(writer)
+                else:
+                    await _send_frame(writer, json.dumps(
+                        {"ok": False, "error": repr(e)[:500]}).encode())
+            except Exception:
+                pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _do_scan(self, req: dict, writer) -> None:
+        """Scan a file local to the worker, optionally SQL-filter, stream."""
+        from pathlib import Path
+
+        from arkflow_tpu.plugins.input.file import _infer_format, _scan
+        from arkflow_tpu.sql import SessionContext
+
+        path = req.get("path")
+        if not path:
+            raise ConfigError("scan needs 'path'")
+        self._check_path(path)
+        p = Path(path)
+        if not p.exists():
+            raise ConfigError(f"worker: {path} does not exist")
+        fmt = req.get("format") or _infer_format(p)
+        query = req.get("query")
+        batch_rows = int(req.get("batch_rows", 8192))
+        await _send_frame(writer, json.dumps({"ok": True}).encode())
+        writer._arkflow_streaming = True
+        loop = asyncio.get_running_loop()
+        it = _scan(p, fmt, batch_rows)
+        while True:
+            rb = await loop.run_in_executor(None, lambda: next(it, None))
+            if rb is None:
+                break
+            if query:
+                def _filter(rb=rb):
+                    ctx = SessionContext()
+                    ctx.register_batch("flow", MessageBatch(rb))
+                    return ctx.sql(query)
+                out = await loop.run_in_executor(None, _filter)
+                if out.num_rows == 0:
+                    continue
+                rb = out.record_batch
+            await _send_data(writer, batch_to_ipc(rb))
+        await _end_stream(writer)
+
+    async def _do_sqlite(self, req: dict, writer) -> None:
+        """Run a sqlite query against a database file local to the worker."""
+        import sqlite3
+
+        path, query = req.get("path"), req.get("query")
+        if not path or not query:
+            raise ConfigError("sqlite action needs 'path' and 'query'")
+        self._check_path(path)
+        batch_rows = int(req.get("batch_rows", 8192))
+        # check_same_thread=False: fetchmany runs in executor threads; access
+        # is serialized by the per-connection handler
+        conn = sqlite3.connect(path, check_same_thread=False)
+        try:
+            cur = conn.execute(query)
+            names = [d[0] for d in cur.description or []]
+            await _send_frame(writer, json.dumps({"ok": True}).encode())
+            writer._arkflow_streaming = True
+            loop = asyncio.get_running_loop()
+            while True:
+                rows = await loop.run_in_executor(None, cur.fetchmany, batch_rows)
+                if not rows:
+                    break
+                cols = list(zip(*rows))
+                rb = pa.RecordBatch.from_arrays(
+                    [pa.array(list(c)) for c in cols], names=names)
+                await _send_data(writer, batch_to_ipc(rb))
+            await _end_stream(writer)
+        finally:
+            conn.close()
+
+    async def _do_query(self, req: dict, writer) -> None:
+        """Run SQL over client-shipped tables (distributed join/shuffle leg)."""
+        from arkflow_tpu.sql import SessionContext
+
+        sql = req.get("sql")
+        if not sql:
+            raise ConfigError("query needs 'sql'")
+        ctx = SessionContext()
+        for name, b64 in (req.get("tables") or {}).items():
+            batches = ipc_to_batches(base64.b64decode(b64))
+            if batches:
+                tbl = pa.Table.from_batches(batches)
+                ctx.register_batch(
+                    name, MessageBatch(tbl.combine_chunks().to_batches()[0]))
+        # heavy joins must not stall other connections on this worker
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: ctx.sql(sql))
+        await _send_frame(writer, json.dumps({"ok": True}).encode())
+        writer._arkflow_streaming = True
+        if out.num_rows > 0:
+            await _send_data(writer, batch_to_ipc(out.record_batch))
+        await _end_stream(writer)
+
+
+class FlightClient:
+    """Client for a FlightWorker: remote scans stream back as batches."""
+
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.host, self.port = parse_remote_url(url)
+        self.timeout = timeout
+
+    async def _open(self, request: dict):
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(self.host, self.port), self.timeout)
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ConnectError(
+                f"flight worker {self.host}:{self.port} unreachable: {e}") from e
+        await _send_frame(writer, json.dumps(request).encode())
+        status_raw = await asyncio.wait_for(_read_frame(reader), self.timeout)
+        status = json.loads(status_raw.decode())
+        if not status.get("ok"):
+            writer.close()
+            raise ReadError(f"flight worker error: {status.get('error')}")
+        return reader, writer
+
+    async def _stream(self, reader, writer) -> AsyncIterator[pa.RecordBatch]:
+        try:
+            while True:
+                frame = await asyncio.wait_for(_read_frame(reader), self.timeout)
+                if frame is None:
+                    return
+                tag, payload = frame[:1], frame[1:]
+                if tag == ERROR_TAG:
+                    err = json.loads(payload.decode()).get("error")
+                    raise ReadError(f"flight worker stream error: {err}")
+                for rb in ipc_to_batches(payload):
+                    yield rb
+        finally:
+            writer.close()
+
+    async def scan(self, path: str, *, fmt: Optional[str] = None,
+                   query: Optional[str] = None,
+                   batch_rows: int = 8192) -> AsyncIterator[pa.RecordBatch]:
+        """Remote scan; yields record batches as they arrive."""
+        reader, writer = await self._open({
+            "action": "scan", "path": path, "format": fmt,
+            "query": query, "batch_rows": batch_rows,
+        })
+        async for rb in self._stream(reader, writer):
+            yield rb
+
+    async def sqlite(self, path: str, query: str,
+                     batch_rows: int = 8192) -> AsyncIterator[pa.RecordBatch]:
+        """Remote sqlite query; yields record batches as they arrive."""
+        reader, writer = await self._open({
+            "action": "sqlite", "path": path, "query": query,
+            "batch_rows": batch_rows,
+        })
+        async for rb in self._stream(reader, writer):
+            yield rb
+
+    async def query(self, sql: str,
+                    tables: Optional[dict[str, MessageBatch]] = None) -> MessageBatch:
+        """Ship small tables to the worker, run SQL there, get the result."""
+        enc = {
+            name: base64.b64encode(batch_to_ipc(b.record_batch)).decode()
+            for name, b in (tables or {}).items()
+        }
+        reader, writer = await self._open(
+            {"action": "query", "sql": sql, "tables": enc})
+        batches = [rb async for rb in self._stream(reader, writer)]
+        return MessageBatch(batches[0]) if batches else MessageBatch.empty()

@@ -113,7 +113,8 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
     return out.astype(x.dtype)
 
 
-def _moe_mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
+def _moe_mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig,
+             token_mask=None) -> jnp.ndarray:
     """Switch-style top-1 MoE SwiGLU with capacity-based dispatch/combine.
 
     Each token routes to its top expert; tokens queue into per-expert capacity
@@ -122,6 +123,11 @@ def _moe_mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
     ``tokens * capacity_factor`` regardless of expert count, and GSPMD shards
     the E dim over the "ep" mesh axis (param specs) — the dispatch/combine
     einsums become the all-to-all.
+
+    ``token_mask`` ([B, S] bool/int) excludes tokens (right padding, inactive
+    serving lanes) from routing entirely: they consume NO expert capacity and
+    produce zero MLP output — otherwise one row's padding could evict another
+    row's real tokens from a full expert queue.
     """
     import math
 
@@ -138,6 +144,8 @@ def _moe_mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
     top = jnp.argmax(probs, axis=-1)  # [T]
     weight = jnp.take_along_axis(probs, top[:, None], axis=-1)[:, 0]  # [T]
     expert_onehot = jax.nn.one_hot(top, e, dtype=jnp.float32)  # [T, E]
+    if token_mask is not None:
+        expert_onehot = expert_onehot * token_mask.reshape(tokens, 1).astype(jnp.float32)
     # position of each token in its expert's queue: the routed column holds
     # position+1, others 0; sum over E then subtract 1
     pos_plus1 = (jnp.cumsum(expert_onehot, axis=0) * expert_onehot).sum(axis=-1)
@@ -166,12 +174,12 @@ def _moe_mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
     return out.reshape(b, s, d).astype(dtype), (lb, z)
 
 
-def _mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig) -> jnp.ndarray:
+def _mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig, token_mask=None) -> jnp.ndarray:
     """Dense SwiGLU or Switch MoE, depending on cfg (aux stats dropped) —
     the shared MLP for the incremental-decode paths, where the aux loss is
     irrelevant."""
     if cfg.num_experts > 1:
-        out, _aux = _moe_mlp(lp, y, cfg)
+        out, _aux = _moe_mlp(lp, y, cfg, token_mask=token_mask)
         return out
     gate = jax.nn.silu(cm.dense(lp["w_gate"], y).astype(jnp.float32)).astype(y.dtype)
     return cm.dense(lp["w_down"], gate * cm.dense(lp["w_up"], y))
@@ -410,6 +418,7 @@ def prefill(params: dict, cfg: DecoderConfig, input_ids, cache: dict,
     causal = jnp.tril(jnp.ones((t, t), bool))[None, None]
     key_valid = (jnp.arange(t)[None, :] < lengths[:, None])[:, None, None, :]  # [B,1,1,T]
     mask = jnp.logical_and(causal, key_valid)
+    token_mask = jnp.arange(t)[None, :] < lengths[:, None]  # [B, T] real tokens
     x = cm.embedding(params["embed"], input_ids)
 
     def layer(carry, lp):
@@ -431,7 +440,7 @@ def prefill(params: dict, cfg: DecoderConfig, input_ids, cache: dict,
         attn = cm.attention(q, kk, vv, mask).reshape(b, t, cfg.heads * dh)
         x = x + cm.dense(lp["wo"], attn)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
-        x = x + _mlp(lp, y, cfg)
+        x = x + _mlp(lp, y, cfg, token_mask=token_mask)
         return (x, li + 1), (k_cache, v_cache)
 
     (x, _), (ks, vs) = jax.lax.scan(layer, (x, 0), params["layers"])

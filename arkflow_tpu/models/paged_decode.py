@@ -79,7 +79,7 @@ def paged_prefill(params: dict, cfg: DecoderConfig, input_ids, lengths,
         attn = cm.attention(q, kk, vv, mask).reshape(b, t, cfg.heads * dh)
         x = x + cm.dense(lp["wo"], attn)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
-        x = x + _mlp(lp, y, cfg)
+        x = x + _mlp(lp, y, cfg, token_mask=pos_valid)
         return (x,), (kp, vp)
 
     (x,), (new_k, new_v) = jax.lax.scan(
@@ -143,7 +143,8 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, token_ids, lengths,
         attn = cm.attention(q, kk, vv, valid).reshape(s, 1, cfg.heads * dh)
         x = x + cm.dense(lp["wo"], attn)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
-        x = x + _mlp(lp, y, cfg)
+        # inactive lanes must not consume expert capacity (MoE)
+        x = x + _mlp(lp, y, cfg, token_mask=active[:, None])
         return (x,), (kp, vp)
 
     (x,), (new_k, new_v) = jax.lax.scan(

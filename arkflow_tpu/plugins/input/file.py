@@ -84,18 +84,43 @@ def _scan(path: Path, fmt: str, batch_rows: int) -> Iterator[pa.RecordBatch]:
 
 class FileInput(Input):
     def __init__(self, paths: list[Path], fmt: Optional[str], query: Optional[str],
-                 batch_rows: int):
+                 batch_rows: int, remote_url: Optional[str] = None):
         self.paths = paths
         self.fmt = fmt
         self.query = query
         self.batch_rows = batch_rows
+        #: arkflow://host:port — scan executes on a remote flight worker
+        #: (the reference's Ballista remote-context slot, input/file.rs:396)
+        self.remote_url = remote_url
+        if remote_url is not None:
+            from arkflow_tpu.connect.flight import parse_remote_url
+
+            parse_remote_url(remote_url)  # fail fast at build
         self._iter: Optional[Iterator[pa.RecordBatch]] = None
+        self._remote_gen = None
 
     async def connect(self) -> None:
+        if self.remote_url is not None:
+            from arkflow_tpu.connect.flight import FlightClient
+
+            client = FlightClient(self.remote_url)
+            self._remote_gen = self._remote_scan_all(client)
+            return
         for p in self.paths:
             if not p.exists():
                 raise ConfigError(f"file input: {p} does not exist")
         self._iter = self._scan_all()
+
+    async def _remote_scan_all(self, client):
+        for p in self.paths:
+            async for rb in client.scan(str(p), fmt=self.fmt, query=self.query,
+                                        batch_rows=self.batch_rows):
+                yield rb
+
+    async def close(self) -> None:
+        if self._remote_gen is not None:
+            await self._remote_gen.aclose()  # closes the socket; frees the worker
+            self._remote_gen = None
 
     def _scan_all(self) -> Iterator[pa.RecordBatch]:
         for p in self.paths:
@@ -103,6 +128,13 @@ class FileInput(Input):
             yield from _scan(p, fmt, self.batch_rows)
 
     async def read(self) -> tuple[MessageBatch, Ack]:
+        if self._remote_gen is not None:
+            try:
+                rb = await self._remote_gen.__anext__()
+            except StopAsyncIteration:
+                raise EndOfInput() from None
+            # the worker already applied the SQL filter remotely
+            return MessageBatch(rb).with_source("file").with_ingest_time(), NoopAck()
         if self._iter is None:
             raise ReadError("file input not connected")
         while True:  # loop (not recurse) past fully-filtered chunks
@@ -131,4 +163,5 @@ def _build(config: dict, resource: Resource) -> FileInput:
         fmt=config.get("format"),
         query=config.get("query"),
         batch_rows=int(config.get("batch_rows", DEFAULT_RECORD_BATCH_ROWS)),
+        remote_url=config.get("remote_url"),
     )

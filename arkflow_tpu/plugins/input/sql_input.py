@@ -111,9 +111,54 @@ class PostgresInput(Input):
         self._rows = None
 
 
+class RemoteSqliteInput(Input):
+    """sqlite query executed on a remote flight worker (the reference's
+    Ballista remote-context slot for DB scans, ref input/sql.rs:313-315)."""
+
+    def __init__(self, remote_url: str, path: str, query: str, batch_rows: int):
+        from arkflow_tpu.connect.flight import parse_remote_url
+
+        parse_remote_url(remote_url)  # fail fast at build
+        self.remote_url = remote_url
+        self.path = path
+        self.query = query
+        self.batch_rows = batch_rows
+        self._gen = None
+
+    async def connect(self) -> None:
+        from arkflow_tpu.connect.flight import FlightClient
+
+        self._gen = FlightClient(self.remote_url).sqlite(
+            self.path, self.query, batch_rows=self.batch_rows)
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        if self._gen is None:
+            raise ReadError("sql input not connected")
+        try:
+            rb = await self._gen.__anext__()
+        except StopAsyncIteration:
+            raise EndOfInput() from None
+        return MessageBatch(rb).with_source("sql").with_ingest_time(), NoopAck()
+
+    async def close(self) -> None:
+        if self._gen is not None:
+            await self._gen.aclose()  # closes the socket; frees the worker
+            self._gen = None
+
+
 @register_input("sql")
 def _build(config: dict, resource: Resource) -> Input:
     driver = str(config.get("driver", "sqlite")).lower()
+    if config.get("remote_url"):
+        if driver != "sqlite":
+            raise ConfigError(
+                "sql input remote_url currently supports the sqlite driver "
+                "(postgres already executes on its own server)")
+        if not config.get("path") or not config.get("query"):
+            raise ConfigError("remote sql input requires 'path' and 'query'")
+        return RemoteSqliteInput(
+            str(config["remote_url"]), str(config["path"]), str(config["query"]),
+            int(config.get("batch_rows", DEFAULT_RECORD_BATCH_ROWS)))
     if driver in _GATED_DRIVERS:
         raise ConfigError(
             f"sql input driver {driver!r} requires a client library not present in "

@@ -62,11 +62,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="arkflow-tpu", description="TPU-native streaming dataflow engine"
     )
-    parser.add_argument("-c", "--config", required=True, help="path to YAML/JSON/TOML config")
+    parser.add_argument("-c", "--config", help="path to YAML/JSON/TOML config")
     parser.add_argument(
         "-v", "--validate", action="store_true", help="validate the config and exit"
     )
+    parser.add_argument(
+        "--worker", action="store_true",
+        help="run a remote-execution flight worker instead of an engine "
+             "(the distributed scan/SQL tier; see connect/flight.py)")
+    parser.add_argument(
+        "--host", default="127.0.0.1",
+        help="worker bind host (default loopback; binding wider exposes "
+             "file reads — pair with --allow-path)")
+    parser.add_argument("--port", type=int, default=50051, help="worker port")
+    parser.add_argument(
+        "--allow-path", action="append", default=None,
+        help="restrict worker scans to these path prefixes (repeatable)")
     args = parser.parse_args(argv)
+
+    if args.worker:
+        from arkflow_tpu.connect.flight import FlightWorker
+
+        init_logging(LoggingConfig())
+        if args.host not in ("127.0.0.1", "localhost") and not args.allow_path:
+            print("refusing to bind a worker beyond loopback without "
+                  "--allow-path (it would serve arbitrary readable files)",
+                  file=sys.stderr)
+            return 2
+        worker = FlightWorker(args.host, args.port, allow_paths=args.allow_path)
+        try:
+            asyncio.run(worker.serve_forever())
+        except KeyboardInterrupt:
+            pass
+        return 0
+    if not args.config:
+        parser.error("--config is required (or use --worker)")
 
     try:
         cfg = EngineConfig.from_file(args.config)

@@ -86,18 +86,25 @@ class GenerationServer:
         self._loop_task: Optional[asyncio.Task] = None
         self._closed = False
 
+        # donate the KV pools: they are pure in->out state, so XLA updates
+        # them in place instead of copying hundreds of MB per decode step
         self._decode = jax.jit(
             lambda tok, lens, act, table, kp, vp: paged_decode_step(
-                self.params, cfg, tok, lens, act, table, kp, vp))
+                self.params, cfg, tok, lens, act, table, kp, vp),
+            donate_argnums=(4, 5))
         self._prefill = jax.jit(
             lambda ids, lens, table, kp, vp: paged_prefill(
-                self.params, cfg, ids, lens, table, kp, vp))
+                self.params, cfg, ids, lens, table, kp, vp),
+            donate_argnums=(3, 4))
 
         reg = global_registry()
         self.m_steps = reg.counter("arkflow_gen_decode_steps_total", "lockstep decode steps")
         self.m_tokens = reg.counter("arkflow_gen_tokens_total", "tokens generated")
         self.m_active = reg.gauge("arkflow_gen_active_slots", "busy decode slots")
         self.m_waiting = reg.gauge("arkflow_gen_waiting_requests", "admission queue depth")
+        self.m_truncated = reg.counter(
+            "arkflow_gen_truncated_total",
+            "requests cut short by page-pool exhaustion (pool undersized)")
 
     # -- public API --------------------------------------------------------
 
@@ -259,6 +266,14 @@ class GenerationServer:
                 if not candidates:
                     break
                 longest = max(candidates, key=lambda i: int(self._lengths[i]))
+                req = self._slot_req[longest]
+                logger.warning(
+                    "page pool exhausted: truncating slot %d at %d tokens "
+                    "(%d/%d generated) — size num_pages for the workload",
+                    longest, int(self._lengths[longest]),
+                    len(req.tokens) if req else 0,
+                    req.max_new_tokens if req else 0)
+                self.m_truncated.inc()
                 self._finish(longest)
                 act[longest] = False
         loop = asyncio.get_running_loop()
