@@ -92,9 +92,13 @@ def parse_remote_url(url: str) -> tuple[str, int]:
         raise ConfigError(f"remote_url must be arkflow://host:port (got {url!r})")
     rest = url[len("arkflow://"):]
     host, _, port = rest.partition(":")
-    if not host or not port:
+    try:
+        port_n = int(port)
+    except ValueError:
+        port_n = 0
+    if not host or not 0 < port_n < 65536:
         raise ConfigError(f"remote_url must be arkflow://host:port (got {url!r})")
-    return host, int(port)
+    return host, port_n
 
 
 class FlightWorker:
@@ -225,6 +229,8 @@ class FlightWorker:
             await _send_frame(writer, json.dumps({"ok": True}).encode())
             writer._arkflow_streaming = True
             loop = asyncio.get_running_loop()
+            schema: Optional[pa.Schema] = None
+            held: list[pa.RecordBatch] = []  # buffered until types resolve
             while True:
                 rows = await loop.run_in_executor(None, cur.fetchmany, batch_rows)
                 if not rows:
@@ -232,7 +238,22 @@ class FlightWorker:
                 cols = list(zip(*rows))
                 rb = pa.RecordBatch.from_arrays(
                     [pa.array(list(c)) for c in cols], names=names)
-                await _send_data(writer, batch_to_ipc(rb))
+                if schema is None:
+                    if any(pa.types.is_null(f.type) for f in rb.schema) and len(held) < 64:
+                        # a leading all-NULL column would freeze as null-typed
+                        # and clash with later chunks; hold until types appear
+                        held.append(rb)
+                        continue
+                    # stragglers that never resolve (64-chunk cap) become string
+                    schema = _merge_null_types(held + [rb], default=pa.string())
+                    for h in held:
+                        await _send_data(writer, batch_to_ipc(h.cast(schema)))
+                    held = []
+                await _send_data(writer, batch_to_ipc(rb.cast(schema)))
+            if held:  # whole result was null-typed (or tiny): default to string
+                schema = _merge_null_types(held, default=pa.string())
+                for h in held:
+                    await _send_data(writer, batch_to_ipc(h.cast(schema)))
             await _end_stream(writer)
         finally:
             conn.close()
@@ -261,6 +282,21 @@ class FlightWorker:
         await _end_stream(writer)
 
 
+def _merge_null_types(batches: list[pa.RecordBatch],
+                      default: Optional[pa.DataType] = None) -> pa.Schema:
+    """One schema across chunks: null-typed columns adopt the first real
+    type seen in any chunk (or ``default`` when none ever appears)."""
+    fields: list[pa.Field] = list(batches[0].schema)
+    for rb in batches[1:]:
+        for i, f in enumerate(rb.schema):
+            if pa.types.is_null(fields[i].type) and not pa.types.is_null(f.type):
+                fields[i] = f
+    if default is not None:
+        fields = [pa.field(f.name, default) if pa.types.is_null(f.type) else f
+                  for f in fields]
+    return pa.schema(fields)
+
+
 class FlightClient:
     """Client for a FlightWorker: remote scans stream back as batches."""
 
@@ -275,12 +311,17 @@ class FlightClient:
         except (OSError, asyncio.TimeoutError) as e:
             raise ConnectError(
                 f"flight worker {self.host}:{self.port} unreachable: {e}") from e
-        await _send_frame(writer, json.dumps(request).encode())
-        status_raw = await asyncio.wait_for(_read_frame(reader), self.timeout)
-        status = json.loads(status_raw.decode())
-        if not status.get("ok"):
-            writer.close()
-            raise ReadError(f"flight worker error: {status.get('error')}")
+        try:
+            await _send_frame(writer, json.dumps(request).encode())
+            status_raw = await asyncio.wait_for(_read_frame(reader), self.timeout)
+            if status_raw is None:
+                raise ReadError("flight worker closed the stream before a status")
+            status = json.loads(status_raw.decode())
+            if not status.get("ok"):
+                raise ReadError(f"flight worker error: {status.get('error')}")
+        except BaseException:
+            writer.close()  # a failed handshake must not leak the socket
+            raise
         return reader, writer
 
     async def _stream(self, reader, writer) -> AsyncIterator[pa.RecordBatch]:

@@ -1,18 +1,24 @@
-"""File input: scan CSV / JSON / Parquet / Arrow-IPC, optionally SQL-filtered.
+"""File input: scan CSV / JSON / Parquet / Arrow-IPC / Avro, locally or from
+object stores, optionally SQL-filtered.
 
 Mirrors the reference's DataFusion file input (ref:
-crates/arkflow-plugin/src/input/file.rs:66-80): format by config or extension,
-streamed as record batches, optional SQL over the scanned table (the
-``SELECT ... FROM flow`` contract), EOF at end. Object stores (s3/gcs/...)
-are gated: pyarrow's fs handles local paths in this image.
+crates/arkflow-plugin/src/input/file.rs:66-150): format by config or
+extension, streamed as record batches, optional SQL over the scanned table
+(the ``SELECT ... FROM flow`` contract), EOF at end. Object-store URIs
+(``s3://``, ``gs://``, ``hdfs://``, ``abfs://``) resolve through
+pyarrow.fs; Avro decodes via the in-repo Object Container File reader
+(utils/avro.py).
 
 Config:
 
     type: file
-    path: data/events.parquet      # or a list of paths
+    path: s3://bucket/events.parquet   # local path, list, or object-store URI
     format: parquet                # optional; inferred from extension
     query: "SELECT * FROM flow WHERE x > 1"   # optional
     batch_rows: 8192
+    # object-store options (s3):
+    # fs: {endpoint_override: "http://minio:9000", access_key: ..,
+    #      secret_key: .., anonymous: true, region: us-east-1}
 """
 
 from __future__ import annotations
@@ -27,7 +33,8 @@ from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
 from arkflow_tpu.errors import ConfigError, EndOfInput, ReadError
 from arkflow_tpu.sql import SessionContext
 
-_FORMATS = {"csv", "json", "parquet", "arrow", "ipc", "feather"}
+_FORMATS = {"csv", "json", "parquet", "arrow", "ipc", "feather", "avro"}
+_STORE_SCHEMES = ("s3://", "gs://", "gcs://", "hdfs://", "abfs://", "abfss://")
 
 
 def _infer_format(path: Path) -> str:
@@ -41,6 +48,101 @@ def _infer_format(path: Path) -> str:
     if ext in _FORMATS:
         return ext
     raise ConfigError(f"cannot infer format from {path.name!r}; set 'format'")
+
+
+def is_store_uri(path: str) -> bool:
+    return str(path).startswith(_STORE_SCHEMES)
+
+
+def open_store(path: str, fs_config: Optional[dict] = None):
+    """Resolve an object-store URI -> (pyarrow FileSystem, in-store path).
+
+    The explicit ``fs:`` options cover the reference's per-store configs
+    (ref input/file.rs:89-150: endpoints, keys, anonymous access); without
+    them, pyarrow's environment defaults apply (AWS_* vars etc.).
+    """
+    from pyarrow import fs as pafs
+
+    cfg = dict(fs_config or {})
+    if str(path).startswith("s3://") and cfg:
+        kwargs = {}
+        for src, dst in (("endpoint_override", "endpoint_override"),
+                         ("access_key", "access_key"),
+                         ("secret_key", "secret_key"),
+                         ("region", "region"),
+                         ("anonymous", "anonymous"),
+                         ("scheme", "scheme")):
+            if src in cfg:
+                kwargs[dst] = cfg[src]
+        if "secret_key" in kwargs:
+            from arkflow_tpu.utils.auth import resolve_secret
+
+            kwargs["secret_key"] = resolve_secret(str(kwargs["secret_key"]))
+        filesystem = pafs.S3FileSystem(**kwargs)
+        return filesystem, str(path)[len("s3://"):]
+    try:
+        return pafs.FileSystem.from_uri(str(path))
+    except (pa.ArrowInvalid, OSError) as e:
+        raise ConfigError(f"cannot open object store path {path!r}: {e}") from e
+
+
+def _scan_avro(source, batch_rows: int) -> Iterator[pa.RecordBatch]:
+    from arkflow_tpu.utils.avro import read_container, records_to_batch
+
+    schema, records = read_container(source)
+    rows: list[dict] = []
+    for rec in records:
+        rows.append(rec)
+        if len(rows) >= batch_rows:
+            # schema-driven types: an all-null chunk of a nullable column
+            # must not emit a null-typed batch that clashes downstream
+            yield records_to_batch(schema, rows)
+            rows = []
+    if rows:
+        yield records_to_batch(schema, rows)
+
+
+def _scan_store(uri: str, fmt: str, batch_rows: int,
+                fs_config: Optional[dict]) -> Iterator[pa.RecordBatch]:
+    """Scan one object-store file, streaming batches."""
+    filesystem, inner = open_store(uri, fs_config)
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        with filesystem.open_input_file(inner) as f:
+            yield from pq.ParquetFile(f).iter_batches(batch_size=batch_rows)
+        return
+    if fmt in ("arrow", "ipc", "feather"):
+        import pyarrow.ipc as ipc
+
+        # file format (ARROW1 footer, what feather writes) needs random
+        # access; fall back to stream format like the local path does
+        with filesystem.open_input_file(inner) as f:
+            try:
+                reader = ipc.open_file(f)
+                for i in range(reader.num_record_batches):
+                    yield reader.get_batch(i)
+                return
+            except pa.ArrowInvalid:
+                f.seek(0)
+                yield from ipc.open_stream(f)
+                return
+    with filesystem.open_input_stream(inner) as f:
+        if fmt == "avro":
+            yield from _scan_avro(f, batch_rows)
+        elif fmt == "csv":
+            import pyarrow.csv as pacsv
+
+            for batch in pacsv.open_csv(f):
+                for chunk in MessageBatch(batch).split(batch_rows):
+                    yield chunk.record_batch
+        elif fmt == "json":
+            import pyarrow.json as pajson
+
+            table = pajson.read_json(f)
+            yield from table.to_batches(max_chunksize=batch_rows)
+        else:
+            raise ConfigError(f"unsupported object-store format {fmt!r}")
 
 
 def _scan(path: Path, fmt: str, batch_rows: int) -> Iterator[pa.RecordBatch]:
@@ -65,6 +167,10 @@ def _scan(path: Path, fmt: str, batch_rows: int) -> Iterator[pa.RecordBatch]:
         for batch in table.to_batches(max_chunksize=batch_rows):
             yield batch
         return
+    if fmt == "avro":
+        with open(path, "rb") as f:
+            yield from _scan_avro(f, batch_rows)
+        return
     if fmt in ("arrow", "ipc", "feather"):
         import pyarrow.ipc as ipc
 
@@ -83,12 +189,15 @@ def _scan(path: Path, fmt: str, batch_rows: int) -> Iterator[pa.RecordBatch]:
 
 
 class FileInput(Input):
-    def __init__(self, paths: list[Path], fmt: Optional[str], query: Optional[str],
-                 batch_rows: int, remote_url: Optional[str] = None):
+    def __init__(self, paths: list, fmt: Optional[str], query: Optional[str],
+                 batch_rows: int, remote_url: Optional[str] = None,
+                 fs_config: Optional[dict] = None):
+        #: mixed list of local paths and object-store URIs
         self.paths = paths
         self.fmt = fmt
         self.query = query
         self.batch_rows = batch_rows
+        self.fs_config = fs_config
         #: arkflow://host:port — scan executes on a remote flight worker
         #: (the reference's Ballista remote-context slot, input/file.rs:396)
         self.remote_url = remote_url
@@ -107,7 +216,7 @@ class FileInput(Input):
             self._remote_gen = self._remote_scan_all(client)
             return
         for p in self.paths:
-            if not p.exists():
+            if not is_store_uri(str(p)) and not Path(p).exists():
                 raise ConfigError(f"file input: {p} does not exist")
         self._iter = self._scan_all()
 
@@ -124,8 +233,12 @@ class FileInput(Input):
 
     def _scan_all(self) -> Iterator[pa.RecordBatch]:
         for p in self.paths:
-            fmt = self.fmt or _infer_format(p)
-            yield from _scan(p, fmt, self.batch_rows)
+            if is_store_uri(str(p)):
+                fmt = self.fmt or _infer_format(Path(str(p).split("://", 1)[1]))
+                yield from _scan_store(str(p), fmt, self.batch_rows, self.fs_config)
+            else:
+                fmt = self.fmt or _infer_format(Path(p))
+                yield from _scan(Path(p), fmt, self.batch_rows)
 
     async def read(self) -> tuple[MessageBatch, Ack]:
         if self._remote_gen is not None:
@@ -137,11 +250,15 @@ class FileInput(Input):
             return MessageBatch(rb).with_source("file").with_ingest_time(), NoopAck()
         if self._iter is None:
             raise ReadError("file input not connected")
+        import asyncio
+
+        loop = asyncio.get_running_loop()
         while True:  # loop (not recurse) past fully-filtered chunks
-            try:
-                rb = next(self._iter)
-            except StopIteration:
-                raise EndOfInput() from None
+            # off-loop: object-store scans do blocking network range-reads,
+            # local scans block on disk — neither may stall the event loop
+            rb = await loop.run_in_executor(None, lambda: next(self._iter, None))
+            if rb is None:
+                raise EndOfInput()
             batch = MessageBatch(rb)
             if self.query:
                 ctx = SessionContext()
@@ -157,11 +274,12 @@ def _build(config: dict, resource: Resource) -> FileInput:
     raw = config.get("path")
     if not raw:
         raise ConfigError("file input requires 'path'")
-    paths = [Path(p) for p in (raw if isinstance(raw, list) else [raw])]
+    paths = [str(p) for p in (raw if isinstance(raw, list) else [raw])]
     return FileInput(
         paths=paths,
         fmt=config.get("format"),
         query=config.get("query"),
         batch_rows=int(config.get("batch_rows", DEFAULT_RECORD_BATCH_ROWS)),
         remote_url=config.get("remote_url"),
+        fs_config=config.get("fs"),
     )

@@ -1,0 +1,234 @@
+"""Avro decode + object-store file input tests.
+
+The S3 path is hermetic: pyarrow's S3FileSystem points its
+endpoint_override at an in-process HTTP server implementing the tiny
+GET/HEAD (+Range) subset the AWS SDK needs for reads.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
+from arkflow_tpu.errors import CodecError, ConfigError, EndOfInput
+from arkflow_tpu.utils.avro import read_container, write_container
+
+ensure_plugins_loaded()
+
+EVENT_SCHEMA = {
+    "type": "record", "name": "Event", "fields": [
+        {"name": "id", "type": "long"},
+        {"name": "name", "type": "string"},
+        {"name": "temp", "type": ["null", "double"]},
+        {"name": "ok", "type": "boolean"},
+    ],
+}
+
+
+def _events(n):
+    return [{"id": i, "name": f"n{i}", "temp": None if i % 3 == 0 else i * 0.5,
+             "ok": i % 2 == 0} for i in range(n)]
+
+
+def test_avro_roundtrip_codecs_and_blocks():
+    recs = _events(2500)
+    for codec in ("null", "deflate"):
+        buf = io.BytesIO()
+        write_container(buf, EVENT_SCHEMA, recs, codec=codec, block_records=512)
+        buf.seek(0)
+        schema, it = read_container(buf)
+        assert list(it) == recs
+        assert schema["name"] == "Event"
+    with pytest.raises(CodecError, match="magic"):
+        read_container(io.BytesIO(b"not avro data"))
+
+
+def test_avro_complex_types():
+    schema = {"type": "record", "name": "C", "fields": [
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+        {"name": "attrs", "type": {"type": "map", "values": "long"}},
+        {"name": "color", "type": {"type": "enum", "name": "Color",
+                                   "symbols": ["RED", "GREEN"]}},
+        {"name": "raw", "type": "bytes"},
+    ]}
+    recs = [{"tags": ["a", "b"], "attrs": {"x": 1, "y": 2}, "color": "GREEN",
+             "raw": b"\x01\x02"},
+            {"tags": [], "attrs": {}, "color": "RED", "raw": b""}]
+    buf = io.BytesIO()
+    write_container(buf, schema, recs)
+    buf.seek(0)
+    _, it = read_container(buf)
+    assert list(it) == recs
+
+
+def test_file_input_avro(tmp_path):
+    f = tmp_path / "events.avro"
+    with open(f, "wb") as fh:
+        write_container(fh, EVENT_SCHEMA, _events(300), codec="deflate")
+
+    async def go():
+        inp = build_component(
+            "input",
+            {"type": "file", "path": str(f), "batch_rows": 128,
+             "query": "SELECT id, name FROM flow WHERE ok"},
+            Resource(),
+        )
+        await inp.connect()
+        ids = []
+        try:
+            while True:
+                batch, _ = await inp.read()
+                ids += batch.column("id").to_pylist()
+        except EndOfInput:
+            pass
+        assert ids == [i for i in range(300) if i % 2 == 0]
+
+    asyncio.run(go())
+
+
+class _S3Handler(BaseHTTPRequestHandler):
+    """GET/HEAD with Range — the read subset pyarrow's S3 client uses."""
+
+    objects: dict[str, bytes] = {}
+
+    def _object(self):
+        return self.objects.get(self.path.lstrip("/"))
+
+    def do_HEAD(self):
+        body = self._object()
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.send_header("ETag", '"fake"')
+        self.send_header("Last-Modified", "Wed, 01 Jan 2025 00:00:00 GMT")
+        self.send_header("Content-Type", "binary/octet-stream")
+        self.end_headers()
+
+    def do_GET(self):
+        body = self._object()
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            lo, _, hi = rng[len("bytes="):].partition("-")
+            lo = int(lo or 0)
+            hi = min(int(hi) if hi else len(body) - 1, len(body) - 1)
+            part = body[lo:hi + 1]
+            self.send_response(206)
+            self.send_header("Content-Range", f"bytes {lo}-{hi}/{len(body)}")
+        else:
+            part = body
+            self.send_response(200)
+        self.send_header("Content-Length", str(len(part)))
+        self.send_header("Accept-Ranges", "bytes")
+        self.send_header("ETag", '"fake"')
+        self.send_header("Content-Type", "binary/octet-stream")
+        self.end_headers()
+        self.wfile.write(part)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def fake_s3():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _S3Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    _S3Handler.objects.clear()
+
+
+def test_file_input_s3_parquet(fake_s3):
+    tbl = pa.table({"id": list(range(200)), "v": [i * 1.5 for i in range(200)]})
+    sink = pa.BufferOutputStream()
+    pq.write_table(tbl, sink)
+    _S3Handler.objects["bucket/events.parquet"] = sink.getvalue().to_pybytes()
+    port = fake_s3.server_address[1]
+
+    async def go():
+        inp = build_component(
+            "input",
+            {"type": "file", "path": "s3://bucket/events.parquet",
+             "fs": {"endpoint_override": f"http://127.0.0.1:{port}",
+                    "access_key": "test", "secret_key": "test",
+                    "region": "us-east-1", "scheme": "http"},
+             "query": "SELECT id FROM flow WHERE v > 250"},
+            Resource(),
+        )
+        await inp.connect()
+        ids = []
+        try:
+            while True:
+                batch, _ = await inp.read()
+                ids += batch.column("id").to_pylist()
+        except EndOfInput:
+            pass
+        assert ids == [i for i in range(200) if i * 1.5 > 250]
+
+    asyncio.run(go())
+
+
+def test_file_input_s3_avro(fake_s3):
+    buf = io.BytesIO()
+    write_container(buf, EVENT_SCHEMA, _events(50))
+    _S3Handler.objects["bucket/events.avro"] = buf.getvalue()
+    port = fake_s3.server_address[1]
+
+    async def go():
+        inp = build_component(
+            "input",
+            {"type": "file", "path": "s3://bucket/events.avro",
+             "fs": {"endpoint_override": f"http://127.0.0.1:{port}",
+                    "access_key": "test", "secret_key": "test",
+                    "region": "us-east-1", "scheme": "http"}},
+            Resource(),
+        )
+        await inp.connect()
+        batch, _ = await inp.read()
+        assert batch.num_rows == 50
+        assert batch.column("name").to_pylist()[:3] == ["n0", "n1", "n2"]
+
+    asyncio.run(go())
+
+
+def test_store_uri_validation():
+    from arkflow_tpu.plugins.input.file import is_store_uri
+
+    assert is_store_uri("s3://b/k") and is_store_uri("gs://b/k")
+    assert not is_store_uri("/local/path.parquet")
+
+
+def test_avro_all_null_chunk_keeps_declared_type(tmp_path):
+    """An all-null leading chunk of a nullable column must carry the
+    Avro-declared Arrow type, so batches concat cleanly."""
+    recs = ([{"id": i, "name": "x", "temp": None, "ok": True} for i in range(10)]
+            + [{"id": i, "name": "y", "temp": 1.5, "ok": False} for i in range(10)])
+    f = tmp_path / "n.avro"
+    with open(f, "wb") as fh:
+        write_container(fh, EVENT_SCHEMA, recs)
+
+    async def go():
+        inp = build_component(
+            "input", {"type": "file", "path": str(f), "batch_rows": 10}, Resource())
+        await inp.connect()
+        b1, _ = await inp.read()
+        b2, _ = await inp.read()
+        assert b1.record_batch.schema.field("temp").type == pa.float64()
+        pa.Table.from_batches([b1.record_batch, b2.record_batch])  # must not raise
+
+    asyncio.run(go())

@@ -181,3 +181,34 @@ def test_remote_config_validation():
         build_component("input", {"type": "sql", "driver": "postgres",
                                   "uri": "postgres://u@h/db", "query": "q",
                                   "remote_url": "arkflow://h:1"}, r)
+
+
+def test_remote_sqlite_null_leading_chunk_unifies_schema(tmp_path):
+    """Leading all-NULL sqlite chunks must not freeze a null-typed column."""
+    db = tmp_path / "n.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (id INTEGER, v REAL)")
+    conn.executemany("INSERT INTO t VALUES (?, ?)",
+                     [(i, None) for i in range(5)] + [(i, i * 0.5) for i in range(5, 10)])
+    conn.commit()
+    conn.close()
+
+    async def go():
+        worker = FlightWorker("127.0.0.1", 0)
+        await worker.start()
+        try:
+            client = FlightClient(f"arkflow://127.0.0.1:{worker.port}")
+            batches = [rb async for rb in client.sqlite(
+                str(db), "SELECT * FROM t ORDER BY id", batch_rows=5)]
+            pa.Table.from_batches(batches)  # consistent schema across chunks
+            assert batches[0].schema.field("v").type == pa.float64()
+        finally:
+            await worker.stop()
+
+    asyncio.run(go())
+
+
+def test_remote_url_validation_errors_are_config_errors():
+    for bad in ("arkflow://h:50051/", "arkflow://h:abc", "arkflow://h:0"):
+        with pytest.raises(ConfigError):
+            parse_remote_url(bad)

@@ -129,3 +129,18 @@ def test_stream_metrics_registered_per_stream():
     assert s.m_rows_in.value == 1
     assert s.m_rows_out.value == 1
     assert s.m_proc_latency.count >= 1
+
+
+def test_every_example_config_validates():
+    """All examples/*.yaml must parse AND resolve every component type
+    (the same check `--validate` runs), so docs never rot."""
+    from pathlib import Path
+
+    from arkflow_tpu.config import EngineConfig
+
+    examples = sorted((Path(__file__).parent.parent / "examples").glob("*.yaml"))
+    assert len(examples) >= 20
+    for path in examples:
+        cfg = EngineConfig.from_file(str(path))
+        problems = cfg.validate_components()
+        assert not problems, f"{path.name}: {problems}"
