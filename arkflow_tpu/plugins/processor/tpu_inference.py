@@ -180,6 +180,7 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         mesh_spec=mesh_spec,
         checkpoint=config.get("checkpoint"),
         seed=int(config.get("seed", 0)),
+        serving_dtype=config.get("serving_dtype"),
     )
     vocab = getattr(runner.cfg, "vocab_size", 30522)
     tokenizer = build_tokenizer(config.get("tokenizer"), vocab_size=vocab)

@@ -58,11 +58,16 @@ class ModelRunner:
         checkpoint: Optional[str] = None,
         seed: int = 0,
         devices=None,
+        serving_dtype: Optional[str] = None,
     ):
         self.family = get_model(model)
         self.cfg = self.family.make_config(**(model_config or {}))
         self.buckets = buckets or BucketPolicy()
         self.spec = self.family.input_spec(self.cfg)
+        if serving_dtype not in (None, "float32", "bfloat16", "float16"):
+            raise ConfigError(
+                f"serving_dtype {serving_dtype!r} invalid (float32/bfloat16/float16)")
+        self.serving_dtype = serving_dtype
 
         # init on host CPU (op-by-op init over a remote-TPU tunnel is pathological),
         # then transfer to the execution device(s) in one hop
@@ -74,6 +79,19 @@ class ModelRunner:
             params = self.family.init(jax.random.PRNGKey(seed), self.cfg)
         if checkpoint:
             params = self._restore(checkpoint, params)
+        if self.serving_dtype and self.serving_dtype != "float32":
+            # bf16 serving cast: halves param HBM + host->device transfer and
+            # keeps matmuls on the MXU's native dtype; logits/softmax layers
+            # still accumulate/cast to f32 inside the model
+            import jax.numpy as jnp
+
+            target = getattr(jnp, self.serving_dtype)
+            params = jax.tree_util.tree_map(
+                lambda a: a.astype(target)
+                if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+                else a,
+                params,
+            )
 
         self.mesh = None
         axes: dict[str, str] = {}

@@ -66,6 +66,8 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
                     "seq_buckets": [seq],
                     "outputs": ["label", "score"],
                     "warmup": True,
+                    # bf16 params on the chip: half the HBM + transfer, MXU-native
+                    "serving_dtype": "float32" if tiny else "bfloat16",
                 }
             ],
         },

@@ -316,3 +316,32 @@ def test_async_infer_pipelines_and_tracks_duty_cycle():
     assert runner.m_busy_s.value > 0
     assert 0.0 < runner.duty_cycle() <= 1.0
     assert runner.m_inflight.value == 0  # all steps drained
+
+
+def test_serving_dtype_bf16_cast():
+    """bf16 serving params halve memory and still classify stably."""
+    import jax
+    import jax.numpy as jnp
+
+    from arkflow_tpu.tpu.bucketing import BucketPolicy
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    f32 = ModelRunner("bert_classifier", TINY_BERT,
+                      buckets=BucketPolicy(batch_buckets=[4], seq_buckets=[16]))
+    bf16 = ModelRunner("bert_classifier", TINY_BERT,
+                       buckets=BucketPolicy(batch_buckets=[4], seq_buckets=[16]),
+                       serving_dtype="bfloat16")
+    leaves = jax.tree_util.tree_leaves(bf16.params)
+    assert all(leaf.dtype == jnp.bfloat16 for leaf in leaves
+               if jnp.issubdtype(leaf.dtype, jnp.floating))
+    ids = np.asarray(np.random.RandomState(0).randint(1, 100, (4, 16)), np.int32)
+    mask = np.ones((4, 16), np.int32)
+    a = f32.infer_sync({"input_ids": ids, "attention_mask": mask})
+    b = bf16.infer_sync({"input_ids": ids, "attention_mask": mask})
+    # bf16 logits wiggle but the argmax labels should agree on tiny shapes
+    assert (a["label"] == b["label"]).mean() >= 0.75
+    import pytest
+
+    from arkflow_tpu.errors import ConfigError
+    with pytest.raises(ConfigError):
+        ModelRunner("bert_classifier", TINY_BERT, serving_dtype="int8")
