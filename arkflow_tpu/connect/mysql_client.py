@@ -39,7 +39,8 @@ CLIENT_CONNECT_WITH_DB = 8
 # column types -> python converters (text protocol sends strings)
 _INT_TYPES = {0x01, 0x02, 0x03, 0x08, 0x09, 0x0D}   # tiny..longlong, year
 _FLOAT_TYPES = {0x04, 0x05, 0xF6, 0x00}             # float, double, newdecimal, decimal
-_TEXTBLOB_TYPES = {0xFB, 0xFC}                      # blob/text share codes (charset decides)
+_BLOB_TYPES = {0xF9, 0xFA, 0xFB, 0xFC, 0xFE}        # tiny/medium/long/blob/string share
+BINARY_CHARSET = 63                                  # charset 63 = binary data
 
 MAX_PACKET = 0xFFFFFF  # payloads split at 16MiB-1 per the protocol
 
@@ -112,18 +113,19 @@ def _enc_lenenc(data: bytes) -> bytes:
     return b"\xfd" + n.to_bytes(3, "little") + data
 
 
-def decode_text_value(raw: Optional[bytes], col_type: int) -> Any:
+def decode_text_value(raw: Optional[bytes], col_type: int,
+                      charset: int = 45) -> Any:
+    """Column values decode by (type, charset): blob/text share type codes,
+    and the column's charset (63 = binary) decides bytes-vs-str — so every
+    value in a column gets ONE python type (Arrow needs stable columns)."""
     if raw is None:
         return None
     if col_type in _INT_TYPES:
         return int(raw)
     if col_type in _FLOAT_TYPES:
         return float(raw)
-    if col_type in _TEXTBLOB_TYPES:
-        try:
-            return raw.decode()
-        except UnicodeDecodeError:
-            return raw
+    if col_type in _BLOB_TYPES and charset == BINARY_CHARSET:
+        return raw
     return raw.decode(errors="replace")
 
 
@@ -327,11 +329,11 @@ class MySqlClient:
                 return MyQueryResult([], [], [], affected)
             n_cols, _ = _lenenc_int(pkt, 0)
             columns: list[str] = []
-            types: list[int] = []
+            types: list[tuple[int, int]] = []  # (type code, charset)
             for _ in range(n_cols):
                 col = await self._recv()
                 columns.append(self._col_name(col))
-                types.append(self._col_type(col))
+                types.append(self._col_meta(col))
             pkt = await self._recv()
             if pkt[0] != 0xFE:  # EOF after definitions (classic protocol)
                 raise ReadError("mysql: expected EOF after column definitions")
@@ -344,9 +346,9 @@ class MySqlClient:
                     return MyQueryResult(columns, types, rows)
                 pos = 0
                 row: list[Any] = []
-                for t in types:
+                for t, cs in types:
                     raw, pos = _lenenc_str(pkt, pos)
-                    row.append(decode_text_value(raw, t))
+                    row.append(decode_text_value(raw, t, cs))
                 rows.append(row)
 
     @staticmethod
@@ -359,13 +361,15 @@ class MySqlClient:
         return (name or b"").decode(errors="replace")
 
     @staticmethod
-    def _col_type(pkt: bytes) -> int:
+    def _col_meta(pkt: bytes) -> tuple[int, int]:
+        """(type code, charset) from a ColumnDefinition41 packet."""
         pos = 0
         for _ in range(6):  # catalog..org_name
             s, pos = _lenenc_str(pkt, pos)
         n, pos = _lenenc_int(pkt, pos)  # fixed-fields length (0x0c)
+        charset = struct.unpack_from("<H", pkt, pos)[0]
         pos += 2 + 4  # charset + column length
-        return pkt[pos]
+        return pkt[pos], charset
 
     async def insert_rows(self, table: str, columns: list[str],
                           rows: list[list[Any]]) -> int:

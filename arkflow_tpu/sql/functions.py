@@ -13,6 +13,7 @@ thin wrappers over ``pyarrow.compute`` vectorized kernels.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import math
 import time
@@ -193,7 +194,144 @@ _BUILTINS: dict[str, ScalarFn] = {
     "json_get_int": lambda args, n: _json_get(args, n, extract=lambda v: int(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else None),
     "json_get_float": lambda args, n: _json_get(args, n, extract=lambda v: float(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else None),
     "json_get_bool": lambda args, n: _json_get(args, n, extract=lambda v: v if isinstance(v, bool) else None),
+    # VRL-style fallible parsers: failures become NULL, so the VRL idiom
+    # `to_int(.x) ?? 0` maps to `coalesce(parse_int(x), 0)` (see PARITY.md)
+    "parse_int": lambda args, n: _parse_int(args, n),
+    "parse_float": lambda args, n: _rowwise1(args, n, _to_float),
+    "parse_timestamp": lambda args, n: _parse_timestamp(args, n),
+    "format_timestamp": lambda args, n: _format_timestamp(args, n),
+    "regex_match": lambda args, n: _regex_match(args, n),
+    "regex_extract": lambda args, n: _regex_extract(args, n),
+    "parse_key_value": lambda args, n: _parse_key_value(args, n),
+    "parse_url": lambda args, n: _parse_url(args, n),
+    "md5": lambda args, n: _rowwise1(args, n, lambda v: hashlib.md5(str(v).encode()).hexdigest()),
+    "sha256": lambda args, n: _rowwise1(args, n, lambda v: hashlib.sha256(str(v).encode()).hexdigest()),
+    "to_string": lambda args, n: _rowwise1(args, n, str),
 }
+
+
+# -- VRL-style fallible parser implementations ------------------------------
+
+def _pylist(v, n):
+    arr = as_array(v, n)
+    return arr.to_pylist()
+
+
+def _rowwise1(args, n, fn):
+    out = []
+    for v in _pylist(args[0], n):
+        if v is None:
+            out.append(None)
+            continue
+        if isinstance(v, bytes):
+            v = v.decode(errors="replace")
+        try:
+            out.append(fn(v))
+        except Exception:
+            # the fallible-parser contract (PARITY.md): a bad row yields
+            # NULL, never aborts the batch (OverflowError from int(inf),
+            # OSError from out-of-range gmtime, IndexError from a missing
+            # regex group, ...)
+            out.append(None)
+    return pa.array(out)
+
+
+def _to_float(v):
+    return float(v)
+
+
+def _parse_int(args, n):
+    base = int(args[1]) if len(args) > 1 else 10
+
+    def conv(v):
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return int(v)
+        return int(str(v).strip(), base)
+
+    return _rowwise1(args, n, conv)
+
+
+def _parse_timestamp(args, n):
+    """parse_timestamp(x, fmt) -> epoch seconds (UTC) or NULL."""
+    import calendar
+    import time as _t
+
+    fmt = str(args[1]) if len(args) > 1 else "%Y-%m-%dT%H:%M:%S"
+
+    def conv(v):
+        return float(calendar.timegm(_t.strptime(str(v).strip(), fmt)))
+
+    return _rowwise1(args, n, conv)
+
+
+def _format_timestamp(args, n):
+    import time as _t
+
+    fmt = str(args[1]) if len(args) > 1 else "%Y-%m-%dT%H:%M:%S"
+    return _rowwise1(args, n, lambda v: _t.strftime(fmt, _t.gmtime(float(v))))
+
+
+_REGEX_CACHE: dict[str, Any] = {}
+
+
+def _compiled(pattern: str):
+    import re
+
+    rx = _REGEX_CACHE.get(pattern)
+    if rx is None:
+        rx = _REGEX_CACHE[pattern] = re.compile(pattern)
+    return rx
+
+
+def _regex_match(args, n):
+    rx = _compiled(str(args[1]))
+    return _rowwise1(args, n, lambda v: rx.search(str(v)) is not None)
+
+
+def _regex_extract(args, n):
+    """regex_extract(x, pattern [, group]) — group index or name; default 1
+    when the pattern has groups, else the whole match."""
+    rx = _compiled(str(args[1]))
+    group: Any = args[2] if len(args) > 2 else (1 if rx.groups else 0)
+    if isinstance(group, float):
+        group = int(group)
+
+    def conv(v):
+        m = rx.search(str(v))
+        return None if m is None else m.group(group)
+
+    return _rowwise1(args, n, conv)
+
+
+def _parse_key_value(args, n):
+    """parse_key_value(x, key [, pair_sep, kv_sep]) — logfmt-style lookup."""
+    key = str(args[1])
+    pair_sep = str(args[2]) if len(args) > 2 else " "
+    kv_sep = str(args[3]) if len(args) > 3 else "="
+
+    def conv(v):
+        for pair in str(v).split(pair_sep):
+            k, sep, val = pair.partition(kv_sep)
+            if sep and k.strip() == key:
+                return val.strip().strip('"')
+        return None
+
+    return _rowwise1(args, n, conv)
+
+
+def _parse_url(args, n):
+    from urllib.parse import urlparse
+
+    part = str(args[1]) if len(args) > 1 else "host"
+
+    def conv(v):
+        u = urlparse(str(v))
+        val = {"scheme": u.scheme, "host": u.hostname, "port": u.port,
+               "path": u.path, "query": u.query, "fragment": u.fragment,
+               "username": u.username}.get(part)
+        return None if val in (None, "") else val
+
+    return _rowwise1(args, n, conv)
 
 
 #: Aggregates the native GROUP BY planner maps onto pyarrow hash kernels.

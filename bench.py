@@ -25,7 +25,7 @@ def build_sql_config(batch: int) -> dict:
         "name": "bench-sql",
         "input": {"type": "generate", "payload": payload, "interval": 0, "batch_size": batch},
         "pipeline": {
-            "thread_num": 4,
+            "thread_num": int(os.environ.get("BENCH_SQL_WORKERS", "4")),
             "processors": [
                 {"type": "json_to_arrow"},
                 {"type": "sql",

@@ -168,11 +168,11 @@ class FakeMySql:
         columns, types, rows = table
         seq = 1
         self._send(writer, seq, bytes([len(columns)]))
-        for name, t in zip(columns, types):
+        for name, (t, cs) in zip(columns, types):
             coldef = (_lenenc(b"def") + _lenenc(b"db") + _lenenc(b"t")
                       + _lenenc(b"t") + _lenenc(name.encode())
                       + _lenenc(name.encode()) + bytes([0x0C])
-                      + struct.pack("<HIBHB", 45, 255, t, 0, 0) + b"\0\0")
+                      + struct.pack("<HIBHB", cs, 255, t, 0, 0) + b"\0\0")
             seq += 1
             self._send(writer, seq, coldef)
         seq += 1
@@ -188,9 +188,10 @@ class FakeMySql:
         await writer.drain()
 
 
+# (columns, [(type, charset)], rows): varstring charset 45 = utf8, 63 = binary
 SENSORS = {"sensors": (
     ["id", "name", "temp", "flag"],
-    [0x08, 0xFD, 0x05, 0x01],  # longlong, varstring, double, tiny
+    [(0x08, 63), (0xFD, 45), (0x05, 63), (0x01, 63)],
     [[1, "alpha", 20.5, 1], [2, "beta", None, 0]],
 )}
 
@@ -208,6 +209,11 @@ def test_dsn_and_literals():
     assert decode_text_value(b"42", 0x08) == 42
     assert decode_text_value(None, 0x08) is None
     assert decode_text_value(b"2.5", 0x05) == 2.5
+    # blob-vs-text is decided by charset, and the decision is per-COLUMN so
+    # Arrow arrays stay type-stable: binary charset -> always bytes
+    assert decode_text_value(b"\xff\xd8", 0xFC, charset=63) == b"\xff\xd8"
+    assert decode_text_value(b"abc", 0xFC, charset=63) == b"abc"
+    assert decode_text_value(b"abc", 0xFC, charset=45) == "abc"
 
 
 def _uri(srv, user="u", pw=None):

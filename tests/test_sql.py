@@ -242,3 +242,78 @@ def test_sql_injection_guards(ctx):
     # legitimate CTE still works (fallback tier)
     out = ctx.sql("WITH t AS (SELECT id FROM flow WHERE temp > 30) SELECT count(*) AS n FROM t")
     assert out.column("n").to_pylist() == [2]
+
+
+def test_vrl_style_parse_functions():
+    """The VRL feature map (PARITY.md): fallible parsers NULL on failure, so
+    `coalesce(parse_x(...), default)` is the `?? default` idiom."""
+    from arkflow_tpu.sql.eval import evaluate_expression
+
+    b = MessageBatch.from_pydict({
+        "s": ["42", "x", None, " 7 "],
+        "hexs": ["ff", "zz", "10", None],
+        "log": ["level=info msg=ok", "level=error msg=boom", "nope", None],
+        "url": ["https://u@api.example:8443/v1/x?q=1", "bad", None, "http://h/p"],
+        "ts": ["2026-07-29T10:00:00", "garbage", None, "1999-01-01T00:00:00"],
+    })
+    assert evaluate_expression(b, "coalesce(parse_int(s), 0)").to_pylist() == [42, 0, 0, 7]
+    assert evaluate_expression(b, "parse_int(hexs, 16)").to_pylist() == [255, None, 16, None]
+    assert evaluate_expression(b, "parse_float(s)").to_pylist() == [42.0, None, None, 7.0]
+    assert evaluate_expression(b, "parse_key_value(log, 'level')").to_pylist() == [
+        "info", "error", None, None]
+    assert evaluate_expression(b, "parse_url(url, 'host')").to_pylist() == [
+        "api.example", None, None, "h"]
+    assert evaluate_expression(b, "parse_url(url, 'port')").to_pylist() == [
+        8443, None, None, None]
+    ts = evaluate_expression(b, "parse_timestamp(ts, '%Y-%m-%dT%H:%M:%S')").to_pylist()
+    assert ts[1] is None and ts[2] is None and ts[0] and ts[3]
+    rt = evaluate_expression(
+        b, "format_timestamp(parse_timestamp(ts, '%Y-%m-%dT%H:%M:%S'), '%Y-%m-%dT%H:%M:%S')"
+    ).to_pylist()
+    assert rt[0] == "2026-07-29T10:00:00"
+    assert evaluate_expression(b, "regex_match(log, 'level=err')").to_pylist() == [
+        False, True, False, None]
+    assert evaluate_expression(b, "regex_extract(log, 'msg=(\\w+)')").to_pylist() == [
+        "ok", "boom", None, None]
+    assert evaluate_expression(b, "length(sha256(s))").to_pylist() == [64, 64, None, 64]
+    assert evaluate_expression(b, "to_string(parse_int(s))").to_pylist() == [
+        "42", None, None, "7"]
+
+
+def test_vrl_style_conditional_in_remap():
+    """CASE WHEN covers VRL's if/else in the remap slot."""
+    import asyncio
+
+    from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
+
+    ensure_plugins_loaded()
+    proc = build_component(
+        "processor",
+        {"type": "remap", "mappings": {
+            "severity": "CASE WHEN parse_key_value(__value___s, 'level') = 'error' "
+                        "THEN 2 ELSE 1 END"}},
+        Resource(),
+    )
+
+    async def go():
+        import pyarrow as pa
+        b = MessageBatch.from_pydict({"__value___s": ["level=error", "level=info"]})
+        out = (await proc.process(b))[0]
+        assert out.column("severity").to_pylist() == [2, 1]
+
+    asyncio.run(go())
+
+
+def test_fallible_parsers_never_abort_the_batch():
+    """OverflowError/IndexError-class failures also yield NULL (the
+    `?? default` contract), not a batch-level crash."""
+    from arkflow_tpu.sql.eval import evaluate_expression
+
+    b = MessageBatch.from_pydict({"f": [float("inf"), 2.0],
+                                  "big": [1e20, 0.0],
+                                  "log": ["msg=hi", "msg=yo"]})
+    assert evaluate_expression(b, "parse_int(f)").to_pylist() == [None, 2]
+    assert evaluate_expression(b, "format_timestamp(big)").to_pylist()[0] is None
+    # group index beyond the pattern's groups -> NULL rows, not IndexError
+    assert evaluate_expression(b, "regex_extract(log, 'msg=(\\w+)', 2)").to_pylist() == [
+        None, None]
