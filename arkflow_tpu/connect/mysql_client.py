@@ -39,7 +39,7 @@ CLIENT_CONNECT_WITH_DB = 8
 # column types -> python converters (text protocol sends strings)
 _INT_TYPES = {0x01, 0x02, 0x03, 0x08, 0x09, 0x0D}   # tiny..longlong, year
 _FLOAT_TYPES = {0x04, 0x05, 0xF6, 0x00}             # float, double, newdecimal, decimal
-_BLOB_TYPES = {0xF9, 0xFA, 0xFB, 0xFC, 0xFE}        # tiny/medium/long/blob/string share
+_BLOB_TYPES = {0xF9, 0xFA, 0xFB, 0xFC, 0xFD, 0xFE}  # *blob, var_string, string
 BINARY_CHARSET = 63                                  # charset 63 = binary data
 
 MAX_PACKET = 0xFFFFFF  # payloads split at 16MiB-1 per the protocol
@@ -132,7 +132,7 @@ def decode_text_value(raw: Optional[bytes], col_type: int,
 @dataclass
 class MyQueryResult:
     columns: list[str]
-    types: list[int]
+    types: list[tuple[int, int]]  # (type code, charset) per column
     rows: list[list[Any]]
     affected_rows: int = 0
 

@@ -174,6 +174,31 @@ def _moe_mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig,
     return out.reshape(b, s, d).astype(dtype), (lb, z)
 
 
+def _attention_block(lp: dict, x: jnp.ndarray, cfg: DecoderConfig, positions,
+                     causal=None, ring_attn=None) -> jnp.ndarray:
+    """Shared pre-norm GQA attention block (rope, kv-head repeat, residual).
+
+    ``ring_attn`` substitutes the sp-ring kernel for plain masked attention.
+    Used by forward() and the pipeline-parallel stage apply — one source of
+    truth for the layer math."""
+    b, s = positions.shape
+    dh = cfg.dim // cfg.heads
+    group = cfg.heads // cfg.kv_heads
+    y = cm.rms_norm(lp["attn_norm"], x, cfg.norm_eps)
+    q = cm.dense(lp["wq"], y).reshape(b, s, cfg.heads, dh)
+    k = cm.dense(lp["wk"], y).reshape(b, s, cfg.kv_heads, dh)
+    v = cm.dense(lp["wv"], y).reshape(b, s, cfg.kv_heads, dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    k = jnp.repeat(k, group, axis=2)
+    v = jnp.repeat(v, group, axis=2)
+    if ring_attn is not None:
+        attn = ring_attn(q, k, v)
+    else:
+        attn = cm.attention(q, k, v, causal)
+    return x + cm.dense(lp["wo"], attn.reshape(b, s, cfg.heads * dh))
+
+
 def _mlp(lp: dict, y: jnp.ndarray, cfg: DecoderConfig, token_mask=None) -> jnp.ndarray:
     """Dense SwiGLU or Switch MoE, depending on cfg (aux stats dropped) —
     the shared MLP for the incremental-decode paths, where the aux loss is
@@ -224,20 +249,7 @@ def forward(params: dict, cfg: DecoderConfig, input_ids, *, axes=None, mesh=None
         )
 
     def layer(x, lp):
-        y = cm.rms_norm(lp["attn_norm"], x, cfg.norm_eps)
-        q = cm.dense(lp["wq"], y).reshape(b, s, cfg.heads, dh)
-        k = cm.dense(lp["wk"], y).reshape(b, s, cfg.kv_heads, dh)
-        v = cm.dense(lp["wv"], y).reshape(b, s, cfg.kv_heads, dh)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        # GQA: repeat kv heads to match q heads
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
-        if ring_attn is not None:
-            attn = ring_attn(q, k, v).reshape(b, s, cfg.heads * dh)
-        else:
-            attn = cm.attention(q, k, v, causal).reshape(b, s, cfg.heads * dh)
-        x = x + cm.dense(lp["wo"], attn)
+        x = _attention_block(lp, x, cfg, positions, causal, ring_attn)
         x = _shard_act(x, axes)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
         if cfg.num_experts > 1:

@@ -11,6 +11,8 @@ Axes:
 - ``dp``  data parallel (batch)
 - ``tp``  tensor parallel (heads / FFN)
 - ``sp``  sequence parallel (long-context; pairs with ring attention)
+- ``ep``  expert parallel (MoE dispatch/combine)
+- ``pp``  pipeline parallel (layer stages; parallel/pipeline.py schedule)
 """
 
 from __future__ import annotations
@@ -29,11 +31,12 @@ class MeshSpec:
     tp: int = 1
     sp: int = 1
     ep: int = 1  # expert parallel (MoE)
-    axis_names: tuple = ("dp", "tp", "sp", "ep")
+    pp: int = 1  # pipeline parallel (layer stages)
+    axis_names: tuple = ("dp", "tp", "sp", "ep", "pp")
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.tp * self.sp * self.ep
+        return self.dp * self.tp * self.sp * self.ep * self.pp
 
 
 def create_mesh(spec: Optional[MeshSpec] = None, devices=None) -> Mesh:
@@ -45,7 +48,8 @@ def create_mesh(spec: Optional[MeshSpec] = None, devices=None) -> Mesh:
         raise ValueError(
             f"mesh {spec} needs {spec.num_devices} devices, have {len(devices)}"
         )
-    arr = np.array(devices[: spec.num_devices]).reshape(spec.dp, spec.tp, spec.sp, spec.ep)
+    arr = np.array(devices[: spec.num_devices]).reshape(
+        spec.dp, spec.tp, spec.sp, spec.ep, spec.pp)
     return Mesh(arr, spec.axis_names)
 
 

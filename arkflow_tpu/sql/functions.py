@@ -204,8 +204,8 @@ _BUILTINS: dict[str, ScalarFn] = {
     "regex_extract": lambda args, n: _regex_extract(args, n),
     "parse_key_value": lambda args, n: _parse_key_value(args, n),
     "parse_url": lambda args, n: _parse_url(args, n),
-    "md5": lambda args, n: _rowwise1(args, n, lambda v: hashlib.md5(str(v).encode()).hexdigest()),
-    "sha256": lambda args, n: _rowwise1(args, n, lambda v: hashlib.sha256(str(v).encode()).hexdigest()),
+    "md5": lambda args, n: _rowwise1(args, n, lambda v: hashlib.md5(_as_bytes(v)).hexdigest(), raw=True),
+    "sha256": lambda args, n: _rowwise1(args, n, lambda v: hashlib.sha256(_as_bytes(v)).hexdigest(), raw=True),
     "to_string": lambda args, n: _rowwise1(args, n, str),
 }
 
@@ -217,13 +217,19 @@ def _pylist(v, n):
     return arr.to_pylist()
 
 
-def _rowwise1(args, n, fn):
+def _as_bytes(v):
+    """Hash inputs keep their raw bytes (a lossy decode would change the
+    digest); strings hash their utf-8 encoding, matching VRL/`md5sum`."""
+    return bytes(v) if isinstance(v, (bytes, bytearray)) else str(v).encode()
+
+
+def _rowwise1(args, n, fn, raw=False):
     out = []
     for v in _pylist(args[0], n):
         if v is None:
             out.append(None)
             continue
-        if isinstance(v, bytes):
+        if isinstance(v, bytes) and not raw:
             v = v.decode(errors="replace")
         try:
             out.append(fn(v))
@@ -303,14 +309,36 @@ def _regex_extract(args, n):
     return _rowwise1(args, n, conv)
 
 
+def _split_pairs(text: str, pair_sep: str):
+    """Split on pair_sep outside double quotes (logfmt quoting)."""
+    out, cur, quoted = [], [], False
+    i, sep_len = 0, len(pair_sep)
+    while i < len(text):
+        ch = text[i]
+        if ch == '"':
+            quoted = not quoted
+            cur.append(ch)
+            i += 1
+        elif not quoted and text.startswith(pair_sep, i):
+            out.append("".join(cur))
+            cur = []
+            i += sep_len
+        else:
+            cur.append(ch)
+            i += 1
+    out.append("".join(cur))
+    return out
+
+
 def _parse_key_value(args, n):
-    """parse_key_value(x, key [, pair_sep, kv_sep]) — logfmt-style lookup."""
+    """parse_key_value(x, key [, pair_sep, kv_sep]) — logfmt-style lookup;
+    double-quoted values may contain the pair separator."""
     key = str(args[1])
     pair_sep = str(args[2]) if len(args) > 2 else " "
     kv_sep = str(args[3]) if len(args) > 3 else "="
 
     def conv(v):
-        for pair in str(v).split(pair_sep):
+        for pair in _split_pairs(str(v), pair_sep):
             k, sep, val = pair.partition(kv_sep)
             if sep and k.strip() == key:
                 return val.strip().strip('"')

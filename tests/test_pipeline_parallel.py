@@ -1,0 +1,91 @@
+"""Pipeline-parallelism tests on the virtual CPU mesh.
+
+Correctness bar: the GPipe schedule over pp stages must reproduce the
+single-device loss exactly-ish (same math, different partitioning), train,
+and flow gradients into every stage's layer shard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.models import get_model
+from arkflow_tpu.parallel import MeshSpec, create_mesh, shard_params
+from arkflow_tpu.parallel.pipeline import make_pp_train_step, pp_param_specs
+
+TINY = dict(vocab_size=128, dim=32, layers=4, heads=4, kv_heads=2, ffn=64, max_seq=32)
+
+
+def _setup(dp: int, pp: int):
+    devs = jax.devices("cpu")
+    if len(devs) < dp * pp:
+        pytest.skip(f"needs {dp * pp} virtual devices")
+    fam = get_model("decoder_lm")
+    cfg = fam.make_config(**TINY)
+    params = fam.init(jax.random.PRNGKey(0), cfg)
+    mesh = create_mesh(MeshSpec(dp=dp, pp=pp), devices=devs[: dp * pp])
+    return fam, cfg, params, mesh
+
+
+def _batch(b=8, s=16):
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(1, 128, (b, s)), jnp.int32)
+    return {"input_ids": ids, "targets": jnp.roll(ids, -1, axis=1),
+            "mask": jnp.ones((b, s), jnp.int32)}
+
+
+@pytest.mark.parametrize("dp,pp,micro", [(1, 2, 4), (1, 4, 4), (2, 4, 2)])
+def test_pp_loss_matches_single_device(dp, pp, micro):
+    import optax
+
+    fam, cfg, params, mesh = _setup(dp, pp)
+    batch = _batch()
+    ref_loss = float(fam.extras["loss_fn"](
+        params, cfg, batch["input_ids"], batch["targets"], batch["mask"]))
+
+    opt = optax.adamw(1e-3)
+    with mesh:
+        p = shard_params(params, pp_param_specs(cfg), mesh)
+        st = opt.init(p)
+        ts = jax.jit(make_pp_train_step(cfg, opt, mesh, microbatches=micro))
+        _p2, _st2, loss = ts(p, st, batch)
+    assert np.isfinite(float(loss))
+    assert abs(float(loss) - ref_loss) < 3e-2, (float(loss), ref_loss)
+
+
+def test_pp_training_reduces_loss_and_updates_every_stage():
+    import optax
+
+    fam, cfg, params, mesh = _setup(1, 4)
+    batch = _batch()
+    opt = optax.adamw(5e-3)
+    with mesh:
+        p = shard_params(params, pp_param_specs(cfg), mesh)
+        st = opt.init(p)
+        ts = jax.jit(make_pp_train_step(cfg, opt, mesh))
+        before = np.asarray(p["layers"]["wq"]["w"])
+        losses = []
+        for _ in range(5):
+            p, st, loss = ts(p, st, batch)
+            losses.append(float(loss))
+        after = np.asarray(p["layers"]["wq"]["w"])
+    assert losses[-1] < losses[0]
+    # every stage's layer shard moved (grads crossed the ppermute chain)
+    per_layer_delta = np.abs(after - before).reshape(cfg.layers, -1).sum(axis=1)
+    assert (per_layer_delta > 0).all(), per_layer_delta
+
+
+def test_pp_config_validation():
+    import optax
+
+    fam, cfg, params, mesh = _setup(1, 4)
+    bad = fam.make_config(**{**TINY, "layers": 3})
+    with pytest.raises(ConfigError, match="divide"):
+        make_pp_train_step(bad, optax.adamw(1e-3), mesh)
+    moe = fam.make_config(**{**TINY, "num_experts": 4})
+    with pytest.raises(ConfigError, match="MoE"):
+        make_pp_train_step(moe, optax.adamw(1e-3), mesh)
