@@ -391,6 +391,21 @@ def from_hf_state_dict(state: dict, cfg: DecoderConfig) -> dict:
 
 # -- incremental decoding (batched summarization path) ---------------------
 
+def select_token(logits, key=None, temperature: float = 0.0, top_k: int = 0):
+    """Greedy (temperature<=0) or temperature/top-k categorical sampling.
+
+    ``logits``: [B, V] float32; ``key`` required when sampling."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / temperature
+    if top_k > 0:
+        # lax.top_k, not a full vocab sort: this runs once per decoded token
+        k = min(int(top_k), scaled.shape[-1])  # permissive top_k degrades
+        kth = jax.lax.top_k(scaled, k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
 def init_kv_cache(cfg: DecoderConfig, batch: int, max_len: int) -> dict:
     """Cache layout for ragged batched generation:
 
@@ -412,7 +427,7 @@ def init_kv_cache(cfg: DecoderConfig, batch: int, max_len: int) -> dict:
 
 
 def prefill(params: dict, cfg: DecoderConfig, input_ids, cache: dict,
-            lengths=None) -> tuple[jnp.ndarray, dict]:
+            lengths=None, return_logits: bool = False) -> tuple[jnp.ndarray, dict]:
     """Fill a FRESH KV cache with right-padded prompts in one forward pass.
 
     input_ids: [B, T]; ``lengths``: [B] true prompt lengths (default: T for
@@ -461,17 +476,19 @@ def prefill(params: dict, cfg: DecoderConfig, input_ids, cache: dict,
     # read each row's logits at its true last token, not at padding
     last = jnp.clip(lengths - 1, 0, t - 1)
     last_logits = jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0, :]
-    next_ids = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
     new_cache = {
         "k": ks, "v": vs,
         "length": jnp.asarray(t, jnp.int32),
         "lengths": lengths,
         "prompt_len": jnp.asarray(t, jnp.int32),
     }
-    return next_ids, new_cache
+    if return_logits:
+        return last_logits, new_cache
+    return jnp.argmax(last_logits, axis=-1).astype(jnp.int32), new_cache
 
 
-def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict) -> tuple[jnp.ndarray, dict]:
+def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict,
+                return_logits: bool = False) -> tuple[jnp.ndarray, dict]:
     """One token per sequence: [B, 1] ids + cache -> ([B] next ids, cache).
 
     Jittable with a static cache size; the python generation loop lives in
@@ -521,59 +538,72 @@ def decode_step(params: dict, cfg: DecoderConfig, token_ids, cache: dict) -> tup
     (x, _), (ks, vs) = jax.lax.scan(layer, (x, 0), params["layers"])
     x = cm.rms_norm(params["norm_out"], x, cfg.norm_eps)
     logits = cm.dense(params["lm_head"], x).astype(jnp.float32)
-    next_ids = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
     new_cache = {
         "k": ks, "v": vs,
         "length": pos + 1,
         "lengths": lengths + 1,
         "prompt_len": prompt_len,
     }
-    return next_ids, new_cache
+    if return_logits:
+        return logits[:, -1, :], new_cache
+    return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), new_cache
 
 
 def generate(params: dict, cfg: DecoderConfig, input_ids, lengths,
              max_new_tokens: int, eos_id: int = 2,
-             n_real=None) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Whole-sequence greedy generation under one jit: prefill + a
+             n_real=None, temperature: float = 0.0, top_k: int = 0,
+             rng_key=None) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Whole-sequence generation under one jit: prefill + a
     ``lax.while_loop`` decode with EOS early-exit. One device dispatch per
     batch instead of one per token — the difference between usable and
     unusable latency over a remote-TPU link.
 
-    Returns (tokens [B, max_new_tokens] int32 zero-padded after EOS,
-    counts [B] of real tokens per row).
+    ``temperature<=0`` is greedy; otherwise temperature/top-k categorical
+    sampling driven by ``rng_key`` (one split per step, deterministic for a
+    fixed key). Returns (tokens [B, max_new_tokens] int32 zero-padded after
+    EOS, counts [B] of real tokens per row).
     """
     b, t = input_ids.shape
+    sampling = temperature > 0.0
+    key = rng_key if rng_key is not None else jax.random.PRNGKey(0)
     cache = init_kv_cache(cfg, b, t + max_new_tokens)
-    nxt, cache = prefill(params, cfg, input_ids, cache, lengths=lengths)
+    first, cache = prefill(params, cfg, input_ids, cache, lengths=lengths,
+                           return_logits=True)
+    key, sub = jax.random.split(key)
+    nxt = select_token(first, sub, temperature if sampling else 0.0, top_k)
     out0 = jnp.zeros((b, max_new_tokens), jnp.int32)
     # batch-padding rows start done, so they don't gate the EOS early-exit
     done0 = (jnp.arange(b) >= n_real) if n_real is not None else jnp.zeros((b,), bool)
     counts0 = jnp.zeros((b,), jnp.int32)
 
     def cond(state):
-        step, _nxt, done, _counts, _cache, _out = state
+        step, _nxt, _key, done, _counts, _cache, _out = state
         return jnp.logical_and(step < max_new_tokens, ~jnp.all(done))
 
     def body(state):
-        step, nxt, done, counts, cache, out = state
+        step, nxt, key, done, counts, cache, out = state
         # decode at the TOP for steps >= 1 (step 0 uses the prefill token), so
         # the loop never pays a trailing forward pass after the final emission
-        nxt, cache = jax.lax.cond(
-            step > 0,
-            lambda args: decode_step(params, cfg, args[0][:, None], args[1]),
-            lambda args: args,
-            (nxt, cache),
-        )
+        key, sub = jax.random.split(key)
+
+        def decode(args):
+            nxt, cache = args
+            logits, cache = decode_step(params, cfg, nxt[:, None], cache,
+                                        return_logits=True)
+            return select_token(logits, sub, temperature if sampling else 0.0,
+                                top_k), cache
+
+        nxt, cache = jax.lax.cond(step > 0, decode, lambda args: args, (nxt, cache))
         is_eos = nxt == eos_id
         keep = jnp.logical_and(~done, ~is_eos)
         emit = jnp.where(keep, nxt, 0)
         out = jax.lax.dynamic_update_slice(out, emit[:, None], (0, step))
         counts = counts + keep.astype(jnp.int32)
         done = jnp.logical_or(done, is_eos)
-        return step + 1, nxt, done, counts, cache, out
+        return step + 1, nxt, key, done, counts, cache, out
 
-    _, _, _, counts, _, out = jax.lax.while_loop(
-        cond, body, (0, nxt, done0, counts0, cache, out0)
+    _, _, _, _, counts, _, out = jax.lax.while_loop(
+        cond, body, (0, nxt, key, done0, counts0, cache, out0)
     )
     return out, counts
 

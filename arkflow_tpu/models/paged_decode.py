@@ -35,7 +35,7 @@ def init_page_pool(cfg: DecoderConfig, num_pages: int, page_size: int):
 
 
 def paged_prefill(params: dict, cfg: DecoderConfig, input_ids, lengths,
-                  page_table, k_pages, v_pages):
+                  page_table, k_pages, v_pages, return_logits: bool = False):
     """Prefill prompts and scatter their K/V into pages.
 
     input_ids: [B, T] right-padded; lengths: [B]; page_table: [B, P].
@@ -88,12 +88,14 @@ def paged_prefill(params: dict, cfg: DecoderConfig, input_ids, lengths,
     logits = cm.dense(params["lm_head"], x).astype(jnp.float32)
     last = jnp.clip(lengths - 1, 0, t - 1)
     last_logits = jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0, :]
-    next_ids = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-    return next_ids, new_k, new_v
+    if return_logits:
+        return last_logits, new_k, new_v
+    return jnp.argmax(last_logits, axis=-1).astype(jnp.int32), new_k, new_v
 
 
 def paged_decode_step(params: dict, cfg: DecoderConfig, token_ids, lengths,
-                      active, page_table, k_pages, v_pages):
+                      active, page_table, k_pages, v_pages,
+                      return_logits: bool = False):
     """One decode step over all serving slots.
 
     token_ids: [S] current token per slot; lengths: [S] tokens already in
@@ -151,5 +153,6 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, token_ids, lengths,
         layer, (x,), (params["layers"], k_pages, v_pages))
     x = cm.rms_norm(params["norm_out"], x, cfg.norm_eps)
     logits = cm.dense(params["lm_head"], x).astype(jnp.float32)
-    next_ids = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-    return next_ids, new_k, new_v
+    if return_logits:
+        return logits[:, -1, :], new_k, new_v
+    return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), new_k, new_v

@@ -46,7 +46,8 @@ class TpuGenerateProcessor(Processor):
     def __init__(self, model: str, model_config: Optional[dict], *, text_field: str,
                  tokenizer, max_input: int, max_new_tokens: int, eos_id: int,
                  output_field: str, buckets: BucketPolicy, seed: int = 0,
-                 serving: str = "batch", slots: int = 8, page_size: int = 16):
+                 serving: str = "batch", slots: int = 8, page_size: int = 16,
+                 temperature: float = 0.0, top_k: int = 0):
         import jax
 
         from arkflow_tpu.models import get_model
@@ -77,10 +78,14 @@ class TpuGenerateProcessor(Processor):
         ex = self.family.extras
         # whole-generation jit: one device dispatch per batch (prefill +
         # while_loop decode with EOS early-exit), not one per token
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self._rng = jax.random.PRNGKey(seed + 1)
         self._generate = jax.jit(
             functools.partial(
                 ex["generate"], cfg=self.cfg,
                 max_new_tokens=self.max_new_tokens, eos_id=self.eos_id,
+                temperature=self.temperature, top_k=self.top_k,
             )
         )
 
@@ -96,6 +101,7 @@ class TpuGenerateProcessor(Processor):
                 self.params, self.cfg, slots=slots, page_size=page_size,
                 max_seq=self.max_input + self.max_new_tokens, eos_id=eos_id,
                 prompt_buckets=list(buckets.seq_buckets),
+                temperature=self.temperature, top_k=self.top_k, seed=seed + 1,
             )
 
         reg = global_registry()
@@ -104,13 +110,15 @@ class TpuGenerateProcessor(Processor):
 
     # -- generation --------------------------------------------------------
 
-    def _generate_sync(self, ids: np.ndarray, lengths: np.ndarray, n_real: int) -> list[list[int]]:
+    def _generate_sync(self, ids: np.ndarray, lengths: np.ndarray, n_real: int,
+                       rng_key) -> list[list[int]]:
         import jax.numpy as jnp
 
         tokens, counts = self._generate(
             self.params, input_ids=jnp.asarray(ids),
             lengths=jnp.asarray(lengths, jnp.int32),
             n_real=jnp.asarray(n_real, jnp.int32),
+            rng_key=rng_key,
         )
         tokens = np.asarray(tokens)
         counts = np.asarray(counts)
@@ -144,8 +152,13 @@ class TpuGenerateProcessor(Processor):
         bb = self.buckets.batch_bucket(n)
         ids = pad_batch_dim(ids, bb)
         lengths = np.concatenate([lengths, np.ones(bb - n, np.int32)])
+        import jax
+
+        # split on the event loop: concurrent worker batches must not race
+        # the key state in executor threads (duplicate keys = correlated samples)
+        self._rng, sub = jax.random.split(self._rng)
         outs = await asyncio.get_running_loop().run_in_executor(
-            None, self._generate_sync, ids, lengths, n
+            None, self._generate_sync, ids, lengths, n, sub
         )
         texts_out = [self._detok(o) for o in outs]  # already trimmed to n rows
         return [batch.with_column(self.output_field, pa.array(texts_out, pa.string()))]
@@ -173,6 +186,8 @@ def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
         serving=_serving_mode(config),
         slots=int(config.get("slots", 8)),
         page_size=int(config.get("page_size", 16)),
+        temperature=float(config.get("temperature", 0.0)),
+        top_k=int(config.get("top_k", 0)),
     )
 
 

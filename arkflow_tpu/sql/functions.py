@@ -315,7 +315,11 @@ def _split_pairs(text: str, pair_sep: str):
     i, sep_len = 0, len(pair_sep)
     while i < len(text):
         ch = text[i]
-        if ch == '"':
+        if quoted and ch == "\\" and i + 1 < len(text):
+            cur.append(ch)
+            cur.append(text[i + 1])  # escaped char (incl. \") stays in-value
+            i += 2
+        elif ch == '"':
             quoted = not quoted
             cur.append(ch)
             i += 1

@@ -52,7 +52,8 @@ class GenerationServer:
     def __init__(self, params, cfg: DecoderConfig, *, slots: int = 8,
                  page_size: int = 16, num_pages: Optional[int] = None,
                  max_seq: int = 512, eos_id: int = 2,
-                 prompt_buckets: Optional[list[int]] = None):
+                 prompt_buckets: Optional[list[int]] = None,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
         if cfg.use_ring_attention:
             raise ConfigError("paged serving does not support ring attention")
         self.params = params
@@ -86,16 +87,30 @@ class GenerationServer:
         self._loop_task: Optional[asyncio.Task] = None
         self._closed = False
 
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self._key = jax.random.PRNGKey(seed)
+
+        from arkflow_tpu.models.decoder import select_token
+
+        def _pick(logits, key):
+            return select_token(logits, key, self.temperature, self.top_k)
+
         # donate the KV pools: they are pure in->out state, so XLA updates
         # them in place instead of copying hundreds of MB per decode step
-        self._decode = jax.jit(
-            lambda tok, lens, act, table, kp, vp: paged_decode_step(
-                self.params, cfg, tok, lens, act, table, kp, vp),
-            donate_argnums=(4, 5))
-        self._prefill = jax.jit(
-            lambda ids, lens, table, kp, vp: paged_prefill(
-                self.params, cfg, ids, lens, table, kp, vp),
-            donate_argnums=(3, 4))
+        def _decode(tok, lens, act, table, kp, vp, key):
+            logits, kp, vp = paged_decode_step(
+                self.params, cfg, tok, lens, act, table, kp, vp,
+                return_logits=True)
+            return _pick(logits, key), kp, vp
+
+        def _prefill(ids, lens, table, kp, vp, key):
+            logits, kp, vp = paged_prefill(
+                self.params, cfg, ids, lens, table, kp, vp, return_logits=True)
+            return _pick(logits, key), kp, vp
+
+        self._decode = jax.jit(_decode, donate_argnums=(4, 5))
+        self._prefill = jax.jit(_prefill, donate_argnums=(3, 4))
 
         reg = global_registry()
         self.m_steps = reg.counter("arkflow_gen_decode_steps_total", "lockstep decode steps")
@@ -166,11 +181,12 @@ class GenerationServer:
         table = np.zeros((1, self.pages_per_slot), np.int32)
         table[0, :len(pages)] = pages
         loop = asyncio.get_running_loop()
+        self._key, sub = jax.random.split(self._key)
         # off-loop: first call per bucket compiles (seconds on TPU)
         nxt, self.k_pages, self.v_pages = await loop.run_in_executor(
             None, lambda: jax.block_until_ready(self._prefill(
                 jnp.asarray(ids), jnp.asarray([n], jnp.int32), jnp.asarray(table),
-                self.k_pages, self.v_pages)))
+                self.k_pages, self.v_pages, sub)))
         self._lengths[slot] = n
         self._cur_tokens[slot] = int(nxt[0])
         self._handle_token(slot, int(nxt[0]))
@@ -281,10 +297,11 @@ class GenerationServer:
         lens = jnp.asarray(self._lengths)
         act_dev = jnp.asarray(act)
         table = self._table_array()
+        self._key, sub = jax.random.split(self._key)
         # off-loop: one device-step of wall time (plus the first-call compile)
         nxt, self.k_pages, self.v_pages = await loop.run_in_executor(
             None, lambda: jax.block_until_ready(self._decode(
-                cur, lens, act_dev, table, self.k_pages, self.v_pages)))
+                cur, lens, act_dev, table, self.k_pages, self.v_pages, sub)))
         self.m_steps.inc()
         nxt_host = np.asarray(nxt)
         for s in range(self.slots):
