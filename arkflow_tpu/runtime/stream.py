@@ -46,6 +46,7 @@ RECONNECT_DELAY_S = 5.0  # ref stream/mod.rs:190
 class _WorkItem:
     batch: MessageBatch
     ack: Ack
+    enqueued_at: float = 0.0  # loop-clock time it entered the worker queue
 
 
 class _Done:
@@ -87,10 +88,22 @@ class Stream:
         self.m_proc_latency = reg.histogram("arkflow_process_seconds", "pipeline latency", labels)
         self.m_e2e_latency = reg.histogram("arkflow_e2e_seconds", "read-to-written latency", labels)
         self.m_pending = reg.gauge("arkflow_pending_batches", "in-flight batches", labels)
+        self.m_read_latency = reg.histogram(
+            "arkflow_input_read_seconds", "time blocked in input.read()", labels)
+        self.m_queue_wait = reg.histogram(
+            "arkflow_queue_wait_seconds", "work-item wait between input and worker", labels)
+        self.m_write_latency = reg.histogram(
+            "arkflow_output_write_seconds", "output.write() latency per batch", labels)
+        self.m_backpressure_s = reg.counter(
+            "arkflow_backpressure_seconds_total",
+            "worker seconds stalled on the reorder window", labels)
 
         # runtime state
         self._seq_assigned = 0
         self._seq_emitted = 0
+        #: set by the output stage when the reorder window drains below
+        #: MAX_PENDING — backpressured workers wake on it instead of polling
+        self._drained = asyncio.Event()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -154,10 +167,16 @@ class Stream:
         cancel_wait = asyncio.ensure_future(cancel.wait())
         try:
             while not cancel.is_set():
+                loop = asyncio.get_running_loop()
+                t_read = loop.time()
                 read_f = asyncio.ensure_future(self.input.read())
                 done, _ = await asyncio.wait(
                     {read_f, cancel_wait}, return_when=asyncio.FIRST_COMPLETED
                 )
+                if read_f in done:
+                    # only completed reads count: a cancel while idle must
+                    # not record time-until-shutdown as read latency
+                    self.m_read_latency.observe(loop.time() - t_read)
                 if read_f not in done:
                     read_f.cancel()
                     try:
@@ -186,7 +205,7 @@ class Stream:
                     logger.error("[%s] input read error: %s", self.name, e)
                     await asyncio.sleep(0.1)
                     continue
-                item = _WorkItem(batch.with_ingest_time(), ack)
+                item = _WorkItem(batch.with_ingest_time(), ack, loop.time())
                 self.m_batches_in.inc()
                 self.m_rows_in.inc(batch.num_rows)
                 if self.buffer is not None:
@@ -210,14 +229,26 @@ class Stream:
                     await input_q.put(_DONE)
                 return
             batch, ack = item
-            await input_q.put(_WorkItem(batch, ack))
+            await input_q.put(_WorkItem(batch, ack,
+                                        asyncio.get_running_loop().time()))
 
     async def _do_processor(self, input_q: asyncio.Queue, output_q: asyncio.Queue) -> None:
         """Worker: pipeline.process with seq stamping + backpressure (THE hot loop)."""
+        loop = asyncio.get_running_loop()
         while True:
-            # backpressure (ref :263-273)
-            while (self._seq_assigned - self._seq_emitted) > MAX_PENDING:
-                await asyncio.sleep(0.1)
+            # backpressure: event-driven wakeup the moment the reorder window
+            # drains (the reference sleeps 100-500ms, ref :263-273; a poll
+            # adds up to 100ms of latency noise per stall)
+            if (self._seq_assigned - self._seq_emitted) > MAX_PENDING:
+                t_bp = loop.time()
+                while (self._seq_assigned - self._seq_emitted) > MAX_PENDING:
+                    self._drained.clear()
+                    try:
+                        # bounded wait: never deadlocks even if an emit is lost
+                        await asyncio.wait_for(self._drained.wait(), 1.0)
+                    except asyncio.TimeoutError:
+                        pass
+                self.m_backpressure_s.inc(loop.time() - t_bp)
             item = await input_q.get()
             if isinstance(item, _Done):
                 await output_q.put(_DONE)
@@ -225,14 +256,15 @@ class Stream:
             seq = self._seq_assigned
             self._seq_assigned += 1
             self.m_pending.set(self._seq_assigned - self._seq_emitted)
-            t0 = asyncio.get_running_loop().time()
+            self.m_queue_wait.observe(loop.time() - item.enqueued_at)
+            t0 = loop.time()
             try:
                 results = await self.pipeline.process(item.batch)
                 err = None
             except Exception as e:  # processor failure -> error path
                 results = []
                 err = e
-            self.m_proc_latency.observe(asyncio.get_running_loop().time() - t0)
+            self.m_proc_latency.observe(loop.time() - t0)
             await output_q.put((seq, item, results, err))
 
     async def _do_output(self, output_q: asyncio.Queue) -> None:
@@ -256,6 +288,8 @@ class Stream:
                 item, results, err = reorder.pop(next_seq)
                 next_seq += 1
                 self._seq_emitted = next_seq
+                if (self._seq_assigned - self._seq_emitted) <= MAX_PENDING:
+                    self._drained.set()  # wake backpressured workers now
                 await self._emit(item, results, err)
 
     async def _emit(self, item: _WorkItem, results: list[MessageBatch], err: Optional[Exception]) -> None:
@@ -276,9 +310,12 @@ class Stream:
             # ProcessResult::None -> drop + ack (ref :301-303)
             await item.ack.ack()
             return
+        loop = asyncio.get_running_loop()
         try:
             for b in results:
+                t_w = loop.time()
                 await self.output.write(b)
+                self.m_write_latency.observe(loop.time() - t_w)
                 self.m_batches_out.inc()
                 self.m_rows_out.inc(b.num_rows)
         except Exception as e:

@@ -341,11 +341,16 @@ def _parse_key_value(args, n):
     pair_sep = str(args[2]) if len(args) > 2 else " "
     kv_sep = str(args[3]) if len(args) > 3 else "="
 
+    import re as _re
+
     def conv(v):
         for pair in _split_pairs(str(v), pair_sep):
             k, sep, val = pair.partition(kv_sep)
             if sep and k.strip() == key:
-                return val.strip().strip('"')
+                val = val.strip()
+                if len(val) >= 2 and val[0] == '"' and val[-1] == '"':
+                    val = val[1:-1]  # the delimiting quotes only
+                return _re.sub(r"\\(.)", r"\1", val)  # \" -> ", \\ -> \
         return None
 
     return _rowwise1(args, n, conv)

@@ -447,3 +447,41 @@ def test_write_failure_does_not_ack():
     assert sink.dropped_batches == 2  # ok1, ok2 delivered
     assert len(acked) == 2  # poison batch NOT acked -> would replay
     assert stream.m_write_errors.value == 1
+
+
+def test_backpressure_event_driven_wakeup():
+    """Workers stalled on the reorder window wake when it drains (no 100ms
+    poll), and stalled time lands in the backpressure counter."""
+    import arkflow_tpu.runtime.stream as stream_mod
+
+    async def go(monkey_max):
+        old = stream_mod.MAX_PENDING
+        stream_mod.MAX_PENDING = monkey_max
+        try:
+            from arkflow_tpu.plugins.input.memory import MemoryInput
+
+            inp = MemoryInput([str(i).encode() for i in range(40)])
+            seen = []
+
+            class Collect:
+                async def connect(self):
+                    pass
+
+                async def write(self, batch):
+                    await asyncio.sleep(0.002)  # slow output -> window fills
+                    seen.extend(batch.to_binary())
+
+                async def close(self):
+                    pass
+
+            s = stream_mod.Stream(inp, Pipeline([]), Collect(),
+                                  thread_num=4, name="bp-test")
+            await asyncio.wait_for(s.run(asyncio.Event()), 30)
+            assert len(seen) == 40
+            assert [int(x) for x in seen] == list(range(40))  # order preserved
+            return s.m_backpressure_s.value
+        finally:
+            stream_mod.MAX_PENDING = old
+
+    stalled = asyncio.run(go(2))
+    assert stalled > 0.0  # workers actually hit the window and were woken
