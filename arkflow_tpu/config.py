@@ -67,6 +67,10 @@ class StreamConfig:
     buffer: Optional[dict] = None
     temporary: list[TemporaryConfig] = field(default_factory=list)
     name: Optional[str] = None
+    #: crash policy: {max_retries: N, backoff: "5s"} rebuilds and restarts a
+    #: crashed stream (the reference only logs, ref engine/mod.rs:268-273);
+    #: None keeps log-and-stop behavior
+    restart: Optional[dict] = None
 
     @classmethod
     def from_mapping(cls, m: Mapping[str, Any]) -> "StreamConfig":
@@ -85,7 +89,24 @@ class StreamConfig:
             buffer=dict(m["buffer"]) if m.get("buffer") else None,
             temporary=temps,
             name=m.get("name"),
+            restart=_restart_config(m.get("restart")),
         )
+
+
+def _restart_config(m: Any) -> Optional[dict]:
+    if m is None or m is False:
+        return None  # `restart: {}` means "defaults", not "disabled"
+    if not isinstance(m, Mapping):
+        raise ConfigError("stream 'restart' must be a mapping")
+    from arkflow_tpu.utils.duration import parse_duration
+
+    out = {
+        "max_retries": int(m.get("max_retries", 3)),
+        "backoff_s": parse_duration(str(m.get("backoff", "5s"))),
+    }
+    if out["max_retries"] < 0 or out["backoff_s"] < 0:
+        raise ConfigError("stream restart values must be non-negative")
+    return out
 
 
 @dataclass

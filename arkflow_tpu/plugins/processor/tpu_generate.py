@@ -47,11 +47,24 @@ class TpuGenerateProcessor(Processor):
                  tokenizer, max_input: int, max_new_tokens: int, eos_id: int,
                  output_field: str, buckets: BucketPolicy, seed: int = 0,
                  serving: str = "batch", slots: int = 8, page_size: int = 16,
-                 temperature: float = 0.0, top_k: int = 0):
+                 temperature: float = 0.0, top_k: int = 0,
+                 mesh_config: Optional[dict] = None):
         import jax
 
         from arkflow_tpu.models import get_model
 
+        if serving == "continuous" and mesh_config:
+            raise ConfigError(
+                "tpu_generate: continuous serving + mesh sharding is not "
+                "composed yet (use batch mode for tensor-parallel decode)")
+        if mesh_config:
+            allowed = {"dp", "tp", "sp"}
+            unknown = set(mesh_config) - allowed
+            if unknown:
+                raise ConfigError(
+                    f"tpu_generate mesh keys {sorted(unknown)} not supported "
+                    f"here (batch generation shards over {sorted(allowed)}; "
+                    f"ep/pp apply to training/forward paths)")
         self.family = get_model(model)
         if "generate" not in self.family.extras:
             raise ConfigError(f"model {model!r} does not support incremental decoding")
@@ -73,7 +86,21 @@ class TpuGenerateProcessor(Processor):
                 params = self.family.init(jax.random.PRNGKey(seed), self.cfg)
         else:
             params = self.family.init(jax.random.PRNGKey(seed), self.cfg)
-        self.params = jax.device_put(params, jax.devices()[0])
+        # tensor-parallel serving: shard params over a Mesh so decode runs
+        # multi-chip via GSPMD (the KV cache shards over heads implicitly)
+        self.mesh = None
+        if mesh_config:
+            from arkflow_tpu.parallel.mesh import MeshSpec, create_mesh, shard_params
+
+            spec = MeshSpec(dp=int(mesh_config.get("dp", 1)),
+                            tp=int(mesh_config.get("tp", 1)),
+                            sp=int(mesh_config.get("sp", 1)))
+            self.mesh = create_mesh(spec)
+            axes = {name: name for name in self.mesh.axis_names}
+            self.params = shard_params(
+                params, self.family.param_specs(self.cfg, axes), self.mesh)
+        else:
+            self.params = jax.device_put(params, jax.devices()[0])
 
         ex = self.family.extras
         # whole-generation jit: one device dispatch per batch (prefill +
@@ -114,12 +141,16 @@ class TpuGenerateProcessor(Processor):
                        rng_key) -> list[list[int]]:
         import jax.numpy as jnp
 
-        tokens, counts = self._generate(
-            self.params, input_ids=jnp.asarray(ids),
-            lengths=jnp.asarray(lengths, jnp.int32),
-            n_real=jnp.asarray(n_real, jnp.int32),
-            rng_key=rng_key,
-        )
+        import contextlib
+
+        ctx = self.mesh if self.mesh is not None else contextlib.nullcontext()
+        with ctx:
+            tokens, counts = self._generate(
+                self.params, input_ids=jnp.asarray(ids),
+                lengths=jnp.asarray(lengths, jnp.int32),
+                n_real=jnp.asarray(n_real, jnp.int32),
+                rng_key=rng_key,
+            )
         tokens = np.asarray(tokens)
         counts = np.asarray(counts)
         outs = [tokens[i, : counts[i]].tolist() for i in range(n_real)]
@@ -188,6 +219,7 @@ def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
         page_size=int(config.get("page_size", 16)),
         temperature=float(config.get("temperature", 0.0)),
         top_k=int(config.get("top_k", 0)),
+        mesh_config=config.get("mesh"),
     )
 
 

@@ -7,7 +7,9 @@ an HTTP server with ``/health``, ``/readiness``, ``/liveness`` endpoints
 (ref :99-209) — here extended with the ``/metrics`` Prometheus endpoint the
 reference declared a dependency for but never shipped (SURVEY.md section 5).
 
-A crashed stream is logged without taking the engine down (ref :268-273).
+A crashed stream is logged without taking the engine down (ref :268-273);
+with a ``restart:`` policy it is rebuilt from config and restarted with
+backoff — elastic recovery the reference doesn't attempt.
 """
 
 from __future__ import annotations
@@ -129,20 +131,52 @@ class Engine:
         await self._start_health_server()
         self._install_signal_handlers()
 
-        async def run_one(stream: Stream) -> None:
-            try:
-                await stream.run(self.cancel)
-                logger.info("[%s] finished", stream.name)
-            except Exception:
-                logger.exception("[%s] stream crashed", stream.name)
+        async def run_one(stream: Stream, cfg, name: str) -> None:
+            policy = cfg.restart or {}
+            retries = 0
+            while True:
+                try:
+                    await stream.run(self.cancel)
+                    logger.info("[%s] finished", stream.name)
+                    return
+                except Exception:
+                    logger.exception("[%s] stream crashed", stream.name)
+                if not policy or self.cancel.is_set():
+                    return  # reference behavior: log, don't take the engine down
+                if retries >= policy["max_retries"]:
+                    logger.error("[%s] restart budget exhausted (%d)", name,
+                                 policy["max_retries"])
+                    return
+                retries += 1
+                logger.warning("[%s] restarting (%d/%d) in %.1fs", name,
+                               retries, policy["max_retries"], policy["backoff_s"])
+                # cancel-aware backoff: SIGTERM must not wait out the backoff
+                cancel_wait = asyncio.ensure_future(self.cancel.wait())
+                try:
+                    await asyncio.wait({cancel_wait},
+                                       timeout=policy["backoff_s"])
+                finally:
+                    cancel_wait.cancel()
+                if self.cancel.is_set():
+                    return
+                # rebuild from config: the crashed instance's components may
+                # hold broken connections/state; swap it into self.streams so
+                # introspection/shutdown see the LIVE instance
+                stream = build_stream(cfg, name=name)
+                for i, old in enumerate(self.streams):
+                    if old.name == name:
+                        self.streams[i] = stream
+                        break
 
         try:
-            self.streams = [
-                build_stream(s, name=s.name or f"stream-{i}")
+            named = [
+                (build_stream(s, name=s.name or f"stream-{i}"), s,
+                 s.name or f"stream-{i}")
                 for i, s in enumerate(self.config.streams)
             ]
+            self.streams = [st for st, _, _ in named]
             self._ready = True
-            await asyncio.gather(*(run_one(s) for s in self.streams))
+            await asyncio.gather(*(run_one(st, cfg, name) for st, cfg, name in named))
         finally:
             self._ready = False
             if self._runner is not None:

@@ -293,3 +293,38 @@ def test_continuous_server_sampling_deterministic_per_seed():
     # the seed must actually steer sampling: some seed in a small set differs
     others = [asyncio.run(run(seed)) for seed in (43, 44, 45, 46)]
     assert any(o != a for o in others)
+
+
+def test_tpu_generate_tensor_parallel_batch_mode():
+    """tp=2 sharded generation must match single-device greedy output."""
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Resource, build_component
+
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("needs 2 virtual devices")
+    base = {"type": "tpu_generate", "model": "decoder_lm",
+            "model_config": TINY, "max_input": 16, "max_new_tokens": 6,
+            "eos_id": -1, "batch_buckets": [4], "seq_buckets": [16]}
+    single = build_component("processor", base, Resource())
+    tp = build_component("processor", {**base, "mesh": {"tp": 2}}, Resource())
+
+    async def go():
+        batch = MessageBatch.new_binary([b"alpha beta", b"gamma"])
+        a = (await single.process(batch))[0].column("generated").to_pylist()
+        b = (await tp.process(batch))[0].column("generated").to_pylist()
+        assert a == b
+
+    asyncio.run(go())
+
+
+def test_tpu_generate_continuous_plus_mesh_rejected():
+    from arkflow_tpu.components import Resource, build_component
+
+    with pytest.raises(ConfigError, match="composed"):
+        build_component(
+            "processor",
+            {"type": "tpu_generate", "model": "decoder_lm", "model_config": TINY,
+             "serving": "continuous", "mesh": {"tp": 2}},
+            Resource(),
+        )
