@@ -67,9 +67,10 @@ class StreamConfig:
     buffer: Optional[dict] = None
     temporary: list[TemporaryConfig] = field(default_factory=list)
     name: Optional[str] = None
-    #: crash policy: {max_retries: N, backoff: "5s"} rebuilds and restarts a
-    #: crashed stream (the reference only logs, ref engine/mod.rs:268-273);
-    #: None keeps log-and-stop behavior
+    #: crash policy: {max_retries: N, backoff: "5s", reset_after: "5m"}
+    #: rebuilds and restarts a crashed stream (the reference only logs,
+    #: ref engine/mod.rs:268-273); a run longer than reset_after restores
+    #: the full retry budget; None keeps log-and-stop behavior
     restart: Optional[dict] = None
 
     @classmethod
@@ -100,11 +101,17 @@ def _restart_config(m: Any) -> Optional[dict]:
         raise ConfigError("stream 'restart' must be a mapping")
     from arkflow_tpu.utils.duration import parse_duration
 
-    out = {
-        "max_retries": int(m.get("max_retries", 3)),
-        "backoff_s": parse_duration(str(m.get("backoff", "5s"))),
-    }
-    if out["max_retries"] < 0 or out["backoff_s"] < 0:
+    try:
+        out = {
+            "max_retries": int(m.get("max_retries", 3)),
+            "backoff_s": parse_duration(str(m.get("backoff", "5s"))),
+            # a run at least this long resets the retry budget (supervisor
+            # convention: occasional crashes over days shouldn't accumulate)
+            "reset_after_s": parse_duration(str(m.get("reset_after", "5m"))),
+        }
+    except (TypeError, ValueError) as e:
+        raise ConfigError(f"stream 'restart' values invalid: {e}") from e
+    if out["max_retries"] < 0 or out["backoff_s"] < 0 or out["reset_after_s"] < 0:
         raise ConfigError("stream restart values must be non-negative")
     return out
 

@@ -39,7 +39,10 @@ class BertConfig:
     #: per-row lengths; ModelRunner enforces this outside jit). Fully-padded
     #: K tiles are skipped on the MXU; pad positions output zeros instead of
     #: attending (identical [CLS] logits — pad keys are masked either way).
-    use_flash_attention: bool = False
+    #: None = auto: ModelRunner resolves to True on TPU backends (where the
+    #: kernel wins on partially-filled buckets), False elsewhere; direct
+    #: ``apply`` callers get the XLA path unless they opt in explicitly.
+    use_flash_attention: "bool | None" = None
     flash_interpret: bool = False  # CPU-interpret mode (tests)
 
 

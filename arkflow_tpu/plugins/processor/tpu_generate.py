@@ -52,7 +52,9 @@ class TpuGenerateProcessor(Processor):
         import jax
 
         from arkflow_tpu.models import get_model
+        from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
 
+        enable_persistent_cache()  # the whole-generation jit is the costliest compile
         if serving == "continuous" and mesh_config:
             raise ConfigError(
                 "tpu_generate: continuous serving + mesh sharding is not "
@@ -92,13 +94,19 @@ class TpuGenerateProcessor(Processor):
         if mesh_config:
             from arkflow_tpu.parallel.mesh import MeshSpec, create_mesh, shard_params
 
-            spec = MeshSpec(dp=int(mesh_config.get("dp", 1)),
-                            tp=int(mesh_config.get("tp", 1)),
-                            sp=int(mesh_config.get("sp", 1)))
-            self.mesh = create_mesh(spec)
+            try:
+                spec = MeshSpec(dp=int(mesh_config.get("dp", 1)),
+                                tp=int(mesh_config.get("tp", 1)),
+                                sp=int(mesh_config.get("sp", 1)))
+                self.mesh = create_mesh(spec)
+            except ConfigError:
+                raise
+            except (TypeError, ValueError) as e:
+                raise ConfigError(f"tpu_generate mesh config invalid: {e}") from e
             axes = {name: name for name in self.mesh.axis_names}
-            self.params = shard_params(
-                params, self.family.param_specs(self.cfg, axes), self.mesh)
+            pspecs = (self.family.param_specs(self.cfg, axes)
+                      if self.family.param_specs else None)
+            self.params = shard_params(params, pspecs, self.mesh)
         else:
             self.params = jax.device_put(params, jax.devices()[0])
 

@@ -131,10 +131,22 @@ class Engine:
         await self._start_health_server()
         self._install_signal_handlers()
 
+        async def backoff(seconds: float) -> bool:
+            """Cancel-aware sleep; True if we should keep going."""
+            cancel_wait = asyncio.ensure_future(self.cancel.wait())
+            try:
+                await asyncio.wait({cancel_wait}, timeout=seconds)
+            finally:
+                cancel_wait.cancel()
+            return not self.cancel.is_set()
+
         async def run_one(stream: Stream, cfg, name: str) -> None:
+            import time as _time
+
             policy = cfg.restart or {}
             retries = 0
             while True:
+                run_started = _time.monotonic()
                 try:
                     await stream.run(self.cancel)
                     logger.info("[%s] finished", stream.name)
@@ -143,26 +155,30 @@ class Engine:
                     logger.exception("[%s] stream crashed", stream.name)
                 if not policy or self.cancel.is_set():
                     return  # reference behavior: log, don't take the engine down
-                if retries >= policy["max_retries"]:
-                    logger.error("[%s] restart budget exhausted (%d)", name,
-                                 policy["max_retries"])
-                    return
-                retries += 1
-                logger.warning("[%s] restarting (%d/%d) in %.1fs", name,
-                               retries, policy["max_retries"], policy["backoff_s"])
-                # cancel-aware backoff: SIGTERM must not wait out the backoff
-                cancel_wait = asyncio.ensure_future(self.cancel.wait())
-                try:
-                    await asyncio.wait({cancel_wait},
-                                       timeout=policy["backoff_s"])
-                finally:
-                    cancel_wait.cancel()
-                if self.cancel.is_set():
-                    return
-                # rebuild from config: the crashed instance's components may
-                # hold broken connections/state; swap it into self.streams so
-                # introspection/shutdown see the LIVE instance
-                stream = build_stream(cfg, name=name)
+                # a long healthy run earns back the full budget, so a stream
+                # that crashes once a day doesn't die permanently on the Nth
+                if _time.monotonic() - run_started >= policy["reset_after_s"]:
+                    retries = 0
+                # retry loop: each attempt consumes budget and must yield a
+                # FRESH instance — the crashed one's components are closed
+                # and may hold broken connections, so it is never re-run
+                while True:
+                    if retries >= policy["max_retries"]:
+                        logger.error("[%s] restart budget exhausted (%d)", name,
+                                     policy["max_retries"])
+                        return
+                    retries += 1
+                    logger.warning("[%s] restarting (%d/%d) in %.1fs", name,
+                                   retries, policy["max_retries"], policy["backoff_s"])
+                    if not await backoff(policy["backoff_s"]):
+                        return
+                    try:
+                        stream = build_stream(cfg, name=name)
+                        break
+                    except Exception:
+                        logger.exception("[%s] rebuild failed", name)
+                # swap into self.streams so introspection/shutdown see the
+                # LIVE instance
                 for i, old in enumerate(self.streams):
                     if old.name == name:
                         self.streams[i] = stream

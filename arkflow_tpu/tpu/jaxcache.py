@@ -1,0 +1,67 @@
+"""Persistent XLA compilation cache for the serving tier.
+
+Remote/tunneled TPU backends pay tens of seconds (sometimes minutes) per
+executable compile; with the persistent cache each (model, shape, dtype)
+bucket compiles once per machine instead of once per process, so engine
+restarts, benchmark reruns, and the driver's end-of-round `bench.py` all
+start serving at full speed immediately.
+
+The reference engine has no analog (an interpreted CPU data plane never
+compiles); this is TPU-native operational hygiene, same motivation as the
+executable warm-up hook (SURVEY.md §7.5: keep the compiled model fed, never
+stall steady-state on a compile).
+
+Knobs:
+- ``ARKFLOW_JAX_CACHE=0`` disables.
+- ``ARKFLOW_JAX_CACHE_DIR`` overrides the location (default: ``.jax_cache``
+  next to the package, i.e. the repo root; falls back silently if the
+  directory is not creatable).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("arkflow.tpu")
+
+_configured: Optional[str] = None
+_attempted = False
+
+
+def enable_persistent_cache() -> Optional[str]:
+    """Idempotently point JAX at an on-disk compilation cache.
+
+    Returns the cache directory in use, or None when disabled/unavailable.
+    Must run before the first compile to help that compile; safe any time.
+    """
+    global _configured, _attempted
+    if _attempted:
+        return _configured
+    _attempted = True
+    if os.environ.get("ARKFLOW_JAX_CACHE", "1") == "0":
+        return None
+    path = (
+        os.environ.get("ARKFLOW_JAX_CACHE_DIR")
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache every executable regardless of compile time (jax's default
+        # threshold of 1s would skip the small bucket-grid executables that
+        # recompile on every engine restart)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _configured = path
+        logger.debug("persistent XLA compilation cache at %s", path)
+    except Exception as e:  # never let cache plumbing break serving
+        logger.warning("persistent compilation cache unavailable: %s", e)
+        _configured = None
+    return _configured

@@ -60,8 +60,21 @@ class ModelRunner:
         devices=None,
         serving_dtype: Optional[str] = None,
     ):
+        from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
+
+        enable_persistent_cache()
         self.family = get_model(model)
         self.cfg = self.family.make_config(**(model_config or {}))
+        raw_flash = getattr(self.cfg, "use_flash_attention", False)
+        self.cfg = self._resolve_auto_flags(self.cfg, devices, mesh_spec)
+        #: flash explicitly requested in user config (never mutated): only
+        #: then does an unservable mask raise; auto-chosen flash falls back
+        #: to XLA instead of failing the stream. Immutable so concurrent
+        #: _prep threads can't race a fallback into a spurious raise.
+        self._flash_user_forced = raw_flash is True
+        import threading
+
+        self._flash_lock = threading.Lock()
         self.buckets = buckets or BucketPolicy()
         self.spec = self.family.input_spec(self.cfg)
         if serving_dtype not in (None, "float32", "bfloat16", "float16"):
@@ -106,27 +119,12 @@ class ModelRunner:
         self.params = params
         self._axes = axes
 
-        apply_fn = self.family.apply
-        # thread mesh/axes into families whose apply understands sharded
-        # execution (e.g. decoder ring attention); others get plain calls
-        import inspect
-
-        sig = inspect.signature(apply_fn)
-        extra_kwargs: dict[str, Any] = {}
-        if "axes" in sig.parameters and axes:
-            extra_kwargs["axes"] = axes
-        if "mesh" in sig.parameters and self.mesh is not None:
-            extra_kwargs["mesh"] = self.mesh
         if getattr(self.cfg, "use_ring_attention", False) and "sp" not in axes:
             raise ConfigError(
                 "use_ring_attention requires a mesh with an 'sp' axis "
                 "(set mesh: {sp: N} on the processor)"
             )
-
-        def run(params, inputs):
-            return apply_fn(params, self.cfg, **inputs, **extra_kwargs)
-
-        self._jitted = jax.jit(run)
+        self._build_jitted()
 
         reg = global_registry()
         labels = {"model": model}
@@ -151,10 +149,75 @@ class ModelRunner:
         #: overlaps compute of n); more just adds latency
         self.max_in_flight = 2
         self._inflight_sem: Optional[asyncio.Semaphore] = None
-        self._compiling: dict[tuple, asyncio.Event] = {}
         self._inflight = 0
         self._busy_start = 0.0
         self._last_idle_start: Optional[float] = None
+
+    @staticmethod
+    def _resolve_auto_flags(cfg, devices, mesh_spec):
+        """``use_flash_attention=None`` means auto: the ragged Pallas kernel
+        on single-device TPU serving (it skips the fully-padded K tiles XLA
+        attention burns MXU cycles on), XLA attention elsewhere (Pallas on
+        CPU is interpret-only — orders of magnitude slower; under a mesh the
+        kernel would need a shard_map wrapper, so sharded serving keeps the
+        GSPMD-partitionable XLA path). ``ARKFLOW_FLASH=0`` is the operator
+        kill switch: it forces the XLA path even over an explicit
+        ``use_flash_attention: true`` in config."""
+        import os
+
+        if not hasattr(cfg, "use_flash_attention"):
+            return cfg
+        import dataclasses
+
+        if os.environ.get("ARKFLOW_FLASH", "1") == "0":
+            return dataclasses.replace(cfg, use_flash_attention=False)
+        if cfg.use_flash_attention is not None:
+            return cfg
+        if mesh_spec is not None and mesh_spec.num_devices > 1:
+            return dataclasses.replace(cfg, use_flash_attention=False)
+        try:
+            dev = devices[0] if devices else jax.devices()[0]
+            on_tpu = dev.platform == "tpu" or "tpu" in getattr(dev, "device_kind", "").lower()
+        except Exception:
+            on_tpu = False
+        return dataclasses.replace(cfg, use_flash_attention=on_tpu)
+
+    def _build_jitted(self) -> None:
+        """(Re)build the jitted step from the CURRENT self.cfg. jax.jit keys
+        executables on the function object, so any cfg change that alters
+        tracing (e.g. disabling flash attention) must rebuild — mutating
+        self.cfg alone would keep serving stale executables for seen shapes."""
+        apply_fn = self.family.apply
+        # thread mesh/axes into families whose apply understands sharded
+        # execution (e.g. decoder ring attention); others get plain calls
+        import inspect
+
+        sig = inspect.signature(apply_fn)
+        extra_kwargs: dict[str, Any] = {}
+        if "axes" in sig.parameters and self._axes:
+            extra_kwargs["axes"] = self._axes
+        if "mesh" in sig.parameters and self.mesh is not None:
+            extra_kwargs["mesh"] = self.mesh
+        cfg = self.cfg
+
+        def run(params, inputs):
+            return apply_fn(params, cfg, **inputs, **extra_kwargs)
+
+        self._jitted = jax.jit(run)
+
+    def _disable_flash(self) -> None:
+        """Auto-fallback: serve with XLA attention from now on (one
+        recompile per bucket; prior flash executables are abandoned).
+        Concurrent _prep threads may call this together; the lock makes
+        the cfg flip + jit rebuild happen once."""
+        import dataclasses
+
+        with self._flash_lock:
+            if not getattr(self.cfg, "use_flash_attention", False):
+                return  # another thread already fell back
+            self.cfg = dataclasses.replace(self.cfg, use_flash_attention=False)
+            self._seen_shapes.clear()
+            self._build_jitted()
 
     # -- checkpoint --------------------------------------------------------
 
@@ -234,10 +297,18 @@ class ModelRunner:
             lengths = m.sum(axis=1)
             prefix = (np.arange(m.shape[1])[None, :] < lengths[:, None]).astype(m.dtype)
             if not np.array_equal(prefix, m):
-                raise ConfigError(
-                    "use_flash_attention requires right-padded attention masks "
-                    "(contiguous prefix of ones)"
-                )
+                if self._flash_user_forced:
+                    raise ConfigError(
+                        "use_flash_attention requires right-padded attention "
+                        "masks (contiguous prefix of ones)"
+                    )
+                # flash was an auto choice, not user config: serve the
+                # batch via XLA attention instead of failing the stream
+                logger.warning(
+                    "[%s] non-right-padded attention mask: disabling auto "
+                    "flash attention (XLA path; one recompile per bucket)",
+                    self.family.name)
+                self._disable_flash()
         return padded, n
 
     def _dispatch(self, padded: dict[str, Any]):
@@ -294,8 +365,7 @@ class ModelRunner:
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
         padded, n = await loop.run_in_executor(None, self._prep, inputs)
         key = self._shape_key(padded)
-        first = key not in self._seen_shapes
-        if first:
+        if key not in self._seen_shapes:
             self._seen_shapes.add(key)
             self.m_compiles.inc()
         if self._inflight_sem is None:
@@ -304,24 +374,12 @@ class ModelRunner:
             t0 = time.perf_counter()
             self._track_dispatch(t0)
             try:
-                compiling = self._compiling.get(key)
-                if first:
-                    # a cold shape compiles inside dispatch — keep that off
-                    # the event loop; warm shapes dispatch in sub-ms
-                    ev = asyncio.Event()
-                    self._compiling[key] = ev
-                    try:
-                        out = await loop.run_in_executor(None, self._dispatch, padded)
-                    finally:
-                        ev.set()
-                        self._compiling.pop(key, None)
-                elif compiling is not None and not compiling.is_set():
-                    # same shape, compile still in progress elsewhere: this
-                    # dispatch would block inside the compile — keep it off
-                    # the loop too
-                    out = await loop.run_in_executor(None, self._dispatch, padded)
-                else:
-                    out = self._dispatch(padded)
+                # dispatch always runs in the executor: warm shapes cost one
+                # sub-ms thread hop, cold shapes (or a jit swapped mid-flight
+                # by _disable_flash) compile for seconds-to-minutes on remote
+                # backends — never on the event loop, where a compile would
+                # stall every stream plus the health/metrics endpoints
+                out = await loop.run_in_executor(None, self._dispatch, padded)
                 out = await loop.run_in_executor(None, jax.device_get, out)
             finally:
                 t1 = time.perf_counter()

@@ -54,6 +54,9 @@ class GenerationServer:
                  max_seq: int = 512, eos_id: int = 2,
                  prompt_buckets: Optional[list[int]] = None,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+        from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
+
+        enable_persistent_cache()
         if cfg.use_ring_attention:
             raise ConfigError("paged serving does not support ring attention")
         self.params = params

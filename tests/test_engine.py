@@ -185,6 +185,47 @@ def test_stream_restart_policy_rebuilds_crashed_stream(monkeypatch, tmp_path):
     assert crashes["n"] == 2  # crashed twice, third rebuild ran to completion
 
 
+def test_restart_rebuild_failure_does_not_kill_engine(monkeypatch):
+    """A failure while REBUILDING a crashed stream consumes a retry and is
+    retried on the next attempt, rather than escaping asyncio.gather and
+    cancelling every healthy sibling stream."""
+    import arkflow_tpu.runtime.engine as engine_mod
+    from arkflow_tpu.config import EngineConfig
+
+    cfg = EngineConfig.from_mapping({
+        "streams": [{
+            "name": "flaky",
+            "input": {"type": "generate", "payload": "x", "interval": 0,
+                      "batch_size": 1, "count": 1},
+            "pipeline": {"thread_num": 1, "processors": []},
+            "output": {"type": "drop"},
+            "restart": {"max_retries": 2, "backoff": "10ms"},
+        }],
+        "health_check": {"enabled": False},
+    })
+    crashes = {"n": 0}
+
+    async def crash_run(self, cancel):
+        crashes["n"] += 1
+        raise RuntimeError("injected stream crash")
+
+    monkeypatch.setattr(engine_mod.Stream, "run", crash_run)
+    real_build = engine_mod.build_stream
+    builds = {"n": 0}
+
+    def flaky_build(cfg, name=None):
+        builds["n"] += 1
+        if builds["n"] == 2:  # first REBUILD attempt (after initial build)
+            raise RuntimeError("injected rebuild failure")
+        return real_build(cfg, name=name)
+
+    monkeypatch.setattr(engine_mod, "build_stream", flaky_build)
+    engine = engine_mod.Engine(cfg)
+    # must return normally (budget exhausted), not raise out of gather
+    asyncio.run(asyncio.wait_for(engine.run(), 15))
+    assert builds["n"] >= 2 and crashes["n"] >= 2
+
+
 def test_stream_without_restart_policy_stops_on_crash(monkeypatch):
     import arkflow_tpu.runtime.engine as engine_mod
     from arkflow_tpu.config import EngineConfig

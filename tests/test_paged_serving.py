@@ -298,8 +298,9 @@ def test_continuous_server_sampling_deterministic_per_seed():
 def test_tpu_generate_tensor_parallel_batch_mode():
     """tp=2 sharded generation must match single-device greedy output."""
     from arkflow_tpu.batch import MessageBatch
-    from arkflow_tpu.components import Resource, build_component
+    from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
 
+    ensure_plugins_loaded()
     devs = jax.devices("cpu")
     if len(devs) < 2:
         pytest.skip("needs 2 virtual devices")
@@ -319,8 +320,9 @@ def test_tpu_generate_tensor_parallel_batch_mode():
 
 
 def test_tpu_generate_continuous_plus_mesh_rejected():
-    from arkflow_tpu.components import Resource, build_component
+    from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
 
+    ensure_plugins_loaded()
     with pytest.raises(ConfigError, match="composed"):
         build_component(
             "processor",

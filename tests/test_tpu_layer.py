@@ -93,6 +93,67 @@ def test_runner_unknown_model():
         ModelRunner("nope", {})
 
 
+def test_flash_attention_auto_resolution():
+    """use_flash_attention=None resolves per-backend: False on CPU (Pallas
+    would be interpret-only), preserved when set explicitly, and forced off
+    under a >1-device mesh (the kernel is not GSPMD-partitioned)."""
+    auto = ModelRunner("bert_classifier", TINY_BERT, buckets=BucketPolicy((4,), (16,)))
+    assert auto.cfg.use_flash_attention is False  # tests run on CPU
+    explicit = ModelRunner(
+        "bert_classifier", dict(TINY_BERT, use_flash_attention=True, flash_interpret=True),
+        buckets=BucketPolicy((4,), (16,)))
+    assert explicit.cfg.use_flash_attention is True
+    out = explicit.infer_sync({"input_ids": np.ones((2, 16), np.int32),
+                               "attention_mask": np.ones((2, 16), np.int32)})
+    assert out["label"].shape == (2,)
+
+
+def test_flash_auto_falls_back_on_bad_mask():
+    """An auto-chosen flash kernel must not fail the stream on masks it
+    can't serve: the runner flips to XLA attention and serves the batch."""
+    runner = ModelRunner(
+        "bert_classifier", dict(TINY_BERT, use_flash_attention=True, flash_interpret=True),
+        buckets=BucketPolicy((4,), (16,)))
+    runner._flash_user_forced = False  # simulate auto-resolution (CPU resolves False)
+    mask = np.ones((2, 16), np.int32)
+    mask[:, 0] = 0  # left padding: not a contiguous prefix
+    out = runner.infer_sync({"input_ids": np.ones((2, 16), np.int32),
+                             "attention_mask": mask})
+    assert out["label"].shape == (2,)
+    assert runner.cfg.use_flash_attention is False  # fell back, stays XLA
+    # explicit user config still hard-fails (silent mis-attention is worse)
+    explicit = ModelRunner(
+        "bert_classifier", dict(TINY_BERT, use_flash_attention=True, flash_interpret=True),
+        buckets=BucketPolicy((4,), (16,)))
+    with pytest.raises(ConfigError):
+        explicit.infer_sync({"input_ids": np.ones((2, 16), np.int32),
+                             "attention_mask": mask})
+
+
+def test_persistent_cache_idempotent(tmp_path, monkeypatch):
+    import jax
+
+    from arkflow_tpu.tpu import jaxcache
+
+    # jax.config is process-global: restore it so later tests don't compile
+    # into this test's tmp dir
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        monkeypatch.setattr(jaxcache, "_attempted", False)
+        monkeypatch.setattr(jaxcache, "_configured", None)
+        monkeypatch.setenv("ARKFLOW_JAX_CACHE_DIR", str(tmp_path / "jc"))
+        p1 = jaxcache.enable_persistent_cache()
+        p2 = jaxcache.enable_persistent_cache()
+        assert p1 == p2 == str(tmp_path / "jc")
+        monkeypatch.setattr(jaxcache, "_attempted", False)
+        monkeypatch.setenv("ARKFLOW_JAX_CACHE", "0")
+        assert jaxcache.enable_persistent_cache() is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", old_min)
+
+
 def test_e2e_streaming_bert_classification():
     """The minimum end-to-end slice (SURVEY.md section 7 step 4):
     generate -> memory buffer micro-batching -> tpu_inference -> sink."""
