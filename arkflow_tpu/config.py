@@ -27,6 +27,9 @@ from arkflow_tpu.errors import ConfigError
 class PipelineConfig:
     thread_num: int = 0  # 0 -> cpu count
     processors: list[dict] = field(default_factory=list)
+    #: >0 runs the chain in that many worker PROCESSES (GIL escape for
+    #: Python-bound transforms; see runtime/procpool.py). 0 = in-process.
+    process_pool: int = 0
 
     @classmethod
     def from_mapping(cls, m: Mapping[str, Any]) -> "PipelineConfig":
@@ -35,10 +38,15 @@ class PipelineConfig:
         threads = m.get("thread_num", 0)
         if not isinstance(threads, int) or threads < 0:
             raise ConfigError(f"pipeline.thread_num must be a non-negative int, got {threads!r}")
+        pool = m.get("process_pool", 0)
+        if not isinstance(pool, int) or pool < 0:
+            raise ConfigError(
+                f"pipeline.process_pool must be a non-negative int, got {pool!r}")
         procs = m.get("processors", [])
         if not isinstance(procs, list):
             raise ConfigError("pipeline.processors must be a list")
-        return cls(thread_num=threads, processors=[dict(p) for p in procs])
+        return cls(thread_num=threads, processors=[dict(p) for p in procs],
+                   process_pool=pool)
 
     def effective_threads(self) -> int:
         return self.thread_num if self.thread_num > 0 else (os.cpu_count() or 1)

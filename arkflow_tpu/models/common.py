@@ -30,6 +30,10 @@ def dense_init(key, in_dim: int, out_dim: int, *, bias: bool = True) -> Params:
 
 
 def dense(p: Params, x: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    if "w_q" in p:  # W8A8 serving form (models/quantize.py): int8 on the MXU
+        from arkflow_tpu.models.quantize import dense_w8a8
+
+        return dense_w8a8(p, x, dtype)
     y = x.astype(dtype) @ p["w"].astype(dtype)
     if "b" in p:
         y = y + p["b"].astype(dtype)

@@ -28,6 +28,8 @@ Config:
     mesh: {dp: 1, tp: 4}           # optional multi-chip serving
     checkpoint: /path/to/orbax     # optional
     warmup: false                  # precompile bucket grid at connect
+    serving_dtype: bfloat16        # float32 | bfloat16 | float16 | int8
+                                   # (int8 = dynamic W8A8, 2x MXU roofline)
 """
 
 from __future__ import annotations

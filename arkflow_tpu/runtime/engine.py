@@ -157,7 +157,9 @@ class Engine:
                     return  # reference behavior: log, don't take the engine down
                 # a long healthy run earns back the full budget, so a stream
                 # that crashes once a day doesn't die permanently on the Nth
-                if _time.monotonic() - run_started >= policy["reset_after_s"]:
+                # (.get: tolerate policy dicts built without _restart_config)
+                reset_after = policy.get("reset_after_s", float("inf"))
+                if _time.monotonic() - run_started >= reset_after:
                     retries = 0
                 # retry loop: each attempt consumes budget and must yield a
                 # FRESH instance — the crashed one's components are closed

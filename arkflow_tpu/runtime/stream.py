@@ -336,13 +336,24 @@ def build_stream(cfg: StreamConfig, name: Optional[str] = None) -> Stream:
     for tcfg in cfg.temporary:
         resource.temporaries[tcfg.name] = build_component("temporary", tcfg.config, resource)
     input_ = build_component("input", cfg.input, resource)
-    processors = [build_component("processor", p, resource) for p in cfg.pipeline.processors]
+    if cfg.pipeline.process_pool > 0:
+        from arkflow_tpu.runtime.procpool import ProcessPoolPipeline
+
+        # chain lives in the workers; nothing is built in-parent (a parent
+        # copy would double-open connections the workers also hold)
+        pipeline = ProcessPoolPipeline(
+            cfg.pipeline.processors, cfg.pipeline.process_pool,
+            temporary_configs=[(t.name, t.config) for t in cfg.temporary])
+    else:
+        processors = [build_component("processor", p, resource)
+                      for p in cfg.pipeline.processors]
+        pipeline = Pipeline(processors)
     output = build_component("output", cfg.output, resource)
     error_output = build_component("output", cfg.error_output, resource) if cfg.error_output else None
     buffer = build_component("buffer", cfg.buffer, resource) if cfg.buffer else None
     return Stream(
         input_=input_,
-        pipeline=Pipeline(processors),
+        pipeline=pipeline,
         output=output,
         error_output=error_output,
         buffer=buffer,

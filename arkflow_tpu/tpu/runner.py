@@ -77,9 +77,15 @@ class ModelRunner:
         self._flash_lock = threading.Lock()
         self.buckets = buckets or BucketPolicy()
         self.spec = self.family.input_spec(self.cfg)
-        if serving_dtype not in (None, "float32", "bfloat16", "float16"):
+        if serving_dtype not in (None, "float32", "bfloat16", "float16", "int8"):
             raise ConfigError(
-                f"serving_dtype {serving_dtype!r} invalid (float32/bfloat16/float16)")
+                f"serving_dtype {serving_dtype!r} invalid "
+                "(float32/bfloat16/float16/int8)")
+        if serving_dtype == "int8" and mesh_spec is not None and mesh_spec.num_devices > 1:
+            raise ConfigError(
+                "serving_dtype int8 is single-device for now (quantized param "
+                "keys don't line up with the family's tensor-parallel "
+                "param_specs)")
         self.serving_dtype = serving_dtype
 
         # init on host CPU (op-by-op init over a remote-TPU tunnel is pathological),
@@ -92,7 +98,15 @@ class ModelRunner:
             params = self.family.init(jax.random.PRNGKey(seed), self.cfg)
         if checkpoint:
             params = self._restore(checkpoint, params)
-        if self.serving_dtype and self.serving_dtype != "float32":
+        if self.serving_dtype == "int8":
+            # W8A8 dynamic quantization: dense weights to per-channel int8
+            # (doubles the MXU roofline vs bf16), everything else to bf16
+            from arkflow_tpu.models.quantize import quantize_for_serving
+
+            params, n_q = quantize_for_serving(params)
+            logger.info("[%s] int8 serving: %d dense layers quantized",
+                        self.family.name, n_q)
+        elif self.serving_dtype and self.serving_dtype != "float32":
             # bf16 serving cast: halves param HBM + host->device transfer and
             # keeps matmuls on the MXU's native dtype; logits/softmax layers
             # still accumulate/cast to f32 inside the model

@@ -1,0 +1,95 @@
+"""Process-pool pipeline tier: IPC round-trip, e2e stream, error paths."""
+
+import asyncio
+
+import pytest
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import ensure_plugins_loaded
+from arkflow_tpu.config import StreamConfig
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.runtime import build_stream
+from arkflow_tpu.runtime.procpool import (
+    ProcessPoolPipeline,
+    batch_to_ipc,
+    ipc_to_batch,
+)
+
+ensure_plugins_loaded()
+
+
+def test_ipc_round_trip_preserves_metadata():
+    b = (MessageBatch.new_binary([b"a", b"bb"])
+         .with_source("src1").with_offset(7))
+    out = ipc_to_batch(batch_to_ipc(b))
+    assert out.to_binary() == [b"a", b"bb"]
+    assert out.get_meta("__meta_source") == "src1"
+    assert out.get_meta("__meta_offset") == 7
+
+
+def test_process_pool_rejects_device_processors():
+    with pytest.raises(ConfigError, match="device"):
+        ProcessPoolPipeline([{"type": "tpu_inference", "model": "bert_classifier"}], 2)
+
+
+def test_process_pool_pipeline_runs_chain():
+    pool = ProcessPoolPipeline(
+        [{"type": "json_to_arrow"},
+         {"type": "sql", "query": "SELECT v * 2 AS v2 FROM flow WHERE v > 1"}],
+        workers=2)
+
+    async def go():
+        await pool.connect()
+        try:
+            out = await pool.process(
+                MessageBatch.new_binary([b'{"v": 1}', b'{"v": 5}', b'{"v": 9}']))
+            assert len(out) == 1
+            assert out[0].column("v2").to_pylist() == [10, 18]
+        finally:
+            await pool.close()
+
+    asyncio.run(go())
+
+
+def test_process_pool_e2e_stream():
+    """Full stream with pipeline.process_pool: generate -> pool(sql) -> out."""
+    from tests.test_runtime import CollectOutput
+
+    cfg = StreamConfig.from_mapping({
+        "input": {"type": "generate", "payload": '{"v": 3}', "interval": 0,
+                  "batch_size": 4, "count": 12},
+        "pipeline": {
+            "thread_num": 2,
+            "process_pool": 2,
+            "processors": [
+                {"type": "json_to_arrow"},
+                {"type": "sql", "query": "SELECT v + 1 AS w FROM flow"},
+            ],
+        },
+        "output": {"type": "drop"},
+    })
+    stream = build_stream(cfg, name="pool-e2e")
+
+    async def go():
+        cancel = asyncio.Event()
+        await asyncio.wait_for(stream.run(cancel), 120)
+
+    asyncio.run(go())
+    assert stream.m_rows_out.value == 12
+
+
+def test_process_pool_worker_error_propagates():
+    pool = ProcessPoolPipeline(
+        [{"type": "json_to_arrow"},
+         {"type": "sql", "query": "SELECT nosuchcol FROM flow"}],
+        workers=1)
+
+    async def go():
+        await pool.connect()
+        try:
+            with pytest.raises(Exception):
+                await pool.process(MessageBatch.new_binary([b'{"v": 1}']))
+        finally:
+            await pool.close()
+
+    asyncio.run(go())

@@ -405,4 +405,4 @@ def test_serving_dtype_bf16_cast():
 
     from arkflow_tpu.errors import ConfigError
     with pytest.raises(ConfigError):
-        ModelRunner("bert_classifier", TINY_BERT, serving_dtype="int8")
+        ModelRunner("bert_classifier", TINY_BERT, serving_dtype="int4")
