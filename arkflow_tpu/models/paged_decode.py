@@ -93,6 +93,93 @@ def paged_prefill(params: dict, cfg: DecoderConfig, input_ids, lengths,
     return jnp.argmax(last_logits, axis=-1).astype(jnp.int32), new_k, new_v
 
 
+def paged_prefill_chunk(params: dict, cfg: DecoderConfig, input_ids, chunk_off,
+                        chunk_len, page_table, k_pages, v_pages,
+                        return_all: bool = False):
+    """Prefill ONE CHUNK of a prompt at absolute offset ``chunk_off``.
+
+    Chunked prefill keeps continuous serving responsive: a long prompt no
+    longer occupies the device for one monolithic prefill while every
+    decode lane stalls — the scheduler interleaves fixed-size chunks with
+    decode steps (same motivation as Sarathi/vLLM chunked prefill,
+    re-expressed for XLA static shapes: one executable per chunk size).
+
+    input_ids: [B, C] right-padded chunk; chunk_off: [B] absolute start
+    position; chunk_len: [B] true tokens in this chunk; page_table: [B, P]
+    must already map every page the chunk writes (plus all earlier ones).
+    Earlier chunks' K/V are read back through the page-table gather, so
+    attention is exact over positions 0..off+i for query i.
+
+    Returns (last_logits [B, vocab] — at the chunk's final true position,
+    meaningful only for the prompt's last chunk — , k_pages, v_pages).
+    With ``return_all`` (speculative verification): logits for EVERY chunk
+    position, [B, C, vocab].
+
+    Doubles as the speculative-decode verifier: scoring k drafted tokens is
+    one call with C=k. Rejected drafts leave stale K/V at their positions,
+    which is benign — no mask ever admits a key position beyond the
+    querying token's own position, and the position->page mapping is
+    deterministic, so the true token overwrites the same cell when it arrives.
+    """
+    b, t = input_ids.shape
+    p_slots = page_table.shape[1]
+    page = k_pages.shape[2]
+    ctx = p_slots * page
+    dh = cfg.dim // cfg.heads
+    group = cfg.heads // cfg.kv_heads
+
+    positions = chunk_off[:, None] + jnp.arange(t)[None, :]       # [B, C]
+    pos_valid = jnp.arange(t)[None, :] < chunk_len[:, None]       # [B, C]
+    logical_page = positions // page
+    page_idx = jnp.where(
+        pos_valid,
+        jnp.take_along_axis(page_table, jnp.minimum(logical_page, p_slots - 1), axis=1),
+        0,
+    )
+    offset = jnp.where(pos_valid, positions % page, 0)
+    key_pos = jnp.arange(ctx)[None, None, None, :]                # [1,1,1,ctx]
+    # query i attends keys 0..off+i. Padded queries keep this causal mask
+    # rather than an all-False row: a fully-masked softmax is NaN, and a
+    # NaN activation would leak through the MoE dispatch einsum (0 * NaN)
+    # into real tokens' expert inputs. Their finite garbage output is
+    # excluded from routing by token_mask and never read out.
+    mask = key_pos <= positions[:, None, :, None]                 # [B,1,C,ctx]
+    x = cm.embedding(params["embed"], input_ids)
+
+    def layer(carry, lp_and_pools):
+        x, = carry
+        lp, kp, vp = lp_and_pools
+        y = cm.rms_norm(lp["attn_norm"], x, cfg.norm_eps)
+        q = cm.dense(lp["wq"], y).reshape(b, t, cfg.heads, dh)
+        k = cm.dense(lp["wk"], y).reshape(b, t, cfg.kv_heads, dh)
+        v = cm.dense(lp["wv"], y).reshape(b, t, cfg.kv_heads, dh)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        kp = kp.at[page_idx, offset].set(k.astype(jnp.bfloat16))
+        vp = vp.at[page_idx, offset].set(v.astype(jnp.bfloat16))
+        # earlier chunks' keys come back through the page gather (this
+        # chunk's own keys were just scattered, so they are included too)
+        kk = kp[page_table].reshape(b, ctx, cfg.kv_heads, dh).astype(x.dtype)
+        vv = vp[page_table].reshape(b, ctx, cfg.kv_heads, dh).astype(x.dtype)
+        kk = jnp.repeat(kk, group, axis=2)
+        vv = jnp.repeat(vv, group, axis=2)
+        attn = cm.attention(q, kk, vv, mask).reshape(b, t, cfg.heads * dh)
+        x = x + cm.dense(lp["wo"], attn)
+        y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
+        x = x + _mlp(lp, y, cfg, token_mask=pos_valid)
+        return (x,), (kp, vp)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        layer, (x,), (params["layers"], k_pages, v_pages))
+    x = cm.rms_norm(params["norm_out"], x, cfg.norm_eps)
+    logits = cm.dense(params["lm_head"], x).astype(jnp.float32)
+    if return_all:
+        return logits, new_k, new_v
+    last = jnp.clip(chunk_len - 1, 0, t - 1)
+    last_logits = jnp.take_along_axis(logits, last[:, None, None], axis=1)[:, 0, :]
+    return last_logits, new_k, new_v
+
+
 def paged_decode_step(params: dict, cfg: DecoderConfig, token_ids, lengths,
                       active, page_table, k_pages, v_pages,
                       return_logits: bool = False):

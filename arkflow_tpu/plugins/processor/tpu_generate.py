@@ -23,6 +23,12 @@ Config:
     eos_id: 2
     output_field: generated
     batch_buckets: [8, 16]
+    serving: continuous      # batch | continuous (paged KV + lockstep slots)
+    prefill_chunk: 128       # continuous mode: admit long prompts in chunks
+                             # interleaved with decode steps (0 = one-shot)
+    speculative_tokens: 3    # continuous+greedy: self-drafted (n-gram
+                             # lookup) speculative decode, verified in one
+                             # chunk call; exact greedy outputs (0 = off)
 """
 
 from __future__ import annotations
@@ -48,7 +54,8 @@ class TpuGenerateProcessor(Processor):
                  output_field: str, buckets: BucketPolicy, seed: int = 0,
                  serving: str = "batch", slots: int = 8, page_size: int = 16,
                  temperature: float = 0.0, top_k: int = 0,
-                 mesh_config: Optional[dict] = None):
+                 mesh_config: Optional[dict] = None, prefill_chunk: int = 0,
+                 speculative_tokens: int = 0):
         import jax
 
         from arkflow_tpu.models import get_model
@@ -137,6 +144,8 @@ class TpuGenerateProcessor(Processor):
                 max_seq=self.max_input + self.max_new_tokens, eos_id=eos_id,
                 prompt_buckets=list(buckets.seq_buckets),
                 temperature=self.temperature, top_k=self.top_k, seed=seed + 1,
+                prefill_chunk=prefill_chunk,
+                speculative_tokens=speculative_tokens,
             )
 
         reg = global_registry()
@@ -228,6 +237,8 @@ def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
         temperature=float(config.get("temperature", 0.0)),
         top_k=int(config.get("top_k", 0)),
         mesh_config=config.get("mesh"),
+        prefill_chunk=int(config.get("prefill_chunk", 0)),
+        speculative_tokens=int(config.get("speculative_tokens", 0)),
     )
 
 

@@ -143,7 +143,15 @@ class Engine:
         async def run_one(stream: Stream, cfg, name: str) -> None:
             import time as _time
 
-            policy = cfg.restart or {}
+            # normalize once: tolerate policy dicts built without
+            # _restart_config (programmatic StreamConfig) missing any key
+            policy = cfg.restart
+            if policy:
+                policy = {"max_retries": policy.get("max_retries", 3),
+                          "backoff_s": policy.get("backoff_s", 5.0),
+                          "reset_after_s": policy.get("reset_after_s", 300.0)}
+            else:
+                policy = {}
             retries = 0
             while True:
                 run_started = _time.monotonic()
@@ -157,9 +165,7 @@ class Engine:
                     return  # reference behavior: log, don't take the engine down
                 # a long healthy run earns back the full budget, so a stream
                 # that crashes once a day doesn't die permanently on the Nth
-                # (.get: tolerate policy dicts built without _restart_config)
-                reset_after = policy.get("reset_after_s", float("inf"))
-                if _time.monotonic() - run_started >= reset_after:
+                if _time.monotonic() - run_started >= policy["reset_after_s"]:
                     retries = 0
                 # retry loop: each attempt consumes budget and must yield a
                 # FRESH instance — the crashed one's components are closed

@@ -36,6 +36,9 @@ from arkflow_tpu.errors import ConfigError, ProcessError
 DEVICE_PROCESSORS = {"tpu_inference", "tpu_generate"}
 
 _worker_pipeline = None  # per-process chain, built once by _init_worker
+_worker_loop = None  # ONE persistent loop per worker: connections opened at
+# connect() (redis temporaries, client sockets) are loop-bound; running each
+# batch on a fresh asyncio.run loop would leave them attached to a dead loop
 
 
 def batch_to_ipc(batch: MessageBatch) -> bytes:
@@ -56,7 +59,7 @@ def _init_worker(processor_configs: list[dict],
     """Pool-process initializer: build temporaries + the chain once per
     worker (each worker owns its own connections, like a worker thread in
     the reference owns its own client handles)."""
-    global _worker_pipeline
+    global _worker_pipeline, _worker_loop
     from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
     from arkflow_tpu.runtime.pipeline import Pipeline
 
@@ -66,7 +69,8 @@ def _init_worker(processor_configs: list[dict],
         resource.temporaries[tname] = build_component("temporary", tcfg, resource)
     procs = [build_component("processor", p, resource) for p in processor_configs]
     _worker_pipeline = Pipeline(procs)
-    asyncio.run(_worker_pipeline.connect())
+    _worker_loop = asyncio.new_event_loop()
+    _worker_loop.run_until_complete(_worker_pipeline.connect())
 
 
 def _ping() -> bool:
@@ -74,8 +78,10 @@ def _ping() -> bool:
 
 
 def _run_chain(ipc: bytes) -> list[bytes]:
-    """Worker-side: one batch through the whole chain."""
-    outs = asyncio.run(_worker_pipeline.process(ipc_to_batch(ipc)))
+    """Worker-side: one batch through the whole chain (on the worker's
+    persistent loop, where the chain's connections live)."""
+    outs = _worker_loop.run_until_complete(
+        _worker_pipeline.process(ipc_to_batch(ipc)))
     return [batch_to_ipc(b) for b in outs]
 
 

@@ -53,7 +53,8 @@ class GenerationServer:
                  page_size: int = 16, num_pages: Optional[int] = None,
                  max_seq: int = 512, eos_id: int = 2,
                  prompt_buckets: Optional[list[int]] = None,
-                 temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 prefill_chunk: int = 0, speculative_tokens: int = 0):
         from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
 
         enable_persistent_cache()
@@ -78,6 +79,14 @@ class GenerationServer:
             {b for b in (prompt_buckets or [32, 128]) if b <= max_seq} | {max_seq})
         self.k_pages, self.v_pages = init_page_pool(cfg, self.num_pages, page_size)
 
+        # chunked prefill: prompts longer than this admit in fixed-size
+        # chunks interleaved with decode steps, so one long prompt never
+        # stalls every decode lane for a monolithic prefill (0 = one-shot)
+        self.prefill_chunk = int(prefill_chunk)
+        #: slot -> next absolute prefill offset (present while admitting)
+        self._prefill_pos: dict[int, int] = {}
+        self._turn_prefill = True  # alternate chunk/decode under contention
+
         # host-side state
         self._free_pages: list[int] = list(range(1, self.num_pages))
         self._slot_req: list[Optional[_Request]] = [None] * slots
@@ -93,6 +102,19 @@ class GenerationServer:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self._key = jax.random.PRNGKey(seed)
+        # self-speculative greedy decode: draft k-1 tokens by n-gram lookup
+        # over the sequence's own history, verify all k in ONE chunk call.
+        # Decode steps are HBM-bandwidth-bound (weights + KV reads dominate),
+        # so scoring k positions costs barely more than one — every accepted
+        # draft is nearly-free throughput. Greedy only: acceptance compares
+        # argmax, which preserves exact greedy outputs.
+        self.speculative_tokens = int(speculative_tokens)
+        if self.speculative_tokens < 0:
+            raise ConfigError("speculative_tokens must be >= 0")
+        if self.speculative_tokens > 0 and self.temperature != 0.0:
+            raise ConfigError(
+                "speculative_tokens requires greedy decoding (temperature 0); "
+                "sampled acceptance is not implemented")
 
         from arkflow_tpu.models.decoder import select_token
 
@@ -112,12 +134,30 @@ class GenerationServer:
                 self.params, cfg, ids, lens, table, kp, vp, return_logits=True)
             return _pick(logits, key), kp, vp
 
+        def _chunk(ids, off, clen, table, kp, vp):
+            from arkflow_tpu.models.paged_decode import paged_prefill_chunk
+
+            return paged_prefill_chunk(self.params, cfg, ids, off, clen,
+                                       table, kp, vp)
+
+        def _verify(ids, off, clen, table, kp, vp):
+            from arkflow_tpu.models.paged_decode import paged_prefill_chunk
+
+            return paged_prefill_chunk(self.params, cfg, ids, off, clen,
+                                       table, kp, vp, return_all=True)
+
         self._decode = jax.jit(_decode, donate_argnums=(4, 5))
         self._prefill = jax.jit(_prefill, donate_argnums=(3, 4))
+        self._chunk = jax.jit(_chunk, donate_argnums=(4, 5))
+        self._verify = jax.jit(_verify, donate_argnums=(4, 5))
 
         reg = global_registry()
         self.m_steps = reg.counter("arkflow_gen_decode_steps_total", "lockstep decode steps")
         self.m_tokens = reg.counter("arkflow_gen_tokens_total", "tokens generated")
+        self.m_spec_drafted = reg.counter(
+            "arkflow_gen_spec_drafted_total", "draft tokens offered for verification")
+        self.m_spec_accepted = reg.counter(
+            "arkflow_gen_spec_accepted_total", "draft tokens accepted")
         self.m_active = reg.gauge("arkflow_gen_active_slots", "busy decode slots")
         self.m_waiting = reg.gauge("arkflow_gen_waiting_requests", "admission queue depth")
         self.m_truncated = reg.counter(
@@ -177,6 +217,11 @@ class GenerationServer:
         need = self._pages_needed(n + 1)
         pages = [self._free_pages.pop() for _ in range(need)]
         self._slot_pages[slot] = pages
+        if self.prefill_chunk and n > self.prefill_chunk:
+            # chunked admission: the serve loop interleaves prefill chunks
+            # with decode steps; the slot joins decode once fully prefilled
+            self._prefill_pos[slot] = 0
+            return
         bucket = self._bucket(n)
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = req.prompt
@@ -210,6 +255,7 @@ class GenerationServer:
     def _finish(self, slot: int) -> None:
         req = self._slot_req[slot]
         self._slot_req[slot] = None
+        self._prefill_pos.pop(slot, None)
         self._free_pages.extend(self._slot_pages[slot])
         self._slot_pages[slot] = []
         self._lengths[slot] = 0
@@ -217,29 +263,102 @@ class GenerationServer:
         if req is not None and not req.future.done():
             req.future.set_result(req.tokens)
 
-    def _ensure_page_capacity(self, slot: int) -> bool:
-        """Grow the slot's page list to cover position lengths[slot]."""
-        need = self._pages_needed(int(self._lengths[slot]) + 1)
+    async def _prefill_one_chunk(self, slot: int) -> None:
+        """One fixed-size prefill chunk for an admitting slot (one device
+        call); seeds the slot for decode after the final chunk."""
+        req = self._slot_req[slot]
+        if req is None:
+            self._prefill_pos.pop(slot, None)
+            return
+        off = self._prefill_pos[slot]
+        n = len(req.prompt)
+        c = self.prefill_chunk
+        chunk = req.prompt[off:off + c]
+        ids = np.zeros((1, c), np.int32)
+        ids[0, :len(chunk)] = chunk
+        table = np.zeros((1, self.pages_per_slot), np.int32)
+        table[0, :len(self._slot_pages[slot])] = self._slot_pages[slot]
+        loop = asyncio.get_running_loop()
+        logits, self.k_pages, self.v_pages = await loop.run_in_executor(
+            None, lambda: jax.block_until_ready(self._chunk(
+                jnp.asarray(ids), jnp.asarray([off], jnp.int32),
+                jnp.asarray([len(chunk)], jnp.int32), jnp.asarray(table),
+                self.k_pages, self.v_pages)))
+        new_off = off + len(chunk)
+        if new_off < n:
+            self._prefill_pos[slot] = new_off
+            return
+        # final chunk: sample the first generated token and join decode
+        del self._prefill_pos[slot]
+        from arkflow_tpu.models.decoder import select_token
+
+        self._key, sub = jax.random.split(self._key)
+        nxt = select_token(logits, sub, self.temperature, self.top_k)
+        self._lengths[slot] = n
+        self._cur_tokens[slot] = int(nxt[0])
+        self._handle_token(slot, int(nxt[0]))
+
+    def _ensure_page_capacity(self, slot: int, total: Optional[int] = None) -> bool:
+        """Grow the slot's page list to cover positions < ``total``
+        (default: the next write position, lengths+1)."""
+        if total is None:
+            total = int(self._lengths[slot]) + 1
+        need = self._pages_needed(total)
         while len(self._slot_pages[slot]) < need:
             if not self._free_pages:
                 return False
             self._slot_pages[slot].append(self._free_pages.pop())
         return True
 
+    def _reserve_or_truncate(self, s: int, act: np.ndarray) -> None:
+        """Ensure slot ``s`` can write its next position; when the pool is
+        dry, finish the longest active sequence (its tokens so far are its
+        result) and RETRY, so the starved slot never scatters into the
+        scratch page and silently corrupts its context."""
+        while act[s] and not self._ensure_page_capacity(s):
+            candidates = [i for i in range(self.slots)
+                          if act[i] and self._slot_req[i] is not None]
+            if not candidates:
+                break
+            longest = max(candidates, key=lambda i: int(self._lengths[i]))
+            req = self._slot_req[longest]
+            logger.warning(
+                "page pool exhausted: truncating slot %d at %d tokens "
+                "(%d/%d generated) — size num_pages for the workload",
+                longest, int(self._lengths[longest]),
+                len(req.tokens) if req else 0,
+                req.max_new_tokens if req else 0)
+            self.m_truncated.inc()
+            self._finish(longest)
+            act[longest] = False
+
     async def _serve_loop(self) -> None:
         try:
             while not self._closed:
                 admitted = await self._admit_pending()
-                active = [s for s in range(self.slots) if self._slot_req[s]]
-                self.m_active.set(len(active))
+                prefilling = [s for s in range(self.slots)
+                              if s in self._prefill_pos and self._slot_req[s]]
+                active = [s for s in range(self.slots)
+                          if self._slot_req[s] and s not in self._prefill_pos]
+                self.m_active.set(len(active) + len(prefilling))
                 self.m_waiting.set(len(self._pending))
-                if not active:
+                if not active and not prefilling:
                     if not self._pending:
                         return  # drained; next generate() restarts the loop
                     if not admitted:
                         await asyncio.sleep(0.01)  # waiting on pages
                     continue
-                await self._step(active)
+                # interleave under contention: alternate one prefill chunk
+                # with one decode step so neither starves the other
+                if prefilling and (not active or self._turn_prefill):
+                    self._turn_prefill = False
+                    await self._prefill_one_chunk(prefilling[0])
+                    continue
+                self._turn_prefill = True
+                if self.speculative_tokens > 0:
+                    await self._step_speculative(active)
+                else:
+                    await self._step(active)
             # closed with work in flight: fail it rather than hang awaiters
             self._fail_all(ConfigError("generation server closed"))
         except Exception as e:  # fail all in-flight requests, don't hang them
@@ -247,6 +366,7 @@ class GenerationServer:
             self._fail_all(e)
 
     def _fail_all(self, err: Exception) -> None:
+        self._prefill_pos.clear()
         for s in range(self.slots):
             req = self._slot_req[s]
             if req is not None and not req.future.done():
@@ -274,27 +394,8 @@ class GenerationServer:
         """One lockstep decode over all slots (inactive lanes masked)."""
         act = np.zeros(self.slots, bool)
         act[active] = True
-        # every active slot needs a page for its next write position; when
-        # the pool is dry, finish the longest active sequence (its tokens so
-        # far are its result) and RETRY, so the starved slot never scatters
-        # into the scratch page and silently corrupts its context
         for s in active:
-            while act[s] and not self._ensure_page_capacity(s):
-                candidates = [i for i in range(self.slots)
-                              if act[i] and self._slot_req[i] is not None]
-                if not candidates:
-                    break
-                longest = max(candidates, key=lambda i: int(self._lengths[i]))
-                req = self._slot_req[longest]
-                logger.warning(
-                    "page pool exhausted: truncating slot %d at %d tokens "
-                    "(%d/%d generated) — size num_pages for the workload",
-                    longest, int(self._lengths[longest]),
-                    len(req.tokens) if req else 0,
-                    req.max_new_tokens if req else 0)
-                self.m_truncated.inc()
-                self._finish(longest)
-                act[longest] = False
+            self._reserve_or_truncate(s, act)
         loop = asyncio.get_running_loop()
         cur = jnp.asarray(self._cur_tokens)
         lens = jnp.asarray(self._lengths)
@@ -313,3 +414,75 @@ class GenerationServer:
             self._lengths[s] += 1
             self._cur_tokens[s] = nxt_host[s]
             self._handle_token(s, int(nxt_host[s]))
+
+    # -- speculative decode -------------------------------------------------
+
+    @staticmethod
+    def _draft(req: _Request, n: int) -> list[int]:
+        """n draft tokens by 2-gram lookup over the sequence's own history
+        (prompt-lookup decoding): find the most recent earlier occurrence
+        of the trailing bigram and copy what followed it. Falls back to
+        repeating the last token — a wrong draft costs nothing, the verify
+        step degenerates to a plain decode for that slot."""
+        hist = req.prompt + req.tokens
+        out: list[int] = []
+        if len(hist) >= 2 and n > 0:
+            a, b = hist[-2], hist[-1]
+            for i in range(len(hist) - 3, -1, -1):
+                if hist[i] == a and hist[i + 1] == b:
+                    out = hist[i + 2:i + 2 + n]
+                    break
+        while len(out) < n:
+            out.append(hist[-1] if hist else 0)
+        return out[:n]
+
+    async def _step_speculative(self, active: list[int]) -> None:
+        """One verify step: each active slot scores its current token plus
+        up to ``speculative_tokens`` drafts in a single chunk call; the
+        accepted prefix (argmax-consistent) all lands this step."""
+        k = self.speculative_tokens + 1
+        act = np.zeros(self.slots, bool)
+        act[active] = True
+        clen = np.zeros(self.slots, np.int32)
+        ids = np.zeros((self.slots, k), np.int32)
+        for s in active:
+            # width-1 capacity first (truncation policy identical to _step)
+            self._reserve_or_truncate(s, act)
+            if not act[s] or self._slot_req[s] is None:
+                continue
+            req = self._slot_req[s]
+            remaining = req.max_new_tokens - len(req.tokens)
+            room = self.max_seq - int(self._lengths[s])
+            c = max(1, min(k, remaining, room))
+            # widen only as far as free pages allow (never truncate for width)
+            while c > 1 and not self._ensure_page_capacity(
+                    s, int(self._lengths[s]) + c):
+                c -= 1
+            clen[s] = c
+            ids[s, 0] = self._cur_tokens[s]
+            if c > 1:
+                ids[s, 1:c] = self._draft(req, c - 1)
+        loop = asyncio.get_running_loop()
+        table = self._table_array()
+        logits, self.k_pages, self.v_pages = await loop.run_in_executor(
+            None, lambda: jax.block_until_ready(self._verify(
+                jnp.asarray(ids), jnp.asarray(self._lengths),
+                jnp.asarray(clen), table, self.k_pages, self.v_pages)))
+        self.m_steps.inc()
+        lg = np.asarray(logits)
+        for s in range(self.slots):
+            if not act[s] or self._slot_req[s] is None or clen[s] == 0:
+                continue
+            c = int(clen[s])
+            outs = lg[s, :c].argmax(-1).astype(np.int32)
+            accepted = 0
+            while accepted < c - 1 and ids[s, accepted + 1] == outs[accepted]:
+                accepted += 1
+            self.m_spec_drafted.inc(c - 1)
+            self.m_spec_accepted.inc(accepted)
+            self._lengths[s] += accepted + 1
+            self._cur_tokens[s] = int(outs[accepted])
+            for t in outs[:accepted + 1]:
+                self._handle_token(s, int(t))
+                if self._slot_req[s] is None:
+                    break

@@ -349,7 +349,9 @@ def _run_generate_bench(tiny: bool) -> None:
         {"type": "tpu_generate", "model": "decoder_lm", "model_config": model_config,
          "serving": "continuous", "slots": 8, "page_size": 16,
          "max_input": 64, "max_new_tokens": max_new, "eos_id": -1,
-         "batch_buckets": [8], "seq_buckets": [64]},
+         "batch_buckets": [8], "seq_buckets": [64],
+         # BENCH_SPEC=k: self-drafted speculative decode (greedy-exact)
+         "speculative_tokens": int(os.environ.get("BENCH_SPEC", "0"))},
         Resource(),
     )
 
@@ -365,14 +367,20 @@ def _run_generate_bench(tiny: bool) -> None:
 
     elapsed, warm_s = asyncio.run(go())
     total_tokens = rows * max_new
+    detail = {"rows": rows, "max_new_tokens": max_new,
+              "elapsed_s": round(elapsed, 2), "warmup_s": round(warm_s, 2),
+              "serving": "continuous", "slots": 8}
+    server = getattr(proc, "_server", None)
+    if server is not None and server.m_spec_drafted.value > 0:
+        detail["speculative_tokens"] = server.speculative_tokens
+        detail["spec_acceptance"] = round(
+            server.m_spec_accepted.value / server.m_spec_drafted.value, 3)
     print(json.dumps({
         "metric": "decoder_generate_tokens_per_sec" + ("_cpu" if tiny else ""),
         "value": round(total_tokens / elapsed, 1),
         "unit": "tokens/s",
         "vs_baseline": 0.0,  # no reference number exists (ref has no LLM serving)
-        "detail": {"rows": rows, "max_new_tokens": max_new,
-                   "elapsed_s": round(elapsed, 2), "warmup_s": round(warm_s, 2),
-                   "serving": "continuous", "slots": 8},
+        "detail": detail,
     }))
 
 

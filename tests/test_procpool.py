@@ -53,8 +53,6 @@ def test_process_pool_pipeline_runs_chain():
 
 def test_process_pool_e2e_stream():
     """Full stream with pipeline.process_pool: generate -> pool(sql) -> out."""
-    from tests.test_runtime import CollectOutput
-
     cfg = StreamConfig.from_mapping({
         "input": {"type": "generate", "payload": '{"v": 3}', "interval": 0,
                   "batch_size": 4, "count": 12},
