@@ -29,6 +29,10 @@ Config:
     speculative_tokens: 3    # continuous+greedy: self-drafted (n-gram
                              # lookup) speculative decode, verified in one
                              # chunk call; exact greedy outputs (0 = off)
+    prefix_cache_pages: 64   # continuous mode: LRU automatic prefix cache —
+                             # finished prompts donate full KV pages, later
+                             # requests with the same token prefix alias
+                             # them and prefill only the rest (0 = off)
 """
 
 from __future__ import annotations
@@ -55,7 +59,7 @@ class TpuGenerateProcessor(Processor):
                  serving: str = "batch", slots: int = 8, page_size: int = 16,
                  temperature: float = 0.0, top_k: int = 0,
                  mesh_config: Optional[dict] = None, prefill_chunk: int = 0,
-                 speculative_tokens: int = 0):
+                 speculative_tokens: int = 0, prefix_cache_pages: int = 0):
         import jax
 
         from arkflow_tpu.models import get_model
@@ -146,6 +150,7 @@ class TpuGenerateProcessor(Processor):
                 temperature=self.temperature, top_k=self.top_k, seed=seed + 1,
                 prefill_chunk=prefill_chunk,
                 speculative_tokens=speculative_tokens,
+                prefix_cache_pages=prefix_cache_pages,
             )
 
         reg = global_registry()
@@ -239,6 +244,7 @@ def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
         mesh_config=config.get("mesh"),
         prefill_chunk=int(config.get("prefill_chunk", 0)),
         speculative_tokens=int(config.get("speculative_tokens", 0)),
+        prefix_cache_pages=int(config.get("prefix_cache_pages", 0)),
     )
 
 

@@ -54,7 +54,8 @@ class GenerationServer:
                  max_seq: int = 512, eos_id: int = 2,
                  prompt_buckets: Optional[list[int]] = None,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 prefill_chunk: int = 0, speculative_tokens: int = 0):
+                 prefill_chunk: int = 0, speculative_tokens: int = 0,
+                 prefix_cache_pages: int = 0):
         from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
 
         enable_persistent_cache()
@@ -87,8 +88,25 @@ class GenerationServer:
         self._prefill_pos: dict[int, int] = {}
         self._turn_prefill = True  # alternate chunk/decode under contention
 
+        # automatic prefix caching (vLLM-style): finished requests donate
+        # their prompt's FULL pages to an LRU keyed by the token prefix;
+        # later requests alias those pages (refcounted, read-only by
+        # construction — decode only ever writes positions >= its prompt
+        # length, and RoPE positions are absolute, so cached K/V is exact
+        # for any request sharing the token prefix) and prefill only the
+        # remainder through the chunk kernel. 0 = off; N = max cached pages.
+        self.prefix_cache_pages = int(prefix_cache_pages)
+        from collections import OrderedDict
+
+        self._prefix_cache: "OrderedDict[tuple, list[int]]" = OrderedDict()
+        self._cache_held = 0
+        #: token-lengths present in the cache (length -> entry count), so
+        #: lookup probes only stored lengths instead of every page multiple
+        self._prefix_lengths: dict[int, int] = {}
+
         # host-side state
         self._free_pages: list[int] = list(range(1, self.num_pages))
+        self._page_refs: dict[int, int] = {}
         self._slot_req: list[Optional[_Request]] = [None] * slots
         self._slot_pages: list[list[int]] = [[] for _ in range(slots)]
         self._lengths = np.zeros(slots, np.int32)
@@ -163,6 +181,10 @@ class GenerationServer:
         self.m_truncated = reg.counter(
             "arkflow_gen_truncated_total",
             "requests cut short by page-pool exhaustion (pool undersized)")
+        self.m_prefix_hits = reg.counter(
+            "arkflow_gen_prefix_cache_hits_total", "admissions that reused cached prefix pages")
+        self.m_prefix_pages = reg.counter(
+            "arkflow_gen_prefix_pages_shared_total", "pages aliased from the prefix cache")
 
     # -- public API --------------------------------------------------------
 
@@ -190,10 +212,114 @@ class GenerationServer:
         if self._loop_task is not None:
             await self._loop_task
 
-    # -- scheduler ---------------------------------------------------------
+    # -- page accounting ---------------------------------------------------
 
     def _pages_needed(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
+
+    def _alloc_page(self) -> Optional[int]:
+        """One fresh page (ref=1); evicts LRU prefix entries under pressure."""
+        while not self._free_pages:
+            if not self._evict_one():
+                return None
+        p = self._free_pages.pop()
+        self._page_refs[p] = 1
+        return p
+
+    def _ref_page(self, p: int) -> None:
+        self._page_refs[p] += 1
+
+    def _unref_page(self, p: int) -> None:
+        self._page_refs[p] -= 1
+        if self._page_refs[p] == 0:
+            del self._page_refs[p]
+            self._free_pages.append(p)
+
+    def _evict_one(self) -> bool:
+        if not self._prefix_cache:
+            return False
+        key, pages = self._prefix_cache.popitem(last=False)  # LRU
+        self._cache_held -= len(pages)
+        self._prefix_lengths[len(key)] -= 1
+        if self._prefix_lengths[len(key)] == 0:
+            del self._prefix_lengths[len(key)]
+        for p in pages:
+            self._unref_page(p)
+        return True
+
+    def _lookup_prefix(self, prompt: list[int]) -> Optional[tuple]:
+        """Key of the longest cached full-page prefix (no side effects).
+        At least one prompt token is always left to prefill (the last
+        position's logits seed generation)."""
+        if not self._prefix_cache:
+            return None
+        limit = ((len(prompt) - 1) // self.page_size) * self.page_size
+        for length in sorted(self._prefix_lengths, reverse=True):
+            if length > limit:
+                continue
+            key = tuple(prompt[:length])
+            if key in self._prefix_cache:
+                return key
+        return None
+
+    def _cache_prefix(self, req: _Request, pages: list[int]) -> None:
+        """Donate the prompt's full pages to the cache (called at finish,
+        before the slot's refs drop)."""
+        if not self.prefix_cache_pages:
+            return
+        count = min(len(req.prompt) // self.page_size, len(pages))
+        if count == 0:
+            return
+        key = tuple(req.prompt[:count * self.page_size])
+        if key in self._prefix_cache:
+            self._prefix_cache.move_to_end(key)
+            return
+        held = pages[:count]
+        for p in held:
+            self._ref_page(p)
+        self._prefix_cache[key] = list(held)
+        self._cache_held += count
+        self._prefix_lengths[len(key)] = self._prefix_lengths.get(len(key), 0) + 1
+        while self._cache_held > self.prefix_cache_pages:
+            if not self._evict_one():
+                break
+
+    def _evictable_pages(self, keep: Optional[tuple]) -> int:
+        """Pages the cache could free on demand: cache-only refs (ref==1)
+        in entries other than ``keep``."""
+        total = 0
+        for key, pages in self._prefix_cache.items():
+            if key == keep:
+                continue
+            total += sum(1 for p in pages if self._page_refs.get(p) == 1)
+        return total
+
+    def _try_reserve(self, req: _Request) -> Optional[tuple[list[int], int]]:
+        """Reserve every page the request needs: aliased prefix pages plus
+        fresh ones. Infeasible reservations return None WITHOUT side
+        effects (no cache eviction, no metric counts) — a head-of-line
+        stall must not wipe the cache's future savings."""
+        n = len(req.prompt)
+        key = self._lookup_prefix(req.prompt)
+        shared = list(self._prefix_cache[key]) if key is not None else []
+        fresh_needed = self._pages_needed(n + 1) - len(shared)
+        if len(self._free_pages) + self._evictable_pages(key) < fresh_needed:
+            return None
+        if key is not None:
+            self._prefix_cache.move_to_end(key)
+            for p in shared:
+                self._ref_page(p)
+        pages = list(shared)
+        for _ in range(fresh_needed):
+            p = self._alloc_page()
+            if p is None:  # shouldn't happen after the feasibility check
+                for q in pages:
+                    self._unref_page(q)
+                return None
+            pages.append(p)
+        return pages, len(shared) * self.page_size
+
+    # -- scheduler ---------------------------------------------------------
 
     def _table_array(self) -> jnp.ndarray:
         table = np.zeros((self.slots, self.pages_per_slot), np.int32)
@@ -207,20 +333,23 @@ class GenerationServer:
                 return b
         return self.prompt_buckets[-1]
 
-    async def _admit_one(self, slot: int, req: _Request) -> None:
-        """Allocate pages, prefill the prompt, seed the slot."""
+    async def _admit_one(self, slot: int, req: _Request,
+                         pages: list[int], shared_len: int) -> None:
+        """Seed the slot with its reserved pages and start prefill."""
         # register FIRST: if anything below throws, the loop's crash handler
         # fails this future instead of leaving its caller hanging
         self._slot_req[slot] = req
         n = len(req.prompt)
-        # pages for the whole prompt plus the next write position
-        need = self._pages_needed(n + 1)
-        pages = [self._free_pages.pop() for _ in range(need)]
         self._slot_pages[slot] = pages
-        if self.prefill_chunk and n > self.prefill_chunk:
-            # chunked admission: the serve loop interleaves prefill chunks
-            # with decode steps; the slot joins decode once fully prefilled
-            self._prefill_pos[slot] = 0
+        if shared_len > 0:
+            self.m_prefix_hits.inc()
+            self.m_prefix_pages.inc(shared_len // self.page_size)
+        if shared_len > 0 or (self.prefill_chunk and n > self.prefill_chunk):
+            # cooperative admission: the serve loop interleaves prefill
+            # steps with decode; the slot joins decode once fully prefilled.
+            # A cached prefix starts prefill at its boundary — only the
+            # remainder is ever computed.
+            self._prefill_pos[slot] = shared_len
             return
         bucket = self._bucket(n)
         ids = np.zeros((1, bucket), np.int32)
@@ -255,8 +384,13 @@ class GenerationServer:
     def _finish(self, slot: int) -> None:
         req = self._slot_req[slot]
         self._slot_req[slot] = None
+        fully_prefilled = slot not in self._prefill_pos
         self._prefill_pos.pop(slot, None)
-        self._free_pages.extend(self._slot_pages[slot])
+        if req is not None and fully_prefilled:
+            # donate the prompt's full pages before the slot's refs drop
+            self._cache_prefix(req, self._slot_pages[slot])
+        for p in self._slot_pages[slot]:
+            self._unref_page(p)
         self._slot_pages[slot] = []
         self._lengths[slot] = 0
         self._cur_tokens[slot] = 0
@@ -272,7 +406,9 @@ class GenerationServer:
             return
         off = self._prefill_pos[slot]
         n = len(req.prompt)
-        c = self.prefill_chunk
+        # chunk width: the configured chunk size, or (prefix-cache remainder
+        # with chunking off) one bucketed span covering the rest
+        c = self.prefill_chunk if self.prefill_chunk else self._bucket(n - off)
         chunk = req.prompt[off:off + c]
         ids = np.zeros((1, c), np.int32)
         ids[0, :len(chunk)] = chunk
@@ -305,9 +441,10 @@ class GenerationServer:
             total = int(self._lengths[slot]) + 1
         need = self._pages_needed(total)
         while len(self._slot_pages[slot]) < need:
-            if not self._free_pages:
+            p = self._alloc_page()
+            if p is None:
                 return False
-            self._slot_pages[slot].append(self._free_pages.pop())
+            self._slot_pages[slot].append(p)
         return True
 
     def _reserve_or_truncate(self, s: int, act: np.ndarray) -> None:
@@ -372,6 +509,13 @@ class GenerationServer:
             if req is not None and not req.future.done():
                 req.future.set_exception(err)
             self._slot_req[s] = None
+            # return the slot's pages: a crash must not shrink the pool
+            # (leaked refs would eventually wedge every future admission)
+            for p in self._slot_pages[s]:
+                self._unref_page(p)
+            self._slot_pages[s] = []
+            self._lengths[s] = 0
+            self._cur_tokens[s] = 0
         while self._pending:
             req = self._pending.popleft()
             if not req.future.done():
@@ -383,10 +527,12 @@ class GenerationServer:
             if self._slot_req[slot] is not None or not self._pending:
                 continue
             req = self._pending[0]  # peek
-            if len(self._free_pages) < self._pages_needed(len(req.prompt) + 1):
+            reserved = self._try_reserve(req)
+            if reserved is None:
                 break  # head-of-line waits for pages (FIFO fairness)
             self._pending.popleft()
-            await self._admit_one(slot, req)
+            pages, shared_len = reserved
+            await self._admit_one(slot, req, pages, shared_len)
             admitted = True
         return admitted
 

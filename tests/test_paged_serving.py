@@ -267,6 +267,106 @@ def test_speculative_composes_with_chunked_prefill():
     asyncio.run(go())
 
 
+def test_prefix_cache_reuses_pages_and_stays_exact():
+    """A second request sharing the first's prompt prefix must alias the
+    cached pages (fewer fresh prefill tokens) and still emit exactly the
+    reference greedy output."""
+    fam = get_model("decoder_lm")
+    cfg = fam.make_config(**TINY)
+    params = fam.init(jax.random.PRNGKey(9), cfg)
+    common = list(range(3, 3 + 12))  # 12 tokens = 3 full pages of 4
+    p1 = common + [60, 61]
+    p2 = common + [70, 71, 72]  # same 3-page prefix, different tail
+    refs = [_reference_generate(fam, params, cfg, p, max_new=5) for p in (p1, p2)]
+
+    async def go():
+        server = GenerationServer(params, cfg, slots=2, page_size=4,
+                                  max_seq=32, prefix_cache_pages=8)
+        hits0 = server.m_prefix_hits.value  # registry counters are global
+        pages0 = server.m_prefix_pages.value
+        out1 = await server.generate(p1, max_new_tokens=5)
+        assert server.m_prefix_hits.value == hits0  # cold cache
+        out2 = await server.generate(p2, max_new_tokens=5)
+        await server.close()
+        assert [out1, out2] == refs
+        assert server.m_prefix_hits.value == hits0 + 1
+        assert server.m_prefix_pages.value == pages0 + 3  # the full-page prefix
+        # cache still holds refs; every non-cached page was returned
+        assert server._cache_held > 0
+        assert all(c > 0 for c in server._page_refs.values())
+
+    asyncio.run(go())
+
+
+def test_prefix_cache_eviction_frees_pages():
+    fam = get_model("decoder_lm")
+    cfg = fam.make_config(**TINY)
+    params = fam.init(jax.random.PRNGKey(10), cfg)
+
+    async def go():
+        # cache capped at 2 pages -> inserting a 3-page prefix evicts to fit,
+        # and distinct prompts rotate the LRU
+        server = GenerationServer(params, cfg, slots=2, page_size=4,
+                                  max_seq=32, prefix_cache_pages=2)
+        total_pages = server.num_pages - 1
+        for base in (0, 30, 60):
+            await server.generate(list(range(base + 1, base + 10)), max_new_tokens=3)
+        await server.close()
+        assert server._cache_held <= 2
+        # pages referenced only by the cache + free pages == whole pool
+        held = sum(len(v) for v in server._prefix_cache.values())
+        assert held == server._cache_held
+        assert len(server._free_pages) + held == total_pages
+
+    asyncio.run(go())
+
+
+def test_prefix_cache_composes_with_speculation_and_chunks():
+    fam = get_model("decoder_lm")
+    cfg = fam.make_config(**TINY)
+    params = fam.init(jax.random.PRNGKey(11), cfg)
+    common = [5, 9] * 6
+    p1 = common + [33]
+    p2 = common + [44, 45]
+    refs = [_reference_generate(fam, params, cfg, p, max_new=6) for p in (p1, p2)]
+
+    async def go():
+        server = GenerationServer(params, cfg, slots=2, page_size=4,
+                                  max_seq=40, prefix_cache_pages=8,
+                                  prefill_chunk=4, speculative_tokens=3)
+        hits0 = server.m_prefix_hits.value
+        out1 = await server.generate(p1, max_new_tokens=6)
+        out2 = await server.generate(p2, max_new_tokens=6)
+        await server.close()
+        assert [out1, out2] == refs
+        assert server.m_prefix_hits.value >= hits0 + 1
+
+    asyncio.run(go())
+
+
+def test_serve_loop_crash_returns_pages():
+    """A serve-loop crash fails in-flight futures AND returns their pages —
+    repeated crashes must not shrink the pool."""
+    fam = get_model("decoder_lm")
+    cfg = fam.make_config(**TINY)
+    params = fam.init(jax.random.PRNGKey(12), cfg)
+
+    async def go():
+        server = GenerationServer(params, cfg, slots=2, page_size=4, max_seq=32)
+        total = server.num_pages - 1
+
+        def boom(*a, **k):
+            raise RuntimeError("injected device failure")
+
+        server._decode = boom
+        with pytest.raises(RuntimeError):
+            await server.generate([3, 4, 5], max_new_tokens=4)
+        assert len(server._free_pages) == total
+        assert not server._page_refs
+
+    asyncio.run(go())
+
+
 def test_generation_server_validates():
     fam = get_model("decoder_lm")
     cfg = fam.make_config(**TINY)

@@ -1,0 +1,156 @@
+"""Property-based tests (hypothesis): the fuzzing layer of the test
+strategy (SURVEY.md §4 — the reference leans on typed deserialization for
+config robustness; we fuzz the equivalent parsing/round-trip surfaces)."""
+
+from __future__ import annotations
+
+import pyarrow as pa
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.native import _py_crc32c, crc32c
+from arkflow_tpu.sql.engine import SessionContext
+from arkflow_tpu.tpu.bucketing import BucketPolicy, pad_batch_dim, pow2_buckets
+from arkflow_tpu.tpu.tokenizer import HashTokenizer
+from arkflow_tpu.utils.duration import parse_duration
+
+# -- durations -------------------------------------------------------------
+
+
+@given(st.integers(min_value=0, max_value=10**6))
+def test_duration_ms_round_trip(n):
+    assert parse_duration(f"{n}ms") == pytest.approx(n / 1000.0)
+
+
+@given(st.integers(min_value=0, max_value=48), st.integers(min_value=0, max_value=59))
+def test_duration_composes(m, s):
+    assert parse_duration(f"{m}m {s}s") == pytest.approx(m * 60 + s)
+
+
+@given(st.floats(min_value=0, max_value=1e9, allow_nan=False))
+def test_duration_bare_numbers_are_seconds(x):
+    assert parse_duration(x) == pytest.approx(x)
+
+
+@given(st.text(max_size=20))
+def test_duration_never_raises_anything_but_configerror(s):
+    """Arbitrary junk must fail as a clean config error, never a raw
+    ValueError/AttributeError escaping to the CLI."""
+    try:
+        out = parse_duration(s)
+    except ConfigError:
+        return
+    assert isinstance(out, float) and out >= 0
+
+
+# -- batch split/concat ----------------------------------------------------
+
+
+@given(st.lists(st.binary(max_size=40), min_size=1, max_size=50),
+       st.integers(min_value=1, max_value=17))
+@settings(max_examples=50, deadline=None)
+def test_split_concat_round_trip(payloads, max_rows):
+    b = MessageBatch.new_binary(payloads).with_source("prop")
+    parts = b.split(max_rows)
+    assert all(p.num_rows <= max_rows for p in parts)
+    assert sum(p.num_rows for p in parts) == b.num_rows
+    back = MessageBatch.concat(parts)
+    assert back.to_binary() == payloads
+    assert back.get_meta("__meta_source") == "prop"
+
+
+# -- tokenizer -------------------------------------------------------------
+
+
+@given(st.lists(st.binary(max_size=60), min_size=1, max_size=16),
+       st.integers(min_value=4, max_value=48))
+@settings(max_examples=50, deadline=None)
+def test_tokenizer_invariants(texts, max_len):
+    tok = HashTokenizer(1000)
+    ids, mask = tok.encode_batch(texts, max_len)
+    ids2, mask2 = tok.encode_batch(texts, max_len)
+    assert (ids == ids2).all() and (mask == mask2).all()  # deterministic
+    assert ids.shape == mask.shape == (len(texts), max_len)
+    assert (ids >= 0).all() and (ids < 1000).all()
+    # mask is a contiguous prefix (flash-attention precondition)
+    import numpy as np
+
+    lengths = mask.sum(axis=1)
+    prefix = (np.arange(max_len)[None, :] < lengths[:, None]).astype(mask.dtype)
+    assert (mask == prefix).all()
+    assert (lengths >= 2).all()  # cls + sep always present
+
+
+# -- native vs python checksum --------------------------------------------
+
+
+@given(st.binary(max_size=300), st.binary(max_size=50))
+def test_crc32c_matches_python_reference(data, more):
+    assert crc32c(data) == _py_crc32c(data)
+    # streaming property: crc over concat == chained crc
+    assert _py_crc32c(data + more) == _py_crc32c(more, _py_crc32c(data))
+
+
+# -- bucketing -------------------------------------------------------------
+
+
+@given(st.integers(min_value=1, max_value=4096), st.integers(min_value=1, max_value=4096))
+def test_pow2_buckets_cover_range(lo, hi):
+    if lo > hi:
+        lo, hi = hi, lo
+    buckets = pow2_buckets(lo, hi)
+    assert buckets[0] == lo and buckets[-1] == hi
+    assert buckets == sorted(set(buckets))
+
+
+@given(st.integers(min_value=1, max_value=600))
+def test_bucket_policy_always_fits_or_clamps(n):
+    p = BucketPolicy((8, 32, 128), (16, 64))
+    bb = p.batch_bucket(n)
+    assert bb in (8, 32, 128)
+    if n <= 128:
+        assert bb >= n  # fits
+        assert all(b >= bb for b in (8, 32, 128) if b >= n)  # and is smallest
+
+
+@given(st.integers(min_value=1, max_value=64))
+def test_pad_batch_preserves_rows(n):
+    import numpy as np
+
+    arr = np.arange(n * 3, dtype=np.int32).reshape(n, 3)
+    out = pad_batch_dim(arr, 64)
+    assert out.shape == (64, 3)
+    assert (out[:n] == arr).all() and (out[n:] == 0).all()
+
+
+# -- SQL engine vs python reference ---------------------------------------
+
+
+@given(st.lists(st.integers(min_value=-1000, max_value=1000), min_size=1, max_size=40),
+       st.integers(min_value=-1000, max_value=1000))
+@settings(max_examples=40, deadline=None)
+def test_sql_filter_matches_python(values, threshold):
+    batch = MessageBatch.new_arrow(
+        pa.RecordBatch.from_pydict({"v": pa.array(values, pa.int64())}))
+    ctx = SessionContext()
+    ctx.register_batch("flow", batch)
+    out = ctx.sql(f"SELECT v FROM flow WHERE v > {threshold}")
+    assert out.column("v").to_pylist() == [v for v in values if v > threshold]
+
+
+@given(st.lists(st.integers(min_value=0, max_value=5), min_size=1, max_size=40))
+@settings(max_examples=40, deadline=None)
+def test_sql_group_by_matches_python(keys):
+    from collections import Counter
+
+    batch = MessageBatch.new_arrow(
+        pa.RecordBatch.from_pydict({"k": pa.array(keys, pa.int64())}))
+    ctx = SessionContext()
+    ctx.register_batch("flow", batch)
+    out = ctx.sql("SELECT k, count(*) AS n FROM flow GROUP BY k ORDER BY k")
+    expected = sorted(Counter(keys).items())
+    got = list(zip(out.column("k").to_pylist(), out.column("n").to_pylist()))
+    assert got == expected
