@@ -1,0 +1,291 @@
+"""``tpu_train`` processor: online training ON the stream.
+
+The engine's training counterpart to ``tpu_inference``: each in-flight
+batch becomes one optimizer step on an XLA-compiled ``train_step``
+(donated params/opt-state, so updates happen in place on the device), with
+periodic orbax checkpoints. This is the streaming-ML pattern the reference
+cannot express (its processors are stateless user code; ref
+crates/arkflow-plugin/src/processor/python.rs) — e.g. an LSTM-AE anomaly
+model continuously adapting to the live sensor distribution, or a decoder
+LM fine-tuning on fresh CDC text, while downstream ``tpu_inference``
+streams serve the latest checkpoint.
+
+Works with any model family publishing ``make_train_step`` in its extras
+(decoder_lm, lstm_ae). Multi-chip: ``mesh: {dp: N, tp: M, ...}`` shards
+params by the family's PartitionSpecs and the batch over ``dp``.
+
+Config:
+
+    type: tpu_train
+    model: lstm_ae
+    model_config: {features: 3, window: 16}
+    tensor_field: window           # tensor families ([B, T, F] list column)
+    text_field: __value__          # token families (tokenized + shifted)
+    optimizer: {name: adamw, lr: 1e-3, weight_decay: 0.01}
+    batch_buckets: [32]
+    max_seq: 128                   # token families
+    checkpoint: /ckpt/warm-start   # optional restore
+    save_dir: /ckpt/out            # optional periodic save (step_N dirs)
+    save_every: 100
+    loss_field: loss               # per-row loss column on the way out
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+
+from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD, MessageBatch
+from arkflow_tpu.components import Processor, Resource, register_processor
+from arkflow_tpu.errors import ConfigError, ProcessError
+from arkflow_tpu.obs import global_registry
+from arkflow_tpu.tpu.bucketing import BucketPolicy
+from arkflow_tpu.tpu.tokenizer import build_tokenizer
+
+
+def _build_optimizer(cfg: Optional[dict]):
+    import optax
+
+    cfg = dict(cfg or {})
+    name = str(cfg.get("name", "adamw")).lower()
+    lr = float(cfg.get("lr", 1e-3))
+    if name == "adamw":
+        return optax.adamw(lr, weight_decay=float(cfg.get("weight_decay", 0.0)))
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "sgd":
+        return optax.sgd(lr, momentum=float(cfg.get("momentum", 0.0)))
+    raise ConfigError(f"tpu_train optimizer {name!r} unknown (adamw/adam/sgd)")
+
+
+class TpuTrainProcessor(Processor):
+    def __init__(self, model: str, model_config: Optional[dict], *,
+                 optimizer: Optional[dict], text_field: str,
+                 tensor_field: Optional[str], tokenizer, max_seq: int,
+                 buckets: BucketPolicy, loss_field: str,
+                 checkpoint: Optional[str], save_dir: Optional[str],
+                 save_every: int, mesh_config: Optional[dict], seed: int = 0):
+        import jax
+
+        from arkflow_tpu.models import get_model
+        from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
+
+        enable_persistent_cache()
+        self.family = get_model(model)
+        if "make_train_step" not in self.family.extras:
+            raise ConfigError(f"model {model!r} does not publish a train step")
+        self.cfg = self.family.make_config(**(model_config or {}))
+        self.spec = self.family.input_spec(self.cfg)
+        self.text_field = text_field
+        self.tensor_field = tensor_field
+        self.tokenizer = tokenizer
+        self.max_seq = max_seq
+        self.buckets = buckets
+        self.loss_field = loss_field
+        self.save_dir = save_dir
+        self.save_every = int(save_every)
+        self._step_count = 0
+        self._lock = asyncio.Lock()  # one optimizer step at a time
+
+        try:
+            cpu = jax.devices("cpu")[0]
+        except RuntimeError:
+            cpu = None
+        ctx = jax.default_device(cpu) if cpu is not None else None
+        if ctx is not None:
+            with ctx:
+                params = self.family.init(jax.random.PRNGKey(seed), self.cfg)
+        else:
+            params = self.family.init(jax.random.PRNGKey(seed), self.cfg)
+        if checkpoint:
+            from arkflow_tpu.tpu.checkpoint import restore
+
+            params = restore(checkpoint, params)
+
+        self.mesh = None
+        axes: dict = {}
+        if mesh_config:
+            from arkflow_tpu.parallel.mesh import MeshSpec, create_mesh, shard_params
+
+            allowed = {"dp", "tp", "sp", "ep", "pp"}
+            unknown = set(mesh_config) - allowed
+            if unknown:
+                raise ConfigError(f"tpu_train mesh keys {sorted(unknown)} invalid "
+                                  f"(allowed: {sorted(allowed)})")
+            try:
+                spec = MeshSpec(**{k: int(v) for k, v in mesh_config.items()})
+                self.mesh = create_mesh(spec)
+            except ConfigError:
+                raise
+            except (TypeError, ValueError) as e:
+                raise ConfigError(f"tpu_train mesh config invalid: {e}") from e
+            axes = {name: name for name in self.mesh.axis_names}
+            pspecs = (self.family.param_specs(self.cfg, axes)
+                      if self.family.param_specs else None)
+            params = shard_params(params, pspecs, self.mesh)
+        else:
+            params = jax.device_put(params, jax.devices()[0])
+        self.params = params
+
+        optimizer_tx = _build_optimizer(optimizer)
+        import inspect
+
+        mts = self.family.extras["make_train_step"]
+        kwargs = {}
+        sig = inspect.signature(mts)
+        if "axes" in sig.parameters and axes:
+            kwargs["axes"] = axes
+        if "mesh" in sig.parameters and self.mesh is not None:
+            kwargs["mesh"] = self.mesh
+        step = mts(self.cfg, optimizer_tx, **kwargs)
+        # donate params/opt_state: XLA updates weights in place every step
+        self._jitted = jax.jit(step, donate_argnums=(0, 1))
+        # init on the (possibly sharded) params so state follows placement
+        self.opt_state = optimizer_tx.init(self.params)
+
+        reg = global_registry()
+        labels = {"model": model}
+        self.m_steps = reg.counter("arkflow_train_steps_total", "optimizer steps", labels)
+        self.m_rows = reg.counter("arkflow_train_rows_total", "rows trained on", labels)
+        self.m_loss = reg.gauge("arkflow_train_last_loss", "last step's loss", labels)
+        self.m_saves = reg.counter("arkflow_train_checkpoints_total", "checkpoints written", labels)
+
+    # -- batch assembly ----------------------------------------------------
+
+    def _token_batch(self, batch: MessageBatch) -> dict:
+        texts = batch.to_binary(self.text_field)
+        ids, mask = self.tokenizer.encode_batch(texts, self.max_seq)
+        used = int(mask.sum(axis=1).max()) if mask.size else 2
+        sb = self.buckets.seq_bucket(max(used, 2))
+        ids, mask = ids[:, :sb], mask[:, :sb]
+        # causal LM: predict token t+1 from prefix t (mask shifts with targets)
+        return {"input_ids": ids[:, :-1], "targets": ids[:, 1:],
+                "mask": mask[:, 1:]}
+
+    def _tensor_batch(self, batch: MessageBatch) -> dict:
+        name = next(iter(self.spec))
+        dtype, trailing = self.spec[name]
+        field = self.tensor_field or name
+        if not batch.has_column(field):
+            raise ProcessError(f"tpu_train: column {field!r} not found")
+        col = batch.column(field)
+        n = batch.num_rows
+        want = tuple(int(d) for d in trailing)
+        flat = col.flatten()
+        while isinstance(flat, (pa.ListArray, pa.LargeListArray,
+                                pa.FixedSizeListArray)):
+            flat = flat.flatten()
+        arr = flat.to_numpy(zero_copy_only=False).astype(dtype)
+        try:
+            values = arr.reshape(n, *want)
+        except ValueError as e:
+            raise ProcessError(
+                f"tpu_train: column {field!r} does not reshape to {want}: {e}") from e
+        return {name: values}
+
+    def _pad_cycle(self, arrays: dict) -> tuple[dict, int]:
+        """Pad the batch dim to its bucket by CYCLING real rows: unlike
+        zero-padding, repeated real rows keep the loss on-distribution for
+        families without a per-row mask (lstm_ae reconstruction MSE)."""
+        n = next(iter(arrays.values())).shape[0]
+        bb = self.buckets.batch_bucket(n)
+        if bb == n:
+            return arrays, n
+        idx = np.arange(bb) % n
+        return {k: v[idx] for k, v in arrays.items()}, n
+
+    # -- Processor ---------------------------------------------------------
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []
+        needs_tokens = "input_ids" in self.spec
+        arrays = self._token_batch(batch) if needs_tokens else self._tensor_batch(batch)
+        total = next(iter(arrays.values())).shape[0]
+        mb = self.buckets.max_batch()
+        loop = asyncio.get_running_loop()
+        losses: list[float] = []
+        # over-merged batches (backpressure) become several optimizer steps —
+        # every row trains; nothing is silently dropped past the max bucket
+        for i in range(0, total, mb):
+            chunk = {k: v[i:i + mb] for k, v in arrays.items()}
+            chunk, n = self._pad_cycle(chunk)
+            async with self._lock:  # optimizer steps are inherently sequential
+                params, opt_state, loss = await loop.run_in_executor(
+                    None, self._step, chunk)
+                self.params, self.opt_state = params, opt_state
+                self._step_count += 1
+                if (self.save_dir and self.save_every > 0
+                        and self._step_count % self.save_every == 0):
+                    await loop.run_in_executor(None, self._save)
+            losses.append(float(loss))
+            self.m_steps.inc()
+            self.m_rows.inc(n)
+        loss_val = sum(losses) / len(losses)
+        self.m_loss.set(loss_val)
+        out = batch.with_column(self.loss_field,
+                                pa.array([loss_val] * batch.num_rows, pa.float32()))
+        return [out]
+
+    def _step(self, arrays: dict):
+        import jax
+
+        if self.mesh is not None:
+            arrays = self._shard_batch(arrays)
+            with self.mesh:
+                out = self._jitted(self.params, self.opt_state, arrays)
+        else:
+            out = self._jitted(self.params, self.opt_state, arrays)
+        return jax.block_until_ready(out)
+
+    def _shard_batch(self, arrays: dict) -> dict:
+        """Shard the batch over the dp axis when it divides evenly."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if "dp" not in self.mesh.axis_names:
+            return arrays
+        dp = self.mesh.shape["dp"]
+        out = {}
+        for k, v in arrays.items():
+            if v.shape[0] % dp == 0:
+                out[k] = jax.device_put(v, NamedSharding(self.mesh, P("dp")))
+            else:
+                out[k] = v
+        return out
+
+    def _save(self) -> None:
+        from arkflow_tpu.tpu.checkpoint import save
+
+        save(f"{self.save_dir}/step_{self._step_count}", self.params)
+        self.m_saves.inc()
+
+
+@register_processor("tpu_train")
+def _build(config: dict, resource: Resource) -> TpuTrainProcessor:
+    model = config.get("model")
+    if not model:
+        raise ConfigError("tpu_train requires 'model'")
+    max_seq = int(config.get("max_seq", 128))
+    buckets = BucketPolicy.from_config(config, max_seq=max_seq,
+                                       max_batch=int(config.get("max_batch", 256)))
+    vocab = (config.get("model_config") or {}).get("vocab_size", 2048)
+    return TpuTrainProcessor(
+        model,
+        config.get("model_config"),
+        optimizer=config.get("optimizer"),
+        text_field=config.get("text_field", DEFAULT_BINARY_VALUE_FIELD),
+        tensor_field=config.get("tensor_field"),
+        tokenizer=build_tokenizer(config.get("tokenizer"), vocab_size=vocab),
+        max_seq=max_seq,
+        buckets=buckets,
+        loss_field=str(config.get("loss_field", "loss")),
+        checkpoint=config.get("checkpoint"),
+        save_dir=config.get("save_dir"),
+        save_every=int(config.get("save_every", 100)),
+        mesh_config=config.get("mesh"),
+        seed=int(config.get("seed", 0)),
+    )

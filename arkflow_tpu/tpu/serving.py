@@ -96,10 +96,14 @@ class GenerationServer:
         # for any request sharing the token prefix) and prefill only the
         # remainder through the chunk kernel. 0 = off; N = max cached pages.
         self.prefix_cache_pages = int(prefix_cache_pages)
+        if self.prefix_cache_pages < 0:
+            raise ConfigError("prefix_cache_pages must be >= 0")
         from collections import OrderedDict
 
         self._prefix_cache: "OrderedDict[tuple, list[int]]" = OrderedDict()
-        self._cache_held = 0
+        #: DISTINCT pages held by cache entries (page -> entry count):
+        #: nested prefixes share pages, so capacity counts physical pages
+        self._cache_pages: dict[int, int] = {}
         #: token-lengths present in the cache (length -> entry count), so
         #: lookup probes only stored lengths instead of every page multiple
         self._prefix_lengths: dict[int, int] = {}
@@ -235,15 +239,22 @@ class GenerationServer:
             del self._page_refs[p]
             self._free_pages.append(p)
 
+    @property
+    def _cache_held(self) -> int:
+        """Physical pages currently held by the prefix cache."""
+        return len(self._cache_pages)
+
     def _evict_one(self) -> bool:
         if not self._prefix_cache:
             return False
         key, pages = self._prefix_cache.popitem(last=False)  # LRU
-        self._cache_held -= len(pages)
         self._prefix_lengths[len(key)] -= 1
         if self._prefix_lengths[len(key)] == 0:
             del self._prefix_lengths[len(key)]
         for p in pages:
+            self._cache_pages[p] -= 1
+            if self._cache_pages[p] == 0:
+                del self._cache_pages[p]
             self._unref_page(p)
         return True
 
@@ -277,22 +288,26 @@ class GenerationServer:
         held = pages[:count]
         for p in held:
             self._ref_page(p)
+            self._cache_pages[p] = self._cache_pages.get(p, 0) + 1
         self._prefix_cache[key] = list(held)
-        self._cache_held += count
         self._prefix_lengths[len(key)] = self._prefix_lengths.get(len(key), 0) + 1
         while self._cache_held > self.prefix_cache_pages:
             if not self._evict_one():
                 break
 
     def _evictable_pages(self, keep: Optional[tuple]) -> int:
-        """Pages the cache could free on demand: cache-only refs (ref==1)
-        in entries other than ``keep``."""
-        total = 0
+        """DISTINCT pages the cache could free by evicting every entry
+        other than ``keep``: pages whose refs all come from those entries
+        (nested prefixes share pages — count physical pages once)."""
+        keep_pages = set(self._prefix_cache.get(keep, ())) if keep is not None else set()
+        counts: dict[int, int] = {}
         for key, pages in self._prefix_cache.items():
             if key == keep:
                 continue
-            total += sum(1 for p in pages if self._page_refs.get(p) == 1)
-        return total
+            for p in pages:
+                counts[p] = counts.get(p, 0) + 1
+        return sum(1 for p, c in counts.items()
+                   if p not in keep_pages and self._page_refs.get(p) == c)
 
     def _try_reserve(self, req: _Request) -> Optional[tuple[list[int], int]]:
         """Reserve every page the request needs: aliased prefix pages plus

@@ -344,6 +344,30 @@ def test_prefix_cache_composes_with_speculation_and_chunks():
     asyncio.run(go())
 
 
+def test_prefix_cache_counts_distinct_pages_for_nested_prefixes():
+    """A short prefix nested inside a longer cached prefix shares pages;
+    capacity accounting must count physical pages once."""
+    fam = get_model("decoder_lm")
+    cfg = fam.make_config(**TINY)
+    params = fam.init(jax.random.PRNGKey(13), cfg)
+    common = list(range(3, 3 + 8))  # 2 full pages of 4
+
+    async def go():
+        server = GenerationServer(params, cfg, slots=2, page_size=4,
+                                  max_seq=32, prefix_cache_pages=8)
+        # first request caches [p0, p1]; second shares them and extends to
+        # 3 full pages -> caches [p0, p1, p2] as a distinct (longer) entry
+        await server.generate(common + [50], max_new_tokens=3)
+        await server.generate(common + [51, 52, 53, 54, 55], max_new_tokens=3)
+        await server.close()
+        entries = sum(len(v) for v in server._prefix_cache.values())
+        assert len(server._prefix_cache) == 2
+        assert entries == 5          # 2 + 3 entry-held pages
+        assert server._cache_held == 3  # but only 3 DISTINCT pages
+
+    asyncio.run(go())
+
+
 def test_serve_loop_crash_returns_pages():
     """A serve-loop crash fails in-flight futures AND returns their pages —
     repeated crashes must not shrink the pool."""
