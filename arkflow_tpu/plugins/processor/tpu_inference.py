@@ -82,42 +82,10 @@ class TpuInferenceProcessor(Processor):
         return inputs
 
     def _extract_tensor(self, batch: MessageBatch, name: str, dtype: str, trailing: tuple) -> np.ndarray:
-        field = self.tensor_field or name
-        if not batch.has_column(field):
-            raise ProcessError(
-                f"tpu_inference: column {field!r} not found for model input {name!r}"
-            )
-        col = batch.column(field)
-        n = batch.num_rows
-        want = tuple(int(d) for d in trailing)
-        if pa.types.is_binary(col.type) or pa.types.is_large_binary(col.type):
-            size = int(np.prod(want))
-            rows = []
-            for v in col:
-                buf = v.as_py() or b""
-                arr = np.frombuffer(buf, dtype=np.uint8)
-                if arr.size < size:
-                    arr = np.pad(arr, (0, size - arr.size))
-                rows.append(arr[:size].reshape(want).astype(dtype))
-            out = np.stack(rows) if rows else np.zeros((0, *want), dtype)
-            if dtype == "float32":
-                out = out / np.float32(255.0)
-            return out
-        if pa.types.is_list(col.type) or pa.types.is_fixed_size_list(col.type) or pa.types.is_large_list(col.type):
-            flat = col.flatten().to_numpy(zero_copy_only=False).astype(dtype)
-            try:
-                return flat.reshape(n, *want)
-            except ValueError as e:
-                raise ProcessError(
-                    f"tpu_inference: column {field!r} does not reshape to {want} per row: {e}"
-                ) from e
-        # plain numeric column -> [B] or broadcast error
-        arr = col.to_numpy(zero_copy_only=False).astype(dtype)
-        if want and int(np.prod(want)) != 1:
-            raise ProcessError(
-                f"tpu_inference: column {field!r} is scalar per row but input {name!r} wants {want}"
-            )
-        return arr.reshape(n, *([1] * len(want)))
+        from arkflow_tpu.tpu.extract import extract_tensor
+
+        return extract_tensor(batch, self.tensor_field or name, name, dtype,
+                              trailing, who="tpu_inference")
 
     # -- output attachment -------------------------------------------------
 

@@ -40,7 +40,7 @@ import pyarrow as pa
 
 from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD, MessageBatch
 from arkflow_tpu.components import Processor, Resource, register_processor
-from arkflow_tpu.errors import ConfigError, ProcessError
+from arkflow_tpu.errors import ConfigError
 from arkflow_tpu.obs import global_registry
 from arkflow_tpu.tpu.bucketing import BucketPolicy
 from arkflow_tpu.tpu.tokenizer import build_tokenizer
@@ -166,25 +166,12 @@ class TpuTrainProcessor(Processor):
                 "mask": mask[:, 1:]}
 
     def _tensor_batch(self, batch: MessageBatch) -> dict:
+        from arkflow_tpu.tpu.extract import extract_tensor
+
         name = next(iter(self.spec))
         dtype, trailing = self.spec[name]
-        field = self.tensor_field or name
-        if not batch.has_column(field):
-            raise ProcessError(f"tpu_train: column {field!r} not found")
-        col = batch.column(field)
-        n = batch.num_rows
-        want = tuple(int(d) for d in trailing)
-        flat = col.flatten()
-        while isinstance(flat, (pa.ListArray, pa.LargeListArray,
-                                pa.FixedSizeListArray)):
-            flat = flat.flatten()
-        arr = flat.to_numpy(zero_copy_only=False).astype(dtype)
-        try:
-            values = arr.reshape(n, *want)
-        except ValueError as e:
-            raise ProcessError(
-                f"tpu_train: column {field!r} does not reshape to {want}: {e}") from e
-        return {name: values}
+        return {name: extract_tensor(batch, self.tensor_field or name, name,
+                                     dtype, trailing, who="tpu_train")}
 
     def _pad_cycle(self, arrays: dict) -> tuple[dict, int]:
         """Pad the batch dim to its bucket by CYCLING real rows: unlike
@@ -221,10 +208,13 @@ class TpuTrainProcessor(Processor):
                 if (self.save_dir and self.save_every > 0
                         and self._step_count % self.save_every == 0):
                     await loop.run_in_executor(None, self._save)
-            losses.append(float(loss))
+            losses.append((float(loss), n))
             self.m_steps.inc()
             self.m_rows.inc(n)
-        loss_val = sum(losses) / len(losses)
+        # row-weighted mean: the short tail chunk of an over-merged batch
+        # must not count as much as the full chunks
+        total_rows = sum(n for _, n in losses)
+        loss_val = sum(l * n for l, n in losses) / max(total_rows, 1)
         self.m_loss.set(loss_val)
         out = batch.with_column(self.loss_field,
                                 pa.array([loss_val] * batch.num_rows, pa.float32()))

@@ -125,17 +125,29 @@ class ProcessPoolPipeline:
         ])
 
     async def process(self, batch: MessageBatch) -> list[MessageBatch]:
-        pool = self._ensure_pool()
-        try:
-            fut = pool.submit(_run_chain, batch_to_ipc(batch))
-            outs = await asyncio.wrap_future(fut)
-        except ConfigError:
-            raise
-        except ProcessError:
-            raise
-        except Exception as e:  # worker died / unpicklable error
-            raise ProcessError(f"process_pool worker failed: {e}") from e
-        return [ipc_to_batch(o) for o in outs]
+        from concurrent.futures.process import BrokenProcessPool
+
+        ipc = batch_to_ipc(batch)
+        for attempt in (0, 1):
+            pool = self._ensure_pool()
+            try:
+                outs = await asyncio.wrap_future(pool.submit(_run_chain, ipc))
+                return [ipc_to_batch(o) for o in outs]
+            except (ConfigError, ProcessError):
+                raise
+            except BrokenProcessPool as e:
+                # a dead worker poisons the whole executor permanently —
+                # rebuild it once and retry this batch; a second failure
+                # goes to the stream's error path like any processor error
+                pool.shutdown(wait=False, cancel_futures=True)
+                if self._pool is pool:  # a concurrent caller may have
+                    self._pool = None   # already rebuilt it — keep theirs
+                if attempt == 1:
+                    raise ProcessError(
+                        f"process_pool broken twice; giving up on batch: {e}"
+                    ) from e
+            except Exception as e:  # unpicklable error etc.
+                raise ProcessError(f"process_pool worker failed: {e}") from e
 
     async def close(self) -> None:
         if self._pool is not None:

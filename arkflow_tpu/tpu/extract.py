@@ -1,0 +1,59 @@
+"""Arrow column -> model-input ndarray extraction, shared by the device
+processors (tpu_inference / tpu_train) so the list/binary/scalar handling
+can't drift between them."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.errors import ProcessError
+
+
+def extract_tensor(batch: MessageBatch, field: str, name: str, dtype: str,
+                   want: tuple, *, who: str) -> np.ndarray:
+    """One column -> [B, *want] ndarray.
+
+    - binary columns: raw bytes, zero-padded/truncated to prod(want) per
+      row, reshaped; float32 targets are normalized from uint8 (images);
+    - (nested) list columns: flattened fully and reshaped;
+    - plain numeric columns: allowed only when want is scalar-compatible.
+    """
+    if not batch.has_column(field):
+        raise ProcessError(f"{who}: column {field!r} not found for model input {name!r}")
+    col = batch.column(field)
+    n = batch.num_rows
+    want = tuple(int(d) for d in want)
+    if pa.types.is_binary(col.type) or pa.types.is_large_binary(col.type):
+        size = int(np.prod(want))
+        rows = []
+        for v in col:
+            buf = v.as_py() or b""
+            arr = np.frombuffer(buf, dtype=np.uint8)
+            if arr.size < size:
+                arr = np.pad(arr, (0, size - arr.size))
+            rows.append(arr[:size].reshape(want).astype(dtype))
+        out = np.stack(rows) if rows else np.zeros((0, *want), dtype)
+        if dtype == "float32":
+            out = out / np.float32(255.0)
+        return out
+    if (pa.types.is_list(col.type) or pa.types.is_fixed_size_list(col.type)
+            or pa.types.is_large_list(col.type)):
+        flat = col.flatten()
+        while isinstance(flat, (pa.ListArray, pa.LargeListArray,
+                                pa.FixedSizeListArray)):
+            flat = flat.flatten()
+        arr = flat.to_numpy(zero_copy_only=False).astype(dtype)
+        try:
+            return arr.reshape(n, *want)
+        except ValueError as e:
+            raise ProcessError(
+                f"{who}: column {field!r} does not reshape to {want} per row: {e}"
+            ) from e
+    arr = col.to_numpy(zero_copy_only=False).astype(dtype)
+    if want and int(np.prod(want)) != 1:
+        raise ProcessError(
+            f"{who}: column {field!r} is scalar per row but input {name!r} wants {want}"
+        )
+    return arr.reshape(n, *([1] * len(want)))

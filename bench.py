@@ -267,11 +267,36 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     seq = int(os.environ.get("BENCH_SEQ", "32"))
 
-    # phase 1: latency mode (bounded rate, micro-batching) — own JSON line
-    lat_detail = {}
-    if os.environ.get("BENCH_SKIP_LATENCY", "0") != "1":
+    # Phase ORDER depends on backend: on CPU (tiny) the latency phase runs
+    # first, cheap. On a real TPU over the tunnel each bucket compile can
+    # take minutes, and the latency phase needs FOUR buckets — so the
+    # saturated headline (ONE compile) measures first, banking its number
+    # (and its executable in the persistent cache) before latency is
+    # attempted. Output order is fixed regardless: latency line first,
+    # headline LAST for last-JSON-line parsers.
+    run_latency = os.environ.get("BENCH_SKIP_LATENCY", "0") != "1"
+    lat = None
+    if run_latency and tiny:
         lat_seconds = float(os.environ.get("BENCH_LAT_SECONDS", "10"))
         lat = asyncio.run(run_bench(lat_seconds, 8, seq, tiny, mode="latency"))
+
+    # saturated throughput — the headline metric.
+    # duty cycle is this phase's DELTA (the latency phase idles on purpose)
+    busy0, stall0 = _busy_stall_from_registry()
+    res = asyncio.run(run_bench(seconds, batch, seq, tiny))
+    busy1, stall1 = _busy_stall_from_registry()
+
+    if run_latency and not tiny:
+        # TPU: bank the headline BEFORE attempting latency — its 4 bucket
+        # compiles can outlive an external kill, and the last printed JSON
+        # line must survive as the headline either way (it is re-printed,
+        # with latency detail, after a successful latency phase)
+        _print_headline(res, tiny, batch, seq, busy1 - busy0, stall1 - stall0, {})
+        lat_seconds = float(os.environ.get("BENCH_LAT_SECONDS", "10"))
+        lat = asyncio.run(run_bench(lat_seconds, 8, seq, tiny, mode="latency"))
+
+    lat_detail = {}
+    if lat is not None:
         lat_detail = {"latency_p50_ms": round(lat["p50_ms"], 2),
                       "latency_p99_ms": round(lat["p99_ms"], 2)}
         print(
@@ -295,14 +320,12 @@ def main() -> None:
             ),
             flush=True,
         )
+    _print_headline(res, tiny, batch, seq, busy1 - busy0, stall1 - stall0,
+                    lat_detail)
 
-    # phase 2: saturated throughput — the headline metric, printed LAST so
-    # last-JSON-line parsers pick it up (latency numbers ride in detail too).
-    # duty cycle is the phase-2 DELTA (the latency phase idles on purpose)
-    busy0, stall0 = _busy_stall_from_registry()
-    res = asyncio.run(run_bench(seconds, batch, seq, tiny))
-    busy1, stall1 = _busy_stall_from_registry()
-    d_busy, d_stall = busy1 - busy0, stall1 - stall0
+
+def _print_headline(res: dict, tiny: bool, batch: int, seq: int,
+                    d_busy: float, d_stall: float, lat_detail: dict) -> None:
     duty = round(d_busy / (d_busy + d_stall), 4) if (d_busy + d_stall) > 0 else 0.0
     baseline = 100_000.0  # BASELINE.json north-star rows/sec/chip
     print(
@@ -326,7 +349,8 @@ def main() -> None:
                     **lat_detail,
                 },
             }
-        )
+        ),
+        flush=True,
     )
 
 

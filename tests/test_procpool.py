@@ -76,6 +76,34 @@ def test_process_pool_e2e_stream():
     assert stream.m_rows_out.value == 12
 
 
+def test_process_pool_recovers_from_worker_death():
+    """A worker hard-exiting poisons the executor; the pipeline rebuilds it
+    and keeps serving subsequent batches."""
+    script = """
+import os
+def process(batch):
+    if batch.column("__value__").to_pylist()[0] == b"die":
+        os._exit(1)
+    return batch
+"""
+    pool = ProcessPoolPipeline([{"type": "python", "script": script}], workers=1)
+
+    async def go():
+        await pool.connect()
+        try:
+            out = await pool.process(MessageBatch.new_binary([b"ok-1"]))
+            assert out[0].to_binary() == [b"ok-1"]
+            with pytest.raises(Exception):
+                await pool.process(MessageBatch.new_binary([b"die"]))
+            # pool was rebuilt; the stream keeps flowing
+            out = await pool.process(MessageBatch.new_binary([b"ok-2"]))
+            assert out[0].to_binary() == [b"ok-2"]
+        finally:
+            await pool.close()
+
+    asyncio.run(go())
+
+
 def test_process_pool_worker_error_propagates():
     pool = ProcessPoolPipeline(
         [{"type": "json_to_arrow"},
