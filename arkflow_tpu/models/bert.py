@@ -43,6 +43,14 @@ class BertConfig:
     #: kernel wins on partially-filled buckets), False elsewhere; direct
     #: ``apply`` callers get the XLA path unless they opt in explicitly.
     use_flash_attention: "bool | None" = None
+    #: trace-time floor: buckets with seq below this use XLA attention even
+    #: when flash is on. At short seq the kernel's tiles degenerate (tile =
+    #: seq < MXU 128x128) and the grid overhead dominates — measured on a
+    #: v5e at seq 32 the Pallas path cost 47% of end-to-end throughput.
+    #: None = unset: no floor for direct/explicit users; ModelRunner's
+    #: auto-resolution fills in the measured crossover (128) only then, so
+    #: an operator-tuned value is never clobbered.
+    flash_min_seq: "int | None" = None
     flash_interpret: bool = False  # CPU-interpret mode (tests)
 
 
@@ -91,7 +99,10 @@ def encode(params: dict, cfg: BertConfig, input_ids, attention_mask):
     lengths = attention_mask.astype(jnp.int32).sum(axis=1)  # contiguous-prefix masks
 
     def _attend(q, k, v):
-        if cfg.use_flash_attention:
+        # s is static at trace time: each bucket decides flash-vs-XLA
+        # independently, so one stream can serve seq-32 on XLA and seq-512
+        # on the ragged kernel from the same config
+        if cfg.use_flash_attention and s >= (cfg.flash_min_seq or 0):
             from arkflow_tpu.ops.ragged_attention import ragged_flash_attention
 
             # largest pow2 tile (<=128) dividing the bucket length, so any

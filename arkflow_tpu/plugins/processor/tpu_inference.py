@@ -151,6 +151,8 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         checkpoint=config.get("checkpoint"),
         seed=int(config.get("seed", 0)),
         serving_dtype=config.get("serving_dtype"),
+        max_in_flight=(int(config["max_in_flight"])
+                       if config.get("max_in_flight") is not None else None),
     )
     vocab = getattr(runner.cfg, "vocab_size", 30522)
     tokenizer = build_tokenizer(config.get("tokenizer"), vocab_size=vocab)

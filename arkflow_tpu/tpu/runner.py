@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from functools import partial
 from typing import Any, Optional
 
@@ -37,6 +38,28 @@ from arkflow_tpu.parallel.mesh import MeshSpec, create_mesh, shard_params
 from arkflow_tpu.tpu.bucketing import BucketPolicy, pad_batch_dim, pad_seq_dim
 
 logger = logging.getLogger("arkflow.tpu")
+
+
+def _env_int(name: str, default: int, minimum: Optional[int] = None) -> int:
+    """Tolerant int env knob: malformed or out-of-range values log a warning
+    and fall back to the default (like the ARKFLOW_FLASH kill switch, a bad
+    env value must not crash runner setup; explicit config values DO raise)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        logger.warning("%s=%r is not an int; using %d", name, raw, default)
+        return default
+    if minimum is not None and val < minimum:
+        logger.warning("%s=%d is below %d; using %d", name, val, minimum, default)
+        return default
+    return val
+
+
+def _env_flash_floor(default: int = 128) -> int:
+    return _env_int("ARKFLOW_FLASH_MIN_SEQ", default)
 
 
 class _nullcontext:
@@ -59,6 +82,7 @@ class ModelRunner:
         seed: int = 0,
         devices=None,
         serving_dtype: Optional[str] = None,
+        max_in_flight: Optional[int] = None,
     ):
         from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
 
@@ -159,9 +183,18 @@ class ModelRunner:
             "arkflow_tpu_infeed_stall_seconds_total",
             "wall seconds the device sat idle between steps (host-bound)", labels)
         self._seen_shapes: set[tuple] = set()
-        #: device queue depth: 2 = double buffering (prep/dispatch n+1
-        #: overlaps compute of n); more just adds latency
-        self.max_in_flight = 2
+        #: device queue depth. 2 = double buffering (prep/dispatch n+1
+        #: overlaps compute of n) — enough when dispatch latency ~ 0. Over
+        #: a remote/tunneled backend each step also pays a dispatch+sync
+        #: round trip (~70ms measured on the axon tunnel vs ~30ms compute
+        #: at b1024: tools/profile_step.py), so keeping ceil((rtt+c)/c)
+        #: steps in flight is what actually saturates the chip. Config
+        #: ``max_in_flight`` / env ARKFLOW_INFLIGHT override.
+        if max_in_flight is None:
+            max_in_flight = _env_int("ARKFLOW_INFLIGHT", 2, minimum=1)
+        if max_in_flight < 1:  # explicit config/kwarg values DO raise
+            raise ConfigError(f"max_in_flight must be >= 1, got {max_in_flight}")
+        self.max_in_flight = max_in_flight
         self._inflight_sem: Optional[asyncio.Semaphore] = None
         self._inflight = 0
         self._busy_start = 0.0
@@ -177,8 +210,6 @@ class ModelRunner:
         GSPMD-partitionable XLA path). ``ARKFLOW_FLASH=0`` is the operator
         kill switch: it forces the XLA path even over an explicit
         ``use_flash_attention: true`` in config."""
-        import os
-
         if not hasattr(cfg, "use_flash_attention"):
             return cfg
         import dataclasses
@@ -186,6 +217,16 @@ class ModelRunner:
         if os.environ.get("ARKFLOW_FLASH", "1") == "0":
             return dataclasses.replace(cfg, use_flash_attention=False)
         if cfg.use_flash_attention is not None:
+            # explicit config keeps its own floor; when config left the
+            # floor unset, a set ARKFLOW_FLASH_MIN_SEQ fills it (a
+            # config-pinned flash_min_seq still wins over the env var —
+            # weaker than the ARKFLOW_FLASH=0 kill switch, which overrides
+            # config unconditionally)
+            if (cfg.use_flash_attention
+                    and getattr(cfg, "flash_min_seq", 0) is None
+                    and os.environ.get("ARKFLOW_FLASH_MIN_SEQ")):
+                return dataclasses.replace(
+                    cfg, flash_min_seq=_env_flash_floor())
             return cfg
         if mesh_spec is not None and mesh_spec.num_devices > 1:
             return dataclasses.replace(cfg, use_flash_attention=False)
@@ -194,7 +235,17 @@ class ModelRunner:
             on_tpu = dev.platform == "tpu" or "tpu" in getattr(dev, "device_kind", "").lower()
         except Exception:
             on_tpu = False
-        return dataclasses.replace(cfg, use_flash_attention=on_tpu)
+        extra = {}
+        if on_tpu and getattr(cfg, "flash_min_seq", 0) is None:
+            # auto-chosen flash only engages at seqs where the kernel wins:
+            # short buckets tile below the MXU (tile=seq<128) and the grid
+            # overhead dominates — v5e A/B at seq 32 measured XLA 47% faster
+            # end-to-end; on-chip the two are within ~5% from seq 128 up
+            # (tools/profile_attention.py), with Pallas ahead at low fill.
+            # Only fills the floor when unset, so an operator-tuned
+            # flash_min_seq in config survives auto-resolution.
+            extra["flash_min_seq"] = _env_flash_floor()
+        return dataclasses.replace(cfg, use_flash_attention=on_tpu, **extra)
 
     def _build_jitted(self) -> None:
         """(Re)build the jitted step from the CURRENT self.cfg. jax.jit keys
@@ -305,9 +356,14 @@ class ModelRunner:
         """Host-side stage: pad to buckets + validate masks (CPU only)."""
         padded, n = self._pad_inputs(inputs)
         if getattr(self.cfg, "use_flash_attention", False) and "attention_mask" in padded:
+            # sub-floor buckets compile the XLA path (models gate on the
+            # static seq), which serves arbitrary masks — don't fail or
+            # globally disable flash over a batch the kernel never sees
+            m = padded["attention_mask"]
+            if m.shape[1] < (getattr(self.cfg, "flash_min_seq", None) or 0):
+                return padded, n
             # the ragged kernel reads row sums as prefix lengths; a
             # non-contiguous mask (left padding) would silently mis-attend
-            m = padded["attention_mask"]
             lengths = m.sum(axis=1)
             prefix = (np.arange(m.shape[1])[None, :] < lengths[:, None]).astype(m.dtype)
             if not np.array_equal(prefix, m):

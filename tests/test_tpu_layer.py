@@ -130,6 +130,46 @@ def test_flash_auto_falls_back_on_bad_mask():
                              "attention_mask": mask})
 
 
+def test_flash_floor_skips_mask_guard_below_floor(monkeypatch):
+    """Buckets below flash_min_seq compile the XLA path, which serves any
+    mask — the right-padding guard must not raise (forced flash) nor
+    globally disable flash (auto) over a bucket the kernel never sees."""
+    monkeypatch.delenv("ARKFLOW_FLASH", raising=False)
+    monkeypatch.delenv("ARKFLOW_FLASH_MIN_SEQ", raising=False)
+    runner = ModelRunner(
+        "bert_classifier",
+        dict(TINY_BERT, use_flash_attention=True, flash_interpret=True,
+             flash_min_seq=64),
+        buckets=BucketPolicy((4,), (16,)))
+    mask = np.ones((2, 16), np.int32)
+    mask[:, 0] = 0  # left padding at seq 16 < floor 64: XLA bucket
+    out = runner.infer_sync({"input_ids": np.ones((2, 16), np.int32),
+                             "attention_mask": mask})
+    assert out["label"].shape == (2,)
+    assert runner.cfg.use_flash_attention is True  # flash NOT abandoned
+
+
+def test_flash_floor_env_override_applies_to_explicit_config(monkeypatch):
+    """ARKFLOW_FLASH_MIN_SEQ overrides explicit use_flash_attention: true
+    (like ARKFLOW_FLASH=0 does) unless config pinned its own floor; a
+    malformed value falls back to the default instead of crashing setup."""
+    monkeypatch.delenv("ARKFLOW_FLASH", raising=False)
+    monkeypatch.setenv("ARKFLOW_FLASH_MIN_SEQ", "64")
+    explicit = ModelRunner(
+        "bert_classifier", dict(TINY_BERT, use_flash_attention=True, flash_interpret=True),
+        buckets=BucketPolicy((4,), (16,)))
+    assert explicit.cfg.flash_min_seq == 64
+    pinned = ModelRunner(
+        "bert_classifier",
+        dict(TINY_BERT, use_flash_attention=True, flash_interpret=True,
+             flash_min_seq=32),
+        buckets=BucketPolicy((4,), (16,)))
+    assert pinned.cfg.flash_min_seq == 32  # config wins over env
+    monkeypatch.setenv("ARKFLOW_FLASH_MIN_SEQ", "not-an-int")
+    from arkflow_tpu.tpu.runner import _env_flash_floor
+    assert _env_flash_floor() == 128
+
+
 def test_persistent_cache_idempotent(tmp_path, monkeypatch):
     import jax
 

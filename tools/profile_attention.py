@@ -14,6 +14,7 @@ skipping fully-padded K tiles, so low fill favors Pallas. This justifies
 
 from __future__ import annotations
 
+import functools
 import json
 import sys
 import time
@@ -41,10 +42,19 @@ def main() -> None:
     fills = [1.0, 0.5, 0.25]
     reps = 30 if on_tpu else 3
 
+    # scalar-reduced outputs + device_get sync: over the axon tunnel
+    # block_until_ready returns without waiting, so timing it measures
+    # dispatch, not compute; device_get of a scalar forces the real wait
+    # with a negligible (4-byte) transfer
     def xla_attn(q, k, v, mask):
-        return cm.attention(q, k, v, mask)
+        return cm.attention(q, k, v, mask).astype(jnp.float32).sum()
 
     jx = jax.jit(xla_attn)
+
+    @functools.partial(jax.jit, static_argnames=("interpret",))
+    def pallas_attn(qh, kh, vh, lengths, interpret=False):
+        return ragged_flash_attention(
+            qh, kh, vh, lengths, interpret=interpret).astype(jnp.float32).sum()
 
     for b, s in shapes:
         rng = np.random.RandomState(0)
@@ -56,11 +66,11 @@ def main() -> None:
             mask = (jnp.arange(s)[None, :] < lengths[:, None])[:, None, None, :]
 
             def run_xla():
-                return jx(q, k, v, mask).block_until_ready()
+                return jax.device_get(jx(q, k, v, mask))
 
             def run_pallas():
-                return ragged_flash_attention(
-                    qh, qh, qh, lengths, interpret=interpret).block_until_ready()
+                return jax.device_get(pallas_attn(qh, qh, qh, lengths,
+                                                  interpret=interpret))
 
             run_xla(); run_pallas()  # compile
             tx = _median_ms(run_xla, reps)

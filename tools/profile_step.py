@@ -1,0 +1,129 @@
+"""Decompose the serving step at bench shapes: where do the milliseconds go?
+
+Times, independently, on the current backend (meant for a real TPU):
+  1. raw jitted forward (ModelRunner._dispatch + block) at (batch, seq)
+  2. host prep (pad/validate, no device work)
+  3. tokenizer encode_batch for `batch` strings
+  4. a reference MXU matmul with the same analytic FLOPs as the forward
+
+(1) vs (4) separates XLA-inefficiency from physics; (2)+(3) vs (1) says
+whether the host pipeline can keep the device fed (with 2 steps in flight,
+host time < device time means the device never starves).
+
+    python tools/profile_step.py            # BERT-base bf16 b1024 s32
+    PROF_BATCH=256 PROF_SEQ=128 PROF_DTYPE=int8 python tools/profile_step.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _median_ms(fn, reps: int = 20) -> float:
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from arkflow_tpu.tpu.bucketing import BucketPolicy
+    from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
+    from arkflow_tpu.tpu.runner import ModelRunner
+    from arkflow_tpu.tpu.tokenizer import build_tokenizer
+
+    enable_persistent_cache()
+    batch = int(os.environ.get("PROF_BATCH", "1024"))
+    seq = int(os.environ.get("PROF_SEQ", "32"))
+    dtype = os.environ.get("PROF_DTYPE", "bfloat16")
+    dev = jax.devices()[0]
+    print(f"# device: {dev} batch={batch} seq={seq} dtype={dtype}",
+          file=sys.stderr, flush=True)
+
+    runner = ModelRunner(
+        "bert_classifier", {},
+        buckets=BucketPolicy((batch,), (seq,)),
+        serving_dtype=dtype,
+    )
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 30000, (batch, seq)).astype(np.int32)
+    mask = np.ones((batch, seq), np.int32)
+    inputs = {"input_ids": ids, "attention_mask": mask}
+
+    # per-call round-trip floor: a no-compute dispatch+sync. Over the axon
+    # tunnel this measured ~70ms — it dominates single-step timings, and
+    # ceil((rtt+compute)/compute) is the in-flight depth that hides it
+    tiny = jax.jit(lambda x: x + 1.0)
+    jax.device_get(tiny(jnp.float32(0)))
+    t_rtt = _median_ms(lambda: jax.device_get(tiny(jnp.float32(0))))
+
+    padded, _ = runner._prep(inputs)
+    # sync via device_get, NOT block_until_ready: over the axon tunnel
+    # block_until_ready returns without waiting (measured 0.119ms for a
+    # 5.6-TFLOP forward = impossible); device_get forces a real round trip
+    # and matches what the serving path does anyway
+    jax.device_get(runner._dispatch(padded))  # compile
+
+    t_step = _median_ms(lambda: jax.device_get(runner._dispatch(padded)))
+    t_prep = _median_ms(lambda: runner._prep(inputs))
+
+    tok = build_tokenizer(None, vocab_size=30522)
+    texts = ["stream processing on tpu: sensor reading nominal"] * batch
+    t_tok = _median_ms(lambda: tok.encode_batch(texts, seq), reps=10)
+
+    # reference matmul at the forward's analytic FLOPs: per-layer GEMMs are
+    # [b*s, h] @ [h, h] shaped; scale rep count so total FLOPs match.
+    # Same formula as bench.py::_bert_flops_per_row (keeps the quadratic
+    # attention term, which dominates scaling at long seq)
+    h, ffn, layers = 768, 3072, 12
+    per_token = 8 * h * h + 4 * h * ffn + 4 * seq * h
+    flops_fwd = float(batch * seq * layers * per_token)
+    a = jnp.asarray(rng.randn(batch * seq, h), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(h, h), jnp.bfloat16)
+    n_mm = max(1, int(round(flops_fwd / (2.0 * batch * seq * h * h))))
+
+    @jax.jit
+    def mm_chain(a, w):
+        def body(x, _):
+            return jnp.dot(x, w), None
+        out, _ = jax.lax.scan(body, a, None, length=n_mm)
+        # scalar output: the device_get sync transfers 4 bytes, so the
+        # timing is the GEMM chain, not a 50MB outfeed
+        return out.astype(jnp.float32).sum()
+
+    jax.device_get(mm_chain(a, w))
+    t_mm = _median_ms(lambda: jax.device_get(mm_chain(a, w)))
+
+    compute = max(t_step - t_rtt, 1e-3)
+    print(json.dumps({
+        "batch": batch, "seq": seq, "dtype": dtype,
+        "roundtrip_floor_ms": round(t_rtt, 3),
+        "device_step_ms": round(t_step, 3),
+        "device_compute_est_ms": round(compute, 3),
+        "host_prep_ms": round(t_prep, 3),
+        "tokenize_ms": round(t_tok, 3),
+        "ref_matmul_same_flops_ms": round(t_mm, 3),
+        "ref_matmul_compute_est_ms": round(max(t_mm - t_rtt, 1e-3), 3),
+        "n_ref_matmuls": n_mm,
+        "step_vs_matmul": (round((t_step - t_rtt) / (t_mm - t_rtt), 2)
+                           if t_mm - t_rtt > 1e-3 else None),
+        "host_total_ms": round(t_prep + t_tok, 3),
+        "host_can_feed_device": (t_prep + t_tok) < t_step,
+        "inflight_to_hide_rtt": int(-(-t_step // compute)),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
