@@ -917,12 +917,13 @@ class PulsarProducer:
 
 
 def auth_from_config(auth: Optional[dict]) -> tuple[Optional[str], Optional[bytes]]:
-    """Mirror of the reference's PulsarAuth enum (token | oauth2).
+    """Mirror of the reference's PulsarAuth enum (token | oauth2),
+    ref pulsar/common.rs:286-325.
 
-    OAuth2 requires a token-endpoint round trip at connect time; in this
-    zero-egress image it is validated but rejected at build with a clear
-    message (same fail-fast stance the reference's validator takes for
-    malformed auth, ref pulsar/common.rs:286-325).
+    Token auth resolves to wire bytes immediately. OAuth2 is validated here
+    (fail fast at build / --validate) but its token is fetched at CONNECT
+    time via :func:`fetch_oauth2_token` — the returned data is ``None`` and
+    the caller exchanges client credentials when it actually dials.
     """
     if not auth:
         return None, None
@@ -938,7 +939,69 @@ def auth_from_config(auth: Optional[dict]) -> tuple[Optional[str], Optional[byte
         for req in ("issuer_url", "credentials_url", "audience"):
             if not auth.get(req):
                 raise ConfigError(f"pulsar oauth2 auth requires {req!r}")
-        raise ConfigError(
-            "pulsar oauth2 auth needs an external token endpoint, which this "
-            "environment cannot reach; use token auth")
+        cred_url = str(auth["credentials_url"])
+        if not cred_url.startswith("file://"):
+            raise ConfigError(
+                "pulsar oauth2 credentials_url must be a file:// URL to a "
+                "key-file JSON (client_id/client_secret)")
+        return "oauth2", None
     raise ConfigError(f"pulsar auth type {kind!r} not supported (token/oauth2)")
+
+
+async def fetch_oauth2_token(auth: dict, timeout: float = 10.0) -> bytes:
+    """OAuth2 client-credentials exchange -> bearer token bytes for CONNECT.
+
+    Matches the reference's oauth2 flow (ref pulsar/common.rs:286-325 via
+    the pulsar-rs OAuth2Authentication): read the key-file JSON named by
+    ``credentials_url`` (file://), discover the token endpoint from the
+    issuer's ``/.well-known/openid-configuration`` (falling back to
+    ``{issuer_url}/oauth/token``), then POST a client_credentials grant
+    with the configured audience/scope. On the wire the fetched token is
+    sent with auth method name "token" (bearer), as real Pulsar clients do.
+    """
+    import json as _json
+
+    import aiohttp
+
+    from urllib.parse import unquote, urlparse
+
+    parsed = urlparse(str(auth["credentials_url"]))
+    path = unquote(parsed.path)  # handles file://localhost/... (RFC 8089)
+    with open(path, "r", encoding="utf-8") as f:
+        creds = _json.load(f)
+    for req in ("client_id", "client_secret"):
+        if req not in creds:
+            raise ConfigError(f"pulsar oauth2 key file missing {req!r}")
+    issuer = str(auth["issuer_url"]).rstrip("/")
+    async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=timeout)) as session:
+        token_endpoint = f"{issuer}/oauth/token"
+        try:
+            async with session.get(
+                    f"{issuer}/.well-known/openid-configuration") as resp:
+                if resp.status == 200:
+                    disc = await resp.json(content_type=None)
+                    token_endpoint = disc.get("token_endpoint", token_endpoint)
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            # discovery is best-effort: a hung endpoint, non-JSON body
+            # (JSONDecodeError is a ValueError), or connection error all
+            # fall back to the conventional {issuer}/oauth/token path
+            pass
+        form = {
+            "grant_type": "client_credentials",
+            "client_id": str(creds["client_id"]),
+            "client_secret": str(creds["client_secret"]),
+            "audience": str(auth["audience"]),
+        }
+        if auth.get("scope"):
+            form["scope"] = str(auth["scope"])
+        async with session.post(token_endpoint, data=form) as resp:
+            if resp.status != 200:
+                body = (await resp.text())[:200]
+                raise ConnectionError(
+                    f"pulsar oauth2 token endpoint returned {resp.status}: {body}")
+            payload = await resp.json(content_type=None)
+    token = payload.get("access_token")
+    if not token:
+        raise ConnectionError("pulsar oauth2 response has no access_token")
+    return str(token).encode()

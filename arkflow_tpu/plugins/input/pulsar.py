@@ -30,6 +30,7 @@ from arkflow_tpu.connect.pulsar_client import (
     PulsarClient,
     PulsarConsumer,
     auth_from_config,
+    fetch_oauth2_token,
     parse_service_url,
     validate_topic,
 )
@@ -66,6 +67,7 @@ class PulsarInput(Input):
         self.subscription_type = subscription_type
         self.initial_position = initial_position
         self.auth_method, self.auth_data = auth_from_config(auth)
+        self._auth_cfg = auth
         self.retry = RetryConfig.from_config(retry)
         self.codec = codec
         self._client: Optional[PulsarClient] = None
@@ -76,24 +78,31 @@ class PulsarInput(Input):
         if self._client is not None:  # reconnect: drop the old sockets/tasks
             await self._client.close()
             self._client = None
-        client = PulsarClient(
-            self.service_url, auth_method=self.auth_method, auth_data=self.auth_data
-        )
 
-        async def subscribe():
-            return await client.subscribe(
-                self.topic, self.subscription_name,
-                sub_type=self.subscription_type,
-                initial_position=self.initial_position,
+        async def dial():
+            # the WHOLE dial retries together: a transient token-endpoint
+            # failure backs off like a broker blip, and each retry fetches
+            # a fresh bearer (tokens expire; it rides as "token" on wire)
+            auth_method, auth_data = self.auth_method, self.auth_data
+            if auth_method == "oauth2":
+                auth_data = await fetch_oauth2_token(self._auth_cfg)
+                auth_method = "token"
+            client = PulsarClient(
+                self.service_url, auth_method=auth_method, auth_data=auth_data
             )
+            try:
+                consumer = await client.subscribe(
+                    self.topic, self.subscription_name,
+                    sub_type=self.subscription_type,
+                    initial_position=self.initial_position,
+                )
+            except Exception:
+                await client.close()  # don't leak the connection on failure
+                raise
+            return client, consumer
 
-        try:
-            self._consumer = await retry_with_backoff(
-                subscribe, self.retry, what=f"pulsar subscribe {self.topic}")
-        except Exception:
-            await client.close()  # don't leak the connection on failure
-            raise
-        self._client = client
+        self._client, self._consumer = await retry_with_backoff(
+            dial, self.retry, what=f"pulsar subscribe {self.topic}")
 
     async def read(self) -> tuple[MessageBatch, Ack]:
         if self._closed or self._consumer is None:

@@ -26,6 +26,7 @@ from arkflow_tpu.connect.pulsar_client import (
     PulsarClient,
     PulsarProducer,
     auth_from_config,
+    fetch_oauth2_token,
     parse_service_url,
     validate_topic,
 )
@@ -45,6 +46,7 @@ class PulsarOutput(Output):
             validate_topic(str(topic.eval_scalar(None)))
         self.topic = topic
         self.auth_method, self.auth_data = auth_from_config(auth)
+        self._auth_cfg = auth
         self.retry = RetryConfig.from_config(retry)
         self.codec = codec
         self._client: Optional[PulsarClient] = None
@@ -53,9 +55,19 @@ class PulsarOutput(Output):
     async def connect(self) -> None:
         if self._client is not None:  # reconnect: drop the old sockets/tasks
             await self._client.close()
-            self._producers.clear()
+            self._client = None  # a failed re-dial must not leave a closed
+            self._producers.clear()  # client passing the write() guard
+        auth_method, auth_data = self.auth_method, self.auth_data
+        if auth_method == "oauth2":
+            # fresh client-credentials exchange per dial (tokens expire);
+            # retried with the same backoff the broker steps get, so a
+            # transient token-endpoint 5xx behaves like a broker blip
+            auth_data = await retry_with_backoff(
+                lambda: fetch_oauth2_token(self._auth_cfg), self.retry,
+                what="pulsar oauth2 token")
+            auth_method = "token"
         self._client = PulsarClient(
-            self.service_url, auth_method=self.auth_method, auth_data=self.auth_data
+            self.service_url, auth_method=auth_method, auth_data=auth_data
         )
         try:
             if not self.topic.is_expr:

@@ -60,7 +60,7 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
         "pipeline": {
             # workers must cover the device queue depth or the semaphore
             # can't fill: each in-flight step is held by one processor call
-            "thread_num": max(2, int(os.environ.get("BENCH_INFLIGHT", "2"))),
+            "thread_num": max(2, int(os.environ.get("BENCH_INFLIGHT", "6"))),
             "processors": [
                 {
                     "type": "tpu_inference",
@@ -73,7 +73,7 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
                     "warmup": True,
                     # device queue depth: >2 hides per-dispatch round-trip
                     # latency on remote/tunneled backends (profile_step.py)
-                    "max_in_flight": int(os.environ.get("BENCH_INFLIGHT", "2")),
+                    "max_in_flight": int(os.environ.get("BENCH_INFLIGHT", "6")),
                     # bf16 params on the chip: half the HBM + transfer,
                     # MXU-native; BENCH_DTYPE=int8 serves W8A8 (2x roofline)
                     "serving_dtype": "float32" if tiny

@@ -9,6 +9,7 @@ client, input and output are exercised over real sockets with real frames.
 from __future__ import annotations
 
 import asyncio
+import json
 import struct
 
 import pytest
@@ -428,6 +429,141 @@ def test_input_output_components_end_to_end():
     asyncio.run(go())
 
 
+class FakeOAuthServer:
+    """Minimal HTTP token endpoint: OIDC discovery + client_credentials."""
+
+    def __init__(self, token: str = "tok-abc"):
+        self.token = token
+        self.grants: list[dict] = []
+        self.server = None
+        self.port = 0
+
+    async def start(self) -> None:
+        self.server = await asyncio.start_server(self._client, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        self.server.close()
+        await self.server.wait_closed()
+
+    async def _client(self, reader, writer) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                method, path, _ = line.decode().split(" ", 2)
+                length = 0
+                while True:
+                    h = (await reader.readline()).decode().strip()
+                    if not h:
+                        break
+                    k, _, v = h.partition(":")
+                    if k.lower() == "content-length":
+                        length = int(v)
+                body = (await reader.readexactly(length)).decode() if length else ""
+                if method == "GET" and "openid-configuration" in path:
+                    payload = json.dumps({
+                        "token_endpoint":
+                            f"http://127.0.0.1:{self.port}/custom/token"})
+                elif method == "POST" and path == "/custom/token":
+                    from urllib.parse import parse_qsl
+
+                    self.grants.append(dict(parse_qsl(body)))
+                    payload = json.dumps({"access_token": self.token,
+                                          "token_type": "Bearer",
+                                          "expires_in": 300})
+                else:
+                    writer.write(b"HTTP/1.1 404 Not Found\r\n"
+                                 b"Content-Length: 0\r\nConnection: close\r\n\r\n")
+                    await writer.drain()
+                    return
+                writer.write(
+                    f"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+                    f"Content-Length: {len(payload)}\r\n\r\n{payload}".encode())
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+
+def test_oauth2_end_to_end_token_fetch_and_connect(tmp_path):
+    """OAuth2 auth: discovery -> client_credentials grant -> bearer rides the
+    CONNECT frame as token auth, verified by a broker that requires it."""
+    async def go():
+        oauth = FakeOAuthServer(token="tok-e2e")
+        await oauth.start()
+        broker = FakePulsarBroker(required_token="tok-e2e")
+        await broker.start()
+        key = tmp_path / "key.json"
+        key.write_text(json.dumps({"client_id": "cid", "client_secret": "sec"}))
+        auth = {"type": "oauth2",
+                "issuer_url": f"http://127.0.0.1:{oauth.port}",
+                "credentials_url": f"file://{key}",
+                "audience": "urn:pulsar:cluster",
+                "scope": "produce consume"}
+        try:
+            url = f"pulsar://127.0.0.1:{broker.port}"
+            out = build_component(
+                "output", {"type": "pulsar", "service_url": url, "topic": "t",
+                           "codec": "json", "auth": auth}, Resource())
+            inp = build_component(
+                "input", {"type": "pulsar", "service_url": url, "topic": "t",
+                          "subscription_name": "s", "initial_position": "earliest",
+                          "codec": "json", "auth": auth}, Resource())
+            await out.connect()
+            await inp.connect()
+            await out.write(MessageBatch.from_pydict({"v": [7]}))
+            b, ack = await asyncio.wait_for(inp.read(), 5)
+            assert b.column("v").to_pylist() == [7]
+            await ack.ack()
+            await inp.close()
+            await out.close()
+        finally:
+            await broker.stop()
+            await oauth.stop()
+        grant = oauth.grants[0]
+        assert grant["grant_type"] == "client_credentials"
+        assert grant["client_id"] == "cid"
+        assert grant["client_secret"] == "sec"
+        assert grant["audience"] == "urn:pulsar:cluster"
+        assert grant["scope"] == "produce consume"
+
+    asyncio.run(go())
+
+
+def test_oauth2_bad_token_rejected_by_broker(tmp_path):
+    """A broker that requires a different token closes the connection: the
+    fetched-but-wrong bearer must surface as a connect failure, not hang."""
+    async def go():
+        oauth = FakeOAuthServer(token="wrong")
+        await oauth.start()
+        broker = FakePulsarBroker(required_token="right")
+        await broker.start()
+        key = tmp_path / "key.json"
+        key.write_text(json.dumps({"client_id": "c", "client_secret": "s"}))
+        try:
+            inp = build_component(
+                "input",
+                {"type": "pulsar",
+                 "service_url": f"pulsar://127.0.0.1:{broker.port}",
+                 "topic": "t", "subscription_name": "s",
+                 "retry": {"max_attempts": 1},
+                 "auth": {"type": "oauth2",
+                          "issuer_url": f"http://127.0.0.1:{oauth.port}",
+                          "credentials_url": f"file://{key}",
+                          "audience": "a"}},
+                Resource())
+            with pytest.raises(Exception):
+                await asyncio.wait_for(inp.connect(), 10)
+        finally:
+            await broker.stop()
+            await oauth.stop()
+
+    asyncio.run(go())
+
+
 def test_pulsar_config_validation():
     r = Resource()
     with pytest.raises(ConfigError):
@@ -440,12 +576,19 @@ def test_pulsar_config_validation():
     with pytest.raises(ConfigError):
         build_component("output", {"type": "pulsar", "service_url": "kafka://h",
                                    "topic": "t"}, r)
-    # oauth2 is validated then rejected with a clear message (zero-egress image)
-    with pytest.raises(ConfigError, match="oauth2"):
+    # oauth2: missing fields and non-file credentials_url fail fast at build
+    with pytest.raises(ConfigError, match="issuer_url"):
+        build_component("output", {"type": "pulsar", "service_url": "pulsar://h",
+                                   "topic": "t",
+                                   "auth": {"type": "oauth2",
+                                            "credentials_url": "file:///k.json",
+                                            "audience": "z"}}, r)
+    with pytest.raises(ConfigError, match="file://"):
         build_component("output", {"type": "pulsar", "service_url": "pulsar://h",
                                    "topic": "t",
                                    "auth": {"type": "oauth2", "issuer_url": "x",
-                                            "credentials_url": "y", "audience": "z"}}, r)
+                                            "credentials_url": "https://y",
+                                            "audience": "z"}}, r)
     with pytest.raises(ConfigError):
         build_component("input", {"type": "pulsar", "service_url": "pulsar://h",
                                   "topic": "t", "subscription_name": "s",
