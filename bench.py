@@ -45,7 +45,9 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
         {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4, "ffn": 64,
          "max_positions": 64, "num_labels": 2}
         if tiny
-        else {}
+        # bf16 softmax halves scores bandwidth: ~11% of the step at b1024
+        # (labels argmax-identical; BENCH_SOFTMAX_DTYPE=float32 reverts)
+        else {"softmax_dtype": os.environ.get("BENCH_SOFTMAX_DTYPE", "bfloat16")}
     )
     payload = "stream processing on tpu: sensor reading nominal, no anomaly detected"
     return {

@@ -263,6 +263,29 @@ def test_bert_flash_attention_matches_dense_logits():
     np.testing.assert_array_equal(np.asarray(dense["label"]), np.asarray(flash["label"]))
 
 
+def test_bert_bf16_softmax_matches_f32_labels():
+    """softmax_dtype=bfloat16 (serving bandwidth opt) must keep argmax
+    labels identical and logits close on the tiny model; bad values fail
+    fast at config build."""
+    fam = get_model("bert_classifier")
+    cfg32 = fam.make_config(**TINY_BERT)
+    cfg16 = fam.make_config(**TINY_BERT, softmax_dtype="bfloat16")
+    p = fam.init(jax.random.PRNGKey(3), cfg32)
+    rng = np.random.RandomState(3)
+    ids = jnp.asarray(rng.randint(1, 100, (4, 16)), jnp.int32)
+    mask = jnp.asarray([[1] * 16, [1] * 11 + [0] * 5, [1] * 7 + [0] * 9,
+                        [1] * 2 + [0] * 14], jnp.int32)
+    a = fam.apply(p, cfg32, input_ids=ids, attention_mask=mask)
+    b = fam.apply(p, cfg16, input_ids=ids, attention_mask=mask)
+    np.testing.assert_array_equal(np.asarray(a["label"]), np.asarray(b["label"]))
+    np.testing.assert_allclose(np.asarray(a["logits"]), np.asarray(b["logits"]),
+                               atol=5e-2, rtol=2e-2)
+    from arkflow_tpu.errors import ConfigError
+    import pytest
+    with pytest.raises(ConfigError, match="softmax_dtype"):
+        fam.make_config(**TINY_BERT, softmax_dtype="float16")
+
+
 def test_bert_flash_min_seq_gates_kernel_per_bucket():
     """flash_min_seq is a trace-time floor: buckets shorter than it compile
     the XLA attention path even with flash on (at short seq the Pallas tiles
