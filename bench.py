@@ -5,7 +5,7 @@ micro-batching -> tpu_inference BERT-base -> drop sink) — the hermetic stand-i
 for BASELINE.json config 2 (Kafka -> BERT-base classify -> Kafka) with broker
 I/O excluded so the number is rows/sec/chip. Prints ONE JSON line.
 
-Env knobs: BENCH_SECONDS (default 15), BENCH_BATCH (256), BENCH_SEQ (32),
+Env knobs: BENCH_SECONDS (default 15), BENCH_BATCH (1024), BENCH_SEQ (32),
 BENCH_TINY=1 for a CPU-sized smoke run, BENCH_MODE=sql for the CPU reference
 anchor (BASELINE.json config 1: generate -> json_to_arrow -> sql filter).
 """
@@ -116,7 +116,9 @@ def build_latency_config(seq: int, tiny: bool) -> dict:
                     "model": "bert_classifier",
                     "model_config": model_config,
                     "max_seq": seq,
-                    "batch_buckets": [8, 16, 32, 64],
+                    # TPU: 2 buckets = 2 tunnel compiles before first rows
+                    # (4 once blew the first-rows deadline -> no data)
+                    "batch_buckets": [8, 16, 32, 64] if tiny else [8, 64],
                     "seq_buckets": [seq],
                     "outputs": ["label", "score"],
                     "warmup": True,
@@ -145,7 +147,10 @@ async def run_bench(seconds: float, batch: int, seq: int, tiny: bool,
         cfg_map = build_stream_config(batch, seq, tiny)
     cfg = StreamConfig.from_mapping(cfg_map)
     print("bench: building model...", file=sys.stderr, flush=True)
-    stream = build_stream(cfg, name="bench")
+    # per-phase stream name: metrics are labeled by stream, so the latency
+    # phase must NOT share the headline's e2e histogram (a shared "bench"
+    # label once reported the headline's saturated p99 as the latency p99)
+    stream = build_stream(cfg)  # labeled by cfg.name: per-phase metrics
     print("bench: model built; compiling + streaming...", file=sys.stderr, flush=True)
     cancel = asyncio.Event()
 
@@ -156,7 +161,8 @@ async def run_bench(seconds: float, batch: int, seq: int, tiny: bool,
 
     async def controller():
         # wait until the first rows flow (compile done), then time the window
-        t_deadline = time.time() + 300
+        # (tunnel compiles of full-size models can take minutes each)
+        t_deadline = time.time() + (300 if tiny else 900)
         while rows_out.value == 0 and time.time() < t_deadline:
             await asyncio.sleep(0.25)
         rows_start = rows_out.value
@@ -271,12 +277,12 @@ def main() -> None:
         except RuntimeError:
             pass
     seconds = float(os.environ.get("BENCH_SECONDS", "15"))
-    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    batch = int(os.environ.get("BENCH_BATCH", "1024"))
     seq = int(os.environ.get("BENCH_SEQ", "32"))
 
     # Phase ORDER depends on backend: on CPU (tiny) the latency phase runs
     # first, cheap. On a real TPU over the tunnel each bucket compile can
-    # take minutes, and the latency phase needs FOUR buckets — so the
+    # take minutes, and the latency phase needs TWO extra buckets — so the
     # saturated headline (ONE compile) measures first, banking its number
     # (and its executable in the persistent cache) before latency is
     # attempted. Output order is fixed regardless: latency line first,
@@ -294,7 +300,7 @@ def main() -> None:
     busy1, stall1 = _busy_stall_from_registry()
 
     if run_latency and not tiny:
-        # TPU: bank the headline BEFORE attempting latency — its 4 bucket
+        # TPU: bank the headline BEFORE attempting latency — its bucket
         # compiles can outlive an external kill, and the last printed JSON
         # line must survive as the headline either way (it is re-printed,
         # with latency detail, after a successful latency phase)
@@ -302,6 +308,12 @@ def main() -> None:
         lat_seconds = float(os.environ.get("BENCH_LAT_SECONDS", "10"))
         lat = asyncio.run(run_bench(lat_seconds, 8, seq, tiny, mode="latency"))
 
+    if lat is not None and lat["rows"] == 0:
+        # compile never finished inside the controller deadline: there is
+        # no latency data — say so instead of printing stale quantiles
+        print("bench: latency phase produced 0 rows (compile exceeded "
+              "deadline); omitting latency metric", file=sys.stderr, flush=True)
+        lat = None
     lat_detail = {}
     if lat is not None:
         lat_detail = {"latency_p50_ms": round(lat["p50_ms"], 2),
@@ -333,6 +345,16 @@ def main() -> None:
 
 def _print_headline(res: dict, tiny: bool, batch: int, seq: int,
                     d_busy: float, d_stall: float, lat_detail: dict) -> None:
+    import math
+
+    if res["rows"] == 0:
+        # compile never finished inside the deadline: no data. Keep the
+        # one-JSON-line contract with finite values (NaN quantiles from an
+        # empty histogram would break strict parsers) and say why.
+        for k in ("p50_ms", "p99_ms"):
+            if math.isnan(res[k]):
+                res[k] = 0.0
+        lat_detail = dict(lat_detail, no_data="0 rows flowed before deadline")
     duty = round(d_busy / (d_busy + d_stall), 4) if (d_busy + d_stall) > 0 else 0.0
     baseline = 100_000.0  # BASELINE.json north-star rows/sec/chip
     print(
