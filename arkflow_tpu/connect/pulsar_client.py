@@ -103,6 +103,23 @@ message CommandConnected {
   optional int32 max_message_size = 3;
 }
 
+message AuthData {
+  optional string auth_method_name = 1;
+  optional bytes auth_data = 2;
+}
+
+message CommandAuthChallenge {
+  optional string server_version = 1;
+  optional AuthData challenge = 2;
+  optional int32 protocol_version = 3;
+}
+
+message CommandAuthResponse {
+  optional string client_version = 1;
+  optional AuthData response = 2;
+  optional int32 protocol_version = 3;
+}
+
 message CommandSubscribe {
   enum SubType {
     Exclusive = 0;
@@ -273,6 +290,8 @@ message BaseCommand {
     PONG = 19;
     LOOKUP = 23;
     LOOKUP_RESPONSE = 24;
+    AUTH_CHALLENGE = 36;
+    AUTH_RESPONSE = 37;
   }
   required Type type = 1;
   optional CommandConnect connect = 2;
@@ -295,6 +314,8 @@ message BaseCommand {
   optional CommandPong pong = 19;
   optional CommandLookupTopic lookupTopic = 23;
   optional CommandLookupTopicResponse lookupTopicResponse = 24;
+  optional CommandAuthChallenge authChallenge = 36;
+  optional CommandAuthResponse authResponse = 37;
 }
 
 message CommandProducerSuccess {
@@ -444,9 +465,18 @@ class _Conn:
 
     def __init__(self, host: str, port: int, *, tls: bool = False,
                  auth_method: Optional[str] = None, auth_data: Optional[bytes] = None,
-                 timeout: float = 10.0, proxy_to_broker_url: Optional[str] = None):
+                 timeout: float = 10.0, proxy_to_broker_url: Optional[str] = None,
+                 auth_refresh=None, on_auth_data=None):
         self.host, self.port, self.tls = host, port, tls
         self.auth_method, self.auth_data = auth_method, auth_data
+        # async () -> bytes: re-acquire credentials for AUTH_CHALLENGE
+        # (OAuth2 bearers expire; brokers challenge mid-connection)
+        self.auth_refresh = auth_refresh
+        # bytes -> None: propagate a refreshed bearer to the owning client so
+        # NEW connections (broker failover, expr topics) don't dial with the
+        # stale token fetched at connect time
+        self.on_auth_data = on_auth_data
+        self._auth_task: Optional[asyncio.Task] = None
         self.timeout = timeout
         self.proxy_to_broker_url = proxy_to_broker_url
         self.reader: Optional[asyncio.StreamReader] = None
@@ -553,6 +583,14 @@ class _Conn:
             self.writer.write(encode_simple(pong))
             await self.writer.drain()
             return
+        if t == 36:  # AUTH_CHALLENGE: broker wants fresh credentials
+            # (bearer expiry, typ. every ~300s for OAuth2). Answer off the
+            # read loop: the refresh may do an HTTP token exchange and must
+            # not stall frame dispatch. Ref behavior: pulsar clients answer
+            # AUTH_RESPONSE in place of tearing down the connection.
+            if self._auth_task is None or self._auth_task.done():
+                self._auth_task = asyncio.create_task(self._answer_auth_challenge())
+            return
         if t == 9:  # MESSAGE -> route to consumer queue
             cons = self._consumers.get(cmd.message.consumer_id)
             if cons is not None:
@@ -599,6 +637,31 @@ class _Conn:
             return
         logger.debug("pulsar: unhandled command type %d", t)
 
+    async def _answer_auth_challenge(self) -> None:
+        data = self.auth_data or b""
+        if self.auth_refresh is not None:
+            try:
+                data = await self.auth_refresh()
+                self.auth_data = data
+                if self.on_auth_data is not None:
+                    self.on_auth_data(data)
+            except Exception as e:
+                # answer with the stale bearer rather than going silent: the
+                # broker's rejection then surfaces as a normal Disconnection
+                # and the stream's reconnect loop takes over
+                logger.warning("pulsar: credential refresh for AUTH_CHALLENGE "
+                               "failed (answering with previous data): %s", e)
+        cmd = proto()["BaseCommand"]()
+        cmd.type = 37  # AUTH_RESPONSE
+        cmd.authResponse.client_version = CLIENT_VERSION
+        cmd.authResponse.protocol_version = PROTOCOL_VERSION
+        cmd.authResponse.response.auth_method_name = self.auth_method or "none"
+        cmd.authResponse.response.auth_data = data
+        try:
+            await self.send_frame(encode_simple(cmd))
+        except (ConnectionError, OSError) as e:
+            logger.warning("pulsar: could not send AUTH_RESPONSE: %s", e)
+
     async def request(self, cmd) -> "object":
         """Send a command carrying a request_id and await its response."""
         req_id = _outgoing_request_id(cmd)
@@ -617,6 +680,12 @@ class _Conn:
 
     async def close(self) -> None:
         self._closed = True
+        if self._auth_task is not None and not self._auth_task.done():
+            self._auth_task.cancel()
+            try:
+                await self._auth_task
+            except (asyncio.CancelledError, Exception):
+                pass
         if self._reader_task:
             self._reader_task.cancel()
             try:
@@ -656,10 +725,11 @@ class PulsarClient:
 
     def __init__(self, service_url: str, *, auth_method: Optional[str] = None,
                  auth_data: Optional[bytes] = None, timeout: float = 10.0,
-                 max_lookup_redirects: int = 3):
+                 max_lookup_redirects: int = 3, auth_refresh=None):
         self.service_url = service_url
         self.host, self.port, self.tls = parse_service_url(service_url)
         self.auth_method, self.auth_data = auth_method, auth_data
+        self.auth_refresh = auth_refresh
         self.timeout = timeout
         self.max_lookup_redirects = max_lookup_redirects
         self._conns: dict[tuple[str, int], _Conn] = {}
@@ -668,6 +738,11 @@ class PulsarClient:
     def _next_id(self) -> int:
         self._ids += 1
         return self._ids
+
+    def _set_auth_data(self, data: bytes) -> None:
+        """A connection's AUTH_CHALLENGE refresh updates the client-level
+        bearer too, so later connections dial with live credentials."""
+        self.auth_data = data
 
     async def _get_conn(self, host: str, port: int,
                         proxy_to_broker_url: Optional[str] = None,
@@ -680,7 +755,9 @@ class PulsarClient:
                      tls=self.tls if tls is None else tls,
                      auth_method=self.auth_method,
                      auth_data=self.auth_data, timeout=self.timeout,
-                     proxy_to_broker_url=proxy_to_broker_url)
+                     proxy_to_broker_url=proxy_to_broker_url,
+                     auth_refresh=self.auth_refresh,
+                     on_auth_data=self._set_auth_data)
         await conn.connect()
         self._conns[key] = conn
         return conn
@@ -940,10 +1017,23 @@ def auth_from_config(auth: Optional[dict]) -> tuple[Optional[str], Optional[byte
             if not auth.get(req):
                 raise ConfigError(f"pulsar oauth2 auth requires {req!r}")
         cred_url = str(auth["credentials_url"])
-        if not cred_url.startswith("file://"):
+        # file:// (local key file), data: (inline JSON), and http(s)://
+        # (remote key file — what the reference's validate_url accepts,
+        # pulsar/common.rs:326-330) are all valid key-file sources
+        if not cred_url.startswith(("file://", "data:", "http://", "https://")):
             raise ConfigError(
-                "pulsar oauth2 credentials_url must be a file:// URL to a "
-                "key-file JSON (client_id/client_secret)")
+                "pulsar oauth2 credentials_url must be a file://, data:, or "
+                "http(s):// URL to a key-file JSON (client_id/client_secret)")
+        for url_key in ("issuer_url", "credentials_url"):
+            u = str(auth[url_key])
+            if u.startswith("http://"):
+                # the client secret (key file GET / client_credentials POST)
+                # would transit in the clear — allowed (test rigs), but
+                # never silently
+                logger.warning(
+                    "pulsar oauth2 %s %r uses plain http: client credentials "
+                    "will transit unencrypted; use https in production",
+                    url_key, u)
         return "oauth2", None
     raise ConfigError(f"pulsar auth type {kind!r} not supported (token/oauth2)")
 
@@ -965,10 +1055,33 @@ async def fetch_oauth2_token(auth: dict, timeout: float = 10.0) -> bytes:
 
     from urllib.parse import unquote, urlparse
 
-    parsed = urlparse(str(auth["credentials_url"]))
-    path = unquote(parsed.path)  # handles file://localhost/... (RFC 8089)
-    with open(path, "r", encoding="utf-8") as f:
-        creds = _json.load(f)
+    cred_url = str(auth["credentials_url"])
+    if cred_url.startswith("data:"):
+        # data:[application/json][;base64],<payload> — inline key file
+        import base64
+
+        header, _, body = cred_url.partition(",")
+        raw = base64.b64decode(body) if header.endswith(";base64") else unquote(body).encode()
+        creds = _json.loads(raw)
+    elif cred_url.startswith(("http://", "https://")):
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=timeout)) as session:
+            async with session.get(cred_url) as resp:
+                if resp.status != 200:
+                    raise ConnectionError(
+                        f"pulsar oauth2 credentials_url returned {resp.status}")
+                creds = await resp.json(content_type=None)
+    else:
+        parsed = urlparse(cred_url)
+        path = unquote(parsed.path)  # handles file://localhost/... (RFC 8089)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                creds = _json.load(f)
+        except FileNotFoundError as e:
+            # ConfigError: permanent — fails fast through retry_with_backoff
+            raise ConfigError(f"pulsar oauth2 key file not found: {path}") from e
+        except ValueError as e:
+            raise ConfigError(f"pulsar oauth2 key file is not valid JSON: {e}") from e
     for req in ("client_id", "client_secret"):
         if req not in creds:
             raise ConfigError(f"pulsar oauth2 key file missing {req!r}")

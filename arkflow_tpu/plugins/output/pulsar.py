@@ -67,7 +67,11 @@ class PulsarOutput(Output):
                 what="pulsar oauth2 token")
             auth_method = "token"
         self._client = PulsarClient(
-            self.service_url, auth_method=auth_method, auth_data=auth_data
+            self.service_url, auth_method=auth_method, auth_data=auth_data,
+            # broker AUTH_CHALLENGEs (bearer expiry) re-run the token
+            # exchange in place instead of dropping the connection
+            auth_refresh=(lambda: fetch_oauth2_token(self._auth_cfg))
+            if self.auth_method == "oauth2" else None,
         )
         try:
             if not self.topic.is_expr:

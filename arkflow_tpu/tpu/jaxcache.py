@@ -42,14 +42,25 @@ def enable_persistent_cache() -> Optional[str]:
     _attempted = True
     if os.environ.get("ARKFLOW_JAX_CACHE", "1") == "0":
         return None
-    path = (
-        os.environ.get("ARKFLOW_JAX_CACHE_DIR")
-        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-        or os.path.join(
-            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    path = os.environ.get("ARKFLOW_JAX_CACHE_DIR") or os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR"
+    )
+    if not path:
+        # CPU backends: no persistent cache. XLA:CPU AOT entries embed the
+        # build machine's feature set and the loader re-checks it against a
+        # host list that never includes XLA's prefer-no-gather/scatter
+        # pseudo-features — so every reload warns (and a cross-host reload
+        # risks SIGILL). The round-2 driver artifact was swamped by exactly
+        # that spew. CPU compiles are fast; the cache only pays for real on
+        # the slow tunneled-TPU compiles. Explicit env dirs still override.
+        if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+            return None
+        path = os.path.join(
+            os.path.dirname(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            ),
             ".jax_cache",
         )
-    )
     try:
         os.makedirs(path, exist_ok=True)
         import jax

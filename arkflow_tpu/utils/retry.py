@@ -53,11 +53,17 @@ class RetryConfig:
 
 async def retry_with_backoff(op, config: RetryConfig, *, what: str = "operation",
                              retry_on: tuple = (Exception,)):
-    """Run ``await op()`` with up to config.max_attempts tries."""
+    """Run ``await op()`` with up to config.max_attempts tries.
+
+    ConfigError always fails fast: a mistyped config (missing key file,
+    absent client_id, bad URL) cannot heal with backoff, and retrying it
+    only delays the error the operator needs to see."""
     last: Exception | None = None
     for attempt in range(config.max_attempts):
         try:
             return await op()
+        except ConfigError:
+            raise
         except retry_on as e:
             last = e
             if attempt < config.max_attempts - 1:

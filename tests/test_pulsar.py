@@ -34,10 +34,12 @@ class FakePulsarBroker:
 
     def __init__(self, *, required_token: str | None = None,
                  redirect_to: "FakePulsarBroker | None" = None,
-                 fail_sends: int = 0):
+                 fail_sends: int = 0, challenge_after_connect: bool = False):
         self.required_token = required_token
         self.redirect_to = redirect_to
         self.fail_sends = fail_sends  # fail this many SENDs with SEND_ERROR
+        self.challenge_after_connect = challenge_after_connect
+        self.auth_responses: list[tuple[str, bytes]] = []  # (method, data)
         self.port = 0
         self.topics: dict[str, list[tuple[bytes, dict]]] = {}
         self.acked: list[tuple[int, int, int]] = []  # (ledger, entry, batch_index)
@@ -88,6 +90,13 @@ class FakePulsarBroker:
             resp.connected.protocol_version = 12
             writer.write(encode_simple(resp))
             await writer.drain()
+            if self.challenge_after_connect:
+                chal = P["BaseCommand"]()
+                chal.type = 36  # AUTH_CHALLENGE
+                chal.authChallenge.server_version = "fake-pulsar"
+                chal.authChallenge.challenge.auth_method_name = "token"
+                writer.write(encode_simple(chal))
+                await writer.drain()
             while True:
                 cmd, payload = await self._read_frame(reader)
                 await self._handle(cmd, payload, writer)
@@ -102,6 +111,11 @@ class FakePulsarBroker:
     async def _handle(self, cmd, payload, writer) -> None:
         P = proto()
         t = cmd.type
+        if t == 37:  # AUTH_RESPONSE
+            self.auth_responses.append(
+                (cmd.authResponse.response.auth_method_name,
+                 bytes(cmd.authResponse.response.auth_data)))
+            return
         out = P["BaseCommand"]()
         if t == 23:  # LOOKUP
             self.lookups += 1
@@ -466,6 +480,9 @@ class FakeOAuthServer:
                     payload = json.dumps({
                         "token_endpoint":
                             f"http://127.0.0.1:{self.port}/custom/token"})
+                elif method == "GET" and path == "/key.json":
+                    payload = json.dumps({"client_id": "cid",
+                                          "client_secret": "sec"})
                 elif method == "POST" and path == "/custom/token":
                     from urllib.parse import parse_qsl
 
@@ -576,18 +593,19 @@ def test_pulsar_config_validation():
     with pytest.raises(ConfigError):
         build_component("output", {"type": "pulsar", "service_url": "kafka://h",
                                    "topic": "t"}, r)
-    # oauth2: missing fields and non-file credentials_url fail fast at build
+    # oauth2: missing fields and unsupported credentials_url schemes fail
+    # fast at build (file/data/http(s) are all accepted)
     with pytest.raises(ConfigError, match="issuer_url"):
         build_component("output", {"type": "pulsar", "service_url": "pulsar://h",
                                    "topic": "t",
                                    "auth": {"type": "oauth2",
                                             "credentials_url": "file:///k.json",
                                             "audience": "z"}}, r)
-    with pytest.raises(ConfigError, match="file://"):
+    with pytest.raises(ConfigError, match="credentials_url"):
         build_component("output", {"type": "pulsar", "service_url": "pulsar://h",
                                    "topic": "t",
                                    "auth": {"type": "oauth2", "issuer_url": "x",
-                                            "credentials_url": "https://y",
+                                            "credentials_url": "ftp://y",
                                             "audience": "z"}}, r)
     with pytest.raises(ConfigError):
         build_component("input", {"type": "pulsar", "service_url": "pulsar://h",
@@ -718,5 +736,131 @@ def test_broker_initiated_close_consumer_surfaces_disconnection():
             await client.close()
         finally:
             await broker.stop()
+
+    asyncio.run(go())
+
+
+def test_auth_challenge_answered_with_refreshed_token():
+    """AUTH_CHALLENGE mid-connection re-runs the credential refresh and
+    answers AUTH_RESPONSE in place — no disconnect (bearer-expiry path)."""
+    async def go():
+        broker = FakePulsarBroker(required_token="tok-1",
+                                  challenge_after_connect=True)
+        await broker.start()
+        refreshes = 0
+
+        async def refresh() -> bytes:
+            nonlocal refreshes
+            refreshes += 1
+            return b"tok-2"
+
+        try:
+            client = PulsarClient(f"pulsar://127.0.0.1:{broker.port}",
+                                  auth_method="token", auth_data=b"tok-1",
+                                  auth_refresh=refresh)
+            cons = await client.subscribe("t", "s")
+            for _ in range(100):
+                if broker.auth_responses:
+                    break
+                await asyncio.sleep(0.02)
+            assert broker.auth_responses == [("token", b"tok-2")]
+            assert refreshes == 1
+            # connection stayed healthy through the challenge
+            assert not cons.conn._closed
+            # the refreshed bearer propagates to the client, so connections
+            # dialed AFTER expiry use live credentials
+            assert client.auth_data == b"tok-2"
+            await client.close()
+        finally:
+            await broker.stop()
+
+    asyncio.run(go())
+
+
+def test_auth_challenge_without_refresh_reuses_static_data():
+    """Static token auth (no refresh hook) answers the challenge with the
+    configured bearer rather than going silent."""
+    async def go():
+        broker = FakePulsarBroker(required_token="tok-static",
+                                  challenge_after_connect=True)
+        await broker.start()
+        try:
+            client = PulsarClient(f"pulsar://127.0.0.1:{broker.port}",
+                                  auth_method="token", auth_data=b"tok-static")
+            await client.subscribe("t", "s")
+            for _ in range(100):
+                if broker.auth_responses:
+                    break
+                await asyncio.sleep(0.02)
+            assert broker.auth_responses == [("token", b"tok-static")]
+            await client.close()
+        finally:
+            await broker.stop()
+
+    asyncio.run(go())
+
+
+def test_oauth2_credentials_url_data_and_http(tmp_path):
+    """credentials_url accepts data: (inline JSON) and http(s):// (remote key
+    file — the only forms the reference's validate_url accepts) in addition
+    to file://."""
+    import base64
+
+    from arkflow_tpu.connect.pulsar_client import auth_from_config, fetch_oauth2_token
+
+    async def go():
+        oauth = FakeOAuthServer(token="tok-d")
+        await oauth.start()
+        key_json = json.dumps({"client_id": "cid", "client_secret": "sec"})
+        data_url = ("data:application/json;base64,"
+                    + base64.b64encode(key_json.encode()).decode())
+        try:
+            auth = {"type": "oauth2",
+                    "issuer_url": f"http://127.0.0.1:{oauth.port}",
+                    "credentials_url": data_url,
+                    "audience": "aud"}
+            assert auth_from_config(auth) == ("oauth2", None)
+            tok = await fetch_oauth2_token(auth)
+            assert tok == b"tok-d"
+            # http(s):// key-file source: fetched from the remote URL
+            auth_http = dict(auth,
+                             credentials_url=f"http://127.0.0.1:{oauth.port}/key.json")
+            assert auth_from_config(auth_http) == ("oauth2", None)
+            tok = await fetch_oauth2_token(auth_http)
+            assert tok == b"tok-d"
+            assert oauth.grants[-1]["client_id"] == "cid"
+            # non-200 key-file fetch is a transient ConnectionError (retryable)
+            auth_404 = dict(auth,
+                            credentials_url=f"http://127.0.0.1:{oauth.port}/gone.json")
+            with pytest.raises(ConnectionError):
+                await fetch_oauth2_token(auth_404)
+        finally:
+            await oauth.stop()
+
+    asyncio.run(go())
+
+
+def test_oauth2_missing_key_file_fails_fast_not_retried(tmp_path):
+    """A missing key file is a ConfigError: retry_with_backoff must surface
+    it on the FIRST attempt instead of burning max_attempts with backoff."""
+    from arkflow_tpu.connect.pulsar_client import fetch_oauth2_token
+    from arkflow_tpu.utils.retry import RetryConfig, retry_with_backoff
+
+    async def go():
+        auth = {"type": "oauth2", "issuer_url": "http://127.0.0.1:1",
+                "credentials_url": f"file://{tmp_path}/nope.json",
+                "audience": "aud"}
+        attempts = 0
+
+        async def op():
+            nonlocal attempts
+            attempts += 1
+            return await fetch_oauth2_token(auth)
+
+        with pytest.raises(ConfigError):
+            await retry_with_backoff(
+                op, RetryConfig(max_attempts=5, initial_delay_ms=200),
+                what="token")
+        assert attempts == 1
 
     asyncio.run(go())
