@@ -6,7 +6,8 @@ ref: crates/arkflow-plugin/src/input/kafka.rs):
 
 - Metadata v1 (leader discovery), ListOffsets v1 (earliest/latest)
 - Produce v3 / Fetch v4 with record-batch format v2 (magic 2, crc32c from the
-  native tier; gzip compression both ways, snappy/lz4/zstd gated)
+  native tier; gzip/snappy/lz4/zstd compression both ways — snappy and the
+  LZ4 frame ride the native C++ block codecs in utils/xcodecs.py)
 - FindCoordinator v0 (cached per group) + OffsetCommit v2 / OffsetFetch v1
 - Consumer groups: JoinGroup v2 / SyncGroup v1 / Heartbeat v1 / LeaveGroup v1
   with the 'range' assignor; commits carry generation/member so fenced members
@@ -204,8 +205,25 @@ def encode_record_batch(records: list[tuple[Optional[bytes], Optional[bytes]]],
 
         records_bytes = _gzip.compress(records_bytes)
         attrs = 1
+    elif compression == "snappy":
+        from arkflow_tpu.utils.xcodecs import snappy_encode
+
+        records_bytes = snappy_encode(records_bytes)
+        attrs = 2
+    elif compression == "lz4":
+        from arkflow_tpu.utils.xcodecs import lz4_frame_encode
+
+        records_bytes = lz4_frame_encode(records_bytes)
+        attrs = 3
+    elif compression == "zstd":
+        from arkflow_tpu.utils.xcodecs import zstd_encode
+
+        records_bytes = zstd_encode(records_bytes)
+        attrs = 4
     elif compression not in (None, "none"):
-        raise WriteError(f"kafka compression {compression!r} not supported (gzip only)")
+        raise WriteError(
+            f"kafka compression {compression!r} not supported "
+            "(none/gzip/snappy/lz4/zstd)")
 
     # fields covered by crc: attributes..records
     crc_body = (
@@ -305,9 +323,9 @@ def decode_record_set(data: bytes) -> tuple[list[KafkaRecord], Optional[int]]:
             r.pos = end
             continue
         codec_id = attrs & 0x07
-        if codec_id not in (0, 1):  # 0=none, 1=gzip (stdlib); snappy/lz4/zstd need libs
+        if codec_id not in (0, 1, 2, 3, 4):  # none/gzip/snappy/lz4/zstd
             raise ReadError(
-                f"kafka: compression codec {codec_id} not supported (none/gzip only)"
+                f"kafka: compression codec {codec_id} not supported"
             )
         first_ts = r.i64()
         r.i64()  # maxTimestamp
@@ -322,6 +340,18 @@ def decode_record_set(data: bytes) -> tuple[list[KafkaRecord], Optional[int]]:
             import gzip as _gzip
 
             records_blob = _gzip.decompress(records_blob)
+        elif codec_id == 2:
+            from arkflow_tpu.utils.xcodecs import snappy_decode
+
+            records_blob = snappy_decode(bytes(records_blob))
+        elif codec_id == 3:
+            from arkflow_tpu.utils.xcodecs import lz4_frame_decode
+
+            records_blob = lz4_frame_decode(bytes(records_blob))
+        elif codec_id == 4:
+            from arkflow_tpu.utils.xcodecs import zstd_decode
+
+            records_blob = zstd_decode(bytes(records_blob))
         rr = Reader(records_blob)
         for _ in range(n):
             rr.varint()  # record length

@@ -82,6 +82,14 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int32),
         ]
+        for nm in ("ark_lz4_decompress_block", "ark_lz4_compress_block",
+                   "ark_snappy_decompress", "ark_snappy_compress"):
+            fn = getattr(lib, nm)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                           ctypes.c_char_p, ctypes.c_size_t]
+        lib.ark_xxh32.restype = ctypes.c_uint32
+        lib.ark_xxh32.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
         _LIB = lib
     except OSError as e:
         logger.warning("native load failed: %s", e)
@@ -143,6 +151,62 @@ def hash_tokenize_batch(texts: list[bytes], max_len: int, vocab_size: int):
         mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return ids, mask
+
+
+# -- block compression codecs (Kafka snappy/lz4; framing lives in
+# -- arkflow_tpu/utils/xcodecs.py, which also owns the Python fallbacks) -----
+
+def lz4_decompress_block(src: bytes, max_out: int) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    dst = ctypes.create_string_buffer(max_out)
+    n = lib.ark_lz4_decompress_block(src, len(src), dst, max_out)
+    if n < 0:
+        raise ValueError("lz4: corrupt block")
+    return dst.raw[:n]
+
+
+def lz4_compress_block(src: bytes) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    cap = len(src) + len(src) // 255 + 64
+    dst = ctypes.create_string_buffer(cap)
+    n = lib.ark_lz4_compress_block(src, len(src), dst, cap)
+    if n < 0:
+        raise ValueError("lz4: compress overflow")
+    return dst.raw[:n]
+
+
+def snappy_decompress(src: bytes, max_out: int) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    dst = ctypes.create_string_buffer(max(max_out, 1))
+    n = lib.ark_snappy_decompress(src, len(src), dst, max_out)
+    if n < 0:
+        raise ValueError("snappy: corrupt block")
+    return dst.raw[:n]
+
+
+def snappy_compress(src: bytes) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    cap = 32 + len(src) + len(src) // 6
+    dst = ctypes.create_string_buffer(cap)
+    n = lib.ark_snappy_compress(src, len(src), dst, cap)
+    if n < 0:
+        raise ValueError("snappy: compress overflow")
+    return dst.raw[:n]
+
+
+def xxh32(data: bytes, seed: int = 0) -> Optional[int]:
+    lib = _load()
+    if lib is None:
+        return None
+    return lib.ark_xxh32(data, len(data), seed)
 
 
 def pad_gather_i32(values: np.ndarray, offsets: np.ndarray, seq: int,

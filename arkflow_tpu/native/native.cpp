@@ -142,4 +142,299 @@ void ark_pad_gather_i32(const int32_t* values, const int64_t* offsets, int n_row
     }
 }
 
+// ---------------------------------------------------------------------------
+// xxHash32 (XXH32): LZ4-frame header/content checksums (Kafka codec 3)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t xxh_rotl32(uint32_t x, int r) { return (x << r) | (x >> (32 - r)); }
+
+uint32_t ark_xxh32(const uint8_t* p, size_t len, uint32_t seed) {
+    const uint32_t P1 = 2654435761u, P2 = 2246822519u, P3 = 3266489917u,
+                   P4 = 668265263u, P5 = 374761393u;
+    const uint8_t* end = p + len;
+    uint32_t h;
+    if (len >= 16) {
+        uint32_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+        const uint8_t* limit = end - 16;
+        do {
+            uint32_t w;
+            memcpy(&w, p, 4); v1 = xxh_rotl32(v1 + w * P2, 13) * P1; p += 4;
+            memcpy(&w, p, 4); v2 = xxh_rotl32(v2 + w * P2, 13) * P1; p += 4;
+            memcpy(&w, p, 4); v3 = xxh_rotl32(v3 + w * P2, 13) * P1; p += 4;
+            memcpy(&w, p, 4); v4 = xxh_rotl32(v4 + w * P2, 13) * P1; p += 4;
+        } while (p <= limit);
+        h = xxh_rotl32(v1, 1) + xxh_rotl32(v2, 7) + xxh_rotl32(v3, 12) + xxh_rotl32(v4, 18);
+    } else {
+        h = seed + P5;
+    }
+    h += (uint32_t)len;
+    while (p + 4 <= end) {
+        uint32_t w;
+        memcpy(&w, p, 4);
+        h = xxh_rotl32(h + w * P3, 17) * P4;
+        p += 4;
+    }
+    while (p < end) h = xxh_rotl32(h + (*p++) * P5, 11) * P1;
+    h ^= h >> 15; h *= P2; h ^= h >> 13; h *= P3; h ^= h >> 16;
+    return h;
+}
+
+// ---------------------------------------------------------------------------
+// LZ4 block codec (Kafka codec 3 rides the LZ4 *frame* format; the Python
+// layer owns framing, these own the block byte machine)
+// ---------------------------------------------------------------------------
+
+int64_t ark_lz4_decompress_block(const uint8_t* src, size_t srclen,
+                                 uint8_t* dst, size_t dstcap) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + srclen;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + dstcap;
+    while (ip < iend) {
+        uint8_t token = *ip++;
+        size_t litlen = token >> 4;
+        if (litlen == 15) {
+            uint8_t b;
+            do { if (ip >= iend) return -1; b = *ip++; litlen += b; } while (b == 255);
+        }
+        if ((size_t)(iend - ip) < litlen || (size_t)(oend - op) < litlen) return -1;
+        memcpy(op, ip, litlen);
+        ip += litlen; op += litlen;
+        if (ip >= iend) break;  // block ends with literals
+        if (iend - ip < 2) return -1;
+        uint32_t offset = ip[0] | ((uint32_t)ip[1] << 8);
+        ip += 2;
+        if (offset == 0 || (size_t)(op - dst) < offset) return -1;
+        size_t mlen = token & 15;
+        if (mlen == 15) {
+            uint8_t b;
+            do { if (ip >= iend) return -1; b = *ip++; mlen += b; } while (b == 255);
+        }
+        mlen += 4;
+        if ((size_t)(oend - op) < mlen) return -1;
+        const uint8_t* match = op - offset;
+        while (mlen--) *op++ = *match++;  // byte-wise: overlap semantics
+    }
+    return op - dst;
+}
+
+static inline uint32_t lz4_hash(uint32_t v) { return (v * 2654435761u) >> 19; }  // 13-bit
+
+// Greedy single-pass compressor (hash-chain-free, librdkafka-class ratio).
+int64_t ark_lz4_compress_block(const uint8_t* src, size_t n,
+                               uint8_t* dst, size_t cap) {
+    uint8_t* op = dst;
+    uint8_t* oend = dst + cap;
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + n;
+    const uint8_t* anchor = src;
+    static thread_local int32_t table[1 << 13];
+    for (size_t i = 0; i < (1 << 13); i++) table[i] = -1;
+
+    if (n >= 13) {  // spec: last match starts >=12 bytes before end
+        const uint8_t* mflimit = iend - 12;
+        const uint8_t* matchlimit = iend - 5;  // last 5 bytes stay literals
+        while (ip < mflimit) {
+            uint32_t seq;
+            memcpy(&seq, ip, 4);
+            uint32_t h = lz4_hash(seq);
+            int32_t cand = table[h];
+            table[h] = (int32_t)(ip - src);
+            uint32_t cseq = 0;
+            if (cand < 0 || (size_t)((ip - src) - cand) > 65535) { ip++; continue; }
+            memcpy(&cseq, src + cand, 4);
+            if (cseq != seq) { ip++; continue; }
+            const uint8_t* match = src + cand;
+            size_t mlen = 4;
+            while (ip + mlen < matchlimit && ip[mlen] == match[mlen]) mlen++;
+            size_t litlen = (size_t)(ip - anchor);
+            // worst-case emission size check
+            if ((size_t)(oend - op) < 1 + litlen / 255 + 1 + litlen + 2 + mlen / 255 + 1)
+                return -1;
+            uint8_t* token = op++;
+            if (litlen >= 15) {
+                *token = 15 << 4;
+                size_t rest = litlen - 15;
+                while (rest >= 255) { *op++ = 255; rest -= 255; }
+                *op++ = (uint8_t)rest;
+            } else {
+                *token = (uint8_t)(litlen << 4);
+            }
+            memcpy(op, anchor, litlen);
+            op += litlen;
+            uint32_t offset = (uint32_t)(ip - match);
+            *op++ = (uint8_t)offset;
+            *op++ = (uint8_t)(offset >> 8);
+            size_t mrest = mlen - 4;
+            if (mrest >= 15) {
+                *token |= 15;
+                mrest -= 15;
+                while (mrest >= 255) { *op++ = 255; mrest -= 255; }
+                *op++ = (uint8_t)mrest;
+            } else {
+                *token |= (uint8_t)mrest;
+            }
+            ip += mlen;
+            anchor = ip;
+        }
+    }
+    // trailing literals
+    size_t litlen = (size_t)(iend - anchor);
+    if ((size_t)(oend - op) < 1 + litlen / 255 + 1 + litlen) return -1;
+    if (litlen >= 15) {
+        *op++ = 15 << 4;
+        size_t rest = litlen - 15;
+        while (rest >= 255) { *op++ = 255; rest -= 255; }
+        *op++ = (uint8_t)rest;
+    } else {
+        *op++ = (uint8_t)(litlen << 4);
+    }
+    memcpy(op, anchor, litlen);
+    op += litlen;
+    return op - dst;
+}
+
+// ---------------------------------------------------------------------------
+// Snappy block codec (Kafka codec 2; Python layer handles xerial framing)
+// ---------------------------------------------------------------------------
+
+int64_t ark_snappy_decompress(const uint8_t* src, size_t srclen,
+                              uint8_t* dst, size_t dstcap) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + srclen;
+    uint64_t ulen = 0;
+    int shift = 0;
+    for (;;) {
+        if (ip >= iend || shift > 35) return -1;
+        uint8_t b = *ip++;
+        ulen |= (uint64_t)(b & 0x7f) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+    }
+    if (ulen > dstcap) return -1;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + ulen;
+    while (ip < iend) {
+        uint8_t tag = *ip++;
+        uint32_t type = tag & 3;
+        if (type == 0) {  // literal
+            uint32_t len = (tag >> 2) + 1;
+            if (len > 60) {
+                uint32_t nb = len - 60;
+                if ((size_t)(iend - ip) < nb) return -1;
+                len = 0;
+                for (uint32_t i = 0; i < nb; i++) len |= (uint32_t)ip[i] << (8 * i);
+                ip += nb;
+                len += 1;
+            }
+            if ((size_t)(iend - ip) < len || (size_t)(oend - op) < len) return -1;
+            memcpy(op, ip, len);
+            ip += len; op += len;
+        } else {
+            uint32_t len, offset;
+            if (type == 1) {
+                len = 4 + ((tag >> 2) & 7);
+                if (ip >= iend) return -1;
+                offset = ((uint32_t)(tag >> 5) << 8) | *ip++;
+            } else if (type == 2) {
+                len = (tag >> 2) + 1;
+                if (iend - ip < 2) return -1;
+                offset = ip[0] | ((uint32_t)ip[1] << 8);
+                ip += 2;
+            } else {
+                len = (tag >> 2) + 1;
+                if (iend - ip < 4) return -1;
+                offset = ip[0] | ((uint32_t)ip[1] << 8) | ((uint32_t)ip[2] << 16) |
+                         ((uint32_t)ip[3] << 24);
+                ip += 4;
+            }
+            if (offset == 0 || (size_t)(op - dst) < offset ||
+                (size_t)(oend - op) < len) return -1;
+            const uint8_t* match = op - offset;
+            while (len--) *op++ = *match++;
+        }
+    }
+    return (op == oend) ? (int64_t)ulen : -1;
+}
+
+static uint8_t* snappy_emit_literal(uint8_t* op, uint8_t* oend,
+                                    const uint8_t* p, size_t len) {
+    while (len) {
+        size_t chunk = len;  // literal tags address up to 2^32
+        size_t header = chunk <= 60 ? 1 : (chunk <= 0xff ? 2 : (chunk <= 0xffff ? 3 : (chunk <= 0xffffff ? 4 : 5)));
+        if ((size_t)(oend - op) < header + chunk) return nullptr;
+        if (chunk <= 60) {
+            *op++ = (uint8_t)((chunk - 1) << 2);
+        } else {
+            uint32_t nb = (uint32_t)header - 1;
+            *op++ = (uint8_t)((59 + nb) << 2);
+            uint32_t v = (uint32_t)(chunk - 1);
+            for (uint32_t i = 0; i < nb; i++) { *op++ = (uint8_t)v; v >>= 8; }
+        }
+        memcpy(op, p, chunk);
+        op += chunk;
+        p += chunk;
+        len -= chunk;
+    }
+    return op;
+}
+
+int64_t ark_snappy_compress(const uint8_t* src, size_t n,
+                            uint8_t* dst, size_t cap) {
+    uint8_t* op = dst;
+    uint8_t* oend = dst + cap;
+    uint64_t v = n;
+    do {
+        if (op >= oend) return -1;
+        uint8_t b = v & 0x7f;
+        v >>= 7;
+        *op++ = b | (v ? 0x80 : 0);
+    } while (v);
+    static thread_local int32_t table[1 << 13];
+    size_t base = 0;
+    while (base < n) {  // snappy matches within 64KB fragments
+        size_t frag = n - base < 65536 ? n - base : 65536;
+        const uint8_t* fs = src + base;
+        const uint8_t* fe = fs + frag;
+        for (size_t i = 0; i < (1 << 13); i++) table[i] = -1;
+        const uint8_t* ip = fs;
+        const uint8_t* anchor = fs;
+        if (frag >= 8) {
+            const uint8_t* limit = fe - 4;
+            while (ip < limit) {
+                uint32_t seq;
+                memcpy(&seq, ip, 4);
+                uint32_t h = lz4_hash(seq);
+                int32_t cand = table[h];
+                table[h] = (int32_t)(ip - fs);
+                uint32_t cseq;
+                if (cand < 0) { ip++; continue; }
+                memcpy(&cseq, fs + cand, 4);
+                if (cseq != seq) { ip++; continue; }
+                const uint8_t* match = fs + cand;
+                size_t mlen = 4;
+                while (ip + mlen < fe && ip[mlen] == match[mlen]) mlen++;
+                op = snappy_emit_literal(op, oend, anchor, (size_t)(ip - anchor));
+                if (!op) return -1;
+                uint32_t offset = (uint32_t)(ip - match);
+                size_t rest = mlen;
+                while (rest) {  // 2-byte-offset copies, 1..64 each (all legal)
+                    size_t c = rest < 64 ? rest : 64;
+                    if (oend - op < 3) return -1;
+                    *op++ = (uint8_t)(((c - 1) << 2) | 2);
+                    *op++ = (uint8_t)offset;
+                    *op++ = (uint8_t)(offset >> 8);
+                    rest -= c;
+                }
+                ip += mlen;
+                anchor = ip;
+            }
+        }
+        op = snappy_emit_literal(op, oend, anchor, (size_t)(fe - anchor));
+        if (!op) return -1;
+        base += frag;
+    }
+    return op - dst;
+}
+
 }  // extern "C"

@@ -128,9 +128,10 @@ def _build(config: dict, resource: Resource) -> KafkaOutput:
     if not config.get("brokers") or not config.get("topic"):
         raise ConfigError("kafka output requires 'brokers' and 'topic'")
     compression = config.get("compression")
-    if compression not in (None, "none", "gzip"):
+    if compression not in (None, "none", "gzip", "snappy", "lz4", "zstd"):
         raise ConfigError(
-            f"kafka output compression {compression!r} not supported (gzip only)"
+            f"kafka output compression {compression!r} not supported "
+            "(none/gzip/snappy/lz4/zstd)"
         )
     key = config.get("key")
     return KafkaOutput(

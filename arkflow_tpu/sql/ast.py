@@ -75,6 +75,19 @@ class Cast(Expr):
 
 
 @dataclass(frozen=True)
+class WindowFunc(Expr):
+    """``func(...) OVER (PARTITION BY ... ORDER BY ...)``.
+
+    Only the default frame is representable (RANGE UNBOUNDED PRECEDING..
+    CURRENT ROW when ordered, the whole partition otherwise); explicit
+    frames raise UnsupportedSql at parse."""
+
+    func: "Func"
+    partition_by: tuple[Expr, ...] = ()
+    order_by: tuple["OrderItem", ...] = ()
+
+
+@dataclass(frozen=True)
 class Case(Expr):
     operand: Optional[Expr]  # CASE x WHEN ... vs CASE WHEN ...
     whens: tuple[tuple[Expr, Expr], ...] = ()

@@ -1,7 +1,7 @@
 """sqlite3 fallback tier: full-dialect SQL over bridged Arrow batches.
 
-Covers what the native Arrow planner declines — joins, subqueries, CTEs,
-window functions, UNION — by materialising registered batches into an
+Covers what the native Arrow planner declines — subqueries, CTEs, UNION,
+explicit window frames, running MIN/MAX — by materialising registered batches into an
 in-memory sqlite database, executing there, and lifting the result back to
 Arrow. Row-materialising and therefore slow; the native tier owns the hot
 path. User UDFs (``arkflow_tpu.sql.functions``) are bridged via

@@ -217,6 +217,28 @@ class Parser:
             on = self._expr()
         return ast.Join(kind, table, on)
 
+    def _window_spec(self, f: ast.Func) -> ast.WindowFunc:
+        """OVER (PARTITION BY ... ORDER BY ...) — explicit frames are not
+        representable natively and reroute to the fallback engine."""
+        self.expect_op("(")
+        partition: list[ast.Expr] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition = [self._expr()]
+            while self.accept_op(","):
+                partition.append(self._expr())
+        order: list[ast.OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order = [self._order_item()]
+            while self.accept_op(","):
+                order.append(self._order_item())
+        t = self.peek()
+        if t.kind == "ident" and t.value.lower() in ("rows", "range", "groups"):
+            raise UnsupportedSql("explicit window frames not supported natively")
+        self.expect_op(")")
+        return ast.WindowFunc(f, tuple(partition), tuple(order))
+
     def _order_item(self) -> ast.OrderItem:
         e = self._expr()
         asc = True
@@ -399,7 +421,8 @@ class Parser:
                     self.expect_op(")")
                     f = ast.Func(name.lower(), tuple(args), distinct)
                 if self.peek().is_kw("over"):
-                    raise UnsupportedSql("window functions not supported natively")
+                    self.next()
+                    return self._window_spec(f)
                 return f
             # qualified column?
             if self.peek().kind == "op" and self.peek().value == ".":

@@ -1,8 +1,12 @@
 """Native SELECT execution on pyarrow kernels.
 
-Single-table SELECT / WHERE / GROUP BY / HAVING / ORDER BY / LIMIT / DISTINCT
-compiled onto vectorized Arrow compute. Aggregations run on Arrow's hash
-kernels via ``Table.group_by``. Scalar-over-aggregate expressions
+SELECT / WHERE / JOIN / GROUP BY / HAVING / window functions / ORDER BY /
+LIMIT / DISTINCT compiled onto vectorized Arrow compute. Aggregations run on
+Arrow's hash kernels via ``Table.group_by``; equi-joins run on Acero's
+vectorized hash join via ``Table.join`` (the same execution strategy the
+reference gets from DataFusion, ref: crates/arkflow-plugin/src/processor/
+sql.rs:112-129 and buffer/join.rs:111-118); window functions run on the
+sort+segment executor in ``winfuncs.py``. Scalar-over-aggregate expressions
 (``sum(x)/count(*)``) are handled by substituting computed aggregate columns
 into the expression tree and re-evaluating on the aggregated table.
 
@@ -22,6 +26,7 @@ from arkflow_tpu.errors import UnsupportedSql
 from arkflow_tpu.sql import ast
 from arkflow_tpu.sql.eval import Evaluator
 from arkflow_tpu.sql.functions import NATIVE_AGGREGATES, as_array, has_function
+from arkflow_tpu.sql.winfuncs import compute_window
 
 
 def render(e: ast.Expr) -> str:
@@ -34,6 +39,8 @@ def render(e: ast.Expr) -> str:
         inner = "*" if e.is_star else ", ".join(render(a) for a in e.args)
         d = "DISTINCT " if e.distinct else ""
         return f"{e.name}({d}{inner})"
+    if isinstance(e, ast.WindowFunc):
+        return render(e.func) + " over"
     if isinstance(e, ast.Binary):
         return f"{render(e.left)} {e.op} {render(e.right)}"
     if isinstance(e, ast.Unary):
@@ -44,6 +51,8 @@ def render(e: ast.Expr) -> str:
 
 
 def _find_aggregates(e: ast.Expr, out: list[ast.Func]) -> None:
+    if isinstance(e, ast.WindowFunc):
+        return  # its inner func is a window evaluation, not a group aggregate
     if isinstance(e, ast.Func) and (e.name in NATIVE_AGGREGATES or e.is_star and e.name == "count"):
         if e.name in NATIVE_AGGREGATES or e.is_star:
             out.append(e)
@@ -53,6 +62,15 @@ def _find_aggregates(e: ast.Expr, out: list[ast.Func]) -> None:
         raise UnsupportedSql(f"unknown function {e.name!r} in native planner")
     for child in _children(e):
         _find_aggregates(child, out)
+
+
+def _find_windows(e: ast.Expr, out: list[ast.WindowFunc]) -> None:
+    if isinstance(e, ast.WindowFunc):
+        if e not in out:
+            out.append(e)
+        return
+    for child in _children(e):
+        _find_windows(child, out)
 
 
 def _children(e: ast.Expr) -> list[ast.Expr]:
@@ -70,6 +88,8 @@ def _children(e: ast.Expr) -> list[ast.Expr]:
         return list(e.args)
     if isinstance(e, ast.Cast):
         return [e.operand]
+    if isinstance(e, ast.WindowFunc):
+        return [e.func, *e.partition_by, *[o.expr for o in e.order_by]]
     if isinstance(e, ast.Case):
         out = list(e.whens and [x for w in e.whens for x in w] or [])
         if e.operand is not None:
@@ -81,7 +101,8 @@ def _children(e: ast.Expr) -> list[ast.Expr]:
 
 
 def _substitute(e: ast.Expr, mapping: dict[ast.Expr, ast.Column]) -> ast.Expr:
-    """Replace mapped subtrees (group keys / aggregates) with column refs."""
+    """Replace mapped subtrees (group keys / aggregates / windows) with
+    column refs."""
     if e in mapping:
         return mapping[e]
     if isinstance(e, ast.Unary):
@@ -107,16 +128,262 @@ def _substitute(e: ast.Expr, mapping: dict[ast.Expr, ast.Column]) -> ast.Expr:
     return e
 
 
+class _From:
+    """Resolved FROM/JOIN clause: one batch with internal slot columns plus
+    the visible-name -> slot mapping used to build Evaluators."""
+
+    def __init__(self, rb: pa.RecordBatch, names: dict[str, str],
+                 stars: list[tuple[str, str]],
+                 alias_stars: dict[str, list[tuple[str, str]]]):
+        self.rb = rb
+        self.names = names            # bare + qualified visible name -> slot
+        self.stars = stars            # ordered (display, slot) for bare *
+        self.alias_stars = alias_stars  # alias -> [(display, slot)] for a.*
+
+    @property
+    def num_rows(self) -> int:
+        return self.rb.num_rows
+
+    def evaluator(self) -> Evaluator:
+        idx = {nm: i for i, nm in enumerate(self.rb.schema.names)}
+        cols = {name: self.rb.column(idx[slot]) for name, slot in self.names.items()}
+        return Evaluator(cols, self.rb.num_rows)
+
+    def filter(self, mask: pa.Array) -> None:
+        self.rb = self.rb.filter(mask)
+
+    def add_column(self, slot: str, arr: pa.Array) -> None:
+        arrays = [*self.rb.columns, arr]
+        names = [*self.rb.schema.names, slot]
+        self.rb = pa.RecordBatch.from_arrays(arrays, names=names)
+        self.names[slot] = slot
+
+    def star_columns(self, table: Optional[str]) -> list[tuple[str, pa.Array]]:
+        if table is None:
+            pairs = self.stars
+        else:
+            pairs = self.alias_stars.get(table)
+            if pairs is None:
+                raise UnsupportedSql(f"unknown table alias {table!r} in *")
+        idx = {nm: i for i, nm in enumerate(self.rb.schema.names)}
+        return [(display, self.rb.column(idx[slot])) for display, slot in pairs]
+
+
+def _lookup(tables: dict[str, MessageBatch], tref: ast.TableRef) -> pa.RecordBatch:
+    batch = tables.get(tref.name)
+    if batch is None:
+        raise UnsupportedSql(f"unknown table {tref.name!r} (registered: {sorted(tables)})")
+    return batch.record_batch
+
+
+def _single_from(tables: dict[str, MessageBatch], tref: ast.TableRef) -> _From:
+    rb = _lookup(tables, tref)
+    alias = tref.alias or tref.name
+    names: dict[str, str] = {}
+    stars: list[tuple[str, str]] = []
+    for c in rb.schema.names:
+        names[c] = c
+        names[f"{alias}.{c}"] = c
+        stars.append((c, c))
+    return _From(rb, names, stars, {alias: list(stars)})
+
+
+# -- join resolution ---------------------------------------------------------
+
+
+def _conjuncts(e: ast.Expr) -> list[ast.Expr]:
+    if isinstance(e, ast.Binary) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _columns_of(e: ast.Expr, out: list[ast.Column]) -> None:
+    if isinstance(e, ast.Column):
+        out.append(e)
+    for c in _children(e):
+        _columns_of(c, out)
+
+
+def _side_of(e: ast.Expr, left_names: dict[str, str], right_names: dict[str, str]) -> Optional[str]:
+    """'left'/'right' if every column in e resolves to exactly one side."""
+    cols: list[ast.Column] = []
+    _columns_of(e, cols)
+    if not cols:
+        return None  # constant: ambiguous, treat as residual
+    sides = set()
+    for c in cols:
+        key = f"{c.table}.{c.name}" if c.table else c.name
+        in_l = key in left_names
+        in_r = key in right_names
+        if in_l and in_r:
+            raise UnsupportedSql(f"ambiguous column {key!r} in JOIN condition")
+        if in_l:
+            sides.add("left")
+        elif in_r:
+            sides.add("right")
+        else:
+            raise UnsupportedSql(f"no such column {key!r} in JOIN condition")
+    return sides.pop() if len(sides) == 1 else None
+
+
+_JOIN_TYPES = {"inner": "inner", "left": "left outer",
+               "right": "right outer", "full": "full outer"}
+
+
+def _joined_from(sel: ast.Select, tables: dict[str, MessageBatch]) -> _From:
+    """Fold the JOIN chain left-to-right through Acero's hash join."""
+    refs = [(sel.table, None, None)] + [(j.table, j.on, j.kind) for j in sel.joins]
+
+    cur: Optional[pa.Table] = None
+    names: dict[str, str] = {}       # visible name -> slot
+    bare_owner: dict[str, Optional[str]] = {}  # bare name -> slot | None=ambiguous
+    stars: list[tuple[str, str]] = []
+    alias_stars: dict[str, list[tuple[str, str]]] = {}
+
+    for ti, (tref, on, kind) in enumerate(refs):
+        rb = _lookup(tables, tref)
+        alias = tref.alias or tref.name
+        if alias in alias_stars:
+            raise UnsupportedSql(f"duplicate table alias {alias!r}")
+        slots = [f"__t{ti}c{j}" for j in range(rb.num_columns)]
+        right = pa.table(list(rb.columns), names=slots) if rb.num_columns else pa.table({f"__t{ti}c0": pa.nulls(rb.num_rows)})
+        right_names: dict[str, str] = {}
+        for c, s in zip(rb.schema.names, slots):
+            right_names[f"{alias}.{c}"] = s
+            right_names.setdefault(c, s)
+        pairs = [(c, s) for c, s in zip(rb.schema.names, slots)]
+        alias_stars[alias] = pairs
+
+        if cur is None:
+            cur = right
+        else:
+            # ON sees prior tables' qualified names + unambiguous bare names
+            left_vis = dict(names)
+            for c, s in bare_owner.items():
+                if s is not None and c not in left_vis:
+                    left_vis[c] = s
+            cur = _hash_join(cur, right, on, kind, left_vis, right_names)
+
+        stars.extend(pairs)
+        for name, s in right_names.items():
+            if "." in name:
+                names[name] = s
+        for c in rb.schema.names:
+            if c in bare_owner:
+                bare_owner[c] = None  # ambiguous across tables
+            else:
+                bare_owner[c] = right_names[f"{alias}.{c}"]
+
+    for c, s in bare_owner.items():
+        if s is not None and c not in names:
+            names[c] = s
+
+    # residual (non-equi) conditions were applied inside _hash_join; the
+    # accumulated Table becomes one RecordBatch for downstream stages
+    rb_out = MessageBatch.from_table(cur).record_batch
+    return _From(rb_out, names, stars, alias_stars)
+
+
+def _hash_join(cur: pa.Table, right: pa.Table, on: Optional[ast.Expr],
+               kind: str, left_names: dict[str, str],
+               right_names: dict[str, str]) -> pa.Table:
+    """One join step: split ON into equi-keys + residual, run Acero."""
+    # visible names for the accumulated left side: every qualified name so
+    # far, plus unambiguous bare names
+    eqs: list[tuple[ast.Expr, ast.Expr]] = []
+    residual: list[ast.Expr] = []
+    if on is not None:
+        for c in _conjuncts(on):
+            if isinstance(c, ast.Binary) and c.op == "=":
+                ls = _side_of(c.left, left_names, right_names)
+                rs = _side_of(c.right, left_names, right_names)
+                if ls == "left" and rs == "right":
+                    eqs.append((c.left, c.right))
+                    continue
+                if ls == "right" and rs == "left":
+                    eqs.append((c.right, c.left))
+                    continue
+            residual.append(c)
+    if kind in ("left", "right", "full") and (residual or not eqs):
+        raise UnsupportedSql(
+            f"{kind.upper()} JOIN requires a pure equi-join ON condition natively")
+    if residual and not eqs and kind != "cross":
+        # non-equi inner join: cross product + filter
+        kind = "cross"
+
+    def _ev(tbl: pa.Table, nm: dict[str, str]) -> Evaluator:
+        idx = {s: i for i, s in enumerate(tbl.schema.names)}
+        cols = {name: tbl.column(idx[slot]) for name, slot in nm.items() if slot in idx}
+        return Evaluator(cols, tbl.num_rows)
+
+    lkeys, rkeys = [], []
+    ltmp, rtmp = [], []
+    if kind == "cross" or not eqs:
+        # constant-key join = cross product
+        cur = cur.append_column("__xk_l", pa.array([0] * cur.num_rows, pa.int8()))
+        right = right.append_column("__xk_r", pa.array([0] * right.num_rows, pa.int8()))
+        lkeys, rkeys = ["__xk_l"], ["__xk_r"]
+        ltmp, rtmp = ["__xk_l"], ["__xk_r"]
+        join_type = "inner"
+    else:
+        lev, rev = _ev(cur, left_names), _ev(right, right_names)
+        for i, (le, re_) in enumerate(eqs):
+            lv = as_array(lev.eval(le), cur.num_rows)
+            rv = as_array(rev.eval(re_), right.num_rows)
+            # align key types: acero rejects mismatched key types
+            if lv.type != rv.type:
+                common = pa.float64() if (pa.types.is_floating(lv.type) or pa.types.is_floating(rv.type)) else None
+                if common is None:
+                    try:
+                        rv = pc.cast(rv, lv.type)
+                    except pa.ArrowInvalid:
+                        lv = pc.cast(lv, rv.type)
+                else:
+                    lv, rv = pc.cast(lv, common, safe=False), pc.cast(rv, common, safe=False)
+            ln, rn = f"__jk{i}_l", f"__jk{i}_r"
+            cur = cur.append_column(ln, lv)
+            right = right.append_column(rn, rv)
+            lkeys.append(ln)
+            rkeys.append(rn)
+            ltmp.append(ln)
+            rtmp.append(rn)
+        join_type = _JOIN_TYPES[kind]
+
+    joined = cur.join(right, keys=lkeys, right_keys=rkeys,
+                      join_type=join_type, coalesce_keys=False)
+    joined = joined.drop_columns([c for c in ltmp + rtmp if c in joined.schema.names])
+
+    if residual:
+        # bare names visible on BOTH sides are ambiguous: drop them so the
+        # eval raises UnsupportedSql and the sqlite fallback surfaces the
+        # standard "ambiguous column" error instead of silently picking a side
+        both = dict(left_names)
+        for name, slot in right_names.items():
+            if "." not in name and name in both and both[name] != slot:
+                del both[name]
+                continue
+            both[name] = slot
+        ev = _ev(joined, both)
+        mask = None
+        for c in residual:
+            m = as_array(ev.eval(c), joined.num_rows)
+            if not pa.types.is_boolean(m.type):
+                m = pc.cast(m, pa.bool_())
+            mask = m if mask is None else pc.and_kleene(mask, m)
+        joined = joined.filter(pc.fill_null(mask, False))
+    return joined
+
+
+# -- select execution --------------------------------------------------------
+
+
 def execute_select(sel: ast.Select, tables: dict[str, MessageBatch]) -> MessageBatch:
-    """Run a parsed single-table SELECT natively; raise UnsupportedSql otherwise."""
-    if sel.joins:
-        raise UnsupportedSql("joins run on the fallback engine")
+    """Run a parsed SELECT natively; raise UnsupportedSql otherwise."""
     if sel.table is None:
         # SELECT <exprs> without FROM: single-row evaluation
-        batch = MessageBatch.from_pydict({})
         ev = Evaluator({}, 1)
         arrays, names = [], []
-        for i, item in enumerate(sel.items):
+        for item in sel.items:
             if isinstance(item.expr, ast.Star):
                 raise UnsupportedSql("* without FROM")
             v = ev.eval(item.expr)
@@ -124,43 +391,58 @@ def execute_select(sel: ast.Select, tables: dict[str, MessageBatch]) -> MessageB
             names.append(item.alias or render(item.expr))
         return MessageBatch(pa.RecordBatch.from_arrays(arrays, names=names))
 
-    tname = sel.table.name
-    batch = tables.get(tname)
-    if batch is None:
-        raise UnsupportedSql(f"unknown table {tname!r} (registered: {sorted(tables)})")
-    alias = sel.table.alias or tname
-    rb = batch.record_batch
+    src = _joined_from(sel, tables) if sel.joins else _single_from(tables, sel.table)
 
     # WHERE
     if sel.where is not None:
-        ev = Evaluator.for_batch(rb, table=alias)
-        mask = ev.eval(sel.where)
-        mask = as_array(mask, rb.num_rows)
+        wins_in_where: list[ast.WindowFunc] = []
+        _find_windows(sel.where, wins_in_where)
+        if wins_in_where:
+            raise UnsupportedSql("window functions are not allowed in WHERE")
+        ev = src.evaluator()
+        mask = as_array(ev.eval(sel.where), src.num_rows)
         if not pa.types.is_boolean(mask.type):
             mask = pc.cast(mask, pa.bool_())
-        rb = rb.filter(mask)
+        src.filter(mask)
 
-    # aggregate?
+    # aggregate / window discovery
     aggs: list[ast.Func] = []
+    wins: list[ast.WindowFunc] = []
     for item in sel.items:
         if not isinstance(item.expr, ast.Star):
             _find_aggregates(item.expr, aggs)
+            _find_windows(item.expr, wins)
     if sel.having is not None:
         _find_aggregates(sel.having, aggs)
+    for oi in sel.order_by:
+        _find_windows(oi.expr, wins)
+
+    win_mapping: dict[ast.Expr, ast.Column] = {}
+    if wins:
+        if sel.group_by or aggs:
+            raise UnsupportedSql(
+                "window functions mixed with GROUP BY/aggregates not supported natively")
+        ev = src.evaluator()
+        for i, w in enumerate(wins):
+            arr = compute_window(w, ev, src.num_rows)
+            src.add_column(f"__win_{i}", arr)
+            win_mapping[w] = ast.Column(f"__win_{i}")
+
+    agg_env: Optional[tuple[pa.RecordBatch, dict]] = None
     if sel.group_by or aggs:
-        out = _execute_aggregate(sel, rb, alias, aggs)
+        out, agg_env = _execute_aggregate(sel, src, aggs)
     else:
-        out = _execute_projection(sel, rb, alias)
+        out = _execute_projection(sel, src, win_mapping)
 
     # DISTINCT
     if sel.distinct:
         t = pa.Table.from_batches([out])
-        t = t.group_by(t.schema.names).aggregate([])
+        t = t.group_by(t.schema.names, use_threads=False).aggregate([])
         out = MessageBatch.from_table(t).record_batch
 
     # ORDER BY
     if sel.order_by:
-        out = _order(out, sel, alias, rb)
+        out = _order(out, sel, src, win_mapping, agg_env)
 
     # LIMIT/OFFSET
     if sel.offset is not None:
@@ -170,18 +452,20 @@ def execute_select(sel: ast.Select, tables: dict[str, MessageBatch]) -> MessageB
     return MessageBatch(out)
 
 
-def _execute_projection(sel: ast.Select, rb: pa.RecordBatch, alias: str) -> pa.RecordBatch:
-    ev = Evaluator.for_batch(rb, table=alias)
+def _execute_projection(sel: ast.Select, src: _From,
+                        win_mapping: dict[ast.Expr, ast.Column]) -> pa.RecordBatch:
+    ev = src.evaluator()
     arrays: list[pa.Array] = []
     names: list[str] = []
     for item in sel.items:
         if isinstance(item.expr, ast.Star):
-            for i, f in enumerate(rb.schema):
-                arrays.append(rb.column(i))
-                names.append(f.name)
+            for display, arr in src.star_columns(item.expr.table):
+                arrays.append(arr)
+                names.append(display)
             continue
-        v = ev.eval(item.expr)
-        arrays.append(as_array(v, rb.num_rows))
+        e = _substitute(item.expr, win_mapping) if win_mapping else item.expr
+        v = ev.eval(e)
+        arrays.append(as_array(v, src.num_rows))
         names.append(item.alias or render(item.expr))
     return pa.RecordBatch.from_arrays(arrays, names=names)
 
@@ -189,9 +473,10 @@ def _execute_projection(sel: ast.Select, rb: pa.RecordBatch, alias: str) -> pa.R
 _DISTINCT_AGGS = {"count": "count_distinct"}
 
 
-def _execute_aggregate(sel: ast.Select, rb: pa.RecordBatch, alias: str, aggs: list[ast.Func]) -> pa.RecordBatch:
-    ev = Evaluator.for_batch(rb, table=alias)
-    n = rb.num_rows
+def _execute_aggregate(sel: ast.Select, src: _From,
+                       aggs: list[ast.Func]) -> tuple[pa.RecordBatch, tuple]:
+    ev = src.evaluator()
+    n = src.num_rows
 
     # Deduplicate aggregates structurally.
     uniq: list[ast.Func] = []
@@ -259,7 +544,7 @@ def _execute_aggregate(sel: ast.Select, rb: pa.RecordBatch, alias: str, aggs: li
         _assert_resolved(sub, set(agg_rb.schema.names))
         arrays.append(as_array(fev.eval(sub), agg_rb.num_rows))
         names.append(item.alias or render(item.expr))
-    return pa.RecordBatch.from_arrays(arrays, names=names)
+    return pa.RecordBatch.from_arrays(arrays, names=names), (agg_rb, mapping)
 
 
 def _assert_resolved(e: ast.Expr, available: set[str]) -> None:
@@ -272,29 +557,39 @@ def _assert_resolved(e: ast.Expr, available: set[str]) -> None:
         _assert_resolved(c, available)
 
 
-def _order(out: pa.RecordBatch, sel: ast.Select, alias: str, pre_rb: pa.RecordBatch) -> pa.RecordBatch:
+def _order(out: pa.RecordBatch, sel: ast.Select, src: _From,
+           win_mapping: dict[ast.Expr, ast.Column],
+           agg_env: Optional[tuple] = None) -> pa.RecordBatch:
     sort_cols: list[tuple[str, str]] = []
     extra: dict[str, pa.Array] = {}
-    tmp = out
     for i, oi in enumerate(sel.order_by):
         direction = "ascending" if oi.asc else "descending"
-        e = oi.expr
+        e = _substitute(oi.expr, win_mapping) if win_mapping else oi.expr
         if isinstance(e, ast.Literal) and isinstance(e.value, int):
             idx = e.value - 1
             if not (0 <= idx < out.num_columns):
                 raise UnsupportedSql(f"ORDER BY position {e.value} out of range")
             sort_cols.append((out.schema.names[idx], direction))
             continue
-        if isinstance(e, ast.Column) and e.name in out.schema.names:
+        if isinstance(e, ast.Column) and e.table is None and e.name in out.schema.names:
             sort_cols.append((e.name, direction))
             continue
-        # expression over output (aliases) or, failing that, the source rows
+        # expression over output (aliases); else over the aggregated rows
+        # (group keys/aggregates substituted in); else over the source rows
         try:
             v = as_array(Evaluator.for_batch(out).eval(e), out.num_rows)
         except UnsupportedSql:
-            if pre_rb.num_rows != out.num_rows:
-                raise UnsupportedSql("ORDER BY expression not resolvable against output")
-            v = as_array(Evaluator.for_batch(pre_rb, table=alias).eval(e), out.num_rows)
+            if agg_env is not None:
+                agg_rb, amap = agg_env
+                if agg_rb.num_rows != out.num_rows:
+                    raise UnsupportedSql("ORDER BY expression not resolvable against output")
+                sub = _substitute(e, amap)
+                _assert_resolved(sub, set(agg_rb.schema.names))
+                v = as_array(Evaluator.for_batch(agg_rb).eval(sub), out.num_rows)
+            else:
+                if src.num_rows != out.num_rows:
+                    raise UnsupportedSql("ORDER BY expression not resolvable against output")
+                v = as_array(src.evaluator().eval(e), out.num_rows)
         name = f"__sort_{i}"
         extra[name] = v
         sort_cols.append((name, direction))

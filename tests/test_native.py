@@ -81,3 +81,79 @@ def test_native_so_cache_keyed_by_source_hash():
     built = nat._build_lib()
     assert built is not None and built.name == f"_native-{digest}.so"
     assert not (Path(nat.__file__).parent / "_native.so").exists()
+
+
+# -- block compression codecs (native + Python fallbacks) --------------------
+
+
+def test_xxh32_known_vectors_both_tiers():
+    from arkflow_tpu import native
+    from arkflow_tpu.utils.xcodecs import _py_xxh32, xxh32
+
+    vectors = {b"": 0x02CC5D05, b"abc": 0x32D153FF,
+               b"Nobody inspects the spammish repetition": 0xE2293B2F}
+    for data, want in vectors.items():
+        assert _py_xxh32(data) == want
+        assert xxh32(data) == want
+        if native.available():
+            assert native.xxh32(data, 0) == want
+    # seeded
+    assert _py_xxh32(b"abc", 1) != _py_xxh32(b"abc", 0)
+    if native.available():
+        assert native.xxh32(b"abc", 1) == _py_xxh32(b"abc", 1)
+
+
+def test_snappy_cross_tier_roundtrip():
+    import os
+    import random
+
+    from arkflow_tpu import native
+    from arkflow_tpu.utils.xcodecs import (
+        _py_snappy_compress, _py_snappy_decompress,
+        snappy_block_compress, snappy_block_decompress)
+
+    random.seed(7)
+    samples = [b"", b"x", b"ab" * 40000, os.urandom(3000),
+               bytes(random.choices(b"abcdef", k=100000))]
+    for s in samples:
+        enc = snappy_block_compress(s)
+        assert snappy_block_decompress(enc) == s
+        assert _py_snappy_decompress(enc) == s  # py decoder reads native output
+        lit = _py_snappy_compress(s)  # literal-only fallback stream
+        assert snappy_block_decompress(lit) == s
+        if native.available():
+            assert native.snappy_decompress(lit, len(s)) == s
+
+
+def test_lz4_frame_cross_tier_roundtrip():
+    import os
+    import random
+
+    from arkflow_tpu import native
+    from arkflow_tpu.utils.xcodecs import (
+        _py_lz4_decompress_block, lz4_frame_decode, lz4_frame_encode)
+
+    random.seed(8)
+    samples = [b"", b"hello world " * 1000, os.urandom(70000),
+               bytes(random.choices(b"ab", k=200000))]
+    for s in samples:
+        f = lz4_frame_encode(s)
+        assert lz4_frame_decode(f) == s
+        if native.available() and len(s) > 0:
+            blk = native.lz4_compress_block(s[:60000])
+            assert _py_lz4_decompress_block(blk, 60000) == s[:60000]
+
+
+def test_lz4_frame_checksums_detect_corruption():
+    import pytest
+
+    from arkflow_tpu.utils.xcodecs import lz4_frame_decode, lz4_frame_encode
+
+    f = bytearray(lz4_frame_encode(b"payload " * 1000))
+    f[-1] ^= 0xFF  # flip a bit in the content checksum
+    with pytest.raises(ValueError):
+        lz4_frame_decode(bytes(f))
+    g = bytearray(lz4_frame_encode(b"payload " * 1000))
+    g[6] ^= 0x01  # header checksum byte
+    with pytest.raises(ValueError):
+        lz4_frame_decode(bytes(g))

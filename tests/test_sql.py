@@ -317,3 +317,223 @@ def test_fallible_parsers_never_abort_the_batch():
     # group index beyond the pattern's groups -> NULL rows, not IndexError
     assert evaluate_expression(b, "regex_extract(log, 'msg=(\\w+)', 2)").to_pylist() == [
         None, None]
+
+
+# -- native hash joins (Acero) ----------------------------------------------
+
+
+def _join_ctx() -> SessionContext:
+    ctx = SessionContext()
+    ctx.register_batch("orders", MessageBatch.from_pydict({
+        "oid": [1, 2, 3, 4, 5], "cust": [10, 20, 10, 30, None],
+        "amount": [5.0, 7.5, 2.5, 9.0, 1.0]}))
+    ctx.register_batch("customers", MessageBatch.from_pydict({
+        "cid": [10, 20, 40], "name": ["ada", "bob", "cyd"]}))
+    return ctx
+
+
+def _no_fallback(monkeypatch):
+    """Fail the test if the query routes to the sqlite fallback."""
+    import arkflow_tpu.sql.engine as eng
+
+    def boom(q, t):
+        raise AssertionError(f"query fell back to sqlite: {q}")
+
+    monkeypatch.setattr(eng, "execute_fallback", boom)
+
+
+def test_native_inner_join(monkeypatch):
+    _no_fallback(monkeypatch)
+    out = _join_ctx().sql(
+        "SELECT o.oid, c.name FROM orders o JOIN customers c ON o.cust = c.cid "
+        "ORDER BY o.oid").record_batch
+    assert out.to_pydict() == {"oid": [1, 2, 3], "name": ["ada", "bob", "ada"]}
+
+
+def test_native_left_right_full_joins(monkeypatch):
+    _no_fallback(monkeypatch)
+    ctx = _join_ctx()
+    left = ctx.sql("SELECT oid, name FROM orders o LEFT JOIN customers c "
+                   "ON o.cust = c.cid ORDER BY oid").record_batch
+    assert left.column("name").to_pylist() == ["ada", "bob", "ada", None, None]
+    right = ctx.sql("SELECT name, oid FROM orders o RIGHT JOIN customers c "
+                    "ON o.cust = c.cid ORDER BY name").record_batch
+    d = dict(zip(right.column("name").to_pylist(), right.column("oid").to_pylist()))
+    assert d["cyd"] is None and d["bob"] == 2
+    full = ctx.sql("SELECT oid, name FROM orders o FULL OUTER JOIN customers c "
+                   "ON o.cust = c.cid").record_batch
+    assert full.num_rows == 6  # 3 matched + 2 unmatched orders + 1 unmatched cust
+
+
+def test_join_null_keys_never_match(monkeypatch):
+    _no_fallback(monkeypatch)
+    ctx = SessionContext()
+    ctx.register_batch("l", MessageBatch.from_pydict({"k": [1, None]}))
+    ctx.register_batch("r", MessageBatch.from_pydict({"k2": [1, None], "v": [5, 6]}))
+    out = ctx.sql("SELECT l.k, r.v FROM l JOIN r ON l.k = r.k2").record_batch
+    assert out.to_pydict() == {"k": [1], "v": [5]}
+
+
+def test_cross_join_and_non_equi(monkeypatch):
+    _no_fallback(monkeypatch)
+    ctx = _join_ctx()
+    n = ctx.sql("SELECT count(*) AS n FROM orders CROSS JOIN customers").record_batch
+    assert n.column("n").to_pylist() == [15]
+    # non-equi inner join: cross + residual filter
+    out = ctx.sql("SELECT o.oid, c.cid FROM orders o JOIN customers c "
+                  "ON o.cust = c.cid AND o.amount > 3 ORDER BY oid").record_batch
+    assert out.column("oid").to_pylist() == [1, 2]
+
+
+def test_join_with_aggregate_and_expr_keys(monkeypatch):
+    _no_fallback(monkeypatch)
+    ctx = _join_ctx()
+    out = ctx.sql("SELECT c.name, sum(o.amount) AS total FROM orders o "
+                  "JOIN customers c ON o.cust = c.cid "
+                  "GROUP BY c.name ORDER BY c.name").record_batch
+    assert out.to_pydict() == {"name": ["ada", "bob"], "total": [7.5, 7.5]}
+    # expression join keys materialize as temp columns
+    out2 = ctx.sql("SELECT o.oid FROM orders o JOIN customers c "
+                   "ON o.cust + 0 = c.cid ORDER BY oid").record_batch
+    assert out2.column("oid").to_pylist() == [1, 2, 3]
+
+
+def test_join_star_and_qualified_star(monkeypatch):
+    _no_fallback(monkeypatch)
+    ctx = _join_ctx()
+    allc = ctx.sql("SELECT * FROM orders o JOIN customers c ON o.cust = c.cid").record_batch
+    assert allc.schema.names == ["oid", "cust", "amount", "cid", "name"]
+    one = ctx.sql("SELECT c.* FROM orders o JOIN customers c ON o.cust = c.cid").record_batch
+    assert one.schema.names == ["cid", "name"]
+
+
+def test_three_way_join(monkeypatch):
+    _no_fallback(monkeypatch)
+    ctx = _join_ctx()
+    ctx.register_batch("regions", MessageBatch.from_pydict({
+        "rcid": [10, 20], "region": ["eu", "us"]}))
+    out = ctx.sql(
+        "SELECT o.oid, c.name, r.region FROM orders o "
+        "JOIN customers c ON o.cust = c.cid "
+        "JOIN regions r ON c.cid = r.rcid ORDER BY o.oid").record_batch
+    assert out.column("region").to_pylist() == ["eu", "us", "eu"]
+
+
+def test_outer_join_with_residual_falls_back():
+    """LEFT JOIN with a non-equi residual is not natively plannable; it must
+    still produce correct rows through the sqlite fallback."""
+    ctx = _join_ctx()
+    out = ctx.sql("SELECT o.oid, c.name FROM orders o LEFT JOIN customers c "
+                  "ON o.cust = c.cid AND o.amount > 3 ORDER BY o.oid").record_batch
+    assert out.column("name").to_pylist() == ["ada", "bob", None, None, None]
+
+
+# -- native window functions -------------------------------------------------
+
+
+def _win_ctx() -> SessionContext:
+    ctx = SessionContext()
+    ctx.register_batch("t", MessageBatch.from_pydict({
+        "g": ["a", "a", "a", "b", "b"], "x": [3, 1, 2, 5, 4],
+        "v": [10.0, 20.0, 30.0, 40.0, 50.0]}))
+    return ctx
+
+
+def test_window_row_number_rank_dense_rank(monkeypatch):
+    _no_fallback(monkeypatch)
+    out = _win_ctx().sql(
+        "SELECT g, x, row_number() OVER (PARTITION BY g ORDER BY x) AS rn "
+        "FROM t ORDER BY g, x").record_batch
+    assert out.column("rn").to_pylist() == [1, 2, 3, 1, 2]
+    out2 = _win_ctx().sql(
+        "SELECT x, rank() OVER (ORDER BY g) AS r, "
+        "dense_rank() OVER (ORDER BY g) AS dr FROM t ORDER BY x").record_batch
+    assert out2.column("r").to_pylist() == [1, 1, 1, 4, 4]
+    assert out2.column("dr").to_pylist() == [1, 1, 1, 2, 2]
+
+
+def test_window_running_and_whole_partition_aggregates(monkeypatch):
+    _no_fallback(monkeypatch)
+    out = _win_ctx().sql(
+        "SELECT g, x, sum(v) OVER (PARTITION BY g ORDER BY x) AS rs, "
+        "sum(v) OVER (PARTITION BY g) AS tot, "
+        "count(*) OVER () AS n, "
+        "avg(v) OVER (PARTITION BY g) AS m "
+        "FROM t ORDER BY g, x").record_batch
+    assert out.column("rs").to_pylist() == [20.0, 50.0, 60.0, 50.0, 90.0]
+    assert out.column("tot").to_pylist() == [60.0] * 3 + [90.0] * 2
+    assert out.column("n").to_pylist() == [5] * 5
+    assert out.column("m").to_pylist() == [20.0] * 3 + [45.0] * 2
+
+
+def test_window_running_sum_ties_share_value(monkeypatch):
+    """RANGE-frame semantics: peer rows (same ORDER BY key) share the
+    running value."""
+    _no_fallback(monkeypatch)
+    ctx = SessionContext()
+    ctx.register_batch("t", MessageBatch.from_pydict({
+        "k": [1, 1, 2], "v": [10, 20, 30]}))
+    out = ctx.sql("SELECT k, sum(v) OVER (ORDER BY k) AS rs FROM t "
+                  "ORDER BY k, v").record_batch
+    assert out.column("rs").to_pylist() == [30, 30, 60]
+
+
+def test_window_lag_lead_first_last_ntile(monkeypatch):
+    _no_fallback(monkeypatch)
+    out = _win_ctx().sql(
+        "SELECT g, x, lag(x) OVER (PARTITION BY g ORDER BY x) AS p, "
+        "lead(x, 1, -1) OVER (PARTITION BY g ORDER BY x) AS nx, "
+        "first_value(v) OVER (PARTITION BY g ORDER BY x) AS fv, "
+        "last_value(v) OVER (PARTITION BY g ORDER BY x) AS lv, "
+        "ntile(2) OVER (ORDER BY x) AS b "
+        "FROM t ORDER BY g, x").record_batch
+    assert out.column("p").to_pylist() == [None, 1, 2, None, 4]
+    assert out.column("nx").to_pylist() == [2, 3, -1, 5, -1]
+    assert out.column("fv").to_pylist() == [20.0, 20.0, 20.0, 50.0, 50.0]
+    # default frame: last_value ends at the current row
+    assert out.column("lv").to_pylist() == [20.0, 30.0, 10.0, 50.0, 40.0]
+    assert out.column("b").to_pylist() == [1, 1, 1, 2, 2]
+
+
+def test_window_sum_of_ints_stays_integer(monkeypatch):
+    _no_fallback(monkeypatch)
+    ctx = SessionContext()
+    ctx.register_batch("t", MessageBatch.from_pydict({"v": [1, 2, 3]}))
+    out = ctx.sql("SELECT sum(v) OVER () AS s FROM t").record_batch
+    assert out.column("s").to_pylist() == [6, 6, 6]
+    assert pa.types.is_integer(out.schema.field("s").type)
+
+
+def test_window_nulls_ignored_in_aggregates(monkeypatch):
+    _no_fallback(monkeypatch)
+    ctx = SessionContext()
+    ctx.register_batch("t", MessageBatch.from_pydict({
+        "g": ["a", "a", "b"], "v": [1.0, None, None]}))
+    out = ctx.sql("SELECT g, sum(v) OVER (PARTITION BY g) AS s, "
+                  "count(v) OVER (PARTITION BY g) AS c FROM t "
+                  "ORDER BY g").record_batch
+    assert out.column("s").to_pylist() == [1.0, 1.0, None]
+    assert out.column("c").to_pylist() == [1, 1, 0]
+
+
+def test_window_min_max_whole_partition(monkeypatch):
+    _no_fallback(monkeypatch)
+    out = _win_ctx().sql(
+        "SELECT g, min(v) OVER (PARTITION BY g) AS lo, "
+        "max(v) OVER (PARTITION BY g) AS hi FROM t ORDER BY g, x").record_batch
+    assert out.column("lo").to_pylist() == [10.0] * 3 + [40.0] * 2
+    assert out.column("hi").to_pylist() == [30.0] * 3 + [50.0] * 2
+
+
+def test_window_in_order_by_and_unsupported_falls_back():
+    ctx = _win_ctx()
+    # window expr consumed by ORDER BY
+    out = ctx.sql("SELECT x FROM t ORDER BY row_number() OVER (ORDER BY x DESC)").record_batch
+    assert out.column("x").to_pylist() == [5, 4, 3, 2, 1]
+    # running MIN needs sqlite (no prefix-sum form)
+    out2 = ctx.sql("SELECT min(v) OVER (ORDER BY x) AS m FROM t").record_batch
+    assert out2.column("m").to_pylist() == [20.0, 20.0, 10.0, 10.0, 10.0]
+    # explicit frames reroute to sqlite and still execute
+    out3 = ctx.sql("SELECT sum(v) OVER (ORDER BY x ROWS BETWEEN 1 PRECEDING "
+                   "AND CURRENT ROW) AS s FROM t").record_batch
+    assert len(out3.column("s").to_pylist()) == 5
