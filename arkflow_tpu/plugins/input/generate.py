@@ -59,9 +59,11 @@ class GenerateInput(Input):
 
 @register_input("generate")
 def _build(config: dict, resource: Resource) -> GenerateInput:
-    payload = config.get("payload")
+    # 'context' is the reference's field name (generate.rs:26-100);
+    # 'payload' is the clearer alias — both accepted
+    payload = config.get("payload", config.get("context"))
     if payload is None:
-        raise ConfigError("generate input requires 'payload'")
+        raise ConfigError("generate input requires 'payload' (or 'context')")
     if isinstance(payload, (dict, list)):
         import json
 

@@ -1,0 +1,48 @@
+"""VRL processor: reference-compatible ``{type: vrl, statement: ...}`` blocks.
+
+The reference compiles the statement once at build and resolves it per row
+(ref: crates/arkflow-plugin/src/processor/vrl.rs:30-115). Here the statement
+compiles once at build into a vectorized step plan (``sql/vrl.py``) and each
+batch executes columnar — same observable contract (assignments, del, if,
+abort-drops-row, ``??`` defaults), none of the per-row interpretation.
+Programs outside the supported subset fail at build/--validate with a
+pointer at the offending construct, not at stream time.
+"""
+
+from __future__ import annotations
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Processor, Resource, register_processor
+from arkflow_tpu.errors import ConfigError, ProcessError
+from arkflow_tpu.sql.vrl import VrlCompileError, apply_vrl, compile_vrl
+
+
+class VrlProcessor(Processor):
+    def __init__(self, statement: str):
+        try:
+            self.steps = compile_vrl(statement)
+        except VrlCompileError:
+            raise
+        except Exception as e:
+            raise ConfigError(f"vrl: failed to compile statement: {e}") from e
+        if not self.steps:
+            raise ConfigError("vrl: statement compiles to no operations")
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []
+        try:
+            out = apply_vrl(batch, self.steps)
+        except ProcessError:
+            raise
+        except Exception as e:
+            raise ProcessError(f"vrl execution failed: {e}") from e
+        return [out] if out.num_rows else []
+
+
+@register_processor("vrl")
+def _build(config: dict, resource: Resource) -> VrlProcessor:
+    statement = config.get("statement")
+    if not statement:
+        raise ConfigError("vrl processor requires 'statement'")
+    return VrlProcessor(str(statement))

@@ -154,7 +154,13 @@ class Evaluator:
             if not _is_arr(l) and not _is_arr(r):
                 if l is None or r is None:
                     return None
-                return {"+": l + r, "-": l - r, "*": l * r, "/": l / r}[op]
+                if op == "+":
+                    return l + r
+                if op == "-":
+                    return l - r
+                if op == "*":
+                    return l * r
+                return None if r == 0 else l / r  # x/0 -> NULL (sqlite semantics)
             l2, r2 = self._align(l, r)
             return _ARITH[op](l2, r2)
         if op == "%":

@@ -124,7 +124,14 @@ def _json_get(args, n, extract=None):
             out.append(None)
     if extract is None:
         out = [json.dumps(v) if isinstance(v, (dict, list)) else v for v in out]
-        return pa.array([None if v is None else str(v) for v in out], type=pa.string())
+        try:
+            # homogeneous scalars keep their JSON type (ints stay ints —
+            # what VRL's parse_json!(.m).path yields); mixed types fall
+            # back to the string form
+            return pa.array(out)
+        except (pa.ArrowInvalid, pa.ArrowTypeError):
+            return pa.array([None if v is None else str(v) for v in out],
+                            type=pa.string())
     return pa.array(out)
 
 

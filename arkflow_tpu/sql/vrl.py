@@ -1,0 +1,544 @@
+"""VRL (Vector Remap Language) front-end, compiled onto the columnar engine.
+
+The reference embeds the real VRL runtime and resolves programs row by row
+(ref: crates/arkflow-plugin/src/processor/vrl.rs:42-115). A row interpreter
+would throw away columnar execution, so this front-end *compiles* the common
+VRL surface into a short plan of vectorized steps over Arrow batches — the
+same expression engine that powers WHERE clauses and the remap processor.
+A reference config with a ``vrl:`` block runs unmodified when its program
+stays inside the supported subset; anything else fails at build time with a
+clear error naming the unsupported construct.
+
+Supported surface:
+
+- field assignment ``.out = expr`` (top-level and dotted display names)
+- local variables ``tmp = expr`` (inlined at use sites)
+- ``del(.field)``
+- ``if cond { ... } else if ... { ... } else { ... }`` where branches hold
+  assignments (compiled to masked columnar assignments) or ``abort``
+  (compiled to a row filter, VRL's drop-on-abort semantics)
+- operators ``== != < <= > >= && || ! + - * / % ?? ``, literals, parens,
+  ``r'...'`` regex literals
+- the fallible-call forms ``f!(...)`` and ``f(...) ?? default`` (every
+  parser here yields NULL on failure, so ``??`` is ``coalesce``)
+- object-returning parsers used with a path: ``parse_json!(.m).a.b``,
+  ``parse_url!(.u).host``, ``parse_key_value!(.l).level``,
+  ``parse_regex!(.x, r'(?P<g>..)').g``
+- a stdlib mapped onto ``sql/functions.py`` (to_int/to_float/to_string,
+  upcase/downcase/trim/replace/length/contains/starts_with/ends_with/
+  slice/truncate, round/abs/floor/ceil, md5/sha2, match,
+  parse_timestamp/format_timestamp, now, exists/is_null, coalesce)
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.sql import ast
+from arkflow_tpu.sql.eval import Evaluator
+from arkflow_tpu.sql.functions import as_array
+
+
+class VrlCompileError(ConfigError):
+    """VRL program outside the supported subset (build-time)."""
+
+
+# ---------------------------------------------------------------------------
+# lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>[ \t]+)
+  | (?P<comment>\#[^\n]*)
+  | (?P<nl>[\r\n]+)
+  | (?P<regex>r'(?:[^'\\]|\\.)*')
+  | (?P<string>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+  | (?P<number>\d+\.\d+|\d+)
+  | (?P<path>\.(?:[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)*)?)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*!?)
+  | (?P<op>\?\?|==|!=|<=|>=|&&|\|\||[-+*/%<>=!(){},;:])
+    """,
+    re.VERBOSE,
+)
+
+
+@dataclass
+class _Tok:
+    kind: str  # nl string regex number path ident op eof
+    value: str
+    pos: int
+
+
+def _lex(src: str) -> list[_Tok]:
+    toks: list[_Tok] = []
+    i = 0
+    while i < len(src):
+        m = _TOKEN_RE.match(src, i)
+        if m is None:
+            raise VrlCompileError(f"vrl: unexpected character {src[i]!r} at {i}")
+        kind = m.lastgroup
+        i = m.end()
+        if kind in ("ws", "comment"):
+            continue
+        toks.append(_Tok(kind, m.group(), m.start()))
+    toks.append(_Tok("eof", "", len(src)))
+    return toks
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    return re.sub(r"\\(.)", lambda m: {"n": "\n", "t": "\t", "r": "\r"}.get(
+        m.group(1), m.group(1)), body)
+
+
+# ---------------------------------------------------------------------------
+# compiled plan
+# ---------------------------------------------------------------------------
+
+# steps: ("assign", col, expr) | ("cassign", col, cond, value)
+#        | ("del", col) | ("filter", keep_expr)
+Step = tuple
+
+
+# VRL function name -> (sql function name, arity range)
+_FN = {
+    "to_int": "parse_int", "int": "parse_int",
+    "to_float": "parse_float", "float": "parse_float",
+    "to_string": "to_string", "string": "to_string",
+    "upcase": "upper", "downcase": "lower",
+    "trim": "trim", "strip_whitespace": "trim",
+    "replace": "replace", "length": "length", "strlen": "length",
+    "round": "round", "abs": "abs", "floor": "floor", "ceil": "ceil",
+    "md5": "md5", "sha2": "sha256", "sha256": "sha256",
+    "match": "regex_match",
+    "parse_timestamp": "parse_timestamp",
+    "format_timestamp": "format_timestamp",
+    "parse_int": "parse_int", "parse_float": "parse_float",
+    "starts_with": "starts_with", "ends_with": "ends_with",
+    "now": "now", "coalesce": "coalesce",
+    "split_part": "split_part",
+}
+
+# object-returning parsers: path access becomes an extra key argument
+_OBJECT_FNS = {"parse_json", "parse_url", "parse_key_value", "parse_regex"}
+
+_UNSUPPORTED_HINTS = {
+    "split": "no list type in the columnar plan; use split_part(x, sep, n)",
+    "join": "no list type in the columnar plan",
+    "merge": "merge whole events with the json_to_arrow processor",
+    "parse_syslog": "use parse_regex with a syslog pattern",
+    "encode_json": "use the arrow_to_json processor",
+}
+
+
+class _Parser:
+    def __init__(self, src: str):
+        self.toks = _lex(src)
+        self.i = 0
+
+    def peek(self, skip_nl: bool = True) -> _Tok:
+        j = self.i
+        while skip_nl and self.toks[j].kind == "nl":
+            j += 1
+        return self.toks[j]
+
+    def next(self, skip_nl: bool = True) -> _Tok:
+        while skip_nl and self.toks[self.i].kind == "nl":
+            self.i += 1
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_op(self, *ops: str) -> Optional[_Tok]:
+        t = self.peek()
+        if t.kind == "op" and t.value in ops:
+            return self.next()
+        return None
+
+    def expect_op(self, op: str) -> _Tok:
+        t = self.next()
+        if not (t.kind == "op" and t.value == op):
+            raise VrlCompileError(f"vrl: expected {op!r} at {t.pos}, got {t.value!r}")
+        return t
+
+    # -- program -----------------------------------------------------------
+
+    def parse_program(self) -> list[Step]:
+        steps: list[Step] = []
+        env: dict[str, ast.Expr] = {}
+        while self.peek().kind != "eof":
+            if self.accept_op(";"):
+                continue
+            # a bare trailing '.' (VRL's "return the event") is a no-op here
+            t = self.peek()
+            if t.kind == "path" and t.value == ".":
+                nxt = self.toks[self._index_after(t)]
+                if nxt.kind in ("eof", "nl") or (nxt.kind == "op" and nxt.value == ";"):
+                    self.next()
+                    continue
+            steps.extend(self._statement(env))
+        return steps
+
+    def _index_after(self, tok: _Tok) -> int:
+        for j in range(self.i, len(self.toks)):
+            if self.toks[j] is tok:
+                return j + 1
+        return len(self.toks) - 1
+
+    def _statement(self, env: dict[str, ast.Expr],
+                   cond_path: Optional[ast.Expr] = None) -> list[Step]:
+        t = self.peek()
+        if t.kind == "ident" and t.value == "if":
+            return self._if_statement(env, cond_path)
+        if t.kind == "ident" and t.value == "abort":
+            self.next()
+            keep = (ast.Unary("not", cond_path) if cond_path is not None
+                    else ast.Literal(False))
+            return [("filter", keep)]
+        if t.kind == "ident" and t.value in ("del", "del!"):
+            self.next()
+            self.expect_op("(")
+            p = self.next()
+            if p.kind != "path" or p.value == ".":
+                raise VrlCompileError(f"vrl: del() needs a field path at {p.pos}")
+            self.expect_op(")")
+            if cond_path is not None:
+                raise VrlCompileError(
+                    "vrl: del() inside if-branches is not supported; "
+                    "assign null instead")
+            return [("del", p.value[1:])]
+        if t.kind == "path":
+            self.next()
+            if t.value == ".":
+                raise VrlCompileError(
+                    "vrl: whole-event assignment '. = ...' is not supported; "
+                    "use the json_to_arrow processor to expand payloads")
+            # '.out, err = expr': VRL's error-capture tuple. Fallible ops
+            # here yield NULL instead of an error value, so err binds null.
+            err_var = None
+            if self.accept_op(","):
+                ev_tok = self.next()
+                if ev_tok.kind != "ident":
+                    raise VrlCompileError(
+                        f"vrl: expected error variable after ',' at {ev_tok.pos}")
+                err_var = ev_tok.value
+            self.expect_op("=")
+            e = self._expr(env)
+            if err_var is not None:
+                env[err_var] = ast.Literal(None)
+            col = t.value[1:]
+            if cond_path is not None:
+                return [("cassign", col, cond_path, e)]
+            return [("assign", col, e)]
+        if t.kind == "ident":
+            # local variable binding
+            save = self.i
+            name = self.next()
+            if self.accept_op("="):
+                if self.peek().kind == "op" and self.peek().value == "=":
+                    raise VrlCompileError(f"vrl: '==' at statement level at {name.pos}")
+                env[name.value] = self._expr(env)
+                return []
+            self.i = save
+        raise VrlCompileError(f"vrl: unsupported statement at {t.pos}: {t.value!r}")
+
+    def _if_statement(self, env: dict[str, ast.Expr],
+                      cond_path: Optional[ast.Expr]) -> list[Step]:
+        self.next()  # 'if'
+        cond = self._expr(env)
+        here = cond if cond_path is None else ast.Binary("and", cond_path, cond)
+        steps = self._block(env, here)
+        if self.peek().kind == "ident" and self.peek().value == "else":
+            self.next()
+            neg = ast.Unary("not", cond)
+            other = neg if cond_path is None else ast.Binary("and", cond_path, neg)
+            if self.peek().kind == "ident" and self.peek().value == "if":
+                steps.extend(self._if_statement(env, other))
+            else:
+                steps.extend(self._block(env, other))
+        return steps
+
+    def _block(self, env: dict[str, ast.Expr], cond_path: ast.Expr) -> list[Step]:
+        self.expect_op("{")
+        steps: list[Step] = []
+        while not self.accept_op("}"):
+            if self.peek().kind == "eof":
+                raise VrlCompileError("vrl: unterminated block")
+            steps.extend(self._statement(env, cond_path))
+        return steps
+
+    # -- expressions -------------------------------------------------------
+
+    def _expr(self, env) -> ast.Expr:
+        return self._coalesce(env)
+
+    def _coalesce(self, env) -> ast.Expr:
+        left = self._or(env)
+        while self.accept_op("??"):
+            left = ast.Func("coalesce", (left, self._or(env)))
+        return left
+
+    def _or(self, env) -> ast.Expr:
+        left = self._and(env)
+        while self.accept_op("||"):
+            left = ast.Binary("or", left, self._and(env))
+        return left
+
+    def _and(self, env) -> ast.Expr:
+        left = self._not(env)
+        while self.accept_op("&&"):
+            left = ast.Binary("and", left, self._not(env))
+        return left
+
+    def _not(self, env) -> ast.Expr:
+        if self.accept_op("!"):
+            return ast.Unary("not", self._not(env))
+        return self._comparison(env)
+
+    def _comparison(self, env) -> ast.Expr:
+        left = self._additive(env)
+        t = self.peek()
+        if t.kind == "op" and t.value in ("==", "!=", "<", "<=", ">", ">="):
+            self.next()
+            op = "=" if t.value == "==" else t.value
+            return ast.Binary(op, left, self._additive(env))
+        return left
+
+    def _additive(self, env) -> ast.Expr:
+        left = self._mult(env)
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-"):
+                self.next()
+                left = ast.Binary(t.value, left, self._mult(env))
+            else:
+                return left
+
+    def _mult(self, env) -> ast.Expr:
+        left = self._unary(env)
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                self.next()
+                left = ast.Binary(t.value, left, self._unary(env))
+            else:
+                return left
+
+    def _unary(self, env) -> ast.Expr:
+        if self.accept_op("-"):
+            e = self._unary(env)
+            if isinstance(e, ast.Literal) and isinstance(e.value, (int, float)):
+                return ast.Literal(-e.value)
+            return ast.Unary("-", e)
+        return self._primary(env)
+
+    def _primary(self, env) -> ast.Expr:
+        t = self.next()
+        if t.kind == "number":
+            return ast.Literal(float(t.value) if "." in t.value else int(t.value))
+        if t.kind == "string":
+            return ast.Literal(_unquote(t.value))
+        if t.kind == "regex":
+            return ast.Literal(t.value[2:-1])  # r'...' -> pattern text
+        if t.kind == "path":
+            if t.value == ".":
+                raise VrlCompileError(
+                    "vrl: whole-event '.' is only meaningful row-wise; "
+                    "reference a field like .message")
+            return ast.Column(t.value[1:])
+        if t.kind == "ident":
+            name = t.value
+            if name in ("true", "false"):
+                return ast.Literal(name == "true")
+            if name == "null":
+                return ast.Literal(None)
+            if name == "if":
+                return self._if_expression(env)
+            if self.peek(skip_nl=False).kind == "op" and self.peek(skip_nl=False).value == "(":
+                return self._call(name, env)
+            if name.rstrip("!") in env:
+                return env[name.rstrip("!")]
+            raise VrlCompileError(
+                f"vrl: unknown identifier {name!r} at {t.pos} "
+                "(fields are referenced as .name)")
+        if t.kind == "op" and t.value == "(":
+            e = self._expr(env)
+            self.expect_op(")")
+            return e
+        raise VrlCompileError(f"vrl: unexpected token {t.value!r} at {t.pos}")
+
+    def _if_expression(self, env) -> ast.Expr:
+        """``if cond { a } else { b }`` as a value -> CASE WHEN."""
+        cond = self._expr(env)
+        self.expect_op("{")
+        then_v = self._expr(env)
+        self.expect_op("}")
+        otherwise = None
+        if self.peek().kind == "ident" and self.peek().value == "else":
+            self.next()
+            if self.peek().kind == "ident" and self.peek().value == "if":
+                self.next()
+                otherwise = self._if_expression(env)
+            else:
+                self.expect_op("{")
+                otherwise = self._expr(env)
+                self.expect_op("}")
+        return ast.Case(None, ((cond, then_v),), otherwise)
+
+    def _call(self, name: str, env) -> ast.Expr:
+        fallible = name.endswith("!")
+        base = name.rstrip("!")
+        self.expect_op("(")
+        args: list[ast.Expr] = []
+        named: dict[str, ast.Expr] = {}
+        while not self.accept_op(")"):
+            if args or named:
+                self.expect_op(",")
+            t = self.peek()
+            save = self.i
+            if t.kind == "ident":
+                nm = self.next()
+                if self.accept_op(":"):
+                    named[nm.value] = self._expr(env)
+                    continue
+                self.i = save
+            args.append(self._expr(env))
+        return self._lower_call(base, args, named, fallible)
+
+    def _lower_call(self, base: str, args: list[ast.Expr],
+                    named: dict[str, ast.Expr], fallible: bool) -> ast.Expr:
+        # named args map positionally for the functions that take them
+        if base == "parse_timestamp" and "format" in named:
+            args = args + [named.pop("format")]
+        if base in ("replace", "round", "truncate", "slice") and named:
+            for k in list(named):
+                args.append(named.pop(k))
+        if named:
+            raise VrlCompileError(
+                f"vrl: named arguments {sorted(named)} for {base}() not supported")
+
+        if base in _OBJECT_FNS:
+            return self._object_access(base, args)
+        if base == "exists":
+            if len(args) != 1:
+                raise VrlCompileError("vrl: exists() takes one field")
+            return ast.IsNull(args[0], negated=True)
+        if base == "is_null":
+            return ast.IsNull(args[0])
+        if base == "contains":
+            if len(args) != 2:
+                raise VrlCompileError("vrl: contains(haystack, needle)")
+            return ast.Binary(">", ast.Func("strpos", tuple(args)), ast.Literal(0))
+        if base == "slice":
+            # slice(x, start[, end]) 0-based half-open -> substr 1-based len
+            if len(args) == 2:
+                return ast.Func("substr", (args[0], ast.Binary("+", args[1], ast.Literal(1))))
+            if len(args) == 3:
+                return ast.Func("substr", (
+                    args[0], ast.Binary("+", args[1], ast.Literal(1)),
+                    ast.Binary("-", args[2], args[1])))
+            raise VrlCompileError("vrl: slice(x, start[, end])")
+        if base == "truncate":
+            if len(args) != 2:
+                raise VrlCompileError("vrl: truncate(x, limit)")
+            return ast.Func("substr", (args[0], ast.Literal(1), args[1]))
+        mapped = _FN.get(base)
+        if mapped is None:
+            hint = _UNSUPPORTED_HINTS.get(base)
+            raise VrlCompileError(
+                f"vrl: function {base!r} is not in the supported subset"
+                + (f" ({hint})" if hint else "")
+                + f"; supported: {', '.join(sorted(set(_FN) | _OBJECT_FNS | {'exists', 'is_null', 'contains', 'slice', 'truncate', 'del'}))}")
+        return ast.Func(mapped, tuple(args))
+
+    def _object_access(self, base: str, args: list[ast.Expr]) -> ast.Expr:
+        """parse_json!(.m).a.b / parse_url!(.u).host / parse_regex!(..).name —
+        the trailing path becomes the key/part/group argument."""
+        t = self.peek(skip_nl=False)
+        if not (t.kind == "path" and t.value != "."):
+            raise VrlCompileError(
+                f"vrl: {base}() yields an object; access a field from it "
+                f"(e.g. {base}!(.x).field) — whole-object assignment has no "
+                "columnar form")
+        self.next(skip_nl=False)
+        key = t.value[1:]
+        if base == "parse_json":
+            return ast.Func("json_get", (args[0], ast.Literal(key)))
+        if base == "parse_url":
+            return ast.Func("parse_url", (args[0], ast.Literal(key)))
+        if base == "parse_key_value":
+            return ast.Func("parse_key_value", (args[0], ast.Literal(key), *args[1:]))
+        if base == "parse_regex":
+            if len(args) != 2:
+                raise VrlCompileError("vrl: parse_regex(x, r'pattern').group")
+            return ast.Func("regex_extract", (args[0], args[1], ast.Literal(key)))
+        raise VrlCompileError(f"vrl: unhandled object parser {base}")
+
+
+def compile_vrl(statement: str) -> list[Step]:
+    """VRL source -> vectorized step plan. Raises VrlCompileError outside the
+    supported subset (build-time, like the reference's compile at vrl.rs:109)."""
+    return _Parser(statement).parse_program()
+
+
+def apply_vrl(batch: MessageBatch, steps: list[Step]) -> MessageBatch:
+    """Run a compiled plan over one batch."""
+    rb = batch.record_batch
+    for step in steps:
+        n = rb.num_rows
+        ev = Evaluator.for_batch(rb)
+        kind = step[0]
+        if kind == "assign":
+            _, col, e = step
+            rb = _set_column(rb, col, as_array(ev.eval(e), n))
+        elif kind == "cassign":
+            _, col, cond, e = step
+            mask = _bool(ev.eval(cond), n)
+            val = as_array(ev.eval(e), n)
+            names = rb.schema.names
+            if col in names:
+                base = rb.column(names.index(col))
+                if base.type != val.type:
+                    if pa.types.is_null(base.type):
+                        base = pc.cast(base, val.type)
+                    elif pa.types.is_null(val.type):
+                        val = pc.cast(val, base.type)
+                    else:
+                        val = pc.cast(val, base.type, safe=False)
+            else:
+                base = pa.nulls(n, val.type)
+            rb = _set_column(rb, col, pc.if_else(pc.fill_null(mask, False), val, base))
+        elif kind == "del":
+            _, col = step
+            if col in rb.schema.names:
+                rb = rb.drop_columns([col])
+        elif kind == "filter":
+            _, keep = step
+            rb = rb.filter(pc.fill_null(_bool(ev.eval(keep), n), False))
+    return MessageBatch(rb)
+
+
+def _bool(v, n: int) -> pa.Array:
+    a = as_array(v, n)
+    if not pa.types.is_boolean(a.type):
+        a = pc.cast(a, pa.bool_())
+    return a
+
+
+def _set_column(rb: pa.RecordBatch, col: str, arr: pa.Array) -> pa.RecordBatch:
+    names = list(rb.schema.names)
+    arrays = list(rb.columns)
+    if col in names:
+        arrays[names.index(col)] = arr
+    else:
+        names.append(col)
+        arrays.append(arr)
+    return pa.RecordBatch.from_arrays(arrays, names=names)
