@@ -7,7 +7,9 @@ ref: crates/arkflow-plugin/src/input/kafka.rs):
 - Metadata v1 (leader discovery), ListOffsets v1 (earliest/latest)
 - Produce v3 / Fetch v4 with record-batch format v2 (magic 2, crc32c from the
   native tier; gzip/snappy/lz4/zstd compression both ways — snappy and the
-  LZ4 frame ride the native C++ block codecs in utils/xcodecs.py)
+  LZ4 frame ride the native C++ block codecs in utils/xcodecs.py). zstd
+  produces go out as Produce v7 and fetch self-upgrades to v10 on
+  UNSUPPORTED_COMPRESSION_TYPE, per KIP-110's version floors.
 - FindCoordinator v0 (cached per group) + OffsetCommit v2 / OffsetFetch v1
 - Consumer groups: JoinGroup v2 / SyncGroup v1 / Heartbeat v1 / LeaveGroup v1
   with the 'range' assignor; commits carry generation/member so fenced members
@@ -597,6 +599,10 @@ class KafkaClient:
         self._coordinators: dict[str, int] = {}  # group -> node id
         self._bootstrap_conn: Optional[_BrokerConn] = None
         self.topics: dict[str, TopicMeta] = {}
+        # Fetch starts on the classic v4 and upgrades itself to v10 the
+        # first time a broker answers UNSUPPORTED_COMPRESSION_TYPE (KIP-110:
+        # zstd-bearing logs are only served to v10+ fetchers).
+        self._fetch_version = 4
 
     def _make_conn(self, host: str, port: int) -> _BrokerConn:
         return _BrokerConn(host, port, self.client_id,
@@ -678,6 +684,10 @@ class KafkaClient:
                       acks: int = -1, timeout_ms: int = 30000,
                       compression: Optional[str] = None) -> int:
         batch = encode_record_batch(records, compression=compression)
+        # KIP-110: brokers reject zstd batches arriving over Produce < v7
+        # with UNSUPPORTED_COMPRESSION_TYPE. The request schema is identical
+        # across v3-v8 (only the response grew fields), so v7 costs nothing.
+        version = 7 if compression == "zstd" else 3
         body = (
             Writer()
             .string(None)  # transactional_id
@@ -692,7 +702,7 @@ class KafkaClient:
             .build()
         )
         conn = await self._leader_conn(topic, partition)
-        r = await conn.request(API_PRODUCE, 3, body)
+        r = await conn.request(API_PRODUCE, version, body)
         base_offset = -1
         n_topics = r.i32()
         for _ in range(n_topics):
@@ -703,6 +713,8 @@ class KafkaClient:
                 err = r.i16()
                 base_offset = r.i64()
                 r.i64()  # log_append_time
+                if version >= 5:
+                    r.i64()  # log_start_offset
                 if err != 0:
                     if err in (3, 6):  # unknown topic/partition, not leader
                         self.topics.pop(topic, None)
@@ -721,52 +733,78 @@ class KafkaClient:
         >= ``offset`` always.
         """
         next_offset = offset
-        body = (
-            Writer()
-            .i32(-1)  # replica_id
-            .i32(max_wait_ms)
-            .i32(min_bytes)
-            .i32(max_bytes)
-            .i8(0)  # isolation level: read_uncommitted
-            .array(
-                [(topic, partition, offset)],
-                lambda w, t: w.string(t[0]).array(
-                    [(t[1], t[2])],
-                    lambda w2, p: w2.i32(p[0]).i64(p[1]).i32(max_bytes),
-                ),
-            )
-            .build()
-        )
         conn = await self._leader_conn(topic, partition)
-        r = await conn.request(API_FETCH, 4, body)
-        r.i32()  # throttle
-        records: list[KafkaRecord] = []
-        hwm = -1
-        n_topics = r.i32()
-        for _ in range(n_topics):
-            r.string()
-            n_parts = r.i32()
-            for _ in range(n_parts):
-                r.i32()  # partition
-                err = r.i16()
-                hwm = r.i64()
-                r.i64()  # last_stable_offset
-                n_aborted = r.i32()
-                for _ in range(max(0, n_aborted)):
-                    r.i64()
-                    r.i64()
-                record_set = r.bytes_() or b""
-                if err != 0:
-                    if err in (1,):  # offset out of range
-                        raise KafkaProtocolError("fetch", err)
-                    if err in (3, 6, 9):
-                        self.topics.pop(topic, None)
-                    raise Disconnection(f"kafka fetch error code {err}")
-                batch_records, batch_next = decode_record_set(record_set)
-                records.extend(rec for rec in batch_records if rec.offset >= offset)
-                if batch_next is not None:
-                    next_offset = max(next_offset, batch_next)
-        return records, hwm, next_offset
+        while True:
+            version = self._fetch_version
+            w = (
+                Writer()
+                .i32(-1)  # replica_id
+                .i32(max_wait_ms)
+                .i32(min_bytes)
+                .i32(max_bytes)
+                .i8(0)  # isolation level: read_uncommitted
+            )
+            if version >= 7:
+                w.i32(0)  # session_id: sessionless full fetch
+                w.i32(-1)  # session_epoch
+            if version >= 9:
+                def _part(w2: Writer, p) -> None:
+                    w2.i32(p[0]).i32(-1).i64(p[1]).i64(-1).i32(max_bytes)
+                    # current_leader_epoch -1; log_start_offset -1 (consumer)
+            else:
+                def _part(w2: Writer, p) -> None:
+                    w2.i32(p[0]).i64(p[1]).i32(max_bytes)
+            w.array(
+                [(topic, offset)],
+                lambda wt, t: wt.string(topic).array([(partition, offset)], _part),
+            )
+            if version >= 7:
+                w.array([], lambda w2, x: None)  # forgotten_topics_data
+            r = await conn.request(API_FETCH, version, w.build())
+            r.i32()  # throttle
+            if version >= 7:
+                top_err = r.i16()
+                r.i32()  # session_id
+                if top_err != 0:
+                    raise Disconnection(f"kafka fetch error code {top_err}")
+            records: list[KafkaRecord] = []
+            hwm = -1
+            retry_v10 = False
+            n_topics = r.i32()
+            for _ in range(n_topics):
+                r.string()
+                n_parts = r.i32()
+                for _ in range(n_parts):
+                    r.i32()  # partition
+                    err = r.i16()
+                    hwm = r.i64()
+                    r.i64()  # last_stable_offset
+                    if version >= 5:
+                        r.i64()  # log_start_offset
+                    n_aborted = r.i32()
+                    for _ in range(max(0, n_aborted)):
+                        r.i64()
+                        r.i64()
+                    record_set = r.bytes_() or b""
+                    if err != 0:
+                        if err == 76 and version < 10:
+                            # UNSUPPORTED_COMPRESSION_TYPE: the log holds
+                            # zstd batches the broker refuses to serve to
+                            # pre-KIP-110 fetchers. Upgrade and stay there.
+                            self._fetch_version = 10
+                            retry_v10 = True
+                            continue
+                        if err in (1,):  # offset out of range
+                            raise KafkaProtocolError("fetch", err)
+                        if err in (3, 6, 9):
+                            self.topics.pop(topic, None)
+                        raise Disconnection(f"kafka fetch error code {err}")
+                    batch_records, batch_next = decode_record_set(record_set)
+                    records.extend(rec for rec in batch_records if rec.offset >= offset)
+                    if batch_next is not None:
+                        next_offset = max(next_offset, batch_next)
+            if not retry_v10:
+                return records, hwm, next_offset
 
     async def list_offsets(self, topic: str, partition: int, earliest: bool) -> int:
         ts = -2 if earliest else -1

@@ -127,12 +127,26 @@ def _json_get(args, n, extract=None):
         try:
             # homogeneous scalars keep their JSON type (ints stay ints —
             # what VRL's parse_json!(.m).path yields); mixed types fall
-            # back to the string form
+            # back to the string form. This is the DYNAMIC variant
+            # (json_get_dyn): only VRL lowers to it, where evolving column
+            # types are part of the language. The SQL-facing json_get keeps
+            # the always-string contract so a streaming query's output
+            # schema cannot flip batch-to-batch (advisor r3).
             return pa.array(out)
         except (pa.ArrowInvalid, pa.ArrowTypeError):
             return pa.array([None if v is None else str(v) for v in out],
                             type=pa.string())
     return pa.array(out)
+
+
+def _json_to_str(v):
+    """Stable string form for the SQL-facing json_get: JSON text for
+    containers/bools, plain text for scalars, NULL stays NULL."""
+    if v is None:
+        return None
+    if isinstance(v, (dict, list, bool)):
+        return json.dumps(v)
+    return str(v)
 
 
 def _mod(args, n):
@@ -196,7 +210,8 @@ _BUILTINS: dict[str, ScalarFn] = {
     "unix_millis": lambda args, n: int(time.time() * 1000),
     "current_timestamp": lambda args, n: time.time(),
     # json (for the __value__ payload column)
-    "json_get": lambda args, n: _json_get(args, n),
+    "json_get": lambda args, n: _json_get(args, n, extract=_json_to_str),
+    "json_get_dyn": lambda args, n: _json_get(args, n),
     "json_get_str": lambda args, n: _json_get(args, n, extract=lambda v: None if v is None else str(v)),
     "json_get_int": lambda args, n: _json_get(args, n, extract=lambda v: int(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else None),
     "json_get_float": lambda args, n: _json_get(args, n, extract=lambda v: float(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else None),

@@ -12,11 +12,13 @@ clear error naming the unsupported construct.
 Supported surface:
 
 - field assignment ``.out = expr`` (top-level and dotted display names)
-- local variables ``tmp = expr`` (inlined at use sites)
+- local variables ``tmp = expr`` (bound at assignment time: materialized as
+  hidden columns so later mutation of their source fields cannot change them)
 - ``del(.field)``
 - ``if cond { ... } else if ... { ... } else { ... }`` where branches hold
-  assignments (compiled to masked columnar assignments) or ``abort``
-  (compiled to a row filter, VRL's drop-on-abort semantics)
+  assignments (compiled to masked columnar assignments against a branch-entry
+  mask snapshot) or ``abort`` (compiled to a row filter, VRL's
+  drop-on-abort semantics)
 - operators ``== != < <= > >= && || ! + - * / % ?? ``, literals, parens,
   ``r'...'`` regex literals
 - the fallible-call forms ``f!(...)`` and ``f(...) ?? default`` (every
@@ -103,9 +105,22 @@ def _unquote(s: str) -> str:
 # compiled plan
 # ---------------------------------------------------------------------------
 
-# steps: ("assign", col, expr) | ("cassign", col, cond, value)
-#        | ("del", col) | ("filter", keep_expr)
+# steps: ("mask", slot, cond_expr, parent_slot|None)   — branch-entry snapshot
+#      | ("assign", col, expr) | ("cassign", col, slot, value)
+#      | ("del", col) | ("filter", slot|None)          — abort; None = all rows
+#
+# Branch conditions are evaluated ONCE into a numbered mask slot when the
+# if-statement is reached (VRL row semantics: a row's branch choice is fixed
+# before the branch body mutates anything). Body steps then reference the
+# slot instead of re-evaluating the condition against the mutated batch —
+# re-evaluation silently no-op'd later statements whenever a branch assigned
+# to a column its own condition read (advisor r3, high).
 Step = tuple
+
+# hidden-column prefix for materialized local variables (stripped from the
+# output batch). Locals bind their VALUE at assignment time (VRL semantics);
+# textual inlining would re-read mutated source columns (advisor r3, low).
+_LOCAL_PREFIX = "__vrl_"
 
 
 # VRL function name -> (sql function name, arity range)
@@ -143,6 +158,11 @@ class _Parser:
     def __init__(self, src: str):
         self.toks = _lex(src)
         self.i = 0
+        self._mask_slots = 0
+
+    def _new_slot(self) -> int:
+        self._mask_slots += 1
+        return self._mask_slots - 1
 
     def peek(self, skip_nl: bool = True) -> _Tok:
         j = self.i
@@ -194,15 +214,13 @@ class _Parser:
         return len(self.toks) - 1
 
     def _statement(self, env: dict[str, ast.Expr],
-                   cond_path: Optional[ast.Expr] = None) -> list[Step]:
+                   cond_slot: Optional[int] = None) -> list[Step]:
         t = self.peek()
         if t.kind == "ident" and t.value == "if":
-            return self._if_statement(env, cond_path)
+            return self._if_statement(env, cond_slot)
         if t.kind == "ident" and t.value == "abort":
             self.next()
-            keep = (ast.Unary("not", cond_path) if cond_path is not None
-                    else ast.Literal(False))
-            return [("filter", keep)]
+            return [("filter", cond_slot)]
         if t.kind == "ident" and t.value in ("del", "del!"):
             self.next()
             self.expect_op("(")
@@ -210,7 +228,7 @@ class _Parser:
             if p.kind != "path" or p.value == ".":
                 raise VrlCompileError(f"vrl: del() needs a field path at {p.pos}")
             self.expect_op(")")
-            if cond_path is not None:
+            if cond_slot is not None:
                 raise VrlCompileError(
                     "vrl: del() inside if-branches is not supported; "
                     "assign null instead")
@@ -235,44 +253,59 @@ class _Parser:
             if err_var is not None:
                 env[err_var] = ast.Literal(None)
             col = t.value[1:]
-            if cond_path is not None:
-                return [("cassign", col, cond_path, e)]
+            if cond_slot is not None:
+                return [("cassign", col, cond_slot, e)]
             return [("assign", col, e)]
         if t.kind == "ident":
-            # local variable binding
+            # local variable binding: bind the VALUE now by materializing a
+            # hidden column (literals stay inline — nothing can mutate them)
             save = self.i
             name = self.next()
             if self.accept_op("="):
                 if self.peek().kind == "op" and self.peek().value == "=":
                     raise VrlCompileError(f"vrl: '==' at statement level at {name.pos}")
-                env[name.value] = self._expr(env)
-                return []
+                e = self._expr(env)
+                if isinstance(e, ast.Literal):
+                    env[name.value] = e
+                    return []
+                hidden = _LOCAL_PREFIX + name.value
+                env[name.value] = ast.Column(hidden)
+                if cond_slot is not None:
+                    return [("cassign", hidden, cond_slot, e)]
+                return [("assign", hidden, e)]
             self.i = save
         raise VrlCompileError(f"vrl: unsupported statement at {t.pos}: {t.value!r}")
 
     def _if_statement(self, env: dict[str, ast.Expr],
-                      cond_path: Optional[ast.Expr]) -> list[Step]:
+                      parent_slot: Optional[int]) -> list[Step]:
         self.next()  # 'if'
         cond = self._expr(env)
-        here = cond if cond_path is None else ast.Binary("and", cond_path, cond)
-        steps = self._block(env, here)
+        # snapshot BOTH polarities before any body step runs: a then-branch
+        # that assigns to a condition column must not flip rows into/out of
+        # its own else-branch
+        then_slot = self._new_slot()
+        steps: list[Step] = [("mask", then_slot, cond, parent_slot)]
+        else_slot: Optional[int] = None
+        body = self._block(env, then_slot)
         if self.peek().kind == "ident" and self.peek().value == "else":
-            self.next()
-            neg = ast.Unary("not", cond)
-            other = neg if cond_path is None else ast.Binary("and", cond_path, neg)
+            else_slot = self._new_slot()
+            steps.append(("mask", else_slot, ast.Unary("not", cond), parent_slot))
+        steps.extend(body)
+        if else_slot is not None:
+            self.next()  # 'else'
             if self.peek().kind == "ident" and self.peek().value == "if":
-                steps.extend(self._if_statement(env, other))
+                steps.extend(self._if_statement(env, else_slot))
             else:
-                steps.extend(self._block(env, other))
+                steps.extend(self._block(env, else_slot))
         return steps
 
-    def _block(self, env: dict[str, ast.Expr], cond_path: ast.Expr) -> list[Step]:
+    def _block(self, env: dict[str, ast.Expr], cond_slot: int) -> list[Step]:
         self.expect_op("{")
         steps: list[Step] = []
         while not self.accept_op("}"):
             if self.peek().kind == "eof":
                 raise VrlCompileError("vrl: unterminated block")
-            steps.extend(self._statement(env, cond_path))
+            steps.extend(self._statement(env, cond_slot))
         return steps
 
     # -- expressions -------------------------------------------------------
@@ -471,7 +504,9 @@ class _Parser:
         self.next(skip_nl=False)
         key = t.value[1:]
         if base == "parse_json":
-            return ast.Func("json_get", (args[0], ast.Literal(key)))
+            # dynamic variant: VRL values keep their JSON type; the SQL
+            # json_get stays always-string for schema stability
+            return ast.Func("json_get_dyn", (args[0], ast.Literal(key)))
         if base == "parse_url":
             return ast.Func("parse_url", (args[0], ast.Literal(key)))
         if base == "parse_key_value":
@@ -492,16 +527,23 @@ def compile_vrl(statement: str) -> list[Step]:
 def apply_vrl(batch: MessageBatch, steps: list[Step]) -> MessageBatch:
     """Run a compiled plan over one batch."""
     rb = batch.record_batch
+    masks: dict[int, pa.Array] = {}
     for step in steps:
         n = rb.num_rows
         ev = Evaluator.for_batch(rb)
         kind = step[0]
-        if kind == "assign":
+        if kind == "mask":
+            _, slot, cond, parent = step
+            m = pc.fill_null(_bool(ev.eval(cond), n), False)
+            if parent is not None:
+                m = pc.and_(m, masks[parent])
+            masks[slot] = m
+        elif kind == "assign":
             _, col, e = step
             rb = _set_column(rb, col, as_array(ev.eval(e), n))
         elif kind == "cassign":
-            _, col, cond, e = step
-            mask = _bool(ev.eval(cond), n)
+            _, col, slot, e = step
+            mask = masks[slot]
             val = as_array(ev.eval(e), n)
             names = rb.schema.names
             if col in names:
@@ -515,14 +557,24 @@ def apply_vrl(batch: MessageBatch, steps: list[Step]) -> MessageBatch:
                         val = pc.cast(val, base.type, safe=False)
             else:
                 base = pa.nulls(n, val.type)
-            rb = _set_column(rb, col, pc.if_else(pc.fill_null(mask, False), val, base))
+            rb = _set_column(rb, col, pc.if_else(mask, val, base))
         elif kind == "del":
             _, col = step
             if col in rb.schema.names:
                 rb = rb.drop_columns([col])
         elif kind == "filter":
-            _, keep = step
-            rb = rb.filter(pc.fill_null(_bool(ev.eval(keep), n), False))
+            _, slot = step
+            if slot is None:  # top-level abort: drop every row
+                keep = pa.array([False] * n, pa.bool_())
+            else:
+                keep = pc.invert(masks[slot])
+            rb = rb.filter(keep)
+            # live masks must track the surviving rows or later branch
+            # steps would index a stale row set
+            masks = {k: m.filter(keep) for k, m in masks.items()}
+    hidden = [c for c in rb.schema.names if c.startswith(_LOCAL_PREFIX)]
+    if hidden:
+        rb = rb.drop_columns(hidden)
     return MessageBatch(rb)
 
 

@@ -54,6 +54,11 @@ def pin_cpu_env(env: dict, n_devices: int = 8) -> None:
         flags + f" --xla_force_host_platform_device_count={n_devices}"
     ).strip()
     env.setdefault("JAX_ENABLE_X64", "0")
+    # The persistent CPU compile cache (tpu/jaxcache.py) makes XLA's AOT
+    # loader log two C++ E-lines per reloaded executable (same-host feature
+    # pseudo-mismatch, cosmetic). Only a pre-import env var reaches absl's
+    # C++ logging init, so the scrub sets it here; explicit settings win.
+    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 
 def cpu_child_env(n_devices: int = 8) -> dict:

@@ -7,6 +7,7 @@ crate (ref: crates/arkflow-plugin/src/time/mod.rs:18-26).
 
 from __future__ import annotations
 
+import math
 import re
 
 from arkflow_tpu.errors import ConfigError
@@ -41,8 +42,11 @@ _PART = re.compile(r"(\d+(?:\.\d+)?)\s*([a-zµ]+)")
 def parse_duration(value: object) -> float:
     """Parse a config duration into seconds (float)."""
     if isinstance(value, (int, float)) and not isinstance(value, bool):
-        if value < 0:
-            raise ConfigError(f"negative duration: {value}")
+        # NaN slips past the sign check ('nan' < 0 is False) and inf is no
+        # usable timeout either; both reach here via float("nan"/"inf")
+        # string parses too
+        if value < 0 or not math.isfinite(value):
+            raise ConfigError(f"non-finite or negative duration: {value}")
         return float(value)
     if not isinstance(value, str):
         raise ConfigError(f"cannot parse duration from {type(value).__name__}: {value!r}")

@@ -372,4 +372,17 @@ def zstd_encode(data: bytes) -> bytes:
 def zstd_decode(data: bytes) -> bytes:
     import zstandard
 
-    return zstandard.ZstdDecompressor().decompress(data)
+    # decompressobj, not decompress(): streaming producers (Java zstd-jni's
+    # ZstdOutputStream, python stream_writer) emit frames WITHOUT the
+    # content-size header field, which one-shot decompress() refuses with
+    # "could not determine content size in frame header" (advisor r3)
+    out = bytearray()
+    view = data
+    while view:  # concatenated frames decode back-to-back
+        dec = zstandard.ZstdDecompressor().decompressobj()
+        out += dec.decompress(view)
+        leftover = dec.unused_data
+        if not leftover or leftover == view:
+            break
+        view = leftover
+    return bytes(out)

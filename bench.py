@@ -253,6 +253,7 @@ def main() -> None:
             if os.environ.get("JAX_PLATFORMS") != "cpu":
                 env = cpu_child_env(n_devices=1)
                 env["BENCH_TINY"] = "1"
+                env["ARKFLOW_BENCH_CHILD"] = "1"
                 res = subprocess.run([sys.executable, __file__], env=env,
                                      capture_output=True)
                 _relay_child(res)
@@ -268,6 +269,7 @@ def main() -> None:
             # n_devices=1: the CPU anchor is a single-host-device number
             # (comparable across rounds), not a virtual-mesh run
             env = cpu_child_env(n_devices=1)
+            env["ARKFLOW_BENCH_CHILD"] = "1"
             res = subprocess.run([sys.executable, __file__], env=env, capture_output=True)
             _relay_child(res)
             sys.exit(res.returncode)
@@ -299,6 +301,7 @@ def main() -> None:
               file=sys.stderr, flush=True)
         env = cpu_child_env(n_devices=1)
         env["BENCH_TINY"] = "1"
+        env["ARKFLOW_BENCH_CHILD"] = "1"
         res = subprocess.run([sys.executable, __file__], env=env, capture_output=True)
         _relay_child(res)
         sys.exit(res.returncode)

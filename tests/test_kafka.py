@@ -52,6 +52,7 @@ class FakeKafkaBroker:
     def __init__(self, topics: dict[str, int], sasl_plain: tuple | None = None):
         # topics: name -> partition count; sasl_plain: (user, password) to require
         self.logs = {(t, p): [] for t, n in topics.items() for p in range(n)}
+        self.zstd_parts = set()  # partitions holding zstd batches (KIP-110)
         self.group_offsets = {}
         self.sasl_plain = sasl_plain
         self.sasl_attempts = []
@@ -135,7 +136,7 @@ class FakeKafkaBroker:
                 if api in (11, 14):  # group APIs need to await the join barrier
                     body = await self._dispatch_group(api, r)
                 else:
-                    body = self._dispatch(api, r)
+                    body = self._dispatch(api, r, ver)
                 frame = Writer().i32(corr).raw(body).build()
                 writer.write(struct.pack(">i", len(frame)) + frame)
                 await writer.drain()
@@ -173,7 +174,7 @@ class FakeKafkaBroker:
             return Writer().i32(0).i16(err).bytes_(blob).build()
         raise AssertionError(f"unhandled group api {api}")
 
-    def _dispatch(self, api: int, r: Reader) -> bytes:
+    def _dispatch(self, api: int, r: Reader, ver: int = 0) -> bytes:
         if api == 12:  # Heartbeat v1
             group = r.string()
             gen = r.i32()
@@ -221,7 +222,7 @@ class FakeKafkaBroker:
                 for p in parts:
                     w.i16(0).i32(p).i32(0).i32(1).i32(0).i32(1).i32(0)
             return w.build()
-        if api == 0:  # Produce v3
+        if api == 0:  # Produce v3/v7 (same request schema; KIP-110 gate)
             r.string()  # txn id
             r.i16()  # acks
             r.i32()  # timeout
@@ -237,6 +238,14 @@ class FakeKafkaBroker:
                     if log is None:
                         results.append((topic, part, 3, -1))
                         continue
+                    # record-batch v2 header: attributes at byte 21; codec 4
+                    # = zstd, which real brokers refuse below Produce v7
+                    codec = struct.unpack(">h", batch[21:23])[0] & 0x07
+                    if codec == 4 and ver < 7:
+                        results.append((topic, part, 76, -1))
+                        continue
+                    if codec == 4:
+                        self.zstd_parts.add((topic, part))
                     base = len(log)
                     for rec in decode_record_batches(batch):
                         log.append((rec.key, rec.value, rec.timestamp_ms))
@@ -245,13 +254,21 @@ class FakeKafkaBroker:
             w.i32(len(results))
             for topic, part, err, base in results:
                 w.string(topic).i32(1).i32(part).i16(err).i64(base).i64(-1)
+                if ver >= 5:
+                    w.i64(0)  # log_start_offset
             w.i32(0)  # throttle
             return w.build()
-        if api == 1:  # Fetch v4
+        if api == 1:  # Fetch v4/v10 (KIP-110: zstd logs need v10+)
             r.i32(); r.i32(); r.i32(); r.i32(); r.i8()
+            if ver >= 7:
+                r.i32()  # session_id
+                r.i32()  # session_epoch
             n_topics = r.i32()
             w = Writer()
             w.i32(0)  # throttle
+            if ver >= 7:
+                w.i16(0)  # top-level error
+                w.i32(0)  # session_id
             w.i32(n_topics)
             for _ in range(n_topics):
                 topic = r.string()
@@ -259,11 +276,19 @@ class FakeKafkaBroker:
                 w.string(topic).i32(n_parts)
                 for _ in range(n_parts):
                     part = r.i32()
+                    if ver >= 9:
+                        r.i32()  # current_leader_epoch
                     offset = r.i64()
+                    if ver >= 5:
+                        r.i64()  # log_start_offset
                     r.i32()  # partition max bytes
                     log = self.logs.get((topic, part), [])
-                    w.i32(part).i16(0).i64(len(log)).i64(len(log)).i32(0)
-                    records = log[offset : offset + 100]
+                    err = 76 if ((topic, part) in self.zstd_parts and ver < 10) else 0
+                    w.i32(part).i16(err).i64(len(log)).i64(len(log))
+                    if ver >= 5:
+                        w.i64(0)  # log_start_offset
+                    w.i32(0)  # aborted txns
+                    records = log[offset : offset + 100] if err == 0 else []
                     if records:
                         batch = encode_record_batch(
                             [(k, v) for k, v, _ in records], base_ts_ms=records[0][2]
@@ -273,6 +298,9 @@ class FakeKafkaBroker:
                         w.bytes_(batch)
                     else:
                         w.bytes_(b"")
+            # ver >= 7 has no trailing forgotten-topics in the RESPONSE;
+            # the request's forgotten_topics_data array (if any) is simply
+            # left unread here (single-topic tests never send one)
             return w.build()
         if api == 2:  # ListOffsets v1
             r.i32()
@@ -653,6 +681,36 @@ def test_control_batch_advances_next_offset():
     records, next_offset = decode_record_set(bytes(control))
     assert records == []
     assert next_offset == 1  # base_offset 0 + lastOffsetDelta 0 + 1
+
+
+def test_zstd_kip110_version_floors():
+    """zstd produce rides Produce v7 and fetch self-upgrades to v10 when the
+    broker answers UNSUPPORTED_COMPRESSION_TYPE (advisor r3: a real broker
+    rejects zstd below those floors; the fake now enforces them)."""
+    async def go():
+        broker = FakeKafkaBroker({"z": 1})
+        await broker.start()
+        try:
+            client = KafkaClient(f"127.0.0.1:{broker.port}")
+            await client.connect()
+            await client.refresh_metadata(["z"])
+            base = await client.produce("z", 0, [(None, b"zstd payload")],
+                                        compression="zstd")
+            assert base == 0
+            assert ("z", 0) in broker.zstd_parts
+            assert client._fetch_version == 4
+            records, hwm, next_offset = await client.fetch("z", 0, 0)
+            assert client._fetch_version == 10  # upgraded and sticky
+            assert [r.value for r in records] == [b"zstd payload"]
+            assert (hwm, next_offset) == (1, 1)
+            # subsequent fetches stay on v10
+            records, _, _ = await client.fetch("z", 0, 0)
+            assert [r.value for r in records] == [b"zstd payload"]
+            await client.close()
+        finally:
+            await broker.stop()
+
+    asyncio.run(go())
 
 
 @pytest.mark.parametrize("codec", ["snappy", "lz4", "zstd"])

@@ -144,6 +144,31 @@ def test_lz4_frame_cross_tier_roundtrip():
             assert _py_lz4_decompress_block(blk, 60000) == s[:60000]
 
 
+def test_zstd_decode_frames_without_content_size():
+    """Streaming producers (Java zstd-jni ZstdOutputStream) emit frames with
+    no content-size header field; one-shot decompress() refuses those, so the
+    decode path must stream (advisor r3). Concatenated frames too."""
+    import zstandard
+
+    from arkflow_tpu.utils.xcodecs import zstd_decode, zstd_encode
+
+    payload = b"sensor reading nominal " * 400
+    # stream_writer never records the content size in the frame header
+    import io
+
+    buf = io.BytesIO()
+    with zstandard.ZstdCompressor().stream_writer(buf, closefd=False) as w:
+        w.write(payload)
+    streamed = buf.getvalue()
+    params = zstandard.get_frame_parameters(streamed)
+    assert params.content_size in (0, zstandard.CONTENTSIZE_UNKNOWN)
+    assert zstd_decode(streamed) == payload
+    # our own encoder's frames still decode
+    assert zstd_decode(zstd_encode(payload)) == payload
+    # back-to-back frames decode as concatenation (multi-frame producers)
+    assert zstd_decode(zstd_encode(b"one") + streamed) == b"one" + payload
+
+
 def test_lz4_frame_checksums_detect_corruption():
     import pytest
 

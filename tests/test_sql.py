@@ -161,6 +161,30 @@ def test_json_get(ctx):
     assert out.column("v").to_pylist() == [3, 7]
 
 
+def test_json_get_schema_stable_across_batches():
+    """SQL-facing json_get keeps the always-string contract: the same query
+    must not produce int64 on one batch and string on the next (advisor r3).
+    VRL's parse_json lowers to json_get_dyn, which stays dynamically typed."""
+    import pyarrow as pa
+
+    q = "SELECT json_get(__value__, 'v') AS v FROM flow"
+    c1 = SessionContext()
+    c1.register_batch("flow", MessageBatch.new_binary([b'{"v": 1}', b'{"v": 2}']))
+    out1 = c1.sql(q)
+    c2 = SessionContext()
+    c2.register_batch("flow", MessageBatch.new_binary([b'{"v": 1}', b'{"v": "x"}']))
+    out2 = c2.sql(q)
+    assert out1.record_batch.schema.field("v").type == pa.string()
+    assert out1.record_batch.schema == out2.record_batch.schema
+    assert out1.column("v").to_pylist() == ["1", "2"]
+    assert out2.column("v").to_pylist() == ["1", "x"]
+    # dynamic variant keeps JSON types for homogeneous batches
+    c3 = SessionContext()
+    c3.register_batch("flow", MessageBatch.new_binary([b'{"v": 1}']))
+    out3 = c3.sql("SELECT json_get_dyn(__value__, 'v') AS v FROM flow")
+    assert out3.column("v").to_pylist() == [1]
+
+
 def test_evaluate_expression():
     mb = MessageBatch.from_pydict({"x": [1, 2, 3]})
     arr = evaluate_expression(mb, "x * 10 + 1")

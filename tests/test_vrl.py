@@ -55,6 +55,92 @@ def test_if_else_assignments_are_masked():
     assert out.column("alert").to_pylist() == [None, None, True]
 
 
+def test_branch_condition_snapshot_survives_self_mutation():
+    """A branch that assigns to a column its own condition reads must keep
+    executing its remaining statements on the originally-matching rows
+    (advisor r3 high: per-step re-evaluation silently no-op'd them)."""
+    b = MessageBatch.from_pydict({"status": ["error", "ok", "error"]})
+    out = run_vrl(
+        """
+        if .status == "error" {
+          .status = "fatal"
+          .alert = true
+        }
+        """, b)
+    assert out.column("status").to_pylist() == ["fatal", "ok", "fatal"]
+    assert out.column("alert").to_pylist() == [True, None, True]
+
+
+def test_else_branch_snapshot_survives_then_mutation():
+    """The then-branch rewriting the condition column must not leak rows
+    into the else-branch (both polarities snapshot at if-entry)."""
+    b = MessageBatch.from_pydict({"status": ["error", "ok"]})
+    out = run_vrl(
+        """
+        if .status == "error" {
+          .status = "ok"
+        } else {
+          .status = "was_fine"
+        }
+        """, b)
+    assert out.column("status").to_pylist() == ["ok", "was_fine"]
+
+
+def test_abort_inside_branch_after_mutation_drops_matching_rows():
+    """abort after an assignment in the same branch still drops exactly the
+    rows that matched at branch entry."""
+    b = MessageBatch.from_pydict({"level": ["debug", "info", "debug"]})
+    out = run_vrl(
+        """
+        if .level == "debug" {
+          .level = "dropped"
+          abort
+        }
+        .seen = true
+        """, b)
+    assert out.column("level").to_pylist() == ["info"]
+    assert out.column("seen").to_pylist() == [True]
+
+
+def test_abort_then_later_branch_masks_stay_aligned():
+    """A filter shrinking the batch must not desync masks computed earlier
+    (else-slot snapshots are filtered alongside the rows)."""
+    b = MessageBatch.from_pydict({"v": [1, 5, 9, 2]})
+    out = run_vrl(
+        """
+        if .v > 8 { abort } else { .kept = true }
+        """, b)
+    assert out.column("v").to_pylist() == [1, 5, 2]
+    assert out.column("kept").to_pylist() == [True, True, True]
+
+
+def test_local_binds_value_at_assignment_time():
+    """tmp = .a; .a = ...; use of tmp must read the OLD .a (advisor r3 low:
+    textual inlining read the new value)."""
+    b = MessageBatch.from_pydict({"a": [1, 2]})
+    out = run_vrl(
+        """
+        old = .a
+        .a = .a * 100
+        .saved = old
+        """, b)
+    assert out.column("a").to_pylist() == [100, 200]
+    assert out.column("saved").to_pylist() == [1, 2]
+    assert not [c for c in out.record_batch.schema.names if c.startswith("__vrl_")]
+
+
+def test_local_swap_pattern():
+    b = MessageBatch.from_pydict({"a": [1], "b": [9]})
+    out = run_vrl(
+        """
+        t = .a
+        .a = .b
+        .b = t
+        """, b)
+    assert out.column("a").to_pylist() == [9]
+    assert out.column("b").to_pylist() == [1]
+
+
 def test_abort_filters_rows():
     b = MessageBatch.from_pydict({"level": ["info", "debug", "error"]})
     out = run_vrl(
