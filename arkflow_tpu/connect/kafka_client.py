@@ -747,13 +747,16 @@ class KafkaClient:
             if version >= 7:
                 w.i32(0)  # session_id: sessionless full fetch
                 w.i32(-1)  # session_epoch
-            if version >= 9:
-                def _part(w2: Writer, p) -> None:
-                    w2.i32(p[0]).i32(-1).i64(p[1]).i64(-1).i32(max_bytes)
-                    # current_leader_epoch -1; log_start_offset -1 (consumer)
-            else:
-                def _part(w2: Writer, p) -> None:
-                    w2.i32(p[0]).i64(p[1]).i32(max_bytes)
+            def _part(w2: Writer, p) -> None:
+                # each field gated at its KIP introduction version so every
+                # fetch version 4..11 serializes correctly (advisor r4, low)
+                w2.i32(p[0])
+                if version >= 9:
+                    w2.i32(-1)  # current_leader_epoch
+                w2.i64(p[1])
+                if version >= 5:
+                    w2.i64(-1)  # log_start_offset (-1: consumer, not follower)
+                w2.i32(max_bytes)
             w.array(
                 [(topic, offset)],
                 lambda wt, t: wt.string(topic).array([(partition, offset)], _part),

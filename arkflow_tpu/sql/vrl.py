@@ -106,6 +106,7 @@ def _unquote(s: str) -> str:
 # ---------------------------------------------------------------------------
 
 # steps: ("mask", slot, cond_expr, parent_slot|None)   — branch-entry snapshot
+#      | ("maskelse", slot, then_slot, parent_slot|None) — parent AND NOT then
 #      | ("assign", col, expr) | ("cassign", col, slot, value)
 #      | ("del", col) | ("filter", slot|None)          — abort; None = all rows
 #
@@ -265,14 +266,28 @@ class _Parser:
                 if self.peek().kind == "op" and self.peek().value == "=":
                     raise VrlCompileError(f"vrl: '==' at statement level at {name.pos}")
                 e = self._expr(env)
-                if isinstance(e, ast.Literal):
+                # the literal inline shortcut is only sound at top level: a
+                # literal bound inside a branch must land on the branch's rows
+                # only, so it rides the masked cassign path (advisor r4, med)
+                if isinstance(e, ast.Literal) and cond_slot is None:
                     env[name.value] = e
                     return []
                 hidden = _LOCAL_PREFIX + name.value
-                env[name.value] = ast.Column(hidden)
+                steps: list[Step] = []
                 if cond_slot is not None:
-                    return [("cassign", hidden, cond_slot, e)]
-                return [("assign", hidden, e)]
+                    # non-matching rows must keep the pre-branch value, so the
+                    # prior binding is materialized into the hidden column
+                    # before the masked write (unbound-before -> null, which
+                    # is what cassign's missing-column base already yields)
+                    prior = env.get(name.value)
+                    if prior is not None and not (
+                            isinstance(prior, ast.Column) and prior.name == hidden):
+                        steps.append(("assign", hidden, prior))
+                    steps.append(("cassign", hidden, cond_slot, e))
+                else:
+                    steps.append(("assign", hidden, e))
+                env[name.value] = ast.Column(hidden)
+                return steps
             self.i = save
         raise VrlCompileError(f"vrl: unsupported statement at {t.pos}: {t.value!r}")
 
@@ -289,7 +304,10 @@ class _Parser:
         body = self._block(env, then_slot)
         if self.peek().kind == "ident" and self.peek().value == "else":
             else_slot = self._new_slot()
-            steps.append(("mask", else_slot, ast.Unary("not", cond), parent_slot))
+            # else = parent AND NOT then-mask (not `not cond`): the then-mask
+            # is null-filled, so rows whose condition is null fall into else,
+            # matching VRL's null-is-false predicate (advisor r4, low)
+            steps.append(("maskelse", else_slot, then_slot, parent_slot))
         steps.extend(body)
         if else_slot is not None:
             self.next()  # 'else'
@@ -535,6 +553,12 @@ def apply_vrl(batch: MessageBatch, steps: list[Step]) -> MessageBatch:
         if kind == "mask":
             _, slot, cond, parent = step
             m = pc.fill_null(_bool(ev.eval(cond), n), False)
+            if parent is not None:
+                m = pc.and_(m, masks[parent])
+            masks[slot] = m
+        elif kind == "maskelse":
+            _, slot, then_slot, parent = step
+            m = pc.invert(masks[then_slot])
             if parent is not None:
                 m = pc.and_(m, masks[parent])
             masks[slot] = m

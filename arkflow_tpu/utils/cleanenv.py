@@ -58,7 +58,11 @@ def pin_cpu_env(env: dict, n_devices: int = 8) -> None:
     # loader log two C++ E-lines per reloaded executable (same-host feature
     # pseudo-mismatch, cosmetic). Only a pre-import env var reaches absl's
     # C++ logging init, so the scrub sets it here; explicit settings win.
-    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    # CAVEAT: level 3 mutes ALL C++ E-logs in the child. When debugging a
+    # child failure, export ARKFLOW_XLA_VERBOSE=1 (or set
+    # TF_CPP_MIN_LOG_LEVEL yourself) to see them (advisor r4, low).
+    if env.get("ARKFLOW_XLA_VERBOSE") != "1":
+        env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 
 def cpu_child_env(n_devices: int = 8) -> dict:

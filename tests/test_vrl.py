@@ -262,3 +262,57 @@ def test_comments_and_separators():
         .w = .v * 2  # trailing comment
         """, b)
     assert out.column("w").to_pylist() == [8]
+
+
+def test_literal_local_in_branch_is_masked():
+    """A literal bound to a local inside an if-branch must only be visible to
+    matching rows; non-matching rows keep the pre-branch value (advisor r4)."""
+    b = MessageBatch.from_pydict({"c": [True, False]})
+    out = run_vrl("t = 1\nif .c { t = 2 }\n.x = t", b)
+    assert out.column("x").to_pylist() == [2, 1]
+
+
+def test_literal_local_in_both_branches():
+    b = MessageBatch.from_pydict({"c": [True, False]})
+    out = run_vrl("if .c { t = 2 } else { t = 3 }\n.x = t", b)
+    assert out.column("x").to_pylist() == [2, 3]
+
+
+def test_local_first_bound_in_branch_is_null_elsewhere():
+    b = MessageBatch.from_pydict({"c": [True, False]})
+    out = run_vrl("if .c { t = 5 }\n.x = t", b)
+    assert out.column("x").to_pylist() == [5, None]
+
+
+def test_nonliteral_local_rebound_in_branch_keeps_prior_value():
+    b = MessageBatch.from_pydict({"c": [True, False], "v": [10, 20]})
+    out = run_vrl("t = .v\nif .c { t = t + 1 }\n.x = t", b)
+    assert out.column("x").to_pylist() == [11, 20]
+
+
+def test_null_condition_routes_to_else():
+    """VRL treats a null predicate as false: the row takes the else branch
+    (advisor r4)."""
+    b = MessageBatch.from_pydict({"status": ["error", None, "ok"]})
+    out = run_vrl(
+        """
+        if .status == "error" {
+          .sev = "high"
+        } else {
+          .sev = "normal"
+        }
+        """, b)
+    assert out.column("sev").to_pylist() == ["high", "normal", "normal"]
+
+
+def test_null_condition_else_respects_parent_mask():
+    """Nested else under a parent branch: null-cond rows fall into the inner
+    else only when the parent mask admits them."""
+    b = MessageBatch.from_pydict({"p": [True, True, False], "s": ["e", None, None]})
+    out = run_vrl(
+        """
+        if .p {
+          if .s == "e" { .r = "a" } else { .r = "b" }
+        }
+        """, b)
+    assert out.column("r").to_pylist() == ["a", "b", None]
