@@ -68,6 +68,45 @@ def quantize_for_serving(params) -> tuple["dict", int]:
     return walk(params), count
 
 
+def quantize_param_specs(specs):
+    """Mirror ``quantize_for_serving`` over a PartitionSpec pytree so int8
+    param trees shard under the same mesh layouts as their float originals.
+
+    Every dense-spec dict ``{"w": P(..., in_ax, out_ax), "b"?: ...}`` becomes
+    ``{"w_q": <w spec>, "w_scale": <w spec with the in-dim axis replicated>,
+    "b"?: ...}``: ``w_q`` keeps the weight's layout exactly (same shape), and
+    ``w_scale`` has a size-1 in-dim (`[..., 1, out]`), which cannot be split
+    over a >1 mesh axis, so that entry is forced to None while the out-dim
+    sharding rides along. Non-dense specs (embeddings, norms, MoE expert
+    stacks) pass through untouched — quantization leaves those params alone.
+
+    Contract (matches quantize_for_serving's predicate): a dict with a ``w``
+    key is a dense layer. Families keep non-dense weights under other names
+    (``table``, ``scale``, ``w_gate``...), so key presence is sufficient.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def walk(node):
+        if isinstance(node, dict):
+            if _WEIGHT_KEY in node:
+                w = node[_WEIGHT_KEY]
+                entries = tuple(w) if isinstance(w, P) else ()
+                if len(entries) >= 2:
+                    scale = P(*entries[:-2], None, entries[-1])
+                else:  # replicated / underspecified weight spec
+                    scale = P()
+                out = {"w_q": w, "w_scale": scale}
+                if "b" in node:
+                    out["b"] = node["b"]
+                return out
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        return node
+
+    return walk(specs)
+
+
 def dense_w8a8(p: dict, x: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
     """int8 dynamic-activation dense: quantize rows of ``x``, int8 matmul
     (int32 accumulate on the MXU), dequantize, bias."""

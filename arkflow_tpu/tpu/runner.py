@@ -105,11 +105,6 @@ class ModelRunner:
             raise ConfigError(
                 f"serving_dtype {serving_dtype!r} invalid "
                 "(float32/bfloat16/float16/int8)")
-        if serving_dtype == "int8" and mesh_spec is not None and mesh_spec.num_devices > 1:
-            raise ConfigError(
-                "serving_dtype int8 is single-device for now (quantized param "
-                "keys don't line up with the family's tensor-parallel "
-                "param_specs)")
         self.serving_dtype = serving_dtype
 
         # init on host CPU (op-by-op init over a remote-TPU tunnel is pathological),
@@ -150,6 +145,13 @@ class ModelRunner:
             self.mesh = create_mesh(mesh_spec, devices=devices)
             axes = {name: name for name in self.mesh.axis_names}
             pspecs = self.family.param_specs(self.cfg, axes) if self.family.param_specs else None
+            if pspecs is not None and self.serving_dtype == "int8":
+                # int8 params carry {"w_q","w_scale"} where the float tree had
+                # {"w"}; rewrite the spec tree the same way so tp/ep layouts
+                # (and the doubled int8 MXU roofline) survive quantization
+                from arkflow_tpu.models.quantize import quantize_param_specs
+
+                pspecs = quantize_param_specs(pspecs)
             params = shard_params(params, pspecs, self.mesh)
         else:
             target = (devices[0] if devices else jax.devices()[0])

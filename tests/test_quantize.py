@@ -3,7 +3,6 @@
 import numpy as np
 import pytest
 
-from arkflow_tpu.errors import ConfigError
 from arkflow_tpu.tpu.bucketing import BucketPolicy
 from arkflow_tpu.tpu.runner import ModelRunner
 
@@ -71,9 +70,97 @@ def test_runner_int8_decoder_serving_runs():
     assert np.all(np.isfinite(out["logits"]))
 
 
-def test_int8_rejects_multi_device_mesh():
+def test_quantize_param_specs_mirrors_quantized_tree():
+    """The spec transform must yield a pytree congruent with the quantized
+    params: same dict keys, w_q keeping the weight layout and w_scale
+    replicated on its size-1 in-dim."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from arkflow_tpu.models import get_model
+    from arkflow_tpu.models.quantize import quantize_for_serving, quantize_param_specs
+
+    fam = get_model("bert_classifier")
+    cfg = fam.make_config(**TINY_BERT)
+    qparams, _ = quantize_for_serving(fam.init(jax.random.PRNGKey(0), cfg))
+    qspecs = quantize_param_specs(fam.param_specs(cfg, {"tp": "tp"}))
+    # congruent trees: tree_map over both must not raise
+    jax.tree_util.tree_map(lambda a, s: None, qparams, qspecs,
+                           is_leaf=lambda x: x is None or isinstance(x, P))
+    lw = qspecs["layers"]["ffn_out"]
+    assert lw["w_q"] == P(None, "tp", None)        # in-dim sharded weight
+    assert lw["w_scale"] == P(None, None, None)    # size-1 in-dim replicated
+    assert qspecs["pooler"]["w_scale"] == P(None, "tp")  # out-dim rides along
+
+
+def test_runner_int8_tp2_matches_single_device():
+    """int8 + tp=2 serving (the de-gated path) must match int8 single-device
+    per-row outputs on the virtual CPU mesh."""
+    import jax
+
     from arkflow_tpu.parallel.mesh import MeshSpec
 
-    with pytest.raises(ConfigError, match="int8"):
-        ModelRunner("bert_classifier", TINY_BERT, serving_dtype="int8",
-                    mesh_spec=MeshSpec(tp=2))
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("needs 2 virtual devices")
+    buckets = BucketPolicy((4,), (16,))
+    single = ModelRunner("bert_classifier", TINY_BERT, buckets=buckets,
+                         serving_dtype="int8")
+    sharded = ModelRunner("bert_classifier", TINY_BERT, buckets=buckets,
+                          serving_dtype="int8", mesh_spec=MeshSpec(tp=2),
+                          devices=devs[:2])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 512, (4, 16)).astype(np.int32)
+    mask = np.ones((4, 16), np.int32)
+    a = single.infer_sync({"input_ids": ids, "attention_mask": mask})
+    b = sharded.infer_sync({"input_ids": ids, "attention_mask": mask})
+    np.testing.assert_allclose(a["logits"], b["logits"], atol=1e-3)
+    np.testing.assert_array_equal(a["label"], b["label"])
+    # params actually live on both devices with tp-split dense shards
+    wq = sharded.params["layers"]["q"]["w_q"]
+    assert len(wq.addressable_shards) == 2
+    assert wq.addressable_shards[0].data.shape[-1] == wq.shape[-1] // 2
+
+
+def test_runner_int8_tp_dp_mesh_serving():
+    """int8 under a combined dp x tp mesh serves and stays finite."""
+    import jax
+
+    from arkflow_tpu.parallel.mesh import MeshSpec
+
+    devs = jax.devices("cpu")
+    if len(devs) < 4:
+        pytest.skip("needs 4 virtual devices")
+    runner = ModelRunner("bert_classifier", TINY_BERT,
+                         buckets=BucketPolicy((4,), (16,)),
+                         serving_dtype="int8",
+                         mesh_spec=MeshSpec(dp=2, tp=2), devices=devs[:4])
+    rng = np.random.RandomState(1)
+    out = runner.infer_sync({
+        "input_ids": rng.randint(1, 512, (4, 16)).astype(np.int32),
+        "attention_mask": np.ones((4, 16), np.int32),
+    })
+    assert np.all(np.isfinite(out["logits"]))
+
+
+def test_runner_int8_decoder_tp2_matches_single_device():
+    """Decoder family (wq/wk/wv/wo/SwiGLU, no biases) under int8 + tp=2."""
+    import jax
+
+    from arkflow_tpu.parallel.mesh import MeshSpec
+
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("needs 2 virtual devices")
+    tiny = {"vocab_size": 128, "dim": 32, "layers": 2, "heads": 4, "kv_heads": 2,
+            "ffn": 48, "max_seq": 64}
+    buckets = BucketPolicy((2,), (16,))
+    single = ModelRunner("decoder_lm", tiny, buckets=buckets, serving_dtype="int8")
+    sharded = ModelRunner("decoder_lm", tiny, buckets=buckets,
+                          serving_dtype="int8", mesh_spec=MeshSpec(tp=2),
+                          devices=devs[:2])
+    ids = np.random.RandomState(2).randint(1, 128, (2, 16)).astype(np.int32)
+    a = single.infer_sync({"input_ids": ids})
+    b = sharded.infer_sync({"input_ids": ids})
+    # decoder logits are bf16: tp partial-sum reordering costs a few ulp
+    np.testing.assert_allclose(a["logits"], b["logits"], atol=0.05)
