@@ -101,24 +101,38 @@ def init(rng, cfg: BertConfig) -> dict:
     return params
 
 
-def encode(params: dict, cfg: BertConfig, input_ids, attention_mask):
-    """[B, S] ids/mask -> [B, S, hidden] bf16 encodings."""
+def encode(params: dict, cfg: BertConfig, input_ids, attention_mask,
+           *, positions=None, pair_mask=None):
+    """[B, S] ids/mask -> [B, S, hidden] bf16 encodings.
+
+    ``positions``/``pair_mask`` are the packed-execution hooks
+    (tpu/packing.py): per-token position ids and a full [B,1,Sq,Sk]
+    block-diagonal mask. A pair mask disables the ragged flash kernel —
+    it reads prefix lengths, which cannot express segment structure; packed
+    rows are ~fully dense anyway, so the kernel's skip-padded-tiles edge
+    is gone.
+    """
     b, s = input_ids.shape
-    positions = jnp.arange(s)[None, :]
+    if positions is None:
+        positions = jnp.arange(s)[None, :]
     x = (
         cm.embedding(params["embed"]["word"], input_ids)
         + cm.embedding(params["embed"]["position"], positions)
         + cm.embedding(params["embed"]["token_type"], jnp.zeros_like(input_ids))
     )
     x = cm.layer_norm(params["embed"]["ln"], x, cfg.ln_eps)
-    mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,Sk]
+    if pair_mask is not None:
+        mask = pair_mask
+    else:
+        mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,Sk]
     lengths = attention_mask.astype(jnp.int32).sum(axis=1)  # contiguous-prefix masks
+    flash_ok = pair_mask is None
 
     def _attend(q, k, v):
         # s is static at trace time: each bucket decides flash-vs-XLA
         # independently, so one stream can serve seq-32 on XLA and seq-512
         # on the ragged kernel from the same config
-        if cfg.use_flash_attention and s >= (cfg.flash_min_seq or 0):
+        if flash_ok and cfg.use_flash_attention and s >= (cfg.flash_min_seq or 0):
             from arkflow_tpu.ops.ragged_attention import ragged_flash_attention
 
             # largest pow2 tile (<=128) dividing the bucket length, so any
@@ -166,8 +180,46 @@ def apply(params: dict, cfg: BertConfig, *, input_ids, attention_mask) -> dict:
     }
 
 
+def apply_packed(params: dict, cfg: BertConfig, *, input_ids, segment_ids,
+                 position_ids, example_row, example_pos) -> dict:
+    """Packed-execution forward (tpu/packing.py layout): [P, S] packed rows
+    holding E examples. Attention is block-diagonal on ``segment_ids``
+    (tokens never attend across examples; 0 marks dead positions), position
+    embeddings follow ``position_ids``, and each example's [CLS] encoding is
+    gathered from (example_row, example_pos) — outputs are [E] in original
+    example order. Fully-dead padded rows soften to a uniform attention
+    (all scores masked equally) and are sliced away by the caller.
+    """
+    seg = segment_ids
+    pair = (seg[:, None, :] == seg[:, :, None]) & (seg > 0)[:, None, :]
+    pair_mask = pair[:, None, :, :]  # [P, 1, Sq, Sk], broadcast over heads
+    x = encode(params, cfg, input_ids, (seg > 0).astype(jnp.int32),
+               positions=position_ids, pair_mask=pair_mask)
+    cls = x[example_row, example_pos, :]  # [E, hidden]
+    pooled = jnp.tanh(cm.dense(params["pooler"], cls))
+    logits = cm.dense(params["classifier"], pooled).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return {
+        "label": jnp.argmax(logits, axis=-1).astype(jnp.int32),
+        "score": jnp.max(probs, axis=-1),
+        "logits": logits,
+    }
+
+
 def input_spec(cfg: BertConfig) -> dict:
     return {"input_ids": ("int32", ("seq",)), "attention_mask": ("int32", ("seq",))}
+
+
+def packed_input_spec(cfg: BertConfig) -> dict:
+    """Input spec for packed execution. Leading-dim roles: ``packed`` arrays
+    share the packed-row dim P; ``example`` arrays share the example dim E."""
+    return {
+        "input_ids": ("int32", ("seq",)),
+        "segment_ids": ("int32", ("seq",)),
+        "position_ids": ("int32", ("seq",)),
+        "example_row": ("int32", ()),
+        "example_pos": ("int32", ()),
+    }
 
 
 def param_specs(cfg: BertConfig, axes: dict) -> dict:
@@ -255,6 +307,10 @@ register_model(
         apply=apply,
         input_spec=input_spec,
         param_specs=param_specs,
-        extras={"from_hf_state_dict": from_hf_state_dict},
+        extras={
+            "from_hf_state_dict": from_hf_state_dict,
+            "apply_packed": apply_packed,
+            "packed_input_spec": packed_input_spec,
+        },
     )
 )

@@ -7,6 +7,8 @@ the stream EOFs. Config:
 
     type: generate
     payload: '{"sensor":"t1","temp":21.5}'
+    payloads: ['{"a":1}', '{"b":2}']   # alternative: rotate a payload mix
+                                       # across rows (ragged-traffic benches)
     interval: 10ms        # optional; 0 = as fast as downstream pulls
     batch_size: 128
     count: 100000         # optional total-row cap
@@ -26,11 +28,13 @@ from arkflow_tpu.utils.duration import parse_duration
 
 
 class GenerateInput(Input):
-    def __init__(self, payload: bytes, interval_s: float, batch_size: int,
+    def __init__(self, payloads: list[bytes], interval_s: float, batch_size: int,
                  count: Optional[int], codec=None):
         if batch_size <= 0:
             raise ConfigError("generate.batch_size must be positive")
-        self.payload = payload
+        if not payloads:
+            raise ConfigError("generate input requires a payload")
+        self.payloads = payloads
         self.interval_s = interval_s
         self.batch_size = batch_size
         self.count = count
@@ -49,9 +53,12 @@ class GenerateInput(Input):
         n = self.batch_size
         if self.count is not None:
             n = min(n, self.count - self._emitted)
-        # identical rows: build once, slice thereafter (hot path for benches)
+        # identical rows: build once, slice thereafter (hot path for benches);
+        # a payload mix rotates across rows of the template
         if self._template is None or self._template.num_rows < n:
-            self._template = decode_payloads([self.payload] * self.batch_size, self.codec)
+            size = max(n, self.batch_size)
+            rows = [self.payloads[i % len(self.payloads)] for i in range(size)]
+            self._template = decode_payloads(rows, self.codec)
         batch = self._template if n == self._template.num_rows else self._template.slice(0, n)
         self._emitted += n
         return batch.with_source("generate"), NoopAck()
@@ -60,17 +67,28 @@ class GenerateInput(Input):
 @register_input("generate")
 def _build(config: dict, resource: Resource) -> GenerateInput:
     # 'context' is the reference's field name (generate.rs:26-100);
-    # 'payload' is the clearer alias — both accepted
-    payload = config.get("payload", config.get("context"))
-    if payload is None:
-        raise ConfigError("generate input requires 'payload' (or 'context')")
-    if isinstance(payload, (dict, list)):
-        import json
+    # 'payload' is the clearer alias — both accepted. 'payloads' rotates a
+    # mix of rows (ragged-traffic benches / tests).
+    import json
 
-        payload = json.dumps(payload)
+    mix = config.get("payloads")
+    if mix is not None:
+        if not isinstance(mix, (list, tuple)) or not mix:
+            raise ConfigError("generate.payloads must be a non-empty list")
+        payloads = [
+            (json.dumps(p) if isinstance(p, (dict, list)) else str(p)).encode()
+            for p in mix
+        ]
+    else:
+        payload = config.get("payload", config.get("context"))
+        if payload is None:
+            raise ConfigError("generate input requires 'payload' (or 'context')")
+        if isinstance(payload, (dict, list)):
+            payload = json.dumps(payload)
+        payloads = [str(payload).encode()]
     interval = parse_duration(config.get("interval", 0))
     return GenerateInput(
-        payload=str(payload).encode(),
+        payloads=payloads,
         interval_s=interval,
         batch_size=int(config.get("batch_size", 1)),
         count=int(config["count"]) if config.get("count") is not None else None,

@@ -30,6 +30,9 @@ Config:
     warmup: false                  # precompile bucket grid at connect
     serving_dtype: bfloat16        # float32 | bfloat16 | float16 | int8
                                    # (int8 = dynamic W8A8, 2x MXU roofline)
+    packing: true                  # token packing (tpu/packing.py): bin-pack
+                                   # short examples into dense model rows so
+                                   # flops/row tracks real token count
 """
 
 from __future__ import annotations
@@ -52,7 +55,8 @@ if TYPE_CHECKING:  # jax-importing modules load lazily in the builder
 
 class TpuInferenceProcessor(Processor):
     def __init__(self, runner: ModelRunner, *, text_field: str, tensor_field: Optional[str],
-                 tokenizer, max_seq: int, outputs: Optional[list[str]], warmup: bool = False):
+                 tokenizer, max_seq: int, outputs: Optional[list[str]], warmup: bool = False,
+                 packing: bool = False):
         self.runner = runner
         self.text_field = text_field
         self.tensor_field = tensor_field
@@ -60,6 +64,7 @@ class TpuInferenceProcessor(Processor):
         self.max_seq = max_seq
         self.outputs = outputs
         self._warmed = not warmup
+        self.packing = packing
 
     # -- input extraction --------------------------------------------------
 
@@ -121,9 +126,47 @@ class TpuInferenceProcessor(Processor):
             return []
         if not self._warmed:  # direct use without a stream (tests, tools)
             await self.connect()
-        inputs = self._extract(batch)
-        outputs = await self.runner.infer(inputs)
+        if self.packing:
+            outputs = await self._infer_packed(batch)
+        else:
+            inputs = self._extract(batch)
+            outputs = await self.runner.infer(inputs)
         return [self._attach(batch, outputs)]
+
+    async def _infer_packed(self, batch: MessageBatch) -> dict[str, np.ndarray]:
+        """Token-packed inference (tpu/packing.py): tokenize, first-fit-pack
+        examples into dense model rows, serve, gather per-example outputs
+        back into row order. Chunked by EXAMPLE count before packing so both
+        the packed-row and example dims fit the bucket grid."""
+        from arkflow_tpu.tpu.packing import pack_tokens
+
+        def tokenize_and_pack() -> list[dict[str, np.ndarray]]:
+            # host-side Python/numpy loops: off the event loop, like the
+            # runner's own _prep, so a big batch never stalls other streams
+            texts = batch.to_binary(self.text_field)
+            ids, mask = self.tokenizer.encode_batch(texts, self.max_seq)
+            lengths = mask.sum(axis=1).astype(np.int64)
+            mb = self.runner.buckets.max_batch()
+            chunks = []
+            for i in range(0, len(texts), mb):
+                sub_len = lengths[i:i + mb]
+                sb = self.runner.buckets.seq_bucket(int(sub_len.max()) if len(sub_len) else 1)
+                pk = pack_tokens(ids[i:i + mb], sub_len, sb)
+                chunks.append({
+                    "input_ids": pk.input_ids,
+                    "segment_ids": pk.segment_ids,
+                    "position_ids": pk.position_ids,
+                    "example_row": pk.example_row,
+                    "example_pos": pk.example_pos,
+                })
+            return chunks
+
+        loop = asyncio.get_running_loop()
+        chunks = await loop.run_in_executor(None, tokenize_and_pack)
+        outs = await asyncio.gather(*[self.runner.infer(c) for c in chunks])
+        if len(outs) == 1:
+            return outs[0]
+        return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
 
 
 @register_processor("tpu_inference")
@@ -143,6 +186,7 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
     if mesh_cfg:
         mesh_spec = MeshSpec(dp=int(mesh_cfg.get("dp", 1)), tp=int(mesh_cfg.get("tp", 1)),
                              sp=int(mesh_cfg.get("sp", 1)))
+    packing = bool(config.get("packing", False))
     runner = ModelRunner(
         model,
         config.get("model_config"),
@@ -153,6 +197,7 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         serving_dtype=config.get("serving_dtype"),
         max_in_flight=(int(config["max_in_flight"])
                        if config.get("max_in_flight") is not None else None),
+        packed=packing,
     )
     vocab = getattr(runner.cfg, "vocab_size", 30522)
     tokenizer = build_tokenizer(config.get("tokenizer"), vocab_size=vocab)
@@ -164,4 +209,5 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         max_seq=max_seq,
         outputs=config.get("outputs"),
         warmup=bool(config.get("warmup", False)),
+        packing=packing,
     )

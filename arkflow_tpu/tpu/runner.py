@@ -83,6 +83,7 @@ class ModelRunner:
         devices=None,
         serving_dtype: Optional[str] = None,
         max_in_flight: Optional[int] = None,
+        packed: bool = False,
     ):
         from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
 
@@ -100,7 +101,19 @@ class ModelRunner:
 
         self._flash_lock = threading.Lock()
         self.buckets = buckets or BucketPolicy()
-        self.spec = self.family.input_spec(self.cfg)
+        self.packed = packed
+        if packed:
+            # packed execution (tpu/packing.py): the family must publish a
+            # packed apply + its input spec; rows carry several examples, so
+            # flops/row tracks real token count instead of bucket padding
+            extras = self.family.extras or {}
+            if "apply_packed" not in extras:
+                raise ConfigError(
+                    f"model {model!r} has no packed execution support "
+                    "(family extras lack apply_packed/packed_input_spec)")
+            self.spec = extras["packed_input_spec"](self.cfg)
+        else:
+            self.spec = self.family.input_spec(self.cfg)
         if serving_dtype not in (None, "float32", "bfloat16", "float16", "int8"):
             raise ConfigError(
                 f"serving_dtype {serving_dtype!r} invalid "
@@ -167,7 +180,10 @@ class ModelRunner:
         self._build_jitted()
 
         reg = global_registry()
-        labels = {"model": model}
+        # packed runners get their own metric family: fill/padding have
+        # different semantics (token fill vs row fill), and sharing a
+        # reservoir with an unpacked runner would mix the distributions
+        labels = {"model": model, **({"packed": "1"} if packed else {})}
         self.m_infer = reg.histogram("arkflow_tpu_infer_seconds", "device step latency", labels)
         self.m_rows = reg.counter("arkflow_tpu_rows_total", "rows inferred", labels)
         self.m_pad = reg.counter("arkflow_tpu_pad_rows_total", "padding rows (waste)", labels)
@@ -176,6 +192,10 @@ class ModelRunner:
             buckets=[0.125, 0.25, 0.5, 0.75, 0.9, 1.0],
         )
         self.m_compiles = reg.counter("arkflow_tpu_compiles_total", "bucket compiles", labels)
+        self.m_exec_rows = reg.counter(
+            "arkflow_tpu_exec_rows_total",
+            "bucket rows dispatched to the device, padding included (the "
+            "honest FLOPs denominator; rows_total counts true examples)", labels)
         self.m_inflight = reg.gauge(
             "arkflow_tpu_steps_inflight", "device steps dispatched, not yet complete", labels)
         self.m_busy_s = reg.counter(
@@ -185,6 +205,7 @@ class ModelRunner:
             "arkflow_tpu_infeed_stall_seconds_total",
             "wall seconds the device sat idle between steps (host-bound)", labels)
         self._seen_shapes: set[tuple] = set()
+        self._in_warmup = False
         #: device queue depth. 2 = double buffering (prep/dispatch n+1
         #: overlaps compute of n) — enough when dispatch latency ~ 0. Over
         #: a remote/tunneled backend each step also pays a dispatch+sync
@@ -254,7 +275,8 @@ class ModelRunner:
         executables on the function object, so any cfg change that alters
         tracing (e.g. disabling flash attention) must rebuild — mutating
         self.cfg alone would keep serving stale executables for seen shapes."""
-        apply_fn = self.family.apply
+        apply_fn = (self.family.extras["apply_packed"] if self.packed
+                    else self.family.apply)
         # thread mesh/axes into families whose apply understands sharded
         # execution (e.g. decoder ring attention); others get plain calls
         import inspect
@@ -302,8 +324,45 @@ class ModelRunner:
 
     # -- shape plumbing ----------------------------------------------------
 
+    def _pad_inputs_packed(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
+        """Pad a packed layout (tpu/packing.py): [P, S] row arrays pad P to a
+        batch bucket (dead rows: segment 0), [E] example-index arrays pad E
+        to its own batch bucket (they point at row 0/pos 0, sliced off by the
+        true-count return). Fill metric reports TOKEN fill — the quantity
+        packing exists to maximize."""
+        p = inputs["input_ids"].shape[0]
+        e = inputs["example_row"].shape[0]
+        mb = self.buckets.max_batch()
+        if p > mb or e > mb:
+            raise ConfigError(
+                f"packed batch ({p} rows / {e} examples) exceeds the largest "
+                f"bucket {mb}; pack at most max_batch examples per call")
+        pb = self.buckets.batch_bucket(p)
+        eb = self.buckets.batch_bucket(e)
+        out = {}
+        for name, (dtype, trailing) in self.spec.items():
+            arr = inputs.get(name)
+            if arr is None:
+                raise ConfigError(f"model {self.family.name!r} missing input {name!r}")
+            arr = np.asarray(arr, dtype=dtype)
+            if "seq" in trailing:
+                arr = pad_seq_dim(arr, self.buckets.seq_bucket(arr.shape[1]), axis=1)
+                arr = pad_batch_dim(arr, pb)
+            else:
+                arr = pad_batch_dim(arr, eb)
+            out[name] = arr
+        sb = out["input_ids"].shape[1]
+        true_tokens = int((np.asarray(inputs["segment_ids"]) > 0).sum())
+        if not self._in_warmup:  # warmup shapes are not traffic
+            self.m_pad.inc(pb - p)
+            self.m_fill.observe(true_tokens / (pb * sb) if pb * sb else 0.0)
+            self.m_exec_rows.inc(pb)
+        return out, e
+
     def _pad_inputs(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
         """Pad every input to its bucket; returns (padded, true_batch)."""
+        if self.packed:
+            return self._pad_inputs_packed(inputs)
         n = next(iter(inputs.values())).shape[0]
         bb = self.buckets.batch_bucket(n)
         out = {}
@@ -317,8 +376,10 @@ class ModelRunner:
                 arr = pad_seq_dim(arr, sb, axis=1)
             arr = pad_batch_dim(arr, bb)
             out[name] = arr
-        self.m_pad.inc(bb - n)
-        self.m_fill.observe(n / bb)
+        if not self._in_warmup:  # warmup shapes are not traffic
+            self.m_pad.inc(bb - n)
+            self.m_fill.observe(n / bb)
+            self.m_exec_rows.inc(bb)
         return out, n
 
     def _shape_key(self, padded: dict[str, np.ndarray]) -> tuple:
@@ -336,7 +397,9 @@ class ModelRunner:
 
         n_total = next(iter(inputs.values())).shape[0]
         mb = self.buckets.max_batch()
-        if n_total > mb:
+        if n_total > mb and not self.packed:
+            # (packed layouts can't be sliced uniformly — row and example
+            # dims differ; the packer pre-chunks, _pad_inputs_packed raises)
             chunks = [
                 self.infer_sync({k: v[i : i + mb] for k, v in inputs.items()})
                 for i in range(0, n_total, mb)
@@ -350,8 +413,9 @@ class ModelRunner:
             self.m_compiles.inc()
         t0 = time.perf_counter()
         out = jax.device_get(self._dispatch(padded))
-        self.m_infer.observe(time.perf_counter() - t0)
-        self.m_rows.inc(n)
+        if not self._in_warmup:  # warmup compiles are not traffic latency
+            self.m_infer.observe(time.perf_counter() - t0)
+            self.m_rows.inc(n)
         return {k: np.asarray(v)[:n] for k, v in out.items()}
 
     def _prep(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
@@ -426,7 +490,7 @@ class ModelRunner:
         loop = asyncio.get_running_loop()
         n_total = next(iter(inputs.values())).shape[0]
         mb = self.buckets.max_batch()
-        if n_total > mb:
+        if n_total > mb and not self.packed:
             # concurrent chunks: the in-flight semaphore bounds device queue
             # depth, so chunk n+1 preps/dispatches while chunk n computes
             # (serial awaits would idle the device between chunks)
@@ -461,17 +525,35 @@ class ModelRunner:
         return {k: np.asarray(v)[:n] for k, v in out.items()}
 
     def warmup(self, seq_lens: Optional[list[int]] = None) -> int:
-        """Precompile the bucket grid; returns number of executables built."""
+        """Precompile the bucket grid; returns number of executables built.
+
+        Packed mode warms every reachable (row-bucket, example-bucket) pair:
+        the row dim P lands in a smaller-or-equal bucket than the example dim
+        E (each packed row holds >= 1 example), so the upper-triangular grid
+        |B|(|B|+1)/2 x |S| covers all shapes packed traffic can produce —
+        full chunks (eb = max) and tail chunks alike. The persistent compile
+        cache makes this a one-time cost per host.
+        """
         count = 0
         has_seq = any("seq" in t for _, t in self.spec.values())
         seqs = seq_lens or (list(self.buckets.seq_buckets) if has_seq else [None])
-        for bb in self.buckets.batch_buckets:
-            for sl in seqs:
-                fake = {}
-                for name, (dtype, trailing) in self.spec.items():
-                    dims = tuple(sl if d == "seq" else d for d in trailing)
-                    fake[name] = np.zeros((bb, *dims), dtype=dtype)
-                self.infer_sync(fake)
-                count += 1
+        if self.packed:
+            pairs = [(pb, eb) for eb in self.buckets.batch_buckets
+                     for pb in self.buckets.batch_buckets if pb <= eb]
+        else:
+            pairs = [(bb, bb) for bb in self.buckets.batch_buckets]
+        self._in_warmup = True
+        try:
+            for pb, eb in pairs:
+                for sl in seqs:
+                    fake = {}
+                    for name, (dtype, trailing) in self.spec.items():
+                        lead = eb if self.packed and "seq" not in trailing else pb
+                        dims = tuple(sl if d == "seq" else d for d in trailing)
+                        fake[name] = np.zeros((lead, *dims), dtype=dtype)
+                    self.infer_sync(fake)
+                    count += 1
+        finally:
+            self._in_warmup = False
         logger.info("[%s] warmed %d bucket executables", self.family.name, count)
         return count

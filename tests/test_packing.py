@@ -1,0 +1,194 @@
+"""Token packing (tpu/packing.py): packer invariants, packed-vs-padded model
+parity, runner + processor wiring.
+
+The packed path must be an exact re-arrangement: same per-example outputs as
+padded execution, fewer model rows. Distributions mirror real streams (mixed
+short/long texts), not uniform lengths.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from arkflow_tpu.tpu.packing import PackedTokens, pack_tokens
+
+TINY_BERT = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4, "ffn": 64,
+             "max_positions": 64, "num_labels": 2}
+
+
+def _ragged(rng, n, smax, dist="mixed"):
+    """Realistic length mix: mostly short, a long tail."""
+    if dist == "mixed":
+        lengths = np.where(rng.rand(n) < 0.8,
+                           rng.randint(2, max(3, smax // 4), n),
+                           rng.randint(smax // 2, smax + 1, n))
+    else:
+        lengths = rng.randint(1, smax + 1, n)
+    ids = np.zeros((n, smax), np.int32)
+    for i, l in enumerate(lengths):
+        ids[i, :l] = rng.randint(1, 500, l)
+    return ids, lengths.astype(np.int64)
+
+
+def test_packer_places_every_token_once():
+    rng = np.random.RandomState(0)
+    ids, lengths = _ragged(rng, 64, 32)
+    pk = pack_tokens(ids, lengths, 32)
+    assert pk.num_examples == 64
+    assert pk.num_rows <= 64
+    # every example's tokens appear intact at its recorded coordinates
+    for i in range(64):
+        r, c, l = pk.example_row[i], pk.example_pos[i], lengths[i]
+        np.testing.assert_array_equal(pk.input_ids[r, c:c + l], ids[i, :l])
+        seg = pk.segment_ids[r, c:c + l]
+        assert (seg == seg[0]).all() and seg[0] > 0
+        np.testing.assert_array_equal(pk.position_ids[r, c:c + l], np.arange(l))
+    # total live tokens match, and dead positions are zeroed
+    assert (pk.segment_ids > 0).sum() == lengths.sum()
+    assert (pk.input_ids[pk.segment_ids == 0] == 0).all()
+
+
+def test_packer_segments_disjoint_within_row():
+    rng = np.random.RandomState(1)
+    ids, lengths = _ragged(rng, 40, 16)
+    pk = pack_tokens(ids, lengths, 16)
+    for r in range(pk.num_rows):
+        seg = pk.segment_ids[r]
+        live = seg[seg > 0]
+        # each segment id covers a contiguous run
+        for s in np.unique(live):
+            idx = np.where(seg == s)[0]
+            assert (np.diff(idx) == 1).all()
+
+
+def test_packer_beats_padding():
+    """On the mixed distribution FFD packing should at least halve rows."""
+    rng = np.random.RandomState(2)
+    ids, lengths = _ragged(rng, 256, 32)
+    pk = pack_tokens(ids, lengths, 32)
+    assert pk.num_rows <= 256 // 2
+    assert pk.fill_ratio > 0.7
+
+
+def test_packer_truncates_and_handles_empty():
+    ids = np.arange(1, 11, dtype=np.int32).reshape(1, 10)
+    pk = pack_tokens(ids, np.array([10]), 4)
+    np.testing.assert_array_equal(pk.input_ids[0, :4], [1, 2, 3, 4])
+    empty = pack_tokens(np.zeros((0, 4), np.int32), np.zeros((0,)), 4)
+    assert empty.num_rows == 0 and empty.num_examples == 0
+
+
+def test_apply_packed_matches_padded_apply():
+    """Per-example logits from packed execution must match unpacked rows."""
+    import jax
+
+    from arkflow_tpu.models import get_model
+
+    fam = get_model("bert_classifier")
+    cfg = fam.make_config(**TINY_BERT)
+    params = fam.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(3)
+    ids, lengths = _ragged(rng, 16, 24)
+    mask = (np.arange(24)[None, :] < lengths[:, None]).astype(np.int32)
+
+    ref = fam.apply(params, cfg, input_ids=ids, attention_mask=mask)
+    pk = pack_tokens(ids, lengths, 24)
+    got = fam.extras["apply_packed"](
+        params, cfg, input_ids=pk.input_ids, segment_ids=pk.segment_ids,
+        position_ids=pk.position_ids, example_row=pk.example_row,
+        example_pos=pk.example_pos)
+    np.testing.assert_allclose(np.asarray(ref["logits"]),
+                               np.asarray(got["logits"]), atol=3e-2)
+    np.testing.assert_array_equal(np.asarray(ref["label"]), np.asarray(got["label"]))
+
+
+def test_packed_runner_matches_padded_runner():
+    from arkflow_tpu.tpu.bucketing import BucketPolicy
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    buckets = BucketPolicy((8, 16), (8, 16, 32))
+    padded = ModelRunner("bert_classifier", TINY_BERT, buckets=buckets)
+    packed = ModelRunner("bert_classifier", TINY_BERT, buckets=buckets, packed=True)
+    rng = np.random.RandomState(4)
+    ids, lengths = _ragged(rng, 16, 24)
+    mask = (np.arange(24)[None, :] < lengths[:, None]).astype(np.int32)
+    a = padded.infer_sync({"input_ids": ids, "attention_mask": mask})
+
+    pk = pack_tokens(ids, lengths, 32)
+    b = packed.infer_sync({
+        "input_ids": pk.input_ids, "segment_ids": pk.segment_ids,
+        "position_ids": pk.position_ids, "example_row": pk.example_row,
+        "example_pos": pk.example_pos,
+    })
+    assert len(b["label"]) == 16  # E examples out, not P rows
+    np.testing.assert_allclose(a["logits"], b["logits"], atol=3e-2)
+    np.testing.assert_array_equal(a["label"], b["label"])
+
+
+def test_packed_runner_rejects_unsupported_family():
+    from arkflow_tpu.errors import ConfigError
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    with pytest.raises(ConfigError, match="packed"):
+        ModelRunner("lstm_ae", {"features": 4, "hidden": 8, "window": 16},
+                    packed=True)
+
+
+def test_tpu_inference_processor_packing_end_to_end():
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
+
+    ensure_plugins_loaded()
+    texts = [b"short", b"a much longer payload with many more words in it " * 3,
+             b"mid size text here", b"x"] * 8
+    cfg = {
+        "type": "tpu_inference",
+        "model": "bert_classifier",
+        "model_config": TINY_BERT,
+        "max_seq": 32,
+        "batch_buckets": [8, 16],
+        "seq_buckets": [8, 16, 32],
+        "packing": True,
+        "outputs": ["label", "score"],
+    }
+    proc = build_component("processor", cfg, Resource())
+    batch = MessageBatch.from_pydict({"__value__": texts})
+    out = asyncio.run(proc.process(batch))[0]
+    assert out.num_rows == len(texts)
+    assert set(out.record_batch.schema.names) >= {"label", "score"}
+
+    # parity with the unpacked processor on identical inputs
+    cfg2 = dict(cfg)
+    cfg2.pop("packing")
+    plain = build_component("processor", cfg2, Resource())
+    ref = asyncio.run(plain.process(MessageBatch.from_pydict({"__value__": texts})))[0]
+    np.testing.assert_array_equal(
+        out.column("label").to_pylist(), ref.column("label").to_pylist())
+
+
+def test_packed_chunking_splits_by_example_count():
+    """More examples than max_batch: the processor pre-chunks; outputs stay
+    aligned to input row order."""
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
+
+    ensure_plugins_loaded()
+    rng = np.random.RandomState(5)
+    texts = [bytes("w%d " % rng.randint(100), "ascii") * rng.randint(1, 6)
+             for _ in range(40)]
+    cfg = {
+        "type": "tpu_inference",
+        "model": "bert_classifier",
+        "model_config": TINY_BERT,
+        "max_seq": 16,
+        "batch_buckets": [16],
+        "seq_buckets": [16],
+        "packing": True,
+        "outputs": ["label"],
+    }
+    proc = build_component("processor", cfg, Resource())
+    out = asyncio.run(proc.process(MessageBatch.from_pydict({"__value__": texts})))[0]
+    assert out.num_rows == 40
