@@ -12,8 +12,10 @@ ref: crates/arkflow-plugin/src/input/kafka.rs):
   UNSUPPORTED_COMPRESSION_TYPE, per KIP-110's version floors.
 - FindCoordinator v0 (cached per group) + OffsetCommit v2 / OffsetFetch v1
 - Consumer groups: JoinGroup v2 / SyncGroup v1 / Heartbeat v1 / LeaveGroup v1
-  with the 'range' assignor; commits carry generation/member so fenced members
-  fail fast. Static partition lists bypass the group protocol entirely.
+  with the 'range' and 'cooperative-sticky' (KIP-429 incremental rebalance,
+  Subscription v1 owned_partitions) assignors; commits carry generation/member
+  so fenced members fail fast. Static partition lists bypass the group
+  protocol entirely.
 - SASL PLAIN (SaslHandshake v1 + SaslAuthenticate v0) and TLS.
 
 One connection per broker node, requests serialised per connection with
@@ -480,21 +482,29 @@ class JoinResult:
     leader_id: str
     protocol: str
     members: dict[str, list[str]]  # member_id -> subscribed topics (leader only)
+    #: member_id -> topic -> owned partitions (leader only; Subscription v1
+    #: owned_partitions, the KIP-429 cooperative-rebalance input)
+    member_owned: dict[str, dict[str, list[int]]] = field(default_factory=dict)
 
     @property
     def is_leader(self) -> bool:
         return self.member_id == self.leader_id
 
 
-def encode_subscription(topics: list[str]) -> bytes:
-    """ConsumerProtocolSubscription v0: version, topics, user_data."""
-    return (
-        Writer()
-        .i16(0)
-        .array(sorted(topics), lambda w, t: w.string(t))
-        .bytes_(None)
-        .build()
-    )
+def encode_subscription(topics: list[str],
+                        owned: Optional[dict[str, list[int]]] = None) -> bytes:
+    """ConsumerProtocolSubscription: v0 (version, topics, user_data), or v1
+    with ``owned_partitions`` appended (KIP-429 — what cooperative assignors
+    read to keep partitions sticky across rebalances)."""
+    w = Writer().i16(1 if owned is not None else 0)
+    w.array(sorted(topics), lambda w2, t: w2.string(t))
+    w.bytes_(None)
+    if owned is not None:
+        w.array(
+            sorted(owned.items()),
+            lambda w2, kv: w2.string(kv[0]).array(sorted(kv[1]), lambda w3, p: w3.i32(p)),
+        )
+    return w.build()
 
 
 def decode_subscription(data: bytes) -> list[str]:
@@ -504,6 +514,27 @@ def decode_subscription(data: bytes) -> list[str]:
     r.i16()  # version
     n = r.i32()
     return [r.string() for _ in range(max(0, n))]
+
+
+def decode_subscription_owned(data: bytes) -> dict[str, list[int]]:
+    """The v1 owned_partitions block ({} for v0 or absent)."""
+    if not data:
+        return {}
+    r = Reader(data)
+    version = r.i16()
+    n = r.i32()
+    for _ in range(max(0, n)):
+        r.string()
+    r.bytes_()  # user_data
+    if version < 1 or r.remaining() <= 0:
+        return {}
+    out: dict[str, list[int]] = {}
+    k = r.i32()
+    for _ in range(max(0, k)):
+        topic = r.string()
+        m = r.i32()
+        out[topic] = [r.i32() for _ in range(max(0, m))]
+    return out
 
 
 def encode_assignment(assignment: dict[str, list[int]]) -> bytes:
@@ -548,6 +579,88 @@ def range_assign(members: dict[str, list[str]],
             if count:
                 out[mid].setdefault(topic, []).extend(parts[start : start + count])
             start += count
+    return out
+
+
+def cooperative_sticky_assign(
+    members: dict[str, list[str]],
+    owned: dict[str, dict[str, list[int]]],
+    topic_partitions: dict[str, list[int]],
+) -> dict[str, dict[str, list[int]]]:
+    """The 'cooperative-sticky' assignor (KIP-429 incremental rebalance).
+
+    Stickiness: every validly-owned partition stays with its owner, then the
+    pool is balanced (new/unowned partitions to the least-loaded subscriber;
+    overloaded owners shed their excess). The COOPERATIVE rule: a partition
+    migrating from member A to member B is assigned to NOBODY this
+    generation — A notices the revocation in its synced assignment, drops the
+    partition, and rejoins; the follow-up rebalance (A no longer claims it)
+    hands it to B. Members keep fetching their retained partitions throughout
+    — no stop-the-world revoke like the classic eager protocol.
+    """
+    # validate ownership claims: partition exists, owner still subscribed,
+    # claimed exactly once (double claims invalidate both, like Java). ALL
+    # claims — valid or not — are remembered: a partition some member still
+    # believes it owns must go through a revoke round before anyone else may
+    # fetch it, or two generations-valid members overlap (no-overlap is the
+    # KIP-429 invariant)
+    owner: dict[tuple[str, int], str] = {}
+    claims: dict[tuple[str, int], set[str]] = {}
+    dupes: set[tuple[str, int]] = set()
+    for mid, tps in owned.items():
+        if mid not in members:
+            continue
+        for t, ps in tps.items():
+            for p in ps:
+                key = (t, p)
+                claims.setdefault(key, set()).add(mid)
+                if key in owner or key in dupes:
+                    owner.pop(key, None)
+                    dupes.add(key)
+                    continue
+                if t in members[mid] and p in topic_partitions.get(t, []):
+                    owner[key] = mid
+
+    target = dict(owner)
+    load = {mid: 0 for mid in members}
+    for mid in target.values():
+        load[mid] += 1
+    # unowned partitions -> least-loaded subscriber (member-id tiebreak)
+    for t, ps in sorted(topic_partitions.items()):
+        subs = sorted(m for m, ts in members.items() if t in ts)
+        if not subs:
+            continue
+        for p in sorted(ps):
+            if (t, p) not in target:
+                m = min(subs, key=lambda x: (load[x], x))
+                target[(t, p)] = m
+                load[m] += 1
+    # balance: move from overloaded to underloaded while the gap exceeds 1
+    while True:
+        moved = False
+        for key in sorted(target):
+            t = key[0]
+            a = target[key]
+            subs = [m for m, ts in members.items() if t in ts and m != a]
+            if not subs:
+                continue
+            b = min(sorted(subs), key=lambda x: (load[x], x))
+            if load[a] > load[b] + 1:
+                target[key] = b
+                load[a] -= 1
+                load[b] += 1
+                moved = True
+        if not moved:
+            break
+
+    out: dict[str, dict[str, list[int]]] = {mid: {} for mid in members}
+    for (t, p), mid in sorted(target.items()):
+        if claims.get((t, p), set()) - {mid}:
+            # someone other than the target still claims it (migration,
+            # double claim, or stale owner): withheld until every claimant
+            # has seen the revocation and rejoined without it
+            continue
+        out[mid].setdefault(t, []).append(p)
     return out
 
 
@@ -842,11 +955,22 @@ class KafkaClient:
 
     async def join_group(self, group: str, topics: list[str], member_id: str = "",
                          session_timeout_ms: int = 10000,
-                         rebalance_timeout_ms: int = 30000) -> "JoinResult":
-        """JoinGroup v2 with the 'range' consumer protocol. Returns the
-        coordinator's decision; when this member is the leader,
-        ``members`` holds every member's subscribed topics."""
-        meta = encode_subscription(topics)
+                         rebalance_timeout_ms: int = 30000,
+                         assignors: tuple[str, ...] = ("range",),
+                         owned: Optional[dict[str, list[int]]] = None) -> "JoinResult":
+        """JoinGroup v2 offering ``assignors`` in preference order (the broker
+        picks the first protocol every member supports — listing
+        ("cooperative-sticky", "range") upgrades in place like the Java
+        client, falling back to eager range in mixed fleets). For
+        cooperative-sticky the subscription carries ``owned`` partitions
+        (Subscription v1, KIP-429). When this member is the leader,
+        ``members``/``member_owned`` hold every member's subscription."""
+        protocols = [
+            (name,
+             encode_subscription(topics,
+                                 owned if name == "cooperative-sticky" else None))
+            for name in assignors
+        ]
         body = (
             Writer()
             .string(group)
@@ -854,7 +978,7 @@ class KafkaClient:
             .i32(rebalance_timeout_ms)
             .string(member_id)
             .string("consumer")
-            .array([("range", meta)], lambda w, p: w.string(p[0]).bytes_(p[1]))
+            .array(protocols, lambda w, p: w.string(p[0]).bytes_(p[1]))
             .build()
         )
         conn = await self._coordinator_conn(group)
@@ -867,18 +991,20 @@ class KafkaClient:
         leader = r.string()
         my_id = r.string()
         members: dict[str, list[str]] = {}
+        member_owned: dict[str, dict[str, list[int]]] = {}
         n = r.i32()
         for _ in range(max(0, n)):
             mid = r.string()
             mmeta = r.bytes_() or b""
             members[mid] = decode_subscription(mmeta)
+            member_owned[mid] = decode_subscription_owned(mmeta)
         if err == ERR_UNKNOWN_MEMBER_ID and member_id:
             raise GroupRebalance(err)  # retry with a fresh member id
         if err != 0:
             raise KafkaProtocolError("join_group", err)
         return JoinResult(generation=generation, member_id=my_id,
                           leader_id=leader, protocol=protocol or "range",
-                          members=members)
+                          members=members, member_owned=member_owned)
 
     async def sync_group(self, group: str, generation: int, member_id: str,
                          assignments: Optional[dict[str, dict[str, list[int]]]] = None

@@ -9,10 +9,14 @@ from the committed offset.
 
 Partition assignment: when ``partitions`` is configured the consumer is
 static (simple-consumer offsets). Otherwise it joins the consumer group
-dynamically — JoinGroup/SyncGroup with the 'range' assignor, background
-heartbeats, automatic rejoin on rebalance, offset commits fenced by
-generation/member id — so multiple engine instances share the topic the same
-way librdkafka consumers do.
+dynamically — JoinGroup/SyncGroup, background heartbeats, automatic rejoin on
+rebalance, offset commits fenced by generation/member id — so multiple engine
+instances share the topic the same way librdkafka consumers do. The default
+assignor preference is cooperative-sticky then range (like a Java client
+mid-upgrade): under cooperative-sticky a rebalance is INCREMENTAL (KIP-429) —
+retained partitions keep fetching from their in-memory positions (no
+re-fetch, no stop-the-world), only revoked ones stop (followed by the
+protocol's second join round so the new owner can pick them up).
 
 Config:
 
@@ -23,6 +27,7 @@ Config:
     partitions: [0, 1]        # optional; default all
     start: earliest           # earliest | latest (when no committed offset)
     batch_size: 500           # max records per read
+    assignor: cooperative-sticky,range   # preference order; 'range' forces eager
     codec: json               # optional; raw __value__ otherwise
 """
 
@@ -45,6 +50,7 @@ from arkflow_tpu.connect.kafka_client import (
     KafkaClient,
     KafkaProtocolError,
     client_kwargs_from_config,
+    cooperative_sticky_assign,
     range_assign,
 )
 from arkflow_tpu.errors import ConfigError, Disconnection, EndOfInput
@@ -92,9 +98,17 @@ SESSION_TIMEOUT_MS = 10000
 class KafkaInput(Input):
     def __init__(self, brokers: str, topic: str, group: str,
                  partitions: Optional[list[int]], start: str, batch_size: int, codec=None,
-                 client_kwargs: Optional[dict] = None):
+                 client_kwargs: Optional[dict] = None,
+                 assignors: tuple[str, ...] = ("cooperative-sticky", "range")):
         if start not in ("earliest", "latest"):
             raise ConfigError("kafka input 'start' must be earliest|latest")
+        for a in assignors:
+            if a not in ("cooperative-sticky", "range"):
+                raise ConfigError(
+                    f"kafka assignor {a!r} unsupported (cooperative-sticky|range)")
+        if not assignors:
+            raise ConfigError("kafka input needs at least one assignor")
+        self.assignors = tuple(assignors)
         self.brokers = brokers
         self.topic = topic
         self.group = group
@@ -157,15 +171,24 @@ class KafkaInput(Input):
         member = self._member_id
         while not self._closed:
             try:
+                cooperative_offered = "cooperative-sticky" in self.assignors
                 res = await self._client.join_group(
                     self.group, [self.topic], member,
                     session_timeout_ms=SESSION_TIMEOUT_MS,
+                    assignors=self.assignors,
+                    owned=({self.topic: list(self._rr)}
+                           if cooperative_offered else None),
                 )
+                cooperative = res.protocol == "cooperative-sticky"
                 if res.is_leader:
                     union = sorted({t for ts in res.members.values() for t in ts})
                     await self._client.refresh_metadata(union)
                     topic_parts = {t: self._client.partitions(t) for t in union}
-                    assignments = range_assign(res.members, topic_parts)
+                    if cooperative:
+                        assignments = cooperative_sticky_assign(
+                            res.members, res.member_owned, topic_parts)
+                    else:
+                        assignments = range_assign(res.members, topic_parts)
                     mine = await self._client.sync_group(
                         self.group, res.generation, res.member_id, assignments
                     )
@@ -176,14 +199,37 @@ class KafkaInput(Input):
                 self._generation = res.generation
                 self._member_id = res.member_id
                 parts = sorted(mine.get(self.topic, []))
-                self._rr = parts
-                self._offsets = {}
-                if parts:
-                    await self._load_offsets(parts)
+                revoked: set[int] = set()
+                if cooperative and self._joined:
+                    # KIP-429 incremental adoption: retained partitions keep
+                    # their in-memory fetch positions (no offset re-fetch, no
+                    # pause); only the delta changes
+                    old = set(self._rr)
+                    revoked = old - set(parts)
+                    added = sorted(set(parts) - old)
+                    for p in revoked:
+                        self._offsets.pop(p, None)
+                    self._rr = parts
+                    if added:
+                        await self._load_offsets(added)
+                else:
+                    self._rr = parts
+                    self._offsets = {}
+                    if parts:
+                        await self._load_offsets(parts)
                 self._rejoin_needed.clear()
                 self._joined = True
-                logger.info("kafka group %s gen %d: member %s assigned %s",
-                            self.group, self._generation, self._member_id, parts)
+                logger.info("kafka group %s gen %d (%s): member %s assigned %s",
+                            self.group, self._generation, res.protocol,
+                            self._member_id, parts)
+                if cooperative and revoked:
+                    # second phase: having revoked, rejoin immediately so the
+                    # leader can hand the withheld partitions to their new
+                    # owner (we no longer claim them)
+                    logger.info("kafka group %s: revoked %s, rejoining",
+                                self.group, sorted(revoked))
+                    member = self._member_id
+                    continue
                 return
             except GroupRebalance as e:
                 if e.code == ERR_UNKNOWN_MEMBER_ID:
@@ -316,4 +362,8 @@ def _build(config: dict, resource: Resource) -> KafkaInput:
         batch_size=int(config.get("batch_size", 500)),
         codec=build_codec(config.get("codec"), resource),
         client_kwargs=client_kwargs_from_config(config),
+        assignors=tuple(
+            a.strip()
+            for a in str(config.get("assignor", "cooperative-sticky,range")).split(",")
+            if a.strip()),
     )

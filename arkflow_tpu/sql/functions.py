@@ -149,6 +149,90 @@ def _json_to_str(v):
     return str(v)
 
 
+def _split(args, n):
+    """split(text, sep) -> list<string> column (VRL's split; Arrow-native)."""
+    return pc.split_pattern(as_array(args[0], n), pattern=str(args[1]))
+
+
+def _join(args, n):
+    """join(list, sep) -> string column (VRL's join over split output)."""
+    return pc.binary_join(as_array(args[0], n), str(args[1]))
+
+
+def _list_get(args, n):
+    """list_get(list, i) -> element i (0-based; out-of-range/null -> NULL,
+    VRL's indexing semantics rather than an error)."""
+    arr = as_array(args[0], n)
+    idx = args[1]
+    if isinstance(idx, pa.Array):
+        raise UnsupportedSql("list index must be a literal")
+    idx = int(idx)
+    lens = pc.list_value_length(arr)
+    # guard: pc.list_element errors on out-of-range, VRL yields null — mask
+    # short lists to empty via a validity filter built row-wise only when
+    # some row is short (common case stays fully vectorized)
+    ok = pc.fill_null(pc.greater(lens, idx), False)
+    if idx >= 0 and bool(pc.min(ok).as_py() if n else True):
+        return pc.list_element(arr, idx)
+    out = []
+    for v in arr:
+        pv = v.as_py()
+        out.append(pv[idx] if pv is not None and -len(pv) <= idx < len(pv) else None)
+    # pin the element type: an all-out-of-range batch must not flip the
+    # column to null-type (schema stability, like _json_get's contract)
+    return pa.array(out, type=arr.type.value_type)
+
+
+def _merge(args, n):
+    """merge(a, b) -> shallow-merged JSON object text (b's keys win), the
+    columnar form of VRL's object merge (ref vrl.rs runtime): operands are
+    JSON text columns (e.g. raw payloads); non-object/invalid rows -> NULL."""
+    a, b = as_array(args[0], n), as_array(args[1], n)
+
+    def load(v):
+        pv = v.as_py()
+        if pv is None:
+            return None
+        if isinstance(pv, bytes):
+            pv = pv.decode("utf-8", "replace")
+        try:
+            doc = json.loads(pv)
+        except (ValueError, TypeError):
+            return None
+        return doc if isinstance(doc, dict) else None
+
+    out = []
+    for va, vb in zip(a, b):
+        da, db = load(va), load(vb)
+        if da is None and db is None:
+            out.append(None)
+        else:
+            out.append(json.dumps({**(da or {}), **(db or {})}))
+    return pa.array(out, type=pa.string())
+
+
+def _encode_json(args, n):
+    """encode_json(x) -> JSON text per row: lists/structs/scalars serialize,
+    NULL stays NULL (VRL's encode_json; row-wise host pass, not hot-path)."""
+    arr = as_array(args[0], n)
+
+    def debytes(pv):
+        # bytes can hide anywhere (binary columns split to list<binary>):
+        # decode recursively or json.dumps raises and kills the batch
+        if isinstance(pv, bytes):
+            return pv.decode("utf-8", "replace")
+        if isinstance(pv, list):
+            return [debytes(x) for x in pv]
+        if isinstance(pv, dict):
+            return {debytes(k): debytes(v) for k, v in pv.items()}
+        return pv
+
+    def enc(pv):
+        return None if pv is None else json.dumps(debytes(pv), default=str)
+
+    return pa.array([enc(v.as_py()) for v in arr], type=pa.string())
+
+
 def _mod(args, n):
     a, b = as_array(args[0], n), as_array(args[1], n)
     return pc.subtract(a, pc.multiply(pc.cast(pc.floor(pc.divide(pc.cast(a, pa.float64()), pc.cast(b, pa.float64()))), b.type), b))
@@ -198,6 +282,13 @@ _BUILTINS: dict[str, ScalarFn] = {
     "lpad": lambda args, n: pc.utf8_lpad(as_array(args[0], n), width=int(args[1]), padding=str(args[2]) if len(args) > 2 else " "),
     "rpad": lambda args, n: pc.utf8_rpad(as_array(args[0], n), width=int(args[1]), padding=str(args[2]) if len(args) > 2 else " "),
     "split_part": _split_part,
+    # list / object tier (VRL split/join/merge/encode_json on Arrow columns)
+    "split": _split,
+    "join": _join,
+    "array_join": _join,
+    "list_get": _list_get,
+    "merge": _merge,
+    "encode_json": _encode_json,
     # null handling / misc
     "coalesce": _coalesce,
     "ifnull": _coalesce,

@@ -30,6 +30,10 @@ Supported surface:
   upcase/downcase/trim/replace/length/contains/starts_with/ends_with/
   slice/truncate, round/abs/floor/ceil, md5/sha2, match,
   parse_timestamp/format_timestamp, now, exists/is_null, coalesce)
+- the list/object tier: ``split`` (Arrow list column), ``join``, postfix
+  indexing ``split(.x, ",")[0]`` (negative from the end, out-of-range ->
+  null), ``merge`` (shallow JSON object merge, right wins) and
+  ``encode_json`` (ref vrl.rs:42-115 runs these in the embedded runtime)
 """
 
 from __future__ import annotations
@@ -66,7 +70,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>\d+\.\d+|\d+)
   | (?P<path>\.(?:[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)*)?)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*!?)
-  | (?P<op>\?\?|==|!=|<=|>=|&&|\|\||[-+*/%<>=!(){},;:])
+  | (?P<op>\?\?|==|!=|<=|>=|&&|\|\||[-+*/%<>=!(){},;:\[\]])
     """,
     re.VERBOSE,
 )
@@ -141,17 +145,18 @@ _FN = {
     "starts_with": "starts_with", "ends_with": "ends_with",
     "now": "now", "coalesce": "coalesce",
     "split_part": "split_part",
+    # list/object tier: Arrow list columns + row-wise JSON (functions.py)
+    "split": "split", "join": "join",
+    "merge": "merge", "encode_json": "encode_json",
 }
 
 # object-returning parsers: path access becomes an extra key argument
 _OBJECT_FNS = {"parse_json", "parse_url", "parse_key_value", "parse_regex"}
 
+# genuinely non-columnar constructs only (the list/object tier landed in r5:
+# split/join/merge/encode_json are real functions now)
 _UNSUPPORTED_HINTS = {
-    "split": "no list type in the columnar plan; use split_part(x, sep, n)",
-    "join": "no list type in the columnar plan",
-    "merge": "merge whole events with the json_to_arrow processor",
     "parse_syslog": "use parse_regex with a syslog pattern",
-    "encode_json": "use the arrow_to_json processor",
 }
 
 
@@ -392,6 +397,21 @@ class _Parser:
         return self._primary(env)
 
     def _primary(self, env) -> ast.Expr:
+        """An atom plus any postfix ``[i]`` list indexing (VRL's array
+        access; 0-based, negative from the end, out-of-range -> null)."""
+        e = self._atom(env)
+        while self.accept_op("["):
+            neg = self.accept_op("-") is not None
+            t = self.next()
+            if t.kind != "number" or "." in t.value:
+                raise VrlCompileError(
+                    f"vrl: list index must be an integer literal at {t.pos}")
+            self.expect_op("]")
+            idx = -int(t.value) if neg else int(t.value)
+            e = ast.Func("list_get", (e, ast.Literal(idx)))
+        return e
+
+    def _atom(self, env) -> ast.Expr:
         t = self.next()
         if t.kind == "number":
             return ast.Literal(float(t.value) if "." in t.value else int(t.value))

@@ -72,12 +72,15 @@ class FakeKafkaBroker:
             }
         return g
 
-    async def _coordinator_join(self, group, member_id, meta):
+    async def _coordinator_join(self, group, member_id, metas):
+        """``metas``: protocol name -> subscription bytes, in the member's
+        preference order. The coordinator picks the first protocol every
+        member offered (real-broker selection rule)."""
         g = self._group(group)
         if not member_id:
             g["member_seq"] += 1
             member_id = f"m{g['member_seq']}"
-        g["pending"][member_id] = meta
+        g["pending"][member_id] = metas
         g["state"] = "rebalancing"
         fut = asyncio.get_running_loop().create_future()
         g["join_waiters"].append((member_id, fut))
@@ -88,13 +91,23 @@ class FakeKafkaBroker:
                 g["members"] = dict(g["pending"])
                 g["pending"] = {}
                 g["leader"] = sorted(g["members"])[0]
+                # first commonly-supported protocol, by join preference order
+                proto = "range"
+                for cand in g["members"][g["leader"]]:
+                    if all(cand in m for m in g["members"].values()):
+                        proto = cand
+                        break
+                g["protocol"] = proto
                 g["assignments"] = {}
                 g["sync_event"] = asyncio.Event()
                 g["state"] = "awaiting_sync"
                 waiters, g["join_waiters"] = g["join_waiters"], []
                 for mid, f in waiters:
                     if not f.done():
-                        f.set_result((g["generation"], g["leader"], mid, dict(g["members"])))
+                        f.set_result((
+                            g["generation"], g["leader"], mid, proto,
+                            {m: metas_m.get(proto, b"")
+                             for m, metas_m in g["members"].items()}))
             g["window_task"] = asyncio.get_running_loop().create_task(finalize())
         return await fut
 
@@ -155,9 +168,9 @@ class FakeKafkaBroker:
             for _ in range(max(0, n)):
                 name = r.string()
                 metas[name] = r.bytes_() or b""
-            gen, leader, mid, members = await self._coordinator_join(
-                group, member_id, metas.get("range", b""))
-            w = Writer().i32(0).i16(0).i32(gen).string("range").string(leader).string(mid)
+            gen, leader, mid, proto, members = await self._coordinator_join(
+                group, member_id, metas)
+            w = Writer().i32(0).i16(0).i32(gen).string(proto).string(leader).string(mid)
             member_list = sorted(members.items()) if mid == leader else []
             w.array(member_list, lambda w2, kv: w2.string(kv[0]).bytes_(kv[1]))
             return w.build()
@@ -549,8 +562,12 @@ def test_kafka_consumer_group_rebalance():
             c2 = build_component("input", {"type": "kafka", "brokers": brokers,
                                            "topic": "t", "group": "g"}, Resource())
             await c2.connect()  # triggers a rebalance round; c1's heartbeat rejoins
-            for _ in range(100):
-                if c1._generation > gen1 and not c1._rejoin_needed.is_set():
+            # cooperative-sticky converges over TWO rounds (revoke, then
+            # reassign): wait until the split is complete, not just gen+1
+            for _ in range(200):
+                if (sorted(c1._rr + c2._rr) == [0, 1]
+                        and not c1._rejoin_needed.is_set()
+                        and not c2._rejoin_needed.is_set()):
                     break
                 await asyncio.sleep(0.05)
             assert c1._generation > gen1
@@ -770,3 +787,142 @@ def test_snappy_decode_accepts_raw_and_xerial():
     blob = b"payload " * 100
     assert snappy_decode(snappy_block_compress(blob)) == blob
     assert snappy_decode(snappy_encode(blob)) == blob
+
+
+def test_cooperative_sticky_assignor_unit():
+    from arkflow_tpu.connect.kafka_client import cooperative_sticky_assign
+
+    # fresh group: balanced like any assignor
+    out = cooperative_sticky_assign(
+        {"a": ["t"], "b": ["t"]}, {}, {"t": [0, 1, 2, 3]})
+    assert sorted(out["a"]["t"] + out["b"]["t"]) == [0, 1, 2, 3]
+    assert abs(len(out["a"]["t"]) - len(out["b"]["t"])) <= 1
+
+    # b joins a group where a owns everything: migrating partitions are
+    # withheld this round (assigned to nobody), a keeps its retained ones
+    out = cooperative_sticky_assign(
+        {"a": ["t"], "b": ["t"]}, {"a": {"t": [0, 1, 2, 3]}}, {"t": [0, 1, 2, 3]})
+    assert len(out["a"]["t"]) == 2          # kept half
+    assert out["b"] == {}                   # withheld, not yet b's
+    # follow-up round: a no longer claims the revoked ones -> b gets them
+    out2 = cooperative_sticky_assign(
+        {"a": ["t"], "b": ["t"]}, {"a": {"t": out["a"]["t"]}}, {"t": [0, 1, 2, 3]})
+    assert sorted(out2["a"]["t"]) == sorted(out["a"]["t"])  # sticky
+    assert sorted(out2["b"]["t"]) == sorted(
+        set([0, 1, 2, 3]) - set(out["a"]["t"]))
+
+    # double-claimed partition: withheld while BOTH claimants still believe
+    # they own it (no-overlap invariant); assigned once the claims drop
+    out = cooperative_sticky_assign(
+        {"a": ["t"], "b": ["t"]}, {"a": {"t": [0]}, "b": {"t": [0]}}, {"t": [0]})
+    assert out["a"].get("t", []) == [] and out["b"].get("t", []) == []
+    out2 = cooperative_sticky_assign({"a": ["t"], "b": ["t"]}, {}, {"t": [0]})
+    assert sorted(out2["a"].get("t", []) + out2["b"].get("t", [])) == [0]
+
+    # owner that unsubscribed: still withheld until its claim drops (it may
+    # be fetching), then lands on the subscriber
+    out = cooperative_sticky_assign(
+        {"a": ["other"], "b": ["t"]}, {"a": {"t": [0]}}, {"t": [0], "other": []})
+    assert out["b"].get("t", []) == []
+    out2 = cooperative_sticky_assign(
+        {"a": ["other"], "b": ["t"]}, {}, {"t": [0], "other": []})
+    assert out2["b"]["t"] == [0]
+
+
+def test_subscription_v1_owned_roundtrip():
+    from arkflow_tpu.connect.kafka_client import (
+        decode_subscription, decode_subscription_owned, encode_subscription)
+
+    v0 = encode_subscription(["t"])
+    assert decode_subscription(v0) == ["t"]
+    assert decode_subscription_owned(v0) == {}
+    v1 = encode_subscription(["t", "u"], owned={"t": [2, 0], "u": []})
+    assert decode_subscription(v1) == ["t", "u"]
+    assert decode_subscription_owned(v1) == {"t": [0, 2], "u": []}
+
+
+def test_cooperative_rebalance_keeps_positions_without_refetch():
+    """KIP-429 end-to-end: when a second consumer joins, the first KEEPS its
+    retained partition's in-memory fetch position — no offset re-fetch, no
+    replay — while the revoked partition moves to the newcomer."""
+    from arkflow_tpu.plugins.input import kafka as kafka_mod
+
+    async def go():
+        broker = FakeKafkaBroker({"t": 2})
+        broker.JOIN_WINDOW_S = 0.4
+        await broker.start()
+        orig_hb = kafka_mod.HEARTBEAT_INTERVAL_S
+        kafka_mod.HEARTBEAT_INTERVAL_S = 0.05
+        brokers = f"127.0.0.1:{broker.port}"
+        try:
+            prod = KafkaClient(brokers)
+            await prod.connect()
+            await prod.refresh_metadata(["t"])
+            for p in (0, 1):
+                await prod.produce("t", p, [(None, b"x"), (None, b"y"), (None, b"z")])
+            await prod.close()
+
+            c1 = build_component("input", {"type": "kafka", "brokers": brokers,
+                                           "topic": "t", "group": "g"}, Resource())
+            await c1.connect()
+            assert c1._rr == [0, 1]
+            # advance both partitions in memory WITHOUT acking: positions are
+            # ahead of any committed offset, so a re-fetch would rewind them
+            got = set()
+            while got != {0, 1}:
+                batch, _ack = await asyncio.wait_for(c1.read(), timeout=5)
+                got.add(batch.get_meta("__meta_partition"))
+            positions_before = dict(c1._offsets)
+            assert all(v >= 3 for v in positions_before.values())
+
+            # count offset fetches per partition from here on
+            fetches = []
+            orig_fetch = c1._client.offset_fetch
+
+            async def counting_fetch(group, topic, p):
+                fetches.append(p)
+                return await orig_fetch(group, topic, p)
+
+            c1._client.offset_fetch = counting_fetch
+
+            c2 = build_component("input", {"type": "kafka", "brokers": brokers,
+                                           "topic": "t", "group": "g"}, Resource())
+            await c2.connect()
+            for _ in range(200):
+                if (sorted(c1._rr + c2._rr) == [0, 1]
+                        and not c1._rejoin_needed.is_set()
+                        and not c2._rejoin_needed.is_set()):
+                    break
+                await asyncio.sleep(0.05)
+            assert sorted(c1._rr + c2._rr) == [0, 1]
+            assert len(c1._rr) == 1 and len(c2._rr) == 1
+
+            kept = c1._rr[0]
+            # the retained partition kept its exact in-memory position...
+            assert c1._offsets[kept] == positions_before[kept]
+            # ...because it was never re-fetched from the coordinator
+            assert kept not in fetches
+            # and the revoked partition's position is gone from c1
+            revoked = ({0, 1} - {kept}).pop()
+            assert revoked not in c1._offsets
+            await c1.close()
+            await c2.close()
+        finally:
+            kafka_mod.HEARTBEAT_INTERVAL_S = orig_hb
+            await broker.stop()
+
+    asyncio.run(go())
+
+
+def test_assignor_config_range_forces_eager():
+    from arkflow_tpu.plugins.input.kafka import _build as build_kafka
+
+    inp = build_kafka({"brokers": "b", "topic": "t", "group": "g",
+                       "assignor": "range"}, Resource())
+    assert inp.assignors == ("range",)
+    import pytest as _pytest
+
+    from arkflow_tpu.errors import ConfigError as _CE
+    with _pytest.raises(_CE, match="assignor"):
+        build_kafka({"brokers": "b", "topic": "t", "group": "g",
+                     "assignor": "sticky-nonsense"}, Resource())
