@@ -182,3 +182,136 @@ def test_lz4_frame_checksums_detect_corruption():
     g[6] ^= 0x01  # header checksum byte
     with pytest.raises(ValueError):
         lz4_frame_decode(bytes(g))
+
+
+# ---------------------------------------------------------------------------
+# Golden framing vectors (VERDICT r4 item 8): byte blobs hand-derived from the
+# published format specs — snappy format description (literal + all three
+# copy element kinds, long-literal length extension), xerial/snappy-java
+# stream framing, and the LZ4 Frame spec v1.6.x (stored + compressed blocks,
+# content-size field, header/block/content xxh32 checksums). They exercise
+# constructs our own encoders never emit (copies from the literal-only
+# fallback path, stored-vs-compressed block mix), so decode is validated
+# against the SPEC, not against our encoder.
+# ---------------------------------------------------------------------------
+
+def test_snappy_golden_spec_vectors():
+    from arkflow_tpu.utils.xcodecs import (_py_snappy_decompress,
+                                           snappy_block_decompress)
+
+    vectors = [
+        # literal-only: varint(7) + tag (7-1)<<2 + payload
+        (b"\x07\x18arkflow", b"arkflow"),
+        # copy-1 with overlapping offset (RLE): 'a' then len-9 off-1 copy
+        (b"\x0a\x00a\x15\x01", b"a" * 10),
+        # copy-2 (2-byte LE offset)
+        (b"\x14\x240123456789\x26\x0a\x00", b"0123456789" * 2),
+        # copy-4 (4-byte LE offset)
+        (b"\x14\x240123456789\x27\x0a\x00\x00\x00", b"0123456789" * 2),
+        # long literal: 60-code tag + 1-byte length extension
+        (b"\x64\xf0\x63" + bytes(range(100)), bytes(range(100))),
+    ]
+    for blob, expect in vectors:
+        # the active tier (native when built) AND the pure-Python fallback
+        # both face the spec vectors — the fallback is unreachable in CI
+        # otherwise and a copy-path bug there would ship undetected
+        assert snappy_block_decompress(blob) == expect
+        assert _py_snappy_decompress(blob) == expect
+
+
+def test_snappy_xerial_golden_frame():
+    import struct
+
+    from arkflow_tpu.utils.xcodecs import snappy_decode, snappy_encode
+
+    body = b"\x14\x240123456789\x26\x0a\x00"  # copy-2 block from the spec
+    frame = (b"\x82SNAPPY\x00" + struct.pack(">ii", 1, 1)
+             + struct.pack(">i", len(body)) + body)
+    assert snappy_decode(frame) == b"0123456789" * 2
+
+    # encode side: our xerial stream must parse structurally and every chunk
+    # must decode with the independent pure-Python spec decoder
+    from arkflow_tpu.utils.xcodecs import _py_snappy_decompress
+
+    payload = b"kafka snappy framing interop " * 64
+    enc = snappy_encode(payload)
+    assert enc.startswith(b"\x82SNAPPY\x00")
+    version, compat = struct.unpack_from(">ii", enc, 8)
+    assert (version, compat) == (1, 1)
+    i, out = 16, b""
+    while i < len(enc):
+        (clen,) = struct.unpack_from(">i", enc, i)
+        i += 4
+        assert 0 <= clen <= len(enc) - i  # chunk stays in bounds
+        out += _py_snappy_decompress(enc[i:i + clen])
+        i += clen
+    assert out == payload
+
+
+def test_lz4_golden_spec_frames():
+    from arkflow_tpu.utils.xcodecs import (_py_lz4_decompress_block,
+                                           lz4_frame_decode)
+
+    # v1 frame, block-independent, stored (uncompressed) block, EndMark
+    f1 = b'\x04"M\x18`@\x82\x05\x00\x00\x80hello\x00\x00\x00\x00'
+    assert lz4_frame_decode(f1) == b"hello"
+
+    # hand-crafted COMPRESSED block (token 0xAF: 10 literals + extended
+    # 20-byte match at offset 10; final literal-only sequence) + content
+    # checksum — a construct our stored-block fallback encoder never emits
+    f2 = (b'\x04"M\x18d@\xa7\x1b\x00\x00\x00\xaf1234567890\n\x00\x01\xc0'
+          b'ENDOFBLOCKXX\x00\x00\x00\x00\xe3\xf2<}')
+    expect2 = b"1234567890" * 3 + b"ENDOFBLOCKXX"
+    assert lz4_frame_decode(f2) == expect2
+    # the pure-Python block decoder faces the spec block directly too (the
+    # native tier shadows it in CI otherwise)
+    block2 = b"\xaf1234567890\n\x00\x01\xc0ENDOFBLOCKXX"
+    assert _py_lz4_decompress_block(block2, 1 << 16) == expect2
+
+    # content-size field present (decoder skips it) + per-block checksum
+    f3 = (b'\x04"M\x18x@\x03\x00\x00\x00\x00\x00\x00\x00\xf0\x03\x00\x00'
+          b'\x80xyz\xd3/\x93\xf1\x00\x00\x00\x00')
+    assert lz4_frame_decode(f3) == b"xyz"
+
+    # corrupted header checksum must be rejected, not silently accepted
+    bad = bytearray(f1)
+    bad[6] ^= 0xFF
+    with pytest.raises(ValueError, match="header checksum"):
+        lz4_frame_decode(bytes(bad))
+
+
+def test_lz4_encode_decodes_with_spec_decoder():
+    """Our frame encoder's output re-parsed with the pure-Python spec
+    decoder path only (native tier bypassed for blocks)."""
+    import struct
+
+    from arkflow_tpu.utils import xcodecs
+
+    payload = b"lz4 frame interop check " * 200
+    enc = xcodecs.lz4_frame_encode(payload)
+    (magic,) = struct.unpack_from("<I", enc)
+    assert magic == 0x184D2204
+    flg = enc[4]
+    assert flg >> 6 == 1 and flg & 0x04  # v1, content checksum present
+    assert xcodecs.lz4_frame_decode(enc) == payload
+    # the frame must contain at least one genuinely COMPRESSED block, or the
+    # fallback-decoder pass below would test nothing (stored blocks bypass
+    # the block decoder entirely); the native tier is a CI contract here
+    if xcodecs.native.lz4_compress_block(payload[: 1 << 16]) is None:
+        pytest.skip("native tier absent: encoder stores blocks uncompressed")
+    i, any_compressed = 7, False
+    while i < len(enc) - 8:
+        (bsz,) = struct.unpack_from("<I", enc, i)
+        i += 4
+        if bsz == 0:
+            break
+        any_compressed |= not (bsz & 0x80000000)
+        i += bsz & 0x7FFFFFFF
+    assert any_compressed
+    # blocks decode with the pure-Python block decoder too
+    orig = xcodecs.native.lz4_decompress_block
+    xcodecs.native.lz4_decompress_block = lambda blk, mx: None
+    try:
+        assert xcodecs.lz4_frame_decode(enc) == payload
+    finally:
+        xcodecs.native.lz4_decompress_block = orig
