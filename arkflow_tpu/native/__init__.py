@@ -90,6 +90,16 @@ def _load() -> Optional[ctypes.CDLL]:
                            ctypes.c_char_p, ctypes.c_size_t]
         lib.ark_xxh32.restype = ctypes.c_uint32
         lib.ark_xxh32.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32]
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.ark_pack_ffd.restype = ctypes.c_int
+        lib.ark_pack_ffd.argtypes = [i64p, ctypes.c_int, ctypes.c_int, i64p, i64p]
+        lib.ark_pack_fill.restype = None
+        lib.ark_pack_fill.argtypes = [
+            i32p, ctypes.c_int64, i64p, i64p, i64p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            i32p, i32p, i32p, i32p, i32p,
+        ]
         _LIB = lib
     except OSError as e:
         logger.warning("native load failed: %s", e)
@@ -207,6 +217,40 @@ def xxh32(data: bytes, seed: int = 0) -> Optional[int]:
     if lib is None:
         return None
     return lib.ark_xxh32(data, len(data), seed)
+
+
+def pack_tokens_native(ids: np.ndarray, lengths: np.ndarray, seq: int):
+    """Native FFD token packer (tpu/packing.py owns the layout contract and
+    the reference Python implementation). Returns (out_ids, seg, pos, ex_row,
+    ex_pos) or None without the lib. ``lengths`` must be pre-clamped to
+    [1, seq]."""
+    lib = _load()
+    if lib is None:
+        return None
+    ids = np.ascontiguousarray(ids, np.int32)
+    lengths = np.ascontiguousarray(lengths, np.int64)
+    n = int(lengths.shape[0])
+    smax = int(ids.shape[1]) if ids.ndim == 2 else 0
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    bin_of = np.empty(n, np.int64)
+    start_of = np.empty(n, np.int64)
+    n_bins = lib.ark_pack_ffd(
+        lengths.ctypes.data_as(i64p), n, seq,
+        bin_of.ctypes.data_as(i64p), start_of.ctypes.data_as(i64p))
+    out_ids = np.zeros((n_bins, seq), np.int32)
+    seg = np.zeros((n_bins, seq), np.int32)
+    pos = np.zeros((n_bins, seq), np.int32)
+    ex_row = np.empty(n, np.int32)
+    ex_pos = np.empty(n, np.int32)
+    lib.ark_pack_fill(
+        ids.ctypes.data_as(i32p), smax, lengths.ctypes.data_as(i64p),
+        bin_of.ctypes.data_as(i64p), start_of.ctypes.data_as(i64p),
+        n, seq, n_bins,
+        out_ids.ctypes.data_as(i32p), seg.ctypes.data_as(i32p),
+        pos.ctypes.data_as(i32p),
+        ex_row.ctypes.data_as(i32p), ex_pos.ctypes.data_as(i32p))
+    return out_ids, seg, pos, ex_row, ex_pos
 
 
 def pad_gather_i32(values: np.ndarray, offsets: np.ndarray, seq: int,

@@ -9,6 +9,8 @@
 //     (lowercase, [a-z0-9]+ runs or single symbol, FNV-1a 32-bit into [4, vocab)).
 //   - micro-batch assembler: gather+pad variable-length int32 rows into a
 //     fixed [batch, seq] bucket (the pad-to-bucket step of the TPU infeed).
+//   - token packer: first-fit-decreasing bin pack of ragged examples into
+//     dense model rows (padding-free execution; tpu/packing.py layout).
 //
 // Built by arkflow_tpu/native/__init__.py with g++ -O3 -shared -fPIC; every
 // entry point has a Python fallback, so the engine still runs if no compiler
@@ -17,6 +19,8 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <algorithm>
+#include <vector>
 
 extern "C" {
 
@@ -139,6 +143,61 @@ void ark_pad_gather_i32(const int32_t* values, const int64_t* offsets, int n_row
         int64_t n = hi - lo;
         if (n > seq) n = seq;
         memcpy(out + (size_t)r * seq, values + lo, (size_t)n * sizeof(int32_t));
+    }
+}
+
+// ---------------------------------------------------------------------------
+// token packer: first-fit-decreasing bin pack for padding-free execution
+// (tpu/packing.py owns the reference Python implementation + the layout
+// contract; this is the hot-path tier — the Python FFD loop costs ~7ms per
+// 1024-example batch on the 1-core bench host, this runs in microseconds)
+// ---------------------------------------------------------------------------
+
+// Phase 1: placement. lengths[n] (pre-clamped to [1, seq] by the caller);
+// writes bin_of[n], start_of[n]; returns the bin count.
+int ark_pack_ffd(const int64_t* lengths, int n, int seq,
+                 int64_t* bin_of, int64_t* start_of) {
+    std::vector<int> order(n);
+    for (int i = 0; i < n; i++) order[i] = i;
+    std::stable_sort(order.begin(), order.end(),
+                     [&](int a, int b) { return lengths[a] > lengths[b]; });
+    std::vector<int64_t> bin_free;
+    bin_free.reserve(n);
+    for (int k = 0; k < n; k++) {
+        int i = order[k];
+        int64_t len = lengths[i];
+        int b = -1;
+        for (size_t j = 0; j < bin_free.size(); j++) {  // first fit
+            if (bin_free[j] >= len) { b = (int)j; break; }
+        }
+        if (b < 0) {
+            b = (int)bin_free.size();
+            bin_free.push_back(seq);
+        }
+        bin_of[i] = b;
+        start_of[i] = seq - bin_free[b];
+        bin_free[b] -= len;
+    }
+    return (int)bin_free.size();
+}
+
+// Phase 2: fill. ids row-major [n, smax]; out arrays pre-zeroed
+// [n_bins, seq]; seg ids count up per bin in original example order.
+void ark_pack_fill(const int32_t* ids, int64_t smax, const int64_t* lengths,
+                   const int64_t* bin_of, const int64_t* start_of, int n,
+                   int seq, int n_bins, int32_t* out_ids, int32_t* seg,
+                   int32_t* pos, int32_t* ex_row, int32_t* ex_pos) {
+    std::vector<int32_t> seg_next(n_bins, 1);
+    for (int i = 0; i < n; i++) {
+        int64_t b = bin_of[i], st = start_of[i], len = lengths[i];
+        int32_t* orow = out_ids + (size_t)b * seq + st;
+        int32_t* srow = seg + (size_t)b * seq + st;
+        int32_t* prow = pos + (size_t)b * seq + st;
+        memcpy(orow, ids + (size_t)i * smax, (size_t)len * sizeof(int32_t));
+        int32_t s = seg_next[b]++;
+        for (int64_t j = 0; j < len; j++) { srow[j] = s; prow[j] = (int32_t)j; }
+        ex_row[i] = (int32_t)b;
+        ex_pos[i] = (int32_t)st;
     }
 }
 

@@ -31,6 +31,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from arkflow_tpu import native
+
 
 @dataclass
 class PackedTokens:
@@ -65,13 +67,21 @@ def pack_tokens(ids: np.ndarray, lengths: np.ndarray, seq: int) -> PackedTokens:
     ``example_*`` index arrays: entry i is original row i.
     """
     ids = np.asarray(ids)
+    if ids.ndim != 2 or (ids.shape[0] > 0 and ids.shape[1] == 0):
+        raise ValueError(f"pack_tokens: ids must be [n, smax>0], got shape {ids.shape}")
     n = ids.shape[0]
-    lengths = np.minimum(np.asarray(lengths, np.int64), seq)
+    # clamp to the bucket AND the ids row width: a length beyond the row
+    # would read garbage in the native tier / raise in the Python one
+    lengths = np.minimum(np.asarray(lengths, np.int64), min(seq, ids.shape[1]))
     lengths = np.maximum(lengths, 1)  # empty text still occupies its [CLS] slot
     if n == 0:
         z = np.zeros((0, seq), np.int32)
         e = np.zeros((0,), np.int32)
         return PackedTokens(z, z.copy(), z.copy(), e, e.copy())
+
+    nat = native.pack_tokens_native(ids, lengths, seq)
+    if nat is not None:  # hot path: ~7ms/1024 rows in Python, us-scale in C++
+        return PackedTokens(*nat)
 
     order = np.argsort(-lengths, kind="stable")
     bin_free = np.empty(n, np.int64)  # capacity left per bin; at most n bins
