@@ -223,7 +223,8 @@ def pack_tokens_native(ids: np.ndarray, lengths: np.ndarray, seq: int):
     """Native FFD token packer (tpu/packing.py owns the layout contract and
     the reference Python implementation). Returns (out_ids, seg, pos, ex_row,
     ex_pos) or None without the lib. ``lengths`` must be pre-clamped to
-    [1, seq]."""
+    [1, min(seq, ids.shape[1])] (the C++ fill clamps to the row width again
+    as a memory-safety backstop, but bin placement uses lengths as given)."""
     lib = _load()
     if lib is None:
         return None

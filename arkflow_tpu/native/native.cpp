@@ -190,6 +190,7 @@ void ark_pack_fill(const int32_t* ids, int64_t smax, const int64_t* lengths,
     std::vector<int32_t> seg_next(n_bins, 1);
     for (int i = 0; i < n; i++) {
         int64_t b = bin_of[i], st = start_of[i], len = lengths[i];
+        if (len > smax) len = smax;  // never read past the ids row
         int32_t* orow = out_ids + (size_t)b * seq + st;
         int32_t* srow = seg + (size_t)b * seq + st;
         int32_t* prow = pos + (size_t)b * seq + st;

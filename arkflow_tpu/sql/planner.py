@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
@@ -304,9 +305,19 @@ def _hash_join(cur: pa.Table, right: pa.Table, on: Optional[ast.Expr],
                     eqs.append((c.right, c.left))
                     continue
             residual.append(c)
-    if kind in ("left", "right", "full") and (residual or not eqs):
+    if kind in ("left", "right", "full") and not eqs:
         raise UnsupportedSql(
-            f"{kind.upper()} JOIN requires a pure equi-join ON condition natively")
+            f"{kind.upper()} JOIN requires at least one equi-join key natively")
+    # outer join with non-equi residual: Acero can't filter inside the join,
+    # so run the INNER equi-join + residual, then re-append the rows whose
+    # matches were all eliminated (null-extended) — standard outer semantics
+    outer_residual = kind if (kind in ("left", "right", "full") and residual) else None
+    if outer_residual in ("left", "full"):
+        cur = cur.append_column(
+            "__orid_l", pa.array(np.arange(cur.num_rows, dtype=np.int64)))
+    if outer_residual in ("right", "full"):
+        right = right.append_column(
+            "__orid_r", pa.array(np.arange(right.num_rows, dtype=np.int64)))
     if residual and not eqs and kind != "cross":
         # non-equi inner join: cross product + filter
         kind = "cross"
@@ -330,16 +341,23 @@ def _hash_join(cur: pa.Table, right: pa.Table, on: Optional[ast.Expr],
         for i, (le, re_) in enumerate(eqs):
             lv = as_array(lev.eval(le), cur.num_rows)
             rv = as_array(rev.eval(re_), right.num_rows)
-            # align key types: acero rejects mismatched key types
+            # align key types: acero rejects mismatched key types. Null-typed
+            # keys (empty/all-None columns) can't cast — route to the sqlite
+            # fallback instead of leaking ArrowNotImplementedError
+            if pa.types.is_null(lv.type) or pa.types.is_null(rv.type):
+                raise UnsupportedSql("join key column has null type")
             if lv.type != rv.type:
                 common = pa.float64() if (pa.types.is_floating(lv.type) or pa.types.is_floating(rv.type)) else None
-                if common is None:
-                    try:
-                        rv = pc.cast(rv, lv.type)
-                    except pa.ArrowInvalid:
-                        lv = pc.cast(lv, rv.type)
-                else:
-                    lv, rv = pc.cast(lv, common, safe=False), pc.cast(rv, common, safe=False)
+                try:
+                    if common is None:
+                        try:
+                            rv = pc.cast(rv, lv.type)
+                        except pa.ArrowInvalid:
+                            lv = pc.cast(lv, rv.type)
+                    else:
+                        lv, rv = pc.cast(lv, common, safe=False), pc.cast(rv, common, safe=False)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError) as e:
+                    raise UnsupportedSql(f"join key types incompatible: {e}")
             ln, rn = f"__jk{i}_l", f"__jk{i}_r"
             cur = cur.append_column(ln, lv)
             right = right.append_column(rn, rv)
@@ -347,7 +365,7 @@ def _hash_join(cur: pa.Table, right: pa.Table, on: Optional[ast.Expr],
             rkeys.append(rn)
             ltmp.append(ln)
             rtmp.append(rn)
-        join_type = _JOIN_TYPES[kind]
+        join_type = "inner" if outer_residual else _JOIN_TYPES[kind]
 
     joined = cur.join(right, keys=lkeys, right_keys=rkeys,
                       join_type=join_type, coalesce_keys=False)
@@ -371,7 +389,35 @@ def _hash_join(cur: pa.Table, right: pa.Table, on: Optional[ast.Expr],
                 m = pc.cast(m, pa.bool_())
             mask = m if mask is None else pc.and_kleene(mask, m)
         joined = joined.filter(pc.fill_null(mask, False))
+    if outer_residual:
+        if outer_residual in ("left", "full"):
+            joined = _append_unmatched(joined, cur, "__orid_l")
+        if outer_residual in ("right", "full"):
+            joined = _append_unmatched(joined, right, "__orid_r")
+        joined = joined.drop_columns(
+            [c for c in ("__orid_l", "__orid_r") if c in joined.schema.names])
     return joined
+
+
+def _append_unmatched(joined: pa.Table, side: pa.Table, rid: str) -> pa.Table:
+    """Null-extend ``side`` rows with no surviving match into ``joined``
+    (the outer half of an outer join whose ON carries a residual)."""
+    seen = pc.unique(joined.column(rid))
+    keep = pc.invert(pc.is_in(side.column(rid), value_set=seen))
+    miss = side.filter(pc.fill_null(keep, True))
+    if miss.num_rows == 0:
+        return joined
+    cols = []
+    for field in joined.schema:
+        if field.name in miss.schema.names:
+            col = miss.column(field.name)
+            if col.type != field.type:
+                col = pc.cast(col, field.type)
+            cols.append(col)
+        else:
+            cols.append(pa.nulls(miss.num_rows, field.type))
+    return pa.concat_tables(
+        [joined, pa.table(cols, names=joined.schema.names)])
 
 
 # -- select execution --------------------------------------------------------

@@ -11,7 +11,9 @@ surface raises ``UnsupportedSql`` and reroutes to the sqlite fallback.
 Supported: row_number, rank, dense_rank, ntile, lag, lead, first_value,
 last_value, nth_value, and sum/count/avg/min/max with default frames
 (whole partition when unordered; RANGE UNBOUNDED PRECEDING..CURRENT ROW —
-i.e. running-with-peers — when ordered; running min/max fall back).
+i.e. running-with-peers — when ordered, including running min/max via a
+Hillis-Steele scan). NaN follows Postgres/DataFusion ordering: a value, not
+NULL — frames containing one yield NaN for sum/avg/max, min skips it.
 """
 
 from __future__ import annotations
@@ -197,6 +199,7 @@ def _aggregate(name, f, ev, n, idx, idx_np, part_start, peer_end) -> pa.Array:
         valid_np = pc.is_valid(vals).to_numpy(zero_copy_only=False).astype(np.int64)
         valid_b = valid_np.astype(bool)
         integral = pa.types.is_integer(vals.type) or pa.types.is_boolean(vals.type)
+        nan_np = pinf_np = ninf_np = np.zeros(n, np.int64)
         if integral:
             # exact int64 accumulation: float64 prefix sums would silently
             # round sums past 2^53
@@ -205,29 +208,49 @@ def _aggregate(name, f, ev, n, idx, idx_np, part_start, peer_end) -> pa.Array:
         else:
             x = pc.cast(vals, pa.float64(), safe=False).to_numpy(zero_copy_only=False)
             x = np.where(valid_b, x, 0.0)
-            if np.isnan(x).any():
-                # a genuine NaN poisons every later prefix difference; the
-                # sqlite fallback propagates it correctly instead
-                raise UnsupportedSql("window aggregate over NaN values")
+            # NaN is a VALUE, not NULL (Postgres/DataFusion ordering: NaN
+            # sorts above every number). Prefix sums would smear it into
+            # every later frame, so zero it here and re-mark exactly the
+            # frames whose window contains one via a NaN-count prefix.
+            # +/-inf smear the same way (inf - inf = NaN in later frames),
+            # so they get the same treatment with sign-correct overlays.
+            nan_np = np.isnan(x).astype(np.int64)
+            pinf_np = (x == np.inf).astype(np.int64)
+            ninf_np = (x == -np.inf).astype(np.int64)
+            nonfinite = nan_np | pinf_np | ninf_np
+            if nonfinite.any():
+                x = np.where(nonfinite.astype(bool), 0.0, x)
 
     ccum = np.r_[0, np.cumsum(valid_np)]
     cnt = ccum[peer_end + 1] - ccum[part_start]
     if name == "count":
         return _scatter(cnt, idx_np, n)
 
+    ncum = np.r_[0, np.cumsum(nan_np)]
+    frame_nans = ncum[peer_end + 1] - ncum[part_start]
+
     if name in ("min", "max"):
-        # running min/max has no prefix-sum form; support whole-partition only
-        if not np.array_equal(peer_end, _partition_end_like(part_start, n)):
-            raise UnsupportedSql("running MIN/MAX OVER (ORDER BY ...) not supported natively")
-        seg_starts = np.unique(part_start)
         valid_b = valid_np.astype(bool)
+        nan_b = nan_np.astype(bool)
         if integral:
             fill = np.iinfo(np.int64).max if name == "min" else np.iinfo(np.int64).min
+            xm = np.where(valid_b, x, fill)
         else:
             fill = np.inf if name == "min" else -np.inf
-        xm = np.where(valid_b, x, fill)
-        red = (np.minimum if name == "min" else np.maximum).reduceat(xm, seg_starts)
-        per_row = red[np.searchsorted(seg_starts, part_start, side="right") - 1]
+            # restore genuine infinities (zeroed above for the sum path)
+            xv = np.where(pinf_np.astype(bool), np.inf,
+                          np.where(ninf_np.astype(bool), -np.inf, x))
+            # min skips NaN (it sorts above everything); max over a frame
+            # holding one IS NaN — handled below via frame_nans
+            xm = np.where(valid_b & ~nan_b, xv, fill)
+        acc = _running_extreme(xm, part_start, n, is_min=(name == "min"))
+        per_row = acc[peer_end]
+        if not integral:
+            if name == "max":
+                per_row = np.where(frame_nans > 0, np.nan, per_row)
+            else:
+                # all values in frame NaN -> min is NaN
+                per_row = np.where((cnt > 0) & (frame_nans == cnt), np.nan, per_row)
         res = pa.array(per_row)
         null_t = pa.int64() if integral else pa.float64()
         res = pc.if_else(pa.array(cnt > 0), res, pa.nulls(n, null_t))
@@ -235,6 +258,16 @@ def _aggregate(name, f, ev, n, idx, idx_np, part_start, peer_end) -> pa.Array:
 
     scum = np.r_[0 if integral else 0.0, np.cumsum(x)]
     s = scum[peer_end + 1] - scum[part_start]
+    if not integral:
+        # overlay non-finite frames with IEEE semantics: +inf-only -> +inf,
+        # -inf-only -> -inf, both (or any NaN) -> NaN
+        pcum = np.r_[0, np.cumsum(pinf_np)]
+        ncum2 = np.r_[0, np.cumsum(ninf_np)]
+        fp = pcum[peer_end + 1] - pcum[part_start]
+        fn = ncum2[peer_end + 1] - ncum2[part_start]
+        s = np.where((fp > 0) & (fn == 0), np.inf, s)
+        s = np.where((fn > 0) & (fp == 0), -np.inf, s)
+        s = np.where(((fp > 0) & (fn > 0)) | (frame_nans > 0), np.nan, s)
     if name == "avg":
         res = pa.array(np.where(cnt > 0, s / np.maximum(cnt, 1), np.nan))
         return _scatter(pc.if_else(pa.array(cnt > 0), res,
@@ -245,8 +278,27 @@ def _aggregate(name, f, ev, n, idx, idx_np, part_start, peer_end) -> pa.Array:
     return _scatter(res, idx_np, n)
 
 
-def _partition_end_like(part_start: np.ndarray, n: int) -> np.ndarray:
-    """Per-row partition end implied by per-row partition starts."""
-    starts = np.unique(part_start)
-    ends = np.r_[starts[1:], n] - 1
-    return ends[np.searchsorted(starts, part_start, side="right") - 1]
+def _running_extreme(xm: np.ndarray, part_start: np.ndarray, n: int,
+                     is_min: bool) -> np.ndarray:
+    """Per-row min/max over [part_start[i] .. i] in sorted order: a
+    Hillis-Steele scan with partition resets. After k rounds acc[i] covers
+    the last 2^k rows of its partition ending at i; min/max are idempotent,
+    so the overlapping-window merge is exact. log2(longest partition)
+    vectorized passes — running MIN/MAX used to bail to the sqlite fallback.
+    """
+    op = np.minimum if is_min else np.maximum
+    acc = xm.copy()
+    pos = np.arange(n)
+    shift = 1
+    while shift < n:
+        can = pos >= part_start + shift
+        if not can.any():
+            break
+        shifted = np.empty_like(acc)
+        shifted[shift:] = acc[:-shift]
+        shifted[:shift] = acc[:shift]  # never read: 'can' is False there
+        acc = np.where(can, op(acc, shifted), acc)
+        shift <<= 1
+    return acc
+
+

@@ -554,10 +554,135 @@ def test_window_in_order_by_and_unsupported_falls_back():
     # window expr consumed by ORDER BY
     out = ctx.sql("SELECT x FROM t ORDER BY row_number() OVER (ORDER BY x DESC)").record_batch
     assert out.column("x").to_pylist() == [5, 4, 3, 2, 1]
-    # running MIN needs sqlite (no prefix-sum form)
-    out2 = ctx.sql("SELECT min(v) OVER (ORDER BY x) AS m FROM t").record_batch
+    # running MIN now runs natively (Hillis-Steele scan); the explicit outer
+    # ORDER BY pins row order (the old fallback leaked sqlite's sort order)
+    out2 = ctx.sql("SELECT min(v) OVER (ORDER BY x) AS m FROM t "
+                   "ORDER BY x").record_batch
     assert out2.column("m").to_pylist() == [20.0, 20.0, 10.0, 10.0, 10.0]
     # explicit frames reroute to sqlite and still execute
     out3 = ctx.sql("SELECT sum(v) OVER (ORDER BY x ROWS BETWEEN 1 PRECEDING "
                    "AND CURRENT ROW) AS s FROM t").record_batch
     assert len(out3.column("s").to_pylist()) == 5
+
+
+def test_window_running_min_max_native(monkeypatch):
+    """Running MIN/MAX OVER (PARTITION BY .. ORDER BY ..) runs natively via
+    the Hillis-Steele scan (used to bail to the sqlite fallback)."""
+    _no_fallback(monkeypatch)
+    out = _win_ctx().sql(
+        "SELECT g, x, min(v) OVER (PARTITION BY g ORDER BY x) AS lo, "
+        "max(v) OVER (PARTITION BY g ORDER BY x) AS hi "
+        "FROM t ORDER BY g, x").record_batch
+    # g=a sorted by x: v = 20, 30, 10 ; g=b: v = 50, 40
+    assert out.column("lo").to_pylist() == [20.0, 20.0, 10.0, 50.0, 40.0]
+    assert out.column("hi").to_pylist() == [20.0, 30.0, 30.0, 50.0, 50.0]
+
+
+def test_window_running_min_with_nulls_and_long_partition(monkeypatch):
+    _no_fallback(monkeypatch)
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    n = 500
+    v = rng.randn(n)
+    vals = [None if i % 7 == 0 else float(v[i]) for i in range(n)]
+    ctx = SessionContext()
+    ctx.register_batch("u", MessageBatch.from_pydict({
+        "x": list(range(n)), "v": vals}))
+    out = ctx.sql("SELECT min(v) OVER (ORDER BY x) AS m FROM u ORDER BY x").record_batch
+    got = out.column("m").to_pylist()
+    best = None
+    for i in range(n):
+        if vals[i] is not None and (best is None or vals[i] < best):
+            best = vals[i]
+        assert got[i] == best
+
+
+def test_window_aggregates_nan_semantics(monkeypatch):
+    """NaN is a value (Postgres/DataFusion ordering), not NULL: frames
+    containing one yield NaN for sum/avg/max; min skips it (used to bail)."""
+    _no_fallback(monkeypatch)
+    import math
+
+    ctx = SessionContext()
+    ctx.register_batch("t", MessageBatch.from_pydict({
+        "x": [1, 2, 3], "v": [5.0, float("nan"), 1.0]}))
+    out = ctx.sql(
+        "SELECT sum(v) OVER (ORDER BY x) AS s, avg(v) OVER (ORDER BY x) AS a, "
+        "min(v) OVER (ORDER BY x) AS lo, max(v) OVER (ORDER BY x) AS hi "
+        "FROM t ORDER BY x").record_batch
+    s = out.column("s").to_pylist()
+    assert s[0] == 5.0 and math.isnan(s[1]) and math.isnan(s[2])
+    a = out.column("a").to_pylist()
+    assert a[0] == 5.0 and math.isnan(a[1]) and math.isnan(a[2])
+    assert out.column("lo").to_pylist() == [5.0, 5.0, 1.0]  # min skips NaN
+    hi = out.column("hi").to_pylist()
+    assert hi[0] == 5.0 and math.isnan(hi[1]) and math.isnan(hi[2])
+
+
+def test_outer_joins_with_residual_conditions(monkeypatch):
+    """LEFT/RIGHT/FULL JOIN whose ON mixes equi-keys with non-equi residuals
+    now run natively: inner equi-join + residual filter, then null-extension
+    of the rows whose matches were all eliminated (used to bail to sqlite)."""
+    _no_fallback(monkeypatch)
+    c = SessionContext()
+    c.register_batch("a", MessageBatch.from_pydict(
+        {"k": [1, 2, 3], "x": [10, 20, 30]}))
+    c.register_batch("b", MessageBatch.from_pydict(
+        {"k": [1, 1, 2, 4], "y": [5, 15, 100, 7]}))
+
+    out = c.sql("SELECT a.k, a.x, b.y FROM a LEFT JOIN b "
+                "ON a.k = b.k AND b.y < a.x ORDER BY a.k, b.y").record_batch
+    # k=1: y=5 survives (15 >= 10 filtered); k=2: y=100 eliminated -> null row;
+    # k=3: no match -> null row
+    assert out.column("k").to_pylist() == [1, 2, 3]
+    assert out.column("y").to_pylist() == [5, None, None]
+
+    out = c.sql("SELECT b.k, b.y, a.x FROM a RIGHT JOIN b "
+                "ON a.k = b.k AND b.y < a.x ORDER BY b.k, b.y").record_batch
+    assert out.column("k").to_pylist() == [1, 1, 2, 4]
+    assert out.column("x").to_pylist() == [10, None, None, None]
+
+    out = c.sql("SELECT a.k AS ak, b.k AS bk FROM a FULL JOIN b "
+                "ON a.k = b.k AND b.y < a.x ORDER BY a.k, b.y, b.k").record_batch
+    ak = out.column("ak").to_pylist()
+    bk = out.column("bk").to_pylist()
+    # matched: (1,1). unmatched left: 2, 3. unmatched right: k=1(y=15), 2, 4
+    assert sorted((x, y) for x, y in zip(ak, bk) if x is not None and y is not None) == [(1, 1)]
+    assert sorted(x for x, y in zip(ak, bk) if y is None) == [2, 3]
+    assert sorted(y for x, y in zip(ak, bk) if x is None) == [1, 2, 4]
+
+
+def test_window_sum_avg_infinity_semantics(monkeypatch):
+    """+/-inf must not smear NaN into later frames/partitions through the
+    prefix sums; IEEE overlay: inf-only frames stay inf, mixed -> NaN."""
+    _no_fallback(monkeypatch)
+    import math
+
+    ctx = SessionContext()
+    ctx.register_batch("t", MessageBatch.from_pydict({
+        "g": [1, 2, 2, 2], "x": [1, 1, 2, 3],
+        "v": [float("inf"), 1.0, float("-inf"), 2.0]}))
+    out = ctx.sql("SELECT sum(v) OVER (PARTITION BY g ORDER BY x) AS s "
+                  "FROM t ORDER BY g, x").record_batch
+    s = out.column("s").to_pylist()
+    assert s[0] == float("inf")        # frame {inf}
+    assert s[1] == 1.0                 # next partition untouched by the inf
+    assert s[2] == float("-inf")       # frame {1, -inf}
+    assert s[3] == float("-inf")       # frame {1, -inf, 2}
+    out2 = ctx.sql("SELECT max(v) OVER (PARTITION BY g) AS m FROM t "
+                   "ORDER BY g, x").record_batch
+    m = out2.column("m").to_pylist()
+    assert m[0] == float("inf") and m[1] == 2.0
+
+
+def test_join_null_typed_key_falls_back():
+    """A null-typed join key (all-None column) routes to the sqlite fallback
+    instead of leaking ArrowNotImplementedError from the cast."""
+    c = SessionContext()
+    c.register_batch("a", MessageBatch.from_pydict({"k": [None, None], "x": [1, 2]}))
+    c.register_batch("b", MessageBatch.from_pydict({"k": [1, 2], "y": [10, 20]}))
+    out = c.sql("SELECT a.x, b.y FROM a LEFT JOIN b "
+                "ON a.k = b.k AND b.y > a.x ORDER BY a.x").record_batch
+    assert out.column("x").to_pylist() == [1, 2]
+    assert out.column("y").to_pylist() == [None, None]
