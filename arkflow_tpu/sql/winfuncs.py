@@ -183,6 +183,8 @@ def _ranking(name, f, ev, n, idx, idx_np, pos, part_start, part_end,
 def _aggregate(name, f, ev, n, idx, idx_np, part_start, peer_end) -> pa.Array:
     """sum/count/avg/min/max over start..peer_end (= whole partition when
     unordered, running-with-peers when ordered) via prefix sums."""
+    has_nonfinite = False
+    nan_np = pinf_np = ninf_np = None
     if f.is_star:
         if name != "count":
             raise UnsupportedSql(f"{name}(*) is not a window aggregate")
@@ -199,7 +201,6 @@ def _aggregate(name, f, ev, n, idx, idx_np, part_start, peer_end) -> pa.Array:
         valid_np = pc.is_valid(vals).to_numpy(zero_copy_only=False).astype(np.int64)
         valid_b = valid_np.astype(bool)
         integral = pa.types.is_integer(vals.type) or pa.types.is_boolean(vals.type)
-        nan_np = pinf_np = ninf_np = np.zeros(n, np.int64)
         if integral:
             # exact int64 accumulation: float64 prefix sums would silently
             # round sums past 2^53
@@ -214,38 +215,42 @@ def _aggregate(name, f, ev, n, idx, idx_np, part_start, peer_end) -> pa.Array:
             # frames whose window contains one via a NaN-count prefix.
             # +/-inf smear the same way (inf - inf = NaN in later frames),
             # so they get the same treatment with sign-correct overlays.
-            nan_np = np.isnan(x).astype(np.int64)
-            pinf_np = (x == np.inf).astype(np.int64)
-            ninf_np = (x == -np.inf).astype(np.int64)
-            nonfinite = nan_np | pinf_np | ninf_np
-            if nonfinite.any():
-                x = np.where(nonfinite.astype(bool), 0.0, x)
+            if not np.isfinite(x).all():  # rare: keep the hot path lean
+                has_nonfinite = True
+                nan_np = np.isnan(x).astype(np.int64)
+                pinf_np = (x == np.inf).astype(np.int64)
+                ninf_np = (x == -np.inf).astype(np.int64)
+                x = np.where((nan_np | pinf_np | ninf_np).astype(bool), 0.0, x)
 
     ccum = np.r_[0, np.cumsum(valid_np)]
     cnt = ccum[peer_end + 1] - ccum[part_start]
     if name == "count":
         return _scatter(cnt, idx_np, n)
 
-    ncum = np.r_[0, np.cumsum(nan_np)]
-    frame_nans = ncum[peer_end + 1] - ncum[part_start]
+    frame_nans = None
+    if has_nonfinite:
+        ncum = np.r_[0, np.cumsum(nan_np)]
+        frame_nans = ncum[peer_end + 1] - ncum[part_start]
 
     if name in ("min", "max"):
         valid_b = valid_np.astype(bool)
-        nan_b = nan_np.astype(bool)
         if integral:
             fill = np.iinfo(np.int64).max if name == "min" else np.iinfo(np.int64).min
             xm = np.where(valid_b, x, fill)
-        else:
+        elif has_nonfinite:
             fill = np.inf if name == "min" else -np.inf
-            # restore genuine infinities (zeroed above for the sum path)
-            xv = np.where(pinf_np.astype(bool), np.inf,
-                          np.where(ninf_np.astype(bool), -np.inf, x))
+            # restore genuine infinities (zeroed above for the sum path);
             # min skips NaN (it sorts above everything); max over a frame
             # holding one IS NaN — handled below via frame_nans
-            xm = np.where(valid_b & ~nan_b, xv, fill)
+            xv = np.where(pinf_np.astype(bool), np.inf,
+                          np.where(ninf_np.astype(bool), -np.inf, x))
+            xm = np.where(valid_b & ~nan_np.astype(bool), xv, fill)
+        else:
+            fill = np.inf if name == "min" else -np.inf
+            xm = np.where(valid_b, x, fill)
         acc = _running_extreme(xm, part_start, n, is_min=(name == "min"))
         per_row = acc[peer_end]
-        if not integral:
+        if not integral and has_nonfinite:
             if name == "max":
                 per_row = np.where(frame_nans > 0, np.nan, per_row)
             else:
@@ -258,7 +263,7 @@ def _aggregate(name, f, ev, n, idx, idx_np, part_start, peer_end) -> pa.Array:
 
     scum = np.r_[0 if integral else 0.0, np.cumsum(x)]
     s = scum[peer_end + 1] - scum[part_start]
-    if not integral:
+    if not integral and has_nonfinite:
         # overlay non-finite frames with IEEE semantics: +inf-only -> +inf,
         # -inf-only -> -inf, both (or any NaN) -> NaN
         pcum = np.r_[0, np.cumsum(pinf_np)]
