@@ -233,7 +233,6 @@ def _aggregate(name, f, ev, n, idx, idx_np, part_start, peer_end) -> pa.Array:
         frame_nans = ncum[peer_end + 1] - ncum[part_start]
 
     if name in ("min", "max"):
-        valid_b = valid_np.astype(bool)
         if integral:
             fill = np.iinfo(np.int64).max if name == "min" else np.iinfo(np.int64).min
             xm = np.where(valid_b, x, fill)
