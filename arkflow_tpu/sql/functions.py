@@ -317,6 +317,7 @@ _BUILTINS: dict[str, ScalarFn] = {
     "regex_extract": lambda args, n: _regex_extract(args, n),
     "parse_key_value": lambda args, n: _parse_key_value(args, n),
     "parse_url": lambda args, n: _parse_url(args, n),
+    "parse_syslog": lambda args, n: _parse_syslog(args, n),
     "md5": lambda args, n: _rowwise1(args, n, lambda v: hashlib.md5(_as_bytes(v)).hexdigest(), raw=True),
     "sha256": lambda args, n: _rowwise1(args, n, lambda v: hashlib.sha256(_as_bytes(v)).hexdigest(), raw=True),
     "to_string": lambda args, n: _rowwise1(args, n, str),
@@ -467,6 +468,62 @@ def _parse_key_value(args, n):
         return None
 
     return _rowwise1(args, n, conv)
+
+
+_SYSLOG_3164 = None
+_SYSLOG_5424 = None
+
+
+def _parse_syslog(args, n):
+    """parse_syslog(line, part): RFC 5424 and legacy RFC 3164 lines.
+    Parts: severity, facility, timestamp, hostname, appname, procid, msgid,
+    message, version. Unparseable rows -> NULL (fallible, like the VRL fn)."""
+    global _SYSLOG_3164, _SYSLOG_5424
+    import re as _re
+
+    if _SYSLOG_5424 is None:
+        _SYSLOG_5424 = _re.compile(
+            r"^<(?P<pri>\d{1,3})>(?P<version>\d)\s+"
+            r"(?P<timestamp>\S+)\s+(?P<hostname>\S+)\s+(?P<appname>\S+)\s+"
+            r"(?P<procid>\S+)\s+(?P<msgid>\S+)\s+"
+            r"(?P<sd>-|(?:\[.*?\])+)\s*(?P<message>.*)$", _re.DOTALL)
+        _SYSLOG_3164 = _re.compile(
+            r"^<(?P<pri>\d{1,3})>"
+            r"(?P<timestamp>[A-Z][a-z]{2}\s+\d{1,2}\s\d{2}:\d{2}:\d{2})\s+"
+            r"(?P<hostname>\S+)\s+"
+            r"(?P<appname>[^\s:\[]+)(?:\[(?P<procid>\d+)\])?:?\s*"
+            r"(?P<message>.*)$", _re.DOTALL)
+    s = as_array(args[0], n)
+    key = args[1]
+    if isinstance(key, pa.Array):
+        raise UnsupportedSql("parse_syslog part must be a literal")
+    key = str(key)
+
+    def one(v):
+        # fallible-parser contract: a bad row (wrong type, no match) yields
+        # NULL, never aborts the batch
+        if v is None:
+            return None
+        if isinstance(v, bytes):
+            v = v.decode("utf-8", "replace")
+        try:
+            m = _SYSLOG_5424.match(v) or _SYSLOG_3164.match(v)
+        except TypeError:
+            return None
+        if m is None:
+            return None
+        d = m.groupdict()
+        pri = int(d["pri"])
+        if key == "severity":
+            return pri & 7
+        if key == "facility":
+            return pri >> 3
+        if key == "version":
+            return int(d["version"]) if d.get("version") else None
+        val = d.get(key)
+        return None if val in (None, "-") else val
+
+    return pa.array([one(v.as_py()) for v in s])
 
 
 def _parse_url(args, n):

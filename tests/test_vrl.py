@@ -242,8 +242,6 @@ def test_sequential_semantics_see_prior_assignments():
 
 
 def test_unsupported_constructs_fail_at_build_with_hints():
-    with pytest.raises(ConfigError, match="json_to_arrow"):
-        compile_vrl('. = parse_json!(.message)')
     with pytest.raises(ConfigError, match="supported"):
         compile_vrl('.x = some_unknown_fn(.y)')
     with pytest.raises(ConfigError):
@@ -352,11 +350,8 @@ def test_encode_json_on_list_column():
 
 
 def test_unsupported_hint_list_shrunk():
-    """split/merge/encode_json compile now; parse_syslog still hints."""
+    """Every once-hinted construct now compiles and runs."""
     b = MessageBatch.from_pydict({"x": ["a"]})
-    with pytest.raises(VrlCompileError, match="parse_regex"):
-        compile_vrl(".y = parse_syslog(.x)")
-    # and the once-rejected trio runs
     out = run_vrl('.n = length(join(split(.x, " "), "-"))', b)
     assert out.column("n").to_pylist() == [1]
 
@@ -384,3 +379,74 @@ def test_list_get_all_out_of_range_keeps_schema():
 
     assert col.type == pa.string()
     assert col.to_pylist() == [None, None]
+
+
+def test_whole_event_assignment_expands_json():
+    """`. = parse_json!(.message)` replaces the event with the parsed
+    object's columns; __meta_* and locals survive (VRL keeps metadata
+    outside the event the same way)."""
+    import pyarrow as pa
+
+    from arkflow_tpu.batch import MessageBatch as MB
+
+    rb = pa.RecordBatch.from_arrays(
+        [pa.array(['{"a": 1, "b": "x"}', '{"a": 2, "b": "y"}']),
+         pa.array(["k", "k"])],
+        names=["message", "__meta_source"])
+    out = run_vrl(
+        """
+        keep = .message
+        . = parse_json!(.message)
+        .a2 = .a * 10
+        .orig_len = length(keep)
+        """, MB(rb))
+    names = out.record_batch.schema.names
+    assert "message" not in names  # event replaced
+    assert out.column("a").to_pylist() == [1, 2]
+    assert out.column("b").to_pylist() == ["x", "y"]
+    assert out.column("a2").to_pylist() == [10, 20]
+    assert out.column("orig_len").to_pylist() == [18, 18]
+    assert out.column("__meta_source").to_pylist() == ["k", "k"]
+
+
+def test_whole_event_assignment_rejects_in_branch_and_non_json():
+    with pytest.raises(VrlCompileError, match="if-branches"):
+        compile_vrl('if .c { . = parse_json!(.m) }')
+    with pytest.raises(VrlCompileError, match="parse_json"):
+        compile_vrl('. = upcase(.m)')
+
+
+def test_parse_syslog_both_rfcs():
+    b = MessageBatch.from_pydict({"line": [
+        "<34>1 2024-03-01T12:00:00Z web01 nginx 1234 ID47 - upstream timed out",
+        "<13>Feb  5 17:32:18 host42 sshd[991]: Accepted publickey for root",
+        "not syslog at all",
+    ]})
+    out = run_vrl(
+        """
+        .sev = parse_syslog!(.line).severity
+        .fac = parse_syslog!(.line).facility
+        .host = parse_syslog!(.line).hostname
+        .app = parse_syslog!(.line).appname
+        .pid = parse_syslog!(.line).procid
+        .msg = parse_syslog!(.line).message
+        """, b)
+    assert out.column("sev").to_pylist() == [2, 5, None]
+    assert out.column("fac").to_pylist() == [4, 1, None]
+    assert out.column("host").to_pylist() == ["web01", "host42", None]
+    assert out.column("app").to_pylist() == ["nginx", "sshd", None]
+    assert out.column("pid").to_pylist() == ["1234", "991", None]
+    assert out.column("msg").to_pylist() == [
+        "upstream timed out", "Accepted publickey for root", None]
+
+
+def test_parse_syslog_edge_rows():
+    """Non-string rows and multi-element structured data: fallible (NULL),
+    and the 5424 message excludes every SD element."""
+    b = MessageBatch.from_pydict({"line": [
+        '<34>1 2024-03-01T12:00:00Z h app 1 ID [a x="1"][b y="2"] hello',
+    ], "num": [7]})
+    out = run_vrl('.msg = parse_syslog!(.line).message\n'
+                  '.bad = parse_syslog!(.num).severity', b)
+    assert out.column("msg").to_pylist() == ["hello"]
+    assert out.column("bad").to_pylist() == [None]
