@@ -68,7 +68,8 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
     )
     payload = "stream processing on tpu: sensor reading nominal, no anomaly detected"
     packing = os.environ.get("BENCH_PACKING", "0") == "1"
-    if os.environ.get("BENCH_RAGGED", "0") == "1":
+    ragged = os.environ.get("BENCH_RAGGED", "0") == "1"
+    if ragged:
         # realistic length mix (mostly short, a long tail) — the workload
         # token packing exists for; rows rotate through the mix
         word = "sensor reading nominal "
@@ -77,7 +78,11 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
     else:
         src = {"payload": payload}
     return {
-        "name": "bench",
+        # per-phase stream name: metrics are labeled by stream, so the packed
+        # phase must NOT share the padded phase's rows counter / e2e
+        # histogram (a shared name would void the first-rows compile gate
+        # and mix the two phases' quantiles)
+        "name": "bench-packed" if packing else "bench",
         "input": {
             "type": "generate",
             **src,
@@ -97,9 +102,10 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
                     "max_seq": seq,
                     # packing shrinks the row dim to ~E*avg_len/seq, so a
                     # single full-size bucket would pad the win away; a short
-                    # pow2 grid lets packed rows land near their natural size
-                    # (steady-state traffic is uniform -> one bucket serves,
-                    # grid kept small to bound tunnel compiles)
+                    # pow2 grid (down to batch//8: covers packing factors up
+                    # to ~8x, e.g. short payloads at BENCH_SEQ 128) lets
+                    # packed rows land near their natural size while keeping
+                    # the tunnel warmup bounded (10 bucket pairs, cached)
                     "batch_buckets": (sorted({max(8, batch // 8), max(8, batch // 4),
                                               max(8, batch // 2), batch})
                                       if packing else [batch]),
@@ -439,6 +445,37 @@ def main() -> None:
             pass
     _print_headline(res, tiny, batch, seq, busy1 - busy0, stall1 - stall0,
                     lat_detail, exec_rate)
+
+    # Opportunistic packed phase (chip runs only): the padded headline above
+    # is banked (printed + BENCH_RESULT.json); if token packing does better
+    # on the same workload it re-emits as the final JSON line (the driver
+    # parses the last line), self-described with packing:true. Any failure
+    # leaves the padded number standing. Even the bench's constant payload
+    # (~14 tokens vs the 32 bucket) wastes >half the MXU on padding, so this
+    # is the first-order lever toward the 100k north star.
+    if ((not tiny or os.environ.get("BENCH_FORCE_PACKED_PHASE") == "1")
+            and os.environ.get("BENCH_PACKING", "0") != "1"
+            and os.environ.get("BENCH_SKIP_PACKED", "0") != "1"):
+        try:
+            os.environ["BENCH_PACKING"] = "1"
+            busy2, stall2 = _busy_stall_from_registry()
+            exec2, exrows2 = _exec_and_example_rows()
+            res_p = asyncio.run(run_bench(seconds, batch, seq, tiny))
+            busy3, stall3 = _busy_stall_from_registry()
+            exec3, exrows3 = _exec_and_example_rows()
+            ratio_p = ((exec3 - exec2) / (exrows3 - exrows2)
+                       if exrows3 > exrows2 else 1.0)
+            print(f"bench: packed phase: {res_p['rows_per_sec']:.0f} rows/s "
+                  f"vs padded {res['rows_per_sec']:.0f}", file=sys.stderr, flush=True)
+            if res_p["rows_per_sec"] > res["rows_per_sec"]:
+                _print_headline(res_p, tiny, batch, seq, busy3 - busy2,
+                                stall3 - stall2, lat_detail,
+                                res_p["rows_per_sec"] * ratio_p)
+        except Exception as e:  # never lose the banked padded headline
+            print(f"bench: packed phase failed ({e}); padded headline stands",
+                  file=sys.stderr, flush=True)
+        finally:
+            os.environ.pop("BENCH_PACKING", None)
 
 
 def _print_headline(res: dict, tiny: bool, batch: int, seq: int,
