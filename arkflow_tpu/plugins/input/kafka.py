@@ -22,9 +22,9 @@ Config:
 
     type: kafka
     brokers: "localhost:9092"
-    topic: events
+    topics: [events, audit]   # or the single-topic form `topic: events`
     group: arkflow-grp
-    partitions: [0, 1]        # optional; default all
+    partitions: [0, 1]        # optional static assignment (single topic only)
     start: earliest           # earliest | latest (when no committed offset)
     batch_size: 500           # max records per read
     assignor: cooperative-sticky,range   # preference order; 'range' forces eager
@@ -62,9 +62,10 @@ logger = logging.getLogger("arkflow.kafka")
 class KafkaAck(Ack):
     """Commits the consumed offsets when the batch is fully written downstream."""
 
-    def __init__(self, owner: "KafkaInput", partition: int, next_offset: int,
-                 generation: int, member_id: str):
+    def __init__(self, owner: "KafkaInput", topic: str, partition: int,
+                 next_offset: int, generation: int, member_id: str):
         self.owner = owner
+        self.topic = topic
         self.partition = partition
         self.next_offset = next_offset
         self.generation = generation
@@ -72,23 +73,22 @@ class KafkaAck(Ack):
 
     async def ack(self) -> None:
         o = self.owner
+        tp = (self.topic, self.partition)
         try:
-            await o._client.offset_commit(o.group, o.topic, self.partition,
+            await o._client.offset_commit(o.group, self.topic, self.partition,
                                           self.next_offset, self.generation, self.member_id)
-            o._committed[self.partition] = max(
-                o._committed.get(self.partition, -1), self.next_offset
-            )
+            o._committed[tp] = max(o._committed.get(tp, -1), self.next_offset)
         except GroupRebalance:
             # fenced: this member lost the partition mid-flight; the new owner
             # replays from the last committed offset (at-least-once)
             if self.generation == o._generation:
                 o._rejoin_needed.set()  # stale acks from a pre-rejoin generation don't re-trigger
             logger.warning("kafka offset commit fenced (%s/%d, gen %d)",
-                           o.topic, self.partition, self.generation)
+                           self.topic, self.partition, self.generation)
         except Exception as e:
             # at-least-once: a failed commit means replay, never loss
             logger.warning("kafka offset commit failed (%s/%d): %s",
-                           o.topic, self.partition, e)
+                           self.topic, self.partition, e)
 
 
 HEARTBEAT_INTERVAL_S = 3.0
@@ -96,7 +96,7 @@ SESSION_TIMEOUT_MS = 10000
 
 
 class KafkaInput(Input):
-    def __init__(self, brokers: str, topic: str, group: str,
+    def __init__(self, brokers: str, topics: list[str], group: str,
                  partitions: Optional[list[int]], start: str, batch_size: int, codec=None,
                  client_kwargs: Optional[dict] = None,
                  assignors: tuple[str, ...] = ("cooperative-sticky", "range")):
@@ -108,9 +108,15 @@ class KafkaInput(Input):
                     f"kafka assignor {a!r} unsupported (cooperative-sticky|range)")
         if not assignors:
             raise ConfigError("kafka input needs at least one assignor")
+        if not topics:
+            raise ConfigError("kafka input needs at least one topic")
+        if partitions is not None and len(topics) > 1:
+            raise ConfigError(
+                "kafka static 'partitions' requires a single topic; "
+                "multi-topic consumption uses the group protocol")
         self.assignors = tuple(assignors)
         self.brokers = brokers
-        self.topic = topic
+        self.topics = list(topics)
         self.group = group
         self.configured_partitions = partitions
         self.start = start
@@ -118,9 +124,10 @@ class KafkaInput(Input):
         self.codec = codec
         self.client_kwargs = client_kwargs or {}
         self._client: Optional[KafkaClient] = None
-        self._offsets: dict[int, int] = {}  # next offset to fetch per partition
-        self._committed: dict[int, int] = {}
-        self._rr: list[int] = []
+        #: next offset to fetch per (topic, partition)
+        self._offsets: dict[tuple[str, int], int] = {}
+        self._committed: dict[tuple[str, int], int] = {}
+        self._rr: list[tuple[str, int]] = []
         self._rr_idx = 0
         self._closed = False
         # dynamic group membership state
@@ -138,7 +145,7 @@ class KafkaInput(Input):
     async def connect(self) -> None:
         self._client = KafkaClient(self.brokers, **self.client_kwargs)
         await self._client.connect()
-        await self._client.refresh_metadata([self.topic])
+        await self._client.refresh_metadata(self.topics)
         if self.dynamic:
             async with self._join_lock:
                 await self._join_locked()
@@ -146,18 +153,19 @@ class KafkaInput(Input):
         else:
             parts = self.configured_partitions
             if not parts:
-                raise ConfigError(f"kafka input: topic {self.topic!r} has no partitions")
-            self._rr = list(parts)
-            await self._load_offsets(parts)
+                raise ConfigError(
+                    f"kafka input: topic {self.topics[0]!r} has no partitions")
+            self._rr = [(self.topics[0], p) for p in parts]
+            await self._load_offsets(self._rr)
 
-    async def _load_offsets(self, parts: list[int]) -> None:
-        for p in parts:
-            committed = await self._client.offset_fetch(self.group, self.topic, p)
+    async def _load_offsets(self, tps: list[tuple[str, int]]) -> None:
+        for t, p in tps:
+            committed = await self._client.offset_fetch(self.group, t, p)
             if committed >= 0:
-                self._offsets[p] = committed
+                self._offsets[(t, p)] = committed
             else:
-                self._offsets[p] = await self._client.list_offsets(
-                    self.topic, p, earliest=(self.start == "earliest")
+                self._offsets[(t, p)] = await self._client.list_offsets(
+                    t, p, earliest=(self.start == "earliest")
                 )
 
     async def _join(self) -> None:
@@ -172,12 +180,14 @@ class KafkaInput(Input):
         while not self._closed:
             try:
                 cooperative_offered = "cooperative-sticky" in self.assignors
+                owned: dict[str, list[int]] = {}
+                for t, p in self._rr:
+                    owned.setdefault(t, []).append(p)
                 res = await self._client.join_group(
-                    self.group, [self.topic], member,
+                    self.group, self.topics, member,
                     session_timeout_ms=SESSION_TIMEOUT_MS,
                     assignors=self.assignors,
-                    owned=({self.topic: list(self._rr)}
-                           if cooperative_offered else None),
+                    owned=(owned if cooperative_offered else None),
                 )
                 cooperative = res.protocol == "cooperative-sticky"
                 if res.is_leader:
@@ -198,8 +208,9 @@ class KafkaInput(Input):
                     )
                 self._generation = res.generation
                 self._member_id = res.member_id
-                parts = sorted(mine.get(self.topic, []))
-                revoked: set[int] = set()
+                parts = sorted(
+                    (t, p) for t, ps in mine.items() for p in ps)
+                revoked: set[tuple[str, int]] = set()
                 if cooperative and self._joined:
                     # KIP-429 incremental adoption: retained partitions keep
                     # their in-memory fetch positions (no offset re-fetch, no
@@ -207,8 +218,8 @@ class KafkaInput(Input):
                     old = set(self._rr)
                     revoked = old - set(parts)
                     added = sorted(set(parts) - old)
-                    for p in revoked:
-                        self._offsets.pop(p, None)
+                    for tp in revoked:
+                        self._offsets.pop(tp, None)
                     self._rr = parts
                     if added:
                         await self._load_offsets(added)
@@ -276,18 +287,18 @@ class KafkaInput(Input):
                     raise EndOfInput()
                 await asyncio.sleep(0.2)
                 continue
-            p = self._rr[self._rr_idx % len(self._rr)]
+            t, p = self._rr[self._rr_idx % len(self._rr)]
             self._rr_idx += 1
-            offset = self._offsets.get(p)
+            offset = self._offsets.get((t, p))
             if offset is None:
                 continue  # assignment changed under us mid-loop
             try:
                 records, _hwm, next_offset = await self._client.fetch(
-                    self.topic, p, offset, max_wait_ms=250
+                    t, p, offset, max_wait_ms=250
                 )
             except KafkaProtocolError as e:
                 if e.code == 1:  # offset out of range: snap to earliest
-                    self._offsets[p] = await self._client.list_offsets(self.topic, p, True)
+                    self._offsets[(t, p)] = await self._client.list_offsets(t, p, True)
                     continue
                 raise
             if self._closed:
@@ -295,18 +306,18 @@ class KafkaInput(Input):
             if not records:
                 # advance past record-less batches (transaction control
                 # markers, compacted tails) or we refetch them forever
-                self._offsets[p] = max(offset, next_offset)
+                self._offsets[(t, p)] = max(offset, next_offset)
                 if self._rr_idx % len(self._rr) == 0:
                     await asyncio.sleep(0.05)
                 continue
             records = records[: self.batch_size]
-            self._offsets[p] = records[-1].offset + 1
-            batch = self._records_to_batch(records, p)
-            ack = KafkaAck(self, p, records[-1].offset + 1,
+            self._offsets[(t, p)] = records[-1].offset + 1
+            batch = self._records_to_batch(records, t, p)
+            ack = KafkaAck(self, t, p, records[-1].offset + 1,
                            self._generation, self._member_id)
             return batch, ack
 
-    def _records_to_batch(self, records, partition: int) -> MessageBatch:
+    def _records_to_batch(self, records, topic: str, partition: int) -> MessageBatch:
         values = [r.value or b"" for r in records]
         if self.codec is not None:
             base = decode_payloads(values, self.codec)
@@ -315,9 +326,9 @@ class KafkaInput(Input):
             base = MessageBatch.new_binary(values)
             per_row = records
         out = (
-            base.with_source(f"kafka:{self.topic}")
+            base.with_source(f"kafka:{topic}")
             .with_partition(partition)
-            .with_ext_metadata({"topic": self.topic})
+            .with_ext_metadata({"topic": topic})
             .with_ingest_time()
         )
         if per_row is not None and base.num_rows == len(records):
@@ -349,13 +360,20 @@ class KafkaInput(Input):
 
 @register_input("kafka")
 def _build(config: dict, resource: Resource) -> KafkaInput:
-    for req in ("brokers", "topic", "group"):
+    # 'topics: [a, b]' matches the reference schema (input/kafka.rs:39);
+    # 'topic: a' stays as the single-topic convenience form
+    raw_topics = config.get("topics", config.get("topic"))
+    if not raw_topics:
+        raise ConfigError("kafka input requires 'topics' (or 'topic')")
+    topics = ([str(t) for t in raw_topics]
+              if isinstance(raw_topics, (list, tuple)) else [str(raw_topics)])
+    for req in ("brokers", "group"):
         if not config.get(req):
             raise ConfigError(f"kafka input requires {req!r}")
     parts = config.get("partitions")
     return KafkaInput(
         brokers=str(config["brokers"]),
-        topic=str(config["topic"]),
+        topics=topics,
         group=str(config["group"]),
         partitions=[int(p) for p in parts] if parts else None,
         start=str(config.get("start", "earliest")),

@@ -556,7 +556,7 @@ def test_kafka_consumer_group_rebalance():
             c1 = build_component("input", {"type": "kafka", "brokers": brokers,
                                            "topic": "t", "group": "g"}, Resource())
             await c1.connect()
-            assert c1._rr == [0, 1]  # sole member owns everything
+            assert c1._rr == [("t", 0), ("t", 1)]  # sole member owns everything
             gen1 = c1._generation
 
             c2 = build_component("input", {"type": "kafka", "brokers": brokers,
@@ -565,13 +565,13 @@ def test_kafka_consumer_group_rebalance():
             # cooperative-sticky converges over TWO rounds (revoke, then
             # reassign): wait until the split is complete, not just gen+1
             for _ in range(200):
-                if (sorted(c1._rr + c2._rr) == [0, 1]
+                if (sorted(c1._rr + c2._rr) == [("t", 0), ("t", 1)]
                         and not c1._rejoin_needed.is_set()
                         and not c2._rejoin_needed.is_set()):
                     break
                 await asyncio.sleep(0.05)
             assert c1._generation > gen1
-            assert sorted(c1._rr + c2._rr) == [0, 1]
+            assert sorted(c1._rr + c2._rr) == [("t", 0), ("t", 1)]
             assert not (set(c1._rr) & set(c2._rr))  # disjoint split
 
             # each consumer reads only its partition
@@ -587,10 +587,10 @@ def test_kafka_consumer_group_rebalance():
             # c2 leaves; c1's heartbeat notices and reclaims both partitions
             await c2.close()
             for _ in range(100):
-                if c1._rr == [0, 1]:
+                if c1._rr == [("t", 0), ("t", 1)]:
                     break
                 await asyncio.sleep(0.05)
-            assert c1._rr == [0, 1]
+            assert c1._rr == [("t", 0), ("t", 1)]
             await c1.close()
             # offsets were committed with real generation/member (accepted)
             assert broker.group_offsets[("g", "t", p1)] >= 1
@@ -865,7 +865,7 @@ def test_cooperative_rebalance_keeps_positions_without_refetch():
             c1 = build_component("input", {"type": "kafka", "brokers": brokers,
                                            "topic": "t", "group": "g"}, Resource())
             await c1.connect()
-            assert c1._rr == [0, 1]
+            assert c1._rr == [("t", 0), ("t", 1)]
             # advance both partitions in memory WITHOUT acking: positions are
             # ahead of any committed offset, so a re-fetch would rewind them
             got = set()
@@ -889,21 +889,21 @@ def test_cooperative_rebalance_keeps_positions_without_refetch():
                                            "topic": "t", "group": "g"}, Resource())
             await c2.connect()
             for _ in range(200):
-                if (sorted(c1._rr + c2._rr) == [0, 1]
+                if (sorted(c1._rr + c2._rr) == [("t", 0), ("t", 1)]
                         and not c1._rejoin_needed.is_set()
                         and not c2._rejoin_needed.is_set()):
                     break
                 await asyncio.sleep(0.05)
-            assert sorted(c1._rr + c2._rr) == [0, 1]
+            assert sorted(c1._rr + c2._rr) == [("t", 0), ("t", 1)]
             assert len(c1._rr) == 1 and len(c2._rr) == 1
 
             kept = c1._rr[0]
             # the retained partition kept its exact in-memory position...
             assert c1._offsets[kept] == positions_before[kept]
             # ...because it was never re-fetched from the coordinator
-            assert kept not in fetches
+            assert kept[1] not in fetches
             # and the revoked partition's position is gone from c1
-            revoked = ({0, 1} - {kept}).pop()
+            revoked = ({("t", 0), ("t", 1)} - {kept}).pop()
             assert revoked not in c1._offsets
             await c1.close()
             await c2.close()
@@ -975,3 +975,48 @@ def test_cooperative_sticky_invariants_under_churn():
             sizes = [len(owned[m]["t"]) for m in members]
             assert max(sizes) - min(sizes) <= 1, (
                 f"unbalanced after convergence (trial {trial}): {sizes}")
+
+
+def test_kafka_multi_topic_subscription():
+    """`topics: [a, b]` (reference schema, input/kafka.rs:39): one consumer
+    reads both topics with per-batch topic metadata and per-topic commits."""
+    async def go():
+        broker = FakeKafkaBroker({"a": 1, "b": 1})
+        await broker.start()
+        brokers = f"127.0.0.1:{broker.port}"
+        try:
+            prod = KafkaClient(brokers)
+            await prod.connect()
+            await prod.refresh_metadata(["a", "b"])
+            await prod.produce("a", 0, [(None, b"from-a")])
+            await prod.produce("b", 0, [(None, b"from-b")])
+            await prod.close()
+
+            c = build_component("input", {"type": "kafka", "brokers": brokers,
+                                          "topics": ["a", "b"], "group": "g"},
+                                Resource())
+            await c.connect()
+            assert sorted(c._rr) == [("a", 0), ("b", 0)]
+            seen = {}
+            while len(seen) < 2:
+                batch, ack = await asyncio.wait_for(c.read(), timeout=5)
+                topic = batch.get_meta("__meta_ext_topic")
+                seen[topic] = batch.to_binary()[0]
+                await ack.ack()
+            assert seen == {"a": b"from-a", "b": b"from-b"}
+            # commits landed under the right (group, topic, partition)
+            assert broker.group_offsets[("g", "a", 0)] == 1
+            assert broker.group_offsets[("g", "b", 0)] == 1
+            await c.close()
+        finally:
+            await broker.stop()
+
+    asyncio.run(go())
+
+
+def test_kafka_multi_topic_rejects_static_partitions():
+    from arkflow_tpu.plugins.input.kafka import _build as build_kafka
+
+    with pytest.raises(ConfigError, match="single topic"):
+        build_kafka({"brokers": "b", "topics": ["a", "b"], "group": "g",
+                     "partitions": [0]}, Resource())
