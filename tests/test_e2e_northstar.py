@@ -92,3 +92,73 @@ def test_kafka_bert_kafka_end_to_end():
             await broker.stop()
 
     asyncio.run(go())
+
+
+def test_kafka_bert_kafka_packed_int8_end_to_end():
+    """The north-star shape with the round-5 perf stack on: token packing
+    (ragged payload lengths) + W8A8 int8 serving, end to end through the
+    real engine and fake brokers."""
+    async def go():
+        broker = FakeKafkaBroker({"text-in": 1, "scores-out": 1})
+        await broker.start()
+        brokers = f"127.0.0.1:{broker.port}"
+        try:
+            producer = KafkaClient(brokers)
+            await producer.connect()
+            await producer.refresh_metadata(["text-in"])
+            msgs = [(b"ok" if i % 3 == 0 else
+                     b"sensor reading %d looks nominal with extended detail "
+                     b"about the measurement window" % i)
+                    for i in range(24)]
+            await producer.produce("text-in", 0, [(None, m) for m in msgs])
+            await producer.close()
+
+            cfg = EngineConfig.from_mapping(
+                {
+                    "streams": [
+                        {
+                            "name": "northstar-packed",
+                            "input": {"type": "kafka", "brokers": brokers,
+                                      "topic": "text-in", "group": "nsp-grp",
+                                      "batch_size": 16},
+                            "buffer": {"type": "memory", "capacity": 8, "timeout": "20ms"},
+                            "pipeline": {
+                                "thread_num": 2,
+                                "processors": [
+                                    {"type": "tpu_inference", "model": "bert_classifier",
+                                     "model_config": TINY_BERT, "max_seq": 32,
+                                     "batch_buckets": [8, 16], "seq_buckets": [16, 32],
+                                     "packing": True, "serving_dtype": "int8",
+                                     "outputs": ["label", "score"]},
+                                    {"type": "arrow_to_json", "fields": ["label", "score"]},
+                                ],
+                            },
+                            "output": {"type": "kafka", "brokers": brokers,
+                                       "topic": "scores-out"},
+                        }
+                    ],
+                    "health_check": {"enabled": False},
+                }
+            )
+            engine = Engine(cfg)
+            run_task = asyncio.create_task(engine.run())
+
+            async def drain():
+                while len(broker.logs[("scores-out", 0)]) < 24:
+                    await asyncio.sleep(0.1)
+
+            await asyncio.wait_for(drain(), timeout=60)
+            engine.shutdown()
+            await asyncio.wait_for(run_task, timeout=30)
+
+            out = [v for _, v, _ in broker.logs[("scores-out", 0)]]
+            assert len(out) == 24
+            for payload in out:
+                row = json.loads(payload)
+                assert row["label"] in (0, 1)
+                assert 0.0 <= row["score"] <= 1.0
+            assert broker.group_offsets.get(("nsp-grp", "text-in", 0), 0) >= 24
+        finally:
+            await broker.stop()
+
+    asyncio.run(go())
