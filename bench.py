@@ -21,6 +21,18 @@ import os
 import time
 
 
+def _backend() -> str:
+    """The platform the bench actually executed on, read from the live jax
+    backend at emit time — a tiny=0 run forced onto CPU (JAX_PLATFORMS=cpu)
+    must not be labeled tpu by inference from flags."""
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
+
+
 def _bench_dtype(tiny: bool) -> str:
     """The serving dtype every phase runs AND every artifact is tagged with
     — single source so the tags can never disagree with what was served."""
@@ -333,7 +345,7 @@ def main() -> None:
                 "unit": "rows/s",
                 "vs_baseline": 0.0,
                 "detail": {"rows": res["rows"], "elapsed_s": round(res["elapsed_s"], 2),
-                           "batch": batch},
+                           "batch": batch, "backend": _backend()},
             }
         )
         return
@@ -409,7 +421,7 @@ def main() -> None:
         # tagged as such can never be mistaken for chip data (VERDICT r4)
         lat_tagged = dict(
             lat_detail,
-            backend="cpu" if tiny else "tpu",
+            backend=_backend(),
             serving_dtype=_bench_dtype(tiny),
             seq=seq,
             offered_rows_per_sec=LAT_OFFERED_ROWS_PER_SEC,
@@ -514,7 +526,7 @@ def _print_headline(res: dict, tiny: bool, batch: int, seq: int,
                 "device_duty_cycle": duty,
                 # every artifact self-describes backend + precision, so a
                 # CPU fallback can never masquerade as chip data (VERDICT r4)
-                "backend": "cpu" if tiny else "tpu",
+                "backend": _backend(),
                 "serving_dtype": _bench_dtype(tiny),
                 "softmax_dtype": ("float32" if tiny
                                   else os.environ.get("BENCH_SOFTMAX_DTYPE", "bfloat16")),
@@ -587,7 +599,7 @@ def _run_generate_bench(tiny: bool) -> None:
     total_tokens = rows * max_new
     detail = {"rows": rows, "max_new_tokens": max_new,
               "elapsed_s": round(elapsed, 2), "warmup_s": round(warm_s, 2),
-              "serving": "continuous", "slots": 8}
+              "serving": "continuous", "slots": 8, "backend": _backend()}
     server = getattr(proc, "_server", None)
     if server is not None and server.m_spec_drafted.value > 0:
         detail["speculative_tokens"] = server.speculative_tokens
