@@ -54,6 +54,12 @@ class BertConfig:
     #: an operator-tuned value is never clobbered.
     flash_min_seq: "int | None" = None
     flash_interpret: bool = False  # CPU-interpret mode (tests)
+    #: packed execution only: route the block-diagonal attention through the
+    #: segment flash kernel (ops/segment_attention.py) instead of an XLA
+    #: pair mask. Resolved by ModelRunner from ARKFLOW_PACKED_FLASH=1 (TPU
+    #: backends, kill-switchable via ARKFLOW_FLASH=0) — direct callers opt
+    #: in explicitly; stays off until the kernel has chip A/B numbers.
+    packed_flash: bool = False
     #: softmax accumulation dtype for XLA attention. float32 is the safe
     #: default; "bfloat16" halves the scores-tensor bandwidth, worth ~11%
     #: of the whole serving step at b1024/seq32 on a v5e (60.8 -> 54.2ms
@@ -102,15 +108,16 @@ def init(rng, cfg: BertConfig) -> dict:
 
 
 def encode(params: dict, cfg: BertConfig, input_ids, attention_mask,
-           *, positions=None, pair_mask=None):
+           *, positions=None, pair_mask=None, segments=None):
     """[B, S] ids/mask -> [B, S, hidden] bf16 encodings.
 
-    ``positions``/``pair_mask`` are the packed-execution hooks
-    (tpu/packing.py): per-token position ids and a full [B,1,Sq,Sk]
-    block-diagonal mask. A pair mask disables the ragged flash kernel —
-    it reads prefix lengths, which cannot express segment structure; packed
-    rows are ~fully dense anyway, so the kernel's skip-padded-tiles edge
-    is gone.
+    ``positions``/``pair_mask``/``segments`` are the packed-execution hooks
+    (tpu/packing.py): per-token position ids, a full [B,1,Sq,Sk]
+    block-diagonal mask, or (instead of the mask) per-token segment ids
+    driving the segment flash kernel — the mask disables the ragged flash
+    kernel (it reads prefix lengths, which cannot express segment
+    structure); ``segments`` routes to ``ops/segment_attention.py``, which
+    derives the mask in-kernel without O(S^2) HBM traffic.
     """
     b, s = input_ids.shape
     if positions is None:
@@ -126,20 +133,36 @@ def encode(params: dict, cfg: BertConfig, input_ids, attention_mask,
     else:
         mask = attention_mask[:, None, None, :].astype(bool)  # [B,1,1,Sk]
     lengths = attention_mask.astype(jnp.int32).sum(axis=1)  # contiguous-prefix masks
-    flash_ok = pair_mask is None
+    flash_ok = pair_mask is None and segments is None
+
+    def _pow2_tile() -> int:
+        # largest pow2 tile (<=128) dividing the bucket length, so any
+        # configured seq bucket works
+        tile = 1
+        while tile * 2 <= min(s, 128) and s % (tile * 2) == 0:
+            tile *= 2
+        return tile
 
     def _attend(q, k, v):
         # s is static at trace time: each bucket decides flash-vs-XLA
         # independently, so one stream can serve seq-32 on XLA and seq-512
         # on the ragged kernel from the same config
+        if segments is not None:
+            from arkflow_tpu.ops.segment_attention import segment_flash_attention
+
+            tile = _pow2_tile()
+            qh = jnp.einsum("bshd->bhsd", q)
+            kh = jnp.einsum("bshd->bhsd", k)
+            vh = jnp.einsum("bshd->bhsd", v)
+            out = segment_flash_attention(
+                qh, kh, vh, segments, tile_q=tile, tile_k=tile,
+                interpret=cfg.flash_interpret,
+            )
+            return jnp.einsum("bhsd->bshd", out)
         if flash_ok and cfg.use_flash_attention and s >= (cfg.flash_min_seq or 0):
             from arkflow_tpu.ops.ragged_attention import ragged_flash_attention
 
-            # largest pow2 tile (<=128) dividing the bucket length, so any
-            # configured seq bucket works
-            tile = 1
-            while tile * 2 <= min(s, 128) and s % (tile * 2) == 0:
-                tile *= 2
+            tile = _pow2_tile()
             qh = jnp.einsum("bshd->bhsd", q)
             kh = jnp.einsum("bshd->bhsd", k)
             vh = jnp.einsum("bshd->bhsd", v)
@@ -187,14 +210,24 @@ def apply_packed(params: dict, cfg: BertConfig, *, input_ids, segment_ids,
     (tokens never attend across examples; 0 marks dead positions), position
     embeddings follow ``position_ids``, and each example's [CLS] encoding is
     gathered from (example_row, example_pos) — outputs are [E] in original
-    example order. Fully-dead padded rows soften to a uniform attention
-    (all scores masked equally) and are sliced away by the caller.
+    example order. Fully-dead padded rows are sliced away by the caller
+    (their un-gathered encodings are path-dependent: uniform attention on
+    the XLA pair-mask path, exact zeros on the segment-kernel path).
     """
     seg = segment_ids
-    pair = (seg[:, None, :] == seg[:, :, None]) & (seg > 0)[:, None, :]
-    pair_mask = pair[:, None, :, :]  # [P, 1, Sq, Sk], broadcast over heads
-    x = encode(params, cfg, input_ids, (seg > 0).astype(jnp.int32),
-               positions=position_ids, pair_mask=pair_mask)
+    live = (seg > 0).astype(jnp.int32)
+    if cfg.packed_flash and input_ids.shape[1] >= (cfg.flash_min_seq or 0):
+        # opt-in segment flash kernel (ops/segment_attention.py): in-kernel
+        # block-diagonal masking, no O(S^2) mask in HBM. cfg-resolved (see
+        # packed_flash) so the kill switch and backend checks happen at
+        # runner altitude, never as an env read inside the jit.
+        x = encode(params, cfg, input_ids, live,
+                   positions=position_ids, segments=seg)
+    else:
+        pair = (seg[:, None, :] == seg[:, :, None]) & (seg > 0)[:, None, :]
+        pair_mask = pair[:, None, :, :]  # [P, 1, Sq, Sk], broadcast over heads
+        x = encode(params, cfg, input_ids, live,
+                   positions=position_ids, pair_mask=pair_mask)
     cls = x[example_row, example_pos, :]  # [E, hidden]
     pooled = jnp.tanh(cm.dense(params["pooler"], cls))
     logits = cm.dense(params["classifier"], pooled).astype(jnp.float32)

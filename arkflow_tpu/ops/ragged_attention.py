@@ -24,23 +24,14 @@ from jax.experimental import pallas as pl
 _NEG = -1e30
 
 
-def _ragged_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref, *, tile_k: int, causal: bool):
-    bi = pl.program_id(0)
-    qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)  # [TQ, D]
+def flash_softmax_loop(q, k_ref, v_ref, n_tiles, tile_k: int, valid_at):
+    """The online-softmax accumulation over K tiles shared by the ragged and
+    segment kernels (ops/segment_attention.py) — ONE copy of the numerically
+    delicate m/l/corr recurrence. ``valid_at(t) -> [TQ, TK] bool`` supplies
+    each kernel's masking rule. Returns (o, m, l) after ``n_tiles`` tiles.
+    """
     tq, d = q.shape
-    s = k_ref.shape[2]
     scale = 1.0 / math.sqrt(d)
-    length = lengths_ref[bi]
-
-    # K tiles that contain any valid key for this row
-    n_k_row = (length + tile_k - 1) // tile_k
-    if causal:
-        n_k_causal = ((qi + 1) * tq + tile_k - 1) // tile_k
-        n_k_row = jnp.minimum(n_k_row, n_k_causal)
-    n_k_row = jnp.minimum(n_k_row, s // tile_k)
-
-    q_pos = qi * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tile_k), 0)
 
     def body(t, carry):
         o, m, l = carry
@@ -49,12 +40,7 @@ def _ragged_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref, *, tile_k: int, caus
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        k_pos = t * tile_k + jax.lax.broadcasted_iota(jnp.int32, (tq, tile_k), 1)
-        # mask padded keys AND padded queries (pad-query rows emit zeros)
-        valid = jnp.logical_and(k_pos < length, q_pos < length)
-        if causal:
-            valid = jnp.logical_and(valid, k_pos <= q_pos)
-        scores = jnp.where(valid, scores, _NEG)
+        scores = jnp.where(valid_at(t), scores, _NEG)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         p = jnp.exp(scores - m_new[:, None])
         corr = jnp.exp(m - m_new)
@@ -67,7 +53,35 @@ def _ragged_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref, *, tile_k: int, caus
     o0 = jnp.zeros((tq, d), jnp.float32)
     m0 = jnp.full((tq,), _NEG, jnp.float32)
     l0 = jnp.zeros((tq,), jnp.float32)
-    o, m, l = jax.lax.fori_loop(0, n_k_row, body, (o0, m0, l0))
+    return jax.lax.fori_loop(0, n_tiles, body, (o0, m0, l0))
+
+
+def _ragged_kernel(lengths_ref, q_ref, k_ref, v_ref, o_ref, *, tile_k: int, causal: bool):
+    bi = pl.program_id(0)
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [TQ, D]
+    tq, d = q.shape
+    s = k_ref.shape[2]
+    length = lengths_ref[bi]
+
+    # K tiles that contain any valid key for this row
+    n_k_row = (length + tile_k - 1) // tile_k
+    if causal:
+        n_k_causal = ((qi + 1) * tq + tile_k - 1) // tile_k
+        n_k_row = jnp.minimum(n_k_row, n_k_causal)
+    n_k_row = jnp.minimum(n_k_row, s // tile_k)
+
+    q_pos = qi * tq + jax.lax.broadcasted_iota(jnp.int32, (tq, tile_k), 0)
+
+    def valid_at(t):
+        k_pos = t * tile_k + jax.lax.broadcasted_iota(jnp.int32, (tq, tile_k), 1)
+        # mask padded keys AND padded queries (pad-query rows emit zeros)
+        valid = jnp.logical_and(k_pos < length, q_pos < length)
+        if causal:
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        return valid
+
+    o, m, l = flash_softmax_loop(q, k_ref, v_ref, n_k_row, tile_k, valid_at)
     # pad queries (beyond the row's true length) emit zeros; note a fully
     # masked softmax degenerates to uniform (exp(NEG-NEG)=1), so masking by
     # the accumulator alone is not sufficient — mask by query position.

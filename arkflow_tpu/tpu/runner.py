@@ -91,7 +91,8 @@ class ModelRunner:
         self.family = get_model(model)
         self.cfg = self.family.make_config(**(model_config or {}))
         raw_flash = getattr(self.cfg, "use_flash_attention", False)
-        self.cfg = self._resolve_auto_flags(self.cfg, devices, mesh_spec)
+        self.cfg = self._resolve_auto_flags(self.cfg, devices, mesh_spec,
+                                            packed=packed)
         #: flash explicitly requested in user config (never mutated): only
         #: then does an unservable mask raise; auto-chosen flash falls back
         #: to XLA instead of failing the stream. Immutable so concurrent
@@ -224,7 +225,7 @@ class ModelRunner:
         self._last_idle_start: Optional[float] = None
 
     @staticmethod
-    def _resolve_auto_flags(cfg, devices, mesh_spec):
+    def _resolve_auto_flags(cfg, devices, mesh_spec, packed: bool = False):
         """``use_flash_attention=None`` means auto: the ragged Pallas kernel
         on single-device TPU serving (it skips the fully-padded K tiles XLA
         attention burns MXU cycles on), XLA attention elsewhere (Pallas on
@@ -232,13 +233,34 @@ class ModelRunner:
         kernel would need a shard_map wrapper, so sharded serving keeps the
         GSPMD-partitionable XLA path). ``ARKFLOW_FLASH=0`` is the operator
         kill switch: it forces the XLA path even over an explicit
-        ``use_flash_attention: true`` in config."""
+        ``use_flash_attention: true`` in config — including the packed
+        segment kernel. Packed mode: ``ARKFLOW_PACKED_FLASH=1`` opts packed
+        serving into the segment flash kernel on TPU backends (cfg field
+        ``packed_flash``, single-device only like auto flash)."""
         if not hasattr(cfg, "use_flash_attention"):
             return cfg
         import dataclasses
 
+        def _on_tpu() -> bool:
+            try:
+                dev = devices[0] if devices else jax.devices()[0]
+                return (dev.platform == "tpu"
+                        or "tpu" in getattr(dev, "device_kind", "").lower())
+            except Exception:
+                return False
+
+        if (packed and hasattr(cfg, "packed_flash")
+                and not cfg.packed_flash
+                and os.environ.get("ARKFLOW_PACKED_FLASH", "0") == "1"
+                and os.environ.get("ARKFLOW_FLASH", "1") != "0"
+                and (mesh_spec is None or mesh_spec.num_devices <= 1)
+                and (_on_tpu() or cfg.flash_interpret)):
+            cfg = dataclasses.replace(cfg, packed_flash=True)
+
         if os.environ.get("ARKFLOW_FLASH", "1") == "0":
-            return dataclasses.replace(cfg, use_flash_attention=False)
+            return dataclasses.replace(cfg, use_flash_attention=False,
+                                       **({"packed_flash": False}
+                                          if hasattr(cfg, "packed_flash") else {}))
         if cfg.use_flash_attention is not None:
             # explicit config keeps its own floor; when config left the
             # floor unset, a set ARKFLOW_FLASH_MIN_SEQ fills it (a
