@@ -261,6 +261,18 @@ class ModelRunner:
             return dataclasses.replace(cfg, use_flash_attention=False,
                                        **({"packed_flash": False}
                                           if hasattr(cfg, "packed_flash") else {}))
+        if packed and getattr(cfg, "packed_flash", False):
+            # an EXPLICIT packed_flash in config must meet the same guards
+            # the env grant enforces — fail at construction, not with a
+            # Mosaic lowering error on the first packed step
+            if mesh_spec is not None and mesh_spec.num_devices > 1:
+                raise ConfigError(
+                    "packed_flash is single-device for now (the segment "
+                    "kernel needs a shard_map wrapper under a mesh)")
+            if not (_on_tpu() or cfg.flash_interpret):
+                raise ConfigError(
+                    "packed_flash requires a TPU backend "
+                    "(or flash_interpret for CPU tests)")
         if cfg.use_flash_attention is not None:
             # explicit config keeps its own floor; when config left the
             # floor unset, a set ARKFLOW_FLASH_MIN_SEQ fills it (a
@@ -275,11 +287,7 @@ class ModelRunner:
             return cfg
         if mesh_spec is not None and mesh_spec.num_devices > 1:
             return dataclasses.replace(cfg, use_flash_attention=False)
-        try:
-            dev = devices[0] if devices else jax.devices()[0]
-            on_tpu = dev.platform == "tpu" or "tpu" in getattr(dev, "device_kind", "").lower()
-        except Exception:
-            on_tpu = False
+        on_tpu = _on_tpu()
         extra = {}
         if on_tpu and getattr(cfg, "flash_min_seq", 0) is None:
             # auto-chosen flash only engages at seqs where the kernel wins:

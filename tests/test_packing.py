@@ -388,3 +388,34 @@ def test_runner_resolves_packed_flash_with_kill_switch(monkeypatch):
     monkeypatch.setenv("ARKFLOW_FLASH", "0")
     killed = ModelRunner("bert_classifier", cfgk, buckets=buckets, packed=True)
     assert not killed.cfg.packed_flash
+
+
+def test_explicit_packed_flash_guards_at_construction():
+    """An explicit packed_flash: true in model_config must meet the same
+    backend/mesh guards as the env grant — ConfigError at construction, not
+    a Pallas lowering failure mid-stream."""
+    import jax
+
+    from arkflow_tpu.errors import ConfigError
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.bucketing import BucketPolicy
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    buckets = BucketPolicy((8,), (16, 32))
+    # CPU backend without interpret mode: rejected
+    with pytest.raises(ConfigError, match="TPU backend"):
+        ModelRunner("bert_classifier", dict(TINY_BERT, packed_flash=True),
+                    buckets=buckets, packed=True)
+    # multi-device mesh: rejected even with interpret
+    devs = jax.devices("cpu")
+    if len(devs) >= 2:
+        with pytest.raises(ConfigError, match="single-device"):
+            ModelRunner("bert_classifier",
+                        dict(TINY_BERT, packed_flash=True, flash_interpret=True),
+                        buckets=buckets, packed=True,
+                        mesh_spec=MeshSpec(tp=2), devices=devs[:2])
+    # interpret single-device: accepted
+    ok = ModelRunner("bert_classifier",
+                     dict(TINY_BERT, packed_flash=True, flash_interpret=True),
+                     buckets=buckets, packed=True)
+    assert ok.cfg.packed_flash
