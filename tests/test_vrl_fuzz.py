@@ -1,0 +1,249 @@
+"""Differential fuzz: random VRL programs vs a row-wise oracle.
+
+The columnar VRL compiler (sql/vrl.py) earned advisor findings in rounds 3
+and 4 for branch/locals semantics. This test generates hundreds of random
+programs over the supported surface (assignments, locals, nested if/else-if,
+abort, Kleene logic, null propagation, ``??``) and checks the vectorized
+execution against a per-row interpreter encoding the INTENDED semantics.
+
+The generator builds every expression twice in lockstep — VRL source text
+AND a Python closure — so the oracle never parses anything: it executes the
+structured program directly with:
+
+- branch choice fixed at entry; null/false predicates route to else
+- locals bound by value; non-matching rows keep the pre-branch value
+- arithmetic/comparison null-propagation; Kleene and/or; not(null)=null
+- abort drops exactly the rows whose branch matched at entry
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.sql.vrl import apply_vrl, compile_vrl
+
+COLS = ["a", "b", "c"]  # int columns (with nulls)
+
+
+def _arith(op):
+    def fn(x, y):
+        if x is None or y is None:
+            return None
+        return {"+": x + y, "-": x - y, "*": x * y}[op]
+
+    return fn
+
+
+def _cmp(op):
+    def fn(x, y):
+        if x is None or y is None:
+            return None
+        return {"==": x == y, "!=": x != y, "<": x < y, "<=": x <= y,
+                ">": x > y, ">=": x >= y}[op]
+
+    return fn
+
+
+def _k_and(x, y):
+    if x is False or y is False:
+        return False
+    if x is None or y is None:
+        return None
+    return bool(x and y)
+
+
+def _k_or(x, y):
+    if x is True or y is True:
+        return True
+    if x is None or y is None:
+        return None
+    return bool(x or y)
+
+
+class _Gen:
+    """Random program generator; every node yields (vrl_text, closure) where
+    closure(row, env) evaluates the node under the intended semantics."""
+
+    def __init__(self, rng: np.random.RandomState):
+        self.rng = rng
+        self.locals: list[str] = []
+        self.n_locals = 0
+
+    def atom(self):
+        r = self.rng.rand()
+        if r < 0.45:
+            col = COLS[self.rng.randint(len(COLS))]
+            return "." + col, (lambda row, env, c=col: row.get(c))
+        if r < 0.65 and self.locals:
+            name = self.locals[self.rng.randint(len(self.locals))]
+            return name, (lambda row, env, n=name: env.get(n))
+        v = int(self.rng.randint(-5, 10))
+        return str(v), (lambda row, env, k=v: k)
+
+    def int_expr(self, depth: int = 0):
+        if depth >= 2 or self.rng.rand() < 0.4:
+            return self.atom()
+        if self.rng.rand() < 0.15:
+            src, f = self.atom()
+            d = int(self.rng.randint(0, 5))
+            return (f"({src} ?? {d})",
+                    lambda row, env, f=f, d=d: d if f(row, env) is None else f(row, env))
+        op = ["+", "-", "*"][self.rng.randint(3)]
+        ls, lf = self.int_expr(depth + 1)
+        rs, rf = self.int_expr(depth + 1)
+        opf = _arith(op)
+        return (f"({ls} {op} {rs})",
+                lambda row, env, lf=lf, rf=rf, opf=opf: opf(lf(row, env), rf(row, env)))
+
+    def cond(self, depth: int = 0):
+        r = self.rng.rand()
+        if r < 0.5 or depth >= 1:
+            op = ["==", "!=", "<", "<=", ">", ">="][self.rng.randint(6)]
+            ls, lf = self.int_expr(1)
+            rs, rf = self.int_expr(1)
+            opf = _cmp(op)
+            return (f"{ls} {op} {rs}",
+                    lambda row, env, lf=lf, rf=rf, opf=opf: opf(lf(row, env), rf(row, env)))
+        if r < 0.7:
+            cs, cf = self.cond(depth + 1)
+            return (f"!({cs})",
+                    lambda row, env, cf=cf: (None if cf(row, env) is None
+                                             else not cf(row, env)))
+        ls, lf = self.cond(depth + 1)
+        rs, rf = self.cond(depth + 1)
+        if self.rng.rand() < 0.5:
+            return (f"({ls} && {rs})",
+                    lambda row, env, lf=lf, rf=rf: _k_and(lf(row, env), rf(row, env)))
+        return (f"({ls} || {rs})",
+                lambda row, env, lf=lf, rf=rf: _k_or(lf(row, env), rf(row, env)))
+
+    # statements are structured nodes: ("set", target, fn) / ("local", name,
+    # fn) / ("abort",) / ("if", [(cond_fn, body), ...], else_body)
+    def assignment(self):
+        if self.rng.rand() < 0.25:
+            self.n_locals += 1
+            name = f"t{self.n_locals}"
+            src, f = self.int_expr()
+            self.locals.append(name)
+            return f"{name} = {src}", ("local", name, f)
+        target = (COLS[self.rng.randint(len(COLS))]
+                  if self.rng.rand() < 0.5
+                  else f"out{self.rng.randint(3)}")
+        src, f = self.int_expr()
+        return f".{target} = {src}", ("set", target, f)
+
+    def block(self, allow_abort: bool):
+        texts, nodes = [], []
+        for _ in range(self.rng.randint(1, 3)):
+            t, node = self.assignment()
+            texts.append("  " + t)
+            nodes.append(node)
+        if allow_abort and self.rng.rand() < 0.15:
+            texts.append("  abort")
+            nodes.append(("abort",))
+        return texts, nodes
+
+    def if_stmt(self):
+        cs, cf = self.cond()
+        texts = [f"if {cs} {{"]
+        bt, bn = self.block(allow_abort=True)
+        texts += bt
+        chain = [(cf, bn)]
+        if self.rng.rand() < 0.3:
+            cs2, cf2 = self.cond()
+            texts.append(f"}} else if {cs2} {{")
+            bt2, bn2 = self.block(allow_abort=False)
+            texts += bt2
+            chain.append((cf2, bn2))
+        else_body = None
+        if self.rng.rand() < 0.6:
+            texts.append("} else {")
+            bt3, bn3 = self.block(allow_abort=self.rng.rand() < 0.3)
+            texts += bt3
+            else_body = bn3
+        texts.append("}")
+        return texts, ("if", chain, else_body)
+
+    def program(self):
+        texts: list[str] = []
+        nodes: list = []
+        for _ in range(self.rng.randint(2, 5)):
+            if self.rng.rand() < 0.4:
+                t, node = self.if_stmt()
+                texts += t
+            else:
+                t, node = self.assignment()
+                texts.append(t)
+            nodes.append(node)
+        return "\n".join(texts), nodes
+
+
+def _oracle_run(nodes, rows):
+    out_rows = []
+    for row in rows:
+        row = dict(row)
+        env: dict = {}
+        dropped = False
+
+        def run(block):
+            nonlocal dropped
+            for node in block:
+                if dropped:
+                    return
+                kind = node[0]
+                if kind == "set":
+                    row[node[1]] = node[2](row, env)
+                elif kind == "local":
+                    env[node[1]] = node[2](row, env)
+                elif kind == "abort":
+                    dropped = True
+                elif kind == "if":
+                    _, chain, else_body = node
+                    taken = False
+                    for cf, body in chain:
+                        if cf(row, env) is True:  # null/false -> next branch
+                            run(body)
+                            taken = True
+                            break
+                    if not taken and else_body is not None:
+                        run(else_body)
+
+        run(nodes)
+        if not dropped:
+            out_rows.append(row)
+    return out_rows
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_vrl_fuzz_matches_row_oracle(seed):
+    rng = np.random.RandomState(seed)
+    for trial in range(25):
+        gen = _Gen(rng)
+        program, nodes = gen.program()
+        n = 12
+        rows = []
+        for _ in range(n):
+            rows.append({
+                c: None if rng.rand() < 0.2 else int(rng.randint(-5, 10))
+                for c in COLS})
+        batch = MessageBatch.from_pydict({c: [r[c] for r in rows] for c in COLS})
+        try:
+            steps = compile_vrl(program)
+        except Exception as e:  # the generator must stay inside the surface
+            raise AssertionError(f"program failed to compile:\n{program}\n{e}")
+        got = apply_vrl(batch, steps)
+        want = _oracle_run(nodes, rows)
+
+        assert got.num_rows == len(want), (
+            f"row count {got.num_rows} != oracle {len(want)}\n{program}")
+        got_cols = {name: got.column(name).to_pylist()
+                    for name in got.record_batch.schema.names}
+        for key in sorted({k for r in want for k in r}):
+            want_vals = [r.get(key) for r in want]
+            got_vals = got_cols.get(key, [None] * len(want))
+            assert got_vals == want_vals, (
+                f"column {key!r} diverged (seed {seed} trial {trial})\n"
+                f"program:\n{program}\n"
+                f"oracle:   {want_vals}\ncompiled: {got_vals}")
