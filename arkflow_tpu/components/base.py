@@ -31,9 +31,25 @@ from arkflow_tpu.batch import MessageBatch
 class Ack(abc.ABC):
     """Acknowledgement handle delivered alongside every read batch."""
 
+    #: True only when ``nack()`` causes IN-SESSION redelivery that the stream
+    #: will see again (and can count toward ``max_delivery_attempts``). False
+    #: for brokers that only redeliver across consumer restarts (kafka offset
+    #: non-commit) — their attempt counters would reset with the process, so
+    #: the stream quarantines failing batches immediately instead of nacking.
+    redeliverable = False
+
     @abc.abstractmethod
     async def ack(self) -> None:
         """Confirm downstream success (commit offsets, ack broker, ...)."""
+
+    async def nack(self) -> None:
+        """Delivery gave up without success: request redelivery now instead
+        of waiting for the broker's ack timeout. Default no-op — sources
+        whose broker redelivers unacked messages on its own (kafka offset
+        non-commit, mqtt QoS1) need nothing here; in-process test brokers
+        (the fault-injection wrapper) requeue immediately and set
+        ``redeliverable``."""
+        return None
 
 
 class NoopAck(Ack):
@@ -52,9 +68,18 @@ class VecAck(Ack):
     def push(self, ack: Ack) -> None:
         self.acks.append(ack)
 
+    @property
+    def redeliverable(self) -> bool:  # type: ignore[override]
+        return bool(self.acks) and all(
+            getattr(a, "redeliverable", False) for a in self.acks)
+
     async def ack(self) -> None:
         for a in self.acks:
             await a.ack()
+
+    async def nack(self) -> None:
+        for a in self.acks:
+            await a.nack()
 
 
 class FnAck(Ack):

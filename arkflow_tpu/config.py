@@ -13,7 +13,11 @@ from __future__ import annotations
 
 import json
 import os
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # python < 3.11: the vendored fallback has the same API
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Mapping, Optional
@@ -30,6 +34,11 @@ class PipelineConfig:
     #: >0 runs the chain in that many worker PROCESSES (GIL escape for
     #: Python-bound transforms; see runtime/procpool.py). 0 = in-process.
     process_pool: int = 0
+    #: how many times a batch may be delivered (processed + written) before
+    #: it is quarantined to error_output instead of redelivered. 1 keeps the
+    #: quarantine-on-first-failure behavior; >1 lets transient processing
+    #: failures heal through broker/nack redelivery.
+    max_delivery_attempts: int = 1
 
     @classmethod
     def from_mapping(cls, m: Mapping[str, Any]) -> "PipelineConfig":
@@ -45,8 +54,12 @@ class PipelineConfig:
         procs = m.get("processors", [])
         if not isinstance(procs, list):
             raise ConfigError("pipeline.processors must be a list")
+        attempts = m.get("max_delivery_attempts", 1)
+        if not isinstance(attempts, int) or attempts < 1:
+            raise ConfigError(
+                f"pipeline.max_delivery_attempts must be an int >= 1, got {attempts!r}")
         return cls(thread_num=threads, processors=[dict(p) for p in procs],
-                   process_pool=pool)
+                   process_pool=pool, max_delivery_attempts=attempts)
 
     def effective_threads(self) -> int:
         return self.thread_num if self.thread_num > 0 else (os.cpu_count() or 1)
@@ -80,9 +93,24 @@ class StreamConfig:
     #: ref engine/mod.rs:268-273); a run longer than reset_after restores
     #: the full retry budget; None keeps log-and-stop behavior
     restart: Optional[dict] = None
+    #: delivery-path retry for output.write (from ``output.retry``; the key
+    #: also stays visible to connector builders that use it for connect-time
+    #: retries, e.g. pulsar). None -> RetryConfig defaults.
+    output_retry: Optional[object] = None
+    #: circuit breaker over output.write (from ``output.circuit_breaker``);
+    #: None -> disabled
+    output_circuit_breaker: Optional[object] = None
+    error_output_retry: Optional[object] = None
+    error_output_circuit_breaker: Optional[object] = None
+    #: capped-exponential reconnect schedule after input Disconnection (from
+    #: ``input.reconnect``); None -> stream defaults (100ms doubling to 5s)
+    input_reconnect: Optional[object] = None
 
     @classmethod
     def from_mapping(cls, m: Mapping[str, Any]) -> "StreamConfig":
+        from arkflow_tpu.utils.circuit_breaker import CircuitBreakerConfig
+        from arkflow_tpu.utils.retry import RetryConfig
+
         if not isinstance(m, Mapping):
             raise ConfigError("stream config must be a mapping")
         for req in ("input", "output"):
@@ -90,15 +118,30 @@ class StreamConfig:
                 raise ConfigError(f"stream config missing required section {req!r}")
         pipeline = PipelineConfig.from_mapping(m.get("pipeline", {}))
         temps = [TemporaryConfig.from_mapping(t) for t in m.get("temporary", [])]
+        input_cfg = dict(m["input"])
+        reconnect = input_cfg.pop("reconnect", None)
+        output_cfg = dict(m["output"])
+        out_breaker = CircuitBreakerConfig.from_config(output_cfg.pop("circuit_breaker", None))
+        out_retry = RetryConfig.from_config(output_cfg["retry"]) if output_cfg.get("retry") else None
+        err_cfg = dict(m["error_output"]) if m.get("error_output") else None
+        err_breaker = err_retry = None
+        if err_cfg is not None:
+            err_breaker = CircuitBreakerConfig.from_config(err_cfg.pop("circuit_breaker", None))
+            err_retry = RetryConfig.from_config(err_cfg["retry"]) if err_cfg.get("retry") else None
         return cls(
-            input=dict(m["input"]),
+            input=input_cfg,
             pipeline=pipeline,
-            output=dict(m["output"]),
-            error_output=dict(m["error_output"]) if m.get("error_output") else None,
+            output=output_cfg,
+            error_output=err_cfg,
             buffer=dict(m["buffer"]) if m.get("buffer") else None,
             temporary=temps,
             name=m.get("name"),
             restart=_restart_config(m.get("restart")),
+            output_retry=out_retry,
+            output_circuit_breaker=out_breaker,
+            error_output_retry=err_retry,
+            error_output_circuit_breaker=err_breaker,
+            input_reconnect=RetryConfig.from_config(reconnect) if reconnect else None,
         )
 
 

@@ -8,3 +8,4 @@ import arkflow_tpu.plugins.output  # noqa: F401
 import arkflow_tpu.plugins.processor  # noqa: F401
 import arkflow_tpu.plugins.buffer  # noqa: F401
 import arkflow_tpu.plugins.temporary  # noqa: F401
+import arkflow_tpu.plugins.fault  # noqa: F401

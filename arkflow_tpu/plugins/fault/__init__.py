@@ -1,0 +1,9 @@
+"""Fault-injection chaos layer.
+
+Wrap any input / output / processor with ``type: fault`` to inject seeded,
+reproducible fault schedules (disconnects, transient write errors, latency
+spikes, ack failures/duplicates, crash-at-batch-N) — the machinery that lets
+chaos tests prove the runtime's at-least-once delivery claims end to end.
+"""
+
+import arkflow_tpu.plugins.fault.wrappers  # noqa: F401

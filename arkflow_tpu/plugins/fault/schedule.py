@@ -1,0 +1,138 @@
+"""Deterministic fault schedules.
+
+A schedule is a list of fault specs consulted once per operation (read /
+write / process call) of the wrapper that owns it. Triggers:
+
+- ``at: N``       fire at the Nth operation (1-based), ``times`` consecutive
+                  operations (default 1)
+- ``every: N``    fire on every Nth operation
+- ``rate: 0.05``  seeded random firing probability per operation
+- ``match: "s"``  fire when the batch payload contains the substring —
+                  content-deterministic poison pills that survive redelivery
+                  reordering (output/processor faults only)
+
+``times`` bounds the total number of firings (0 = unlimited; defaults to 1
+for ``at`` triggers, unlimited otherwise). Firing state lives inside the
+spec's own config dict (``_state``), which the engine shares across stream
+rebuilds — so a ``crash`` fault fires exactly ``times`` times even when a
+restart policy rebuilds the component from the same config.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Mapping, Optional
+
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.utils.duration import parse_duration
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    at: Optional[int] = None
+    every: Optional[int] = None
+    rate: float = 0.0
+    times: int = 1  # 0 = unlimited
+    duration_s: float = 0.0
+    match: Optional[bytes] = None
+    message: str = ""
+    #: mutable firing state, shared with the config dict so it survives
+    #: stream rebuilds under a restart policy
+    state: dict = field(default_factory=dict)
+
+    @property
+    def fired(self) -> int:
+        return self.state.get("fired", 0)
+
+    def _mark_fired(self) -> None:
+        self.state["fired"] = self.fired + 1
+
+
+def parse_faults(cfg_list: Any, allowed_kinds: frozenset[str],
+                 family: str) -> list[FaultSpec]:
+    if cfg_list is None:
+        return []
+    if not isinstance(cfg_list, list):
+        raise ConfigError(f"fault {family}: 'faults' must be a list")
+    specs: list[FaultSpec] = []
+    for raw in cfg_list:
+        if not isinstance(raw, Mapping):
+            raise ConfigError(f"fault {family}: each fault must be a mapping")
+        kind = raw.get("kind")
+        if kind not in allowed_kinds:
+            raise ConfigError(
+                f"fault {family}: unknown kind {kind!r} (allowed: {sorted(allowed_kinds)})")
+        at = raw.get("at")
+        every = raw.get("every")
+        rate = float(raw.get("rate", 0.0))
+        match = raw.get("match")
+        if match is not None and family == "input":
+            # input reads have no payload yet when faults are decided, so a
+            # match trigger would silently never fire — reject it loudly
+            raise ConfigError(
+                "fault input: 'match' is only supported on output/processor faults")
+        if at is None and every is None and rate == 0.0 and match is None:
+            raise ConfigError(
+                f"fault {family}: {kind} needs a trigger (at / every / rate / match)")
+        if at is not None and (not isinstance(at, int) or at < 1):
+            raise ConfigError(f"fault {family}: 'at' must be an int >= 1")
+        if every is not None and (not isinstance(every, int) or every < 1):
+            raise ConfigError(f"fault {family}: 'every' must be an int >= 1")
+        if not (0.0 <= rate <= 1.0):
+            raise ConfigError(f"fault {family}: 'rate' must be in [0, 1]")
+        times = raw.get("times", 1 if at is not None else 0)
+        if not isinstance(times, int) or times < 0:
+            raise ConfigError(f"fault {family}: 'times' must be an int >= 0")
+        duration = raw.get("duration")
+        spec = FaultSpec(
+            kind=kind,
+            at=at,
+            every=every,
+            rate=rate,
+            times=times,
+            duration_s=parse_duration(duration) if duration is not None else 0.0,
+            match=match.encode() if isinstance(match, str) else match,
+            message=str(raw.get("message", f"chaos: injected {kind}")),
+            # setdefault on the RAW config dict: rebuilds of the same config
+            # see the same state, making one-shot faults truly one-shot
+            state=raw.setdefault("_state", {}) if isinstance(raw, dict) else {},
+        )
+        specs.append(spec)
+    return specs
+
+
+class FaultSchedule:
+    """Per-wrapper schedule; one seeded RNG drives every ``rate`` trigger so
+    a given (seed, operation sequence) always produces the same faults."""
+
+    def __init__(self, specs: Iterable[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self._rng = random.Random(seed)
+
+    def due(self, op: int, payload: Optional[bytes] = None,
+            kinds: Optional[frozenset[str]] = None) -> list[FaultSpec]:
+        """Specs firing at 1-based operation ``op``; consumes firing budgets."""
+        out: list[FaultSpec] = []
+        for spec in self.specs:
+            if kinds is not None and spec.kind not in kinds:
+                continue
+            trig = False
+            if spec.at is not None:
+                trig = op >= spec.at
+            elif spec.every is not None:
+                trig = op % spec.every == 0
+            elif spec.rate > 0.0:
+                trig = self._rng.random() < spec.rate
+            elif spec.match is not None:
+                trig = True  # pure content trigger
+            if trig and spec.match is not None:
+                trig = payload is not None and spec.match in payload
+            if not trig:
+                continue
+            if spec.times and spec.fired >= spec.times:
+                continue
+            spec._mark_fired()
+            out.append(spec)
+        return out

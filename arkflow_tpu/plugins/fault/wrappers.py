@@ -1,0 +1,325 @@
+"""Fault-injecting component wrappers (``type: fault``).
+
+Decorate any inner input / output / processor from config and inject seeded,
+reproducible faults on its operation stream:
+
+    input:
+      type: fault
+      seed: 7
+      redeliver_unacked: true       # act as an in-process broker: nacked /
+                                    # ack-failed batches are redelivered and
+                                    # EOF waits for in-flight deliveries
+      inner: {type: memory, messages: [...]}
+      faults:
+        - {kind: disconnect, at: 4}           # read #4 raises Disconnection
+        - {kind: reconnect_fail, at: 1}       # first reconnect probe fails
+        - {kind: latency, every: 3, duration: 5ms}
+        - {kind: ack_fail, at: 2}             # that read's ack raises once
+        - {kind: ack_dup, at: 5}              # that read's ack fires twice
+        - {kind: crash, at: 9}                # non-Ark error: crashes stream
+
+    output:
+      type: fault
+      inner: {type: drop}
+      faults:
+        - {kind: error, at: 2, times: 3}      # 3 consecutive write attempts fail
+        - {kind: error, match: poison}        # every write of a poison batch
+        - {kind: latency, rate: 0.1, duration: 10ms}
+
+    processors:
+      - type: fault
+        inner: {type: python, ...}            # optional; identity when absent
+        faults:
+          - {kind: error, match: poison}      # content-deterministic poison pill
+
+Crash faults raise a plain RuntimeError (not ArkError) so they escape the
+stream's contained error paths and exercise the engine restart policy; their
+firing state lives in the config dict and survives rebuilds, so
+crash-at-batch-N fires exactly once across restarts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+from typing import Optional
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import (
+    Ack,
+    Input,
+    Output,
+    Processor,
+    Resource,
+    register_input,
+    register_output,
+    register_processor,
+)
+from arkflow_tpu.components.registry import build_component
+from arkflow_tpu.errors import (
+    ArkError,
+    ConfigError,
+    ConnectError,
+    Disconnection,
+    EndOfInput,
+    ProcessError,
+    ReadError,
+    WriteError,
+)
+from arkflow_tpu.plugins.fault.schedule import FaultSchedule, FaultSpec, parse_faults
+
+INPUT_KINDS = frozenset(
+    {"latency", "disconnect", "error", "crash", "ack_fail", "ack_dup", "reconnect_fail"})
+OUTPUT_KINDS = frozenset({"latency", "error", "crash"})
+PROCESSOR_KINDS = frozenset({"latency", "error", "crash"})
+
+#: faults applied before the inner read (they replace the read, losing no data)
+_PRE_READ_KINDS = frozenset({"latency", "disconnect", "error", "crash"})
+_ACK_KINDS = frozenset({"ack_fail", "ack_dup"})
+#: kinds evaluated against the read-op counter; reconnect_fail is excluded —
+#: it runs on its own reconnect counter, and letting read ops see it would
+#: silently consume its firing budget before any reconnect happens
+_READ_KINDS = _PRE_READ_KINDS | _ACK_KINDS
+
+
+def _batch_bytes(batch: MessageBatch) -> bytes:
+    """Payload bytes used for ``match`` triggers."""
+    try:
+        return b"\n".join(batch.to_binary())
+    except ArkError:
+        return repr(batch.to_pydict()).encode()
+
+
+class _TrackingAck(Ack):
+    """Ack wrapper: applies injected ack faults and reports settlement back
+    to the owning input for redelivery bookkeeping."""
+
+    def __init__(self, owner: "FaultInjectingInput", batch: MessageBatch,
+                 inner: Ack, fail_times: int = 0, dup: bool = False,
+                 tracked: bool = False):
+        self._owner = owner
+        self._batch = batch
+        self._inner = inner
+        self._fail_times = fail_times
+        self._dup = dup
+        self._tracked = tracked
+        # the stream's attempt-budgeted nack path engages only for acks
+        # whose source actually redelivers after a nack in-session
+        self.redeliverable = owner.redeliver_unacked
+        self._settled = False
+
+    def _settle(self) -> None:
+        if not self._settled:
+            self._settled = True
+            if self._tracked:
+                self._owner._on_settled()
+
+    async def ack(self) -> None:
+        if self._fail_times > 0:
+            self._fail_times -= 1
+            # a lost ack means the broker will redeliver: simulate that —
+            # but only when this wrapper IS the broker; without
+            # redeliver_unacked a requeued batch would sit in a deque the
+            # EOF path never drains
+            if self._owner.redeliver_unacked:
+                self._owner._requeue(self._batch, self._inner)
+            self._settle()
+            raise WriteError("chaos: injected ack failure")
+        await self._inner.ack()
+        if self._dup:
+            self._dup = False
+            await self._inner.ack()  # duplicated ack must be harmless
+        self._settle()
+
+    async def nack(self) -> None:
+        if self._owner.redeliver_unacked:
+            self._owner._requeue(self._batch, self._inner)
+        else:
+            await self._inner.nack()
+        self._settle()
+
+
+class FaultInjectingInput(Input):
+    def __init__(self, inner: Input, schedule: FaultSchedule,
+                 redeliver_unacked: bool = False):
+        self._inner = inner
+        self._sched = schedule
+        self.redeliver_unacked = redeliver_unacked
+        self._connected = False
+        self._reads = 0
+        self._reconnects = 0
+        self._inner_eof = False
+        self._outstanding = 0
+        self._requeued: deque[tuple[MessageBatch, Ack]] = deque()
+        self._settled_ev = asyncio.Event()
+
+    # -- redelivery bookkeeping -------------------------------------------
+
+    def _requeue(self, batch: MessageBatch, inner_ack: Ack) -> None:
+        self._requeued.append((batch, inner_ack))
+
+    def _on_settled(self) -> None:
+        self._outstanding -= 1
+        self._settled_ev.set()
+
+    # -- Input contract ----------------------------------------------------
+
+    async def connect(self) -> None:
+        if not self._connected:
+            await self._inner.connect()
+            self._connected = True
+            return
+        # later connects are reconnect probes after an injected Disconnection;
+        # the inner component is NOT reset (a real broker keeps its log —
+        # resetting a memory input would fabricate redeliveries)
+        self._reconnects += 1
+        for spec in self._sched.due(self._reconnects, kinds=frozenset({"reconnect_fail"})):
+            raise ConnectError(spec.message)
+
+    async def read(self) -> tuple[MessageBatch, Ack]:
+        while True:
+            if self._requeued:
+                batch, inner_ack = self._requeued.popleft()
+                return self._hand_out(batch, inner_ack, ())
+            if self._inner_eof:
+                if not self.redeliver_unacked or self._outstanding == 0:
+                    raise EndOfInput()
+                # in-flight deliveries may still nack; EOF only once settled
+                self._settled_ev.clear()
+                if self._outstanding > 0 and not self._requeued:
+                    await self._settled_ev.wait()
+                continue
+            self._reads += 1
+            due = self._sched.due(self._reads, kinds=_READ_KINDS)
+            for spec in due:
+                if spec.kind not in _PRE_READ_KINDS:
+                    continue
+                if spec.kind == "latency":
+                    await asyncio.sleep(spec.duration_s)
+                elif spec.kind == "disconnect":
+                    raise Disconnection(spec.message)
+                elif spec.kind == "error":
+                    raise ReadError(spec.message)
+                elif spec.kind == "crash":
+                    raise RuntimeError(spec.message)
+            try:
+                batch, ack = await self._inner.read()
+            except EndOfInput:
+                self._inner_eof = True
+                continue
+            ack_specs = tuple(s for s in due if s.kind in _ACK_KINDS)
+            return self._hand_out(batch, ack, ack_specs)
+
+    def _hand_out(self, batch: MessageBatch, inner_ack: Ack,
+                  ack_specs: tuple[FaultSpec, ...]) -> tuple[MessageBatch, Ack]:
+        if not self.redeliver_unacked and not ack_specs:
+            return batch, inner_ack
+        if self.redeliver_unacked:
+            self._outstanding += 1
+        fail_times = sum(1 for s in ack_specs if s.kind == "ack_fail")
+        dup = any(s.kind == "ack_dup" for s in ack_specs)
+        return batch, _TrackingAck(self, batch, inner_ack, fail_times, dup,
+                                   tracked=self.redeliver_unacked)
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+
+class FaultInjectingOutput(Output):
+    def __init__(self, inner: Output, schedule: FaultSchedule):
+        self._inner = inner
+        self._sched = schedule
+        self._writes = 0
+        # serializing the batch for match triggers is per-write work; skip
+        # it entirely when no configured fault inspects content
+        self._needs_payload = any(s.match is not None for s in schedule.specs)
+
+    @property
+    def inner(self) -> Output:
+        return self._inner
+
+    async def connect(self) -> None:
+        await self._inner.connect()
+
+    async def write(self, batch: MessageBatch) -> None:
+        self._writes += 1
+        payload = _batch_bytes(batch) if self._needs_payload else None
+        for spec in self._sched.due(self._writes, payload=payload):
+            if spec.kind == "latency":
+                await asyncio.sleep(spec.duration_s)
+            elif spec.kind == "error":
+                raise WriteError(spec.message)
+            elif spec.kind == "crash":
+                raise RuntimeError(spec.message)
+        await self._inner.write(batch)
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+
+class FaultInjectingProcessor(Processor):
+    def __init__(self, inner: Optional[Processor], schedule: FaultSchedule):
+        self._inner = inner
+        self._sched = schedule
+        self._calls = 0
+        self._needs_payload = any(s.match is not None for s in schedule.specs)
+
+    async def connect(self) -> None:
+        if self._inner is not None:
+            await self._inner.connect()
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        self._calls += 1
+        payload = _batch_bytes(batch) if self._needs_payload else None
+        for spec in self._sched.due(self._calls, payload=payload):
+            if spec.kind == "latency":
+                await asyncio.sleep(spec.duration_s)
+            elif spec.kind == "error":
+                raise ProcessError(spec.message)
+            elif spec.kind == "crash":
+                raise RuntimeError(spec.message)
+        if self._inner is None:
+            return [batch]
+        return await self._inner.process(batch)
+
+    async def close(self) -> None:
+        if self._inner is not None:
+            await self._inner.close()
+
+
+# -- builders -------------------------------------------------------------
+
+
+def _schedule(config: dict, allowed: frozenset[str], family: str) -> FaultSchedule:
+    specs = parse_faults(config.get("faults"), allowed, family)
+    return FaultSchedule(specs, seed=int(config.get("seed", 0)))
+
+
+@register_input("fault")
+def _build_input(config: dict, resource: Resource) -> FaultInjectingInput:
+    inner_cfg = config.get("inner")
+    if not inner_cfg:
+        raise ConfigError("fault input requires an 'inner' input config")
+    return FaultInjectingInput(
+        build_component("input", inner_cfg, resource),
+        _schedule(config, INPUT_KINDS, "input"),
+        redeliver_unacked=bool(config.get("redeliver_unacked", False)),
+    )
+
+
+@register_output("fault")
+def _build_output(config: dict, resource: Resource) -> FaultInjectingOutput:
+    inner_cfg = config.get("inner")
+    if not inner_cfg:
+        raise ConfigError("fault output requires an 'inner' output config")
+    return FaultInjectingOutput(
+        build_component("output", inner_cfg, resource),
+        _schedule(config, OUTPUT_KINDS, "output"),
+    )
+
+
+@register_processor("fault")
+def _build_processor(config: dict, resource: Resource) -> FaultInjectingProcessor:
+    inner_cfg = config.get("inner")
+    inner = build_component("processor", inner_cfg, resource) if inner_cfg else None
+    return FaultInjectingProcessor(inner, _schedule(config, PROCESSOR_KINDS, "processor"))

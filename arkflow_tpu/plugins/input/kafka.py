@@ -291,7 +291,11 @@ class KafkaInput(Input):
             self._rr_idx += 1
             offset = self._offsets.get((t, p))
             if offset is None:
-                continue  # assignment changed under us mid-loop
+                # assignment changed under us mid-loop; yield so the
+                # heartbeat-task rejoin / offset load can actually run
+                # instead of this loop spinning the event loop dry
+                await asyncio.sleep(0)
+                continue
             try:
                 records, _hwm, next_offset = await self._client.fetch(
                     t, p, offset, max_wait_ms=250

@@ -14,32 +14,45 @@ crates/arkflow-core/src/stream/mod.rs:79-398), re-expressed for asyncio:
   ref :379-396). A processor chain returning nothing acks immediately
   (ref :301-303).
 - ``EndOfInput`` drains and shuts the stream down; ``Disconnection`` puts the
-  input into a 5s reconnect-forever loop (ref :176-203).
-- Errors during processing route the original batch to ``error_output`` when
-  configured, else are logged and acked (ref :358-397).
+  input into a reconnect-forever loop with capped exponential backoff (the
+  reference sleeps a fixed 5s, ref :176-203).
+- Errors during processing route the original batch to ``error_output``:
+  below ``max_delivery_attempts`` the batch is left unacked (nack) so the
+  broker redelivers and the failure can heal; at the budget it is quarantined
+  with attempt-count metadata. Output writes are retried with backoff behind
+  an optional per-output circuit breaker; an ``error_output`` write failure
+  falls back to retry-then-log instead of silently dropping the ack.
 - Ordered close: input -> buffer -> pipeline -> output (ref :400-437).
 """
 
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import time
 from dataclasses import dataclass
 from typing import Optional
 
-from arkflow_tpu.batch import MessageBatch
+import pyarrow as pa
+
+from arkflow_tpu.batch import META_EXT_PREFIX, META_INGEST_TIME, MessageBatch
 from arkflow_tpu.components.base import Ack, Buffer, Input, Output, Resource, Temporary
 from arkflow_tpu.components.registry import build_component
 from arkflow_tpu.config import StreamConfig
 from arkflow_tpu.errors import ArkError, Disconnection, EndOfInput
 from arkflow_tpu.obs import global_registry
 from arkflow_tpu.runtime.pipeline import Pipeline
+from arkflow_tpu.utils.circuit_breaker import CircuitBreaker, CircuitBreakerConfig
+from arkflow_tpu.utils.retry import RetryConfig, retry_with_backoff
 
 logger = logging.getLogger("arkflow.stream")
 
 MAX_PENDING = 1024  # ref stream/mod.rs:34
-RECONNECT_DELAY_S = 5.0  # ref stream/mod.rs:190
+RECONNECT_DELAY_S = 5.0  # cap of the reconnect backoff (the reference's fixed delay, ref stream/mod.rs:190)
+#: bound on the delivery-attempt tracking table; entries clear on success,
+#: so this only matters with thousands of concurrently failing batches
+MAX_TRACKED_ATTEMPTS = 8192
 
 
 @dataclass
@@ -67,6 +80,12 @@ class Stream:
         temporaries: Optional[dict[str, Temporary]] = None,
         thread_num: int = 1,
         name: str = "stream",
+        output_retry: Optional[RetryConfig] = None,
+        output_breaker: Optional[CircuitBreakerConfig] = None,
+        error_output_retry: Optional[RetryConfig] = None,
+        error_output_breaker: Optional[CircuitBreakerConfig] = None,
+        max_delivery_attempts: int = 1,
+        reconnect_retry: Optional[RetryConfig] = None,
     ):
         self.input = input_
         self.pipeline = pipeline
@@ -76,6 +95,10 @@ class Stream:
         self.temporaries = temporaries or {}
         self.thread_num = max(1, thread_num)
         self.name = name
+        self.output_retry = output_retry or RetryConfig()
+        self.error_output_retry = error_output_retry or self.output_retry
+        self.max_delivery_attempts = max(1, max_delivery_attempts)
+        self.reconnect_retry = reconnect_retry  # None -> default derived at run time
 
         reg = global_registry()
         labels = {"stream": name}
@@ -97,10 +120,44 @@ class Stream:
         self.m_backpressure_s = reg.counter(
             "arkflow_backpressure_seconds_total",
             "worker seconds stalled on the reorder window", labels)
+        self.m_out_retries = reg.counter(
+            "arkflow_output_retries_total", "output write retry attempts", labels)
+        self.m_quarantined = reg.counter(
+            "arkflow_quarantined_batches_total",
+            "batches quarantined to error_output after exhausting delivery attempts", labels)
+        self.m_quarantine_drops = reg.counter(
+            "arkflow_quarantine_drops_total",
+            "batches dropped because the error_output write itself kept failing", labels)
+        self.m_ack_failures = reg.counter(
+            "arkflow_ack_failures_total", "ack callbacks that raised", labels)
+        self._out_breaker = (
+            CircuitBreaker(
+                output_breaker,
+                gauge=reg.gauge("arkflow_circuit_state",
+                                "output circuit breaker state (0 closed, 1 open, 2 half-open)",
+                                {**labels, "output": "main"}),
+                trip_counter=reg.counter("arkflow_circuit_trips_total",
+                                         "circuit breaker open transitions",
+                                         {**labels, "output": "main"}),
+            ) if output_breaker else None
+        )
+        self._err_breaker = (
+            CircuitBreaker(
+                error_output_breaker,
+                gauge=reg.gauge("arkflow_circuit_state",
+                                "output circuit breaker state (0 closed, 1 open, 2 half-open)",
+                                {**labels, "output": "error"}),
+                trip_counter=reg.counter("arkflow_circuit_trips_total",
+                                         "circuit breaker open transitions",
+                                         {**labels, "output": "error"}),
+            ) if error_output_breaker else None
+        )
 
         # runtime state
         self._seq_assigned = 0
         self._seq_emitted = 0
+        #: delivery attempts per failing batch fingerprint; cleared on success
+        self._attempts: dict[bytes, int] = {}
         #: set by the output stage when the reorder window drains below
         #: MAX_PENDING — backpressured workers wake on it instead of polling
         self._drained = asyncio.Event()
@@ -190,16 +247,24 @@ class Stream:
                     logger.info("[%s] input exhausted (EOF)", self.name)
                     break
                 except Disconnection as e:
-                    logger.warning("[%s] input disconnected (%s); reconnecting in %.0fs",
-                                   self.name, e, RECONNECT_DELAY_S)
-                    # reconnect-forever loop (ref :183-194)
+                    # reconnect-forever loop with capped exponential backoff
+                    # (the reference sleeps a fixed 5s, ref :183-194); the cap
+                    # defaults to the module-level RECONNECT_DELAY_S so the
+                    # old knob still shortens test reconnects
+                    schedule = self.reconnect_retry or RetryConfig(
+                        max_delay_ms=max(1, int(RECONNECT_DELAY_S * 1000)))
+                    attempt = 0
+                    logger.warning("[%s] input disconnected (%s); reconnecting in %.2fs",
+                                   self.name, e, schedule.delay_s(0))
                     while not cancel.is_set():
                         try:
-                            await asyncio.sleep(RECONNECT_DELAY_S)
+                            await asyncio.sleep(schedule.delay_s(attempt))
                             await self.input.connect()
                             break
                         except Exception as re:
-                            logger.warning("[%s] reconnect failed: %s", self.name, re)
+                            attempt += 1
+                            logger.warning("[%s] reconnect failed (attempt %d): %s; backing off",
+                                           self.name, attempt, re)
                     continue
                 except ArkError as e:
                     logger.error("[%s] input read error: %s", self.name, e)
@@ -292,40 +357,153 @@ class Stream:
                     self._drained.set()  # wake backpressured workers now
                 await self._emit(item, results, err)
 
+    # -- delivery path (hardened) -----------------------------------------
+
+    @staticmethod
+    def _fingerprint(batch: MessageBatch) -> bytes:
+        """Stable identity of a batch across redeliveries: data + broker
+        provenance columns, excluding per-delivery noise (ingest time, ext
+        metadata the error path itself stamps). Sources that stamp offset
+        metadata (kafka, pulsar, ...) get fully distinct keys; content-only
+        sources emitting byte-identical batches share one attempt counter —
+        an accepted approximation, since entries clear on success. Computed
+        on failure paths, plus on successes only while failures are being
+        tracked (the table is non-empty); the all-healthy hot path never
+        pays for it."""
+        rb = batch.record_batch
+        keep = [n for n in rb.schema.names
+                if n != META_INGEST_TIME and not n.startswith(META_EXT_PREFIX)]
+        rb = rb.select(keep)
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, rb.schema) as w:
+            w.write_batch(rb)
+        return hashlib.blake2b(sink.getvalue().to_pybytes(), digest_size=16).digest()
+
+    def _bump_attempts(self, batch: MessageBatch) -> int:
+        key = self._fingerprint(batch)
+        n = self._attempts.get(key, 0) + 1
+        if key not in self._attempts and len(self._attempts) >= MAX_TRACKED_ATTEMPTS:
+            self._attempts.pop(next(iter(self._attempts)))
+        self._attempts[key] = n
+        return n
+
+    def _clear_attempts(self, batch: MessageBatch) -> None:
+        if self._attempts:
+            self._attempts.pop(self._fingerprint(batch), None)
+
+    async def _safe_ack(self, ack: Ack) -> None:
+        """Acks confirm work already durably written; a failing ack must not
+        crash the output stage (the broker redelivers and dedup is the
+        consumer's concern under at-least-once)."""
+        try:
+            await ack.ack()
+        except Exception as e:
+            self.m_ack_failures.inc()
+            logger.warning("[%s] ack failed (duplicate delivery possible): %s", self.name, e)
+
+    async def _safe_nack(self, ack: Ack) -> None:
+        try:
+            await ack.nack()
+        except Exception as e:
+            logger.warning("[%s] nack failed: %s", self.name, e)
+
+    async def _write_guarded(self, output: Output, breaker: Optional[CircuitBreaker],
+                             retry_cfg: RetryConfig, batch: MessageBatch, what: str) -> None:
+        """One delivery: retry-with-backoff around write attempts, each
+        attempt gated by the output's circuit breaker (when configured)."""
+
+        async def attempt() -> None:
+            if breaker is not None:
+                await breaker.acquire()
+            try:
+                await output.write(batch)
+            except Exception:
+                if breaker is not None:
+                    breaker.record_failure()
+                raise
+            if breaker is not None:
+                breaker.record_success()
+
+        await retry_with_backoff(attempt, retry_cfg, what=what,
+                                 on_retry=self.m_out_retries.inc)
+
+    async def _quarantine(self, item: _WorkItem, reason: str, attempts: int) -> None:
+        """Route a poisoned batch to error_output with attempt-count metadata
+        and ack it. A failing error_output write is retried; if it keeps
+        failing the batch is logged and dropped WITH an ack — the old code
+        dropped the ack on the floor, wedging the stream on eternal
+        redelivery of a batch that can no longer go anywhere."""
+        tagged = item.batch.with_ext_metadata(
+            {"error": reason, "delivery_attempts": str(attempts)})
+        try:
+            await self._write_guarded(self.error_output, self._err_breaker,
+                                      self.error_output_retry, tagged,
+                                      f"[{self.name}] error_output write")
+            self.m_quarantined.inc()
+        except Exception:
+            self.m_quarantine_drops.inc()
+            logger.exception(
+                "[%s] error_output write kept failing; DROPPING batch after %d "
+                "delivery attempt(s) (reason: %s)", self.name, attempts, reason)
+        self._clear_attempts(item.batch)
+        await self._safe_ack(item.ack)
+
     async def _emit(self, item: _WorkItem, results: list[MessageBatch], err: Optional[Exception]) -> None:
         if err is not None:
             self.m_errors.inc()
+            attempts = self._bump_attempts(item.batch)
+            if attempts < self.max_delivery_attempts and getattr(
+                    item.ack, "redeliverable", False):
+                # transient failures (model OOM, lookup table blip) heal via
+                # redelivery; only a batch that keeps failing is quarantined.
+                # Without in-session redelivery (Ack.redeliverable) leaving
+                # the batch unacked would silently drop or strand it — those
+                # sources quarantine right away.
+                logger.warning("[%s] processing failed (delivery %d/%d); leaving "
+                               "unacked for redelivery: %s", self.name, attempts,
+                               self.max_delivery_attempts, err)
+                await self._safe_nack(item.ack)
+                return
             if self.error_output is not None:
-                try:
-                    tagged = item.batch.with_ext_metadata({"error": str(err)})
-                    await self.error_output.write(tagged)
-                    await item.ack.ack()
-                except Exception:
-                    logger.exception("[%s] error_output write failed", self.name)
+                await self._quarantine(item, str(err), attempts)
             else:
                 logger.error("[%s] processing error (no error_output): %s", self.name, err)
-                await item.ack.ack()
+                self._clear_attempts(item.batch)
+                await self._safe_ack(item.ack)
             return
         if not results:
             # ProcessResult::None -> drop + ack (ref :301-303)
-            await item.ack.ack()
+            await self._safe_ack(item.ack)
             return
         loop = asyncio.get_running_loop()
         try:
             for b in results:
                 t_w = loop.time()
-                await self.output.write(b)
+                await self._write_guarded(self.output, self._out_breaker,
+                                          self.output_retry, b,
+                                          f"[{self.name}] output write")
                 self.m_write_latency.observe(loop.time() - t_w)
                 self.m_batches_out.inc()
                 self.m_rows_out.inc(b.num_rows)
         except Exception as e:
             self.m_write_errors.inc()
-            logger.error("[%s] output write failed; not acking: %s", self.name, e)
+            attempts = self._bump_attempts(item.batch)
+            if self.error_output is not None and (
+                    attempts >= self.max_delivery_attempts
+                    or not getattr(item.ack, "redeliverable", False)):
+                logger.error("[%s] output write failed after %d delivery attempt(s); "
+                             "quarantining: %s", self.name, attempts, e)
+                await self._quarantine(item, f"output write failed: {e}", attempts)
+            else:
+                logger.error("[%s] output write failed (delivery %d/%d); not acking: %s",
+                             self.name, attempts, self.max_delivery_attempts, e)
+                await self._safe_nack(item.ack)
             return
+        self._clear_attempts(item.batch)
         ingest = item.batch.get_meta("__meta_ingest_time")
         if ingest is not None:
             self.m_e2e_latency.observe(max(0.0, time.time() - ingest / 1000.0))
-        await item.ack.ack()
+        await self._safe_ack(item.ack)
 
 
 def build_stream(cfg: StreamConfig, name: Optional[str] = None) -> Stream:
@@ -360,4 +538,10 @@ def build_stream(cfg: StreamConfig, name: Optional[str] = None) -> Stream:
         temporaries=resource.temporaries,
         thread_num=cfg.pipeline.effective_threads(),
         name=name or cfg.name or "stream",
+        output_retry=cfg.output_retry,
+        output_breaker=cfg.output_circuit_breaker,
+        error_output_retry=cfg.error_output_retry,
+        error_output_breaker=cfg.error_output_circuit_breaker,
+        max_delivery_attempts=cfg.pipeline.max_delivery_attempts,
+        reconnect_retry=cfg.input_reconnect,
     )

@@ -186,7 +186,10 @@ def _list_get(args, n):
 def _merge(args, n):
     """merge(a, b) -> shallow-merged JSON object text (b's keys win), the
     columnar form of VRL's object merge (ref vrl.rs runtime): operands are
-    JSON text columns (e.g. raw payloads); non-object/invalid rows -> NULL."""
+    JSON text columns (e.g. raw payloads). An invalid/non-object operand is
+    treated as the empty object (so the other side passes through); NULL is
+    returned only when BOTH operands are invalid/NULL. This is deliberately
+    more forgiving than reference VRL, which errors on non-object operands."""
     a, b = as_array(args[0], n), as_array(args[1], n)
 
     def load(v):

@@ -662,10 +662,27 @@ def _expand_event(rb: pa.RecordBatch, vals: pa.Array) -> pa.RecordBatch:
             payloads.append(pv)
         else:
             payloads.append(str(pv).encode())
+    codec = JsonCodec()
     try:
-        decoded = JsonCodec().decode_many(payloads)
-    except (ArkError, pa.ArrowInvalid) as e:
-        raise ArkError(f"vrl: '. = parse_json!' failed to decode: {e}") from e
+        decoded = codec.decode_many(payloads)
+    except (ArkError, pa.ArrowInvalid):
+        # parse_json! is fallible PER EVENT in reference VRL: one malformed
+        # row must not fail the whole batch (under at-least-once replay that
+        # would wedge the stream on a single poison record). Fall back to
+        # row-wise validation, substituting {} for any row that cannot
+        # become exactly one event — malformed JSON and multi-object NDJSON
+        # payloads alike (the strict path rejects the latter batch-wide).
+        fixed = []
+        for p in payloads:
+            try:
+                ok = codec.decode(p).num_rows == 1
+            except (ArkError, pa.ArrowInvalid):
+                ok = False
+            fixed.append(p if ok else b"{}")
+        try:
+            decoded = codec.decode_many(fixed)
+        except (ArkError, pa.ArrowInvalid) as e:
+            raise ArkError(f"vrl: '. = parse_json!' failed to decode: {e}") from e
     if decoded.num_rows != rb.num_rows:
         raise ArkError(
             "vrl: '. = parse_json!' payloads must be one object per row "

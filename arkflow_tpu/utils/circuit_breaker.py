@@ -1,0 +1,130 @@
+"""Per-output circuit breaker for the delivery path.
+
+Classic three-state breaker (closed -> open -> half-open -> closed) guarding
+``output.write``: after ``failure_threshold`` consecutive failures the breaker
+opens and callers wait out ``reset_timeout`` instead of hammering a dead sink;
+the first caller after the cooldown becomes the half-open probe, and its
+outcome decides whether the breaker closes again or re-opens for another
+cooldown. The reference has nothing like this — its write path retries never
+and relies wholly on broker redelivery (ref stream/mod.rs:358-397).
+
+A breaker never *drops* work: at-least-once semantics are preserved because
+``acquire()`` delays callers rather than failing them. asyncio runs the stream
+on one thread, so plain state flips are race-free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Any, Mapping, Optional
+
+from arkflow_tpu.errors import ConfigError
+
+CLOSED, OPEN, HALF_OPEN = 0, 1, 2
+_STATE_NAMES = {CLOSED: "closed", OPEN: "open", HALF_OPEN: "half_open"}
+
+
+@dataclass(frozen=True)
+class CircuitBreakerConfig:
+    #: consecutive write failures that trip the breaker open
+    failure_threshold: int = 5
+    #: seconds the breaker stays open before allowing a half-open probe
+    reset_timeout_s: float = 30.0
+
+    @classmethod
+    def from_config(cls, cfg: Mapping[str, Any] | bool | None) -> Optional["CircuitBreakerConfig"]:
+        """None/False -> disabled (None); True/{} -> defaults; mapping -> parsed."""
+        if cfg is None or cfg is False:
+            return None
+        if cfg is True:
+            return cls()
+        if not isinstance(cfg, Mapping):
+            raise ConfigError("circuit_breaker must be a mapping or boolean")
+        from arkflow_tpu.utils.duration import parse_duration
+
+        c = cls(
+            failure_threshold=int(cfg.get("failure_threshold", 5)),
+            reset_timeout_s=parse_duration(str(cfg.get("reset_timeout", "30s"))),
+        )
+        if c.failure_threshold < 1:
+            raise ConfigError("circuit_breaker failure_threshold must be >= 1")
+        if c.reset_timeout_s < 0:
+            raise ConfigError("circuit_breaker reset_timeout must be >= 0")
+        return c
+
+
+class CircuitBreaker:
+    """Wrap write attempts with ``await acquire()`` + ``record_success()`` /
+    ``record_failure()``. ``gauge``/``trip_counter`` are optional metrics
+    hooks (``arkflow_circuit_state`` / ``arkflow_circuit_trips_total``)."""
+
+    def __init__(self, config: CircuitBreakerConfig, gauge=None, trip_counter=None):
+        self.config = config
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self.gauge = gauge
+        self.trip_counter = trip_counter
+        #: transition log (bounded) so tests and debuggers can assert the
+        #: closed->open->half_open->closed lifecycle actually happened
+        self.history: list[str] = [_STATE_NAMES[CLOSED]]
+        if self.gauge is not None:
+            self.gauge.set(CLOSED)
+
+    @property
+    def state(self) -> str:
+        return _STATE_NAMES[self._state]
+
+    def _set_state(self, state: int) -> None:
+        if state == self._state:
+            return
+        self._state = state
+        if len(self.history) < 1024:
+            self.history.append(_STATE_NAMES[state])
+        if self.gauge is not None:
+            self.gauge.set(state)
+
+    async def acquire(self) -> None:
+        """Wait until the breaker permits a write attempt. Returns holding
+        the probe slot when half-open; callers MUST follow with exactly one
+        record_success()/record_failure()."""
+        while True:
+            if self._state == CLOSED:
+                return
+            if self._state == OPEN:
+                remaining = self._opened_at + self.config.reset_timeout_s - time.monotonic()
+                if remaining > 0:
+                    await asyncio.sleep(remaining)
+                    continue
+                self._set_state(HALF_OPEN)
+                self._probe_in_flight = False
+            if self._state == HALF_OPEN:
+                if not self._probe_in_flight:
+                    self._probe_in_flight = True  # this caller is the probe
+                    return
+                # another probe is in flight; wait for its verdict
+                await asyncio.sleep(min(0.01, self.config.reset_timeout_s or 0.01))
+
+    def record_success(self) -> None:
+        self._consecutive_failures = 0
+        self._probe_in_flight = False
+        if self._state != CLOSED:
+            self._set_state(CLOSED)
+
+    def record_failure(self) -> None:
+        self._consecutive_failures += 1
+        if self._state == HALF_OPEN:
+            # failed probe: back to a full cooldown
+            self._probe_in_flight = False
+            self._opened_at = time.monotonic()
+            self._set_state(OPEN)
+            if self.trip_counter is not None:
+                self.trip_counter.inc()
+        elif self._state == CLOSED and self._consecutive_failures >= self.config.failure_threshold:
+            self._opened_at = time.monotonic()
+            self._set_state(OPEN)
+            if self.trip_counter is not None:
+                self.trip_counter.inc()

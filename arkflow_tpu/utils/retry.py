@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from dataclasses import dataclass
+from typing import Callable, Optional
 
 from arkflow_tpu.errors import ConfigError
 
@@ -23,6 +25,9 @@ class RetryConfig:
     initial_delay_ms: int = 100
     max_delay_ms: int = 5000
     backoff_multiplier: float = 2.0
+    #: 0..1 fraction of the capped delay added as random noise, spreading the
+    #: retries of many streams hitting the same recovering broker
+    jitter: float = 0.0
 
     @classmethod
     def from_config(cls, cfg: dict | None) -> "RetryConfig":
@@ -33,6 +38,7 @@ class RetryConfig:
             initial_delay_ms=int(cfg.get("initial_delay_ms", 100)),
             max_delay_ms=int(cfg.get("max_delay_ms", 5000)),
             backoff_multiplier=float(cfg.get("backoff_multiplier", 2.0)),
+            jitter=float(cfg.get("jitter", 0.0)),
         )
         rc.validate()
         return rc
@@ -44,20 +50,29 @@ class RetryConfig:
             raise ConfigError("retry delays must satisfy 0 <= initial <= max")
         if self.backoff_multiplier < 1.0:
             raise ConfigError("retry backoff_multiplier must be >= 1.0")
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ConfigError("retry jitter must be in [0, 1]")
 
     def delay_s(self, attempt: int) -> float:
-        """Delay before retry #attempt (0-based)."""
-        d = self.initial_delay_ms * (self.backoff_multiplier ** attempt)
-        return min(d, self.max_delay_ms) / 1000.0
+        """Delay before retry #attempt (0-based); capped exponential + jitter."""
+        # exponent clamp: reconnect-forever loops pass unbounded attempt
+        # counts, and float ** overflows to OverflowError near 2.0**1024
+        d = self.initial_delay_ms * (self.backoff_multiplier ** min(attempt, 64))
+        d = min(d, self.max_delay_ms) / 1000.0
+        if self.jitter:
+            d *= 1.0 + random.random() * self.jitter
+        return d
 
 
 async def retry_with_backoff(op, config: RetryConfig, *, what: str = "operation",
-                             retry_on: tuple = (Exception,)):
+                             retry_on: tuple = (Exception,),
+                             on_retry: Optional[Callable[[], None]] = None):
     """Run ``await op()`` with up to config.max_attempts tries.
 
     ConfigError always fails fast: a mistyped config (missing key file,
     absent client_id, bad URL) cannot heal with backoff, and retrying it
-    only delays the error the operator needs to see."""
+    only delays the error the operator needs to see. ``on_retry`` fires
+    before each re-attempt (metrics hooks)."""
     last: Exception | None = None
     for attempt in range(config.max_attempts):
         try:
@@ -71,4 +86,6 @@ async def retry_with_backoff(op, config: RetryConfig, *, what: str = "operation"
                 logger.warning("%s failed (attempt %d/%d): %s; retrying in %.2fs",
                                what, attempt + 1, config.max_attempts, e, delay)
                 await asyncio.sleep(delay)
+                if on_retry is not None:
+                    on_retry()
     raise last

@@ -226,6 +226,110 @@ def test_restart_rebuild_failure_does_not_kill_engine(monkeypatch):
     assert builds["n"] >= 2 and crashes["n"] >= 2
 
 
+def test_restart_budget_resets_after_long_run(monkeypatch):
+    """A run lasting at least reset_after earns back the full retry budget:
+    with max_retries=1 and reset_after=0s every crash is forgiven, so a
+    stream crashing 3 times still completes on the 4th run."""
+    import arkflow_tpu.runtime.engine as engine_mod
+    from arkflow_tpu.config import EngineConfig
+
+    cfg = EngineConfig.from_mapping({
+        "streams": [{
+            "name": "forgiven",
+            "input": {"type": "generate", "payload": "x", "interval": 0,
+                      "batch_size": 1, "count": 1},
+            "pipeline": {"thread_num": 1, "processors": []},
+            "output": {"type": "drop"},
+            "restart": {"max_retries": 1, "backoff": "10ms", "reset_after": "0s"},
+        }],
+        "health_check": {"enabled": False},
+    })
+    real_run = engine_mod.Stream.run
+    crashes = {"n": 0}
+
+    async def flaky_run(self, cancel):
+        if crashes["n"] < 3:
+            crashes["n"] += 1
+            raise RuntimeError("injected stream crash")
+        await real_run(self, cancel)
+
+    monkeypatch.setattr(engine_mod.Stream, "run", flaky_run)
+    engine = engine_mod.Engine(cfg)
+    asyncio.run(asyncio.wait_for(engine.run(), 30))
+    assert crashes["n"] == 3  # budget of 1 was reset before each retry
+
+
+def test_restart_budget_not_reset_for_short_runs(monkeypatch):
+    """Short crashing runs must NOT earn the budget back: max_retries=1 with
+    a huge reset_after stops after the initial run + one retry."""
+    import arkflow_tpu.runtime.engine as engine_mod
+    from arkflow_tpu.config import EngineConfig
+
+    cfg = EngineConfig.from_mapping({
+        "streams": [{
+            "name": "exhausted",
+            "input": {"type": "generate", "payload": "x", "interval": 0,
+                      "batch_size": 1, "count": 1},
+            "pipeline": {"thread_num": 1, "processors": []},
+            "output": {"type": "drop"},
+            "restart": {"max_retries": 1, "backoff": "10ms", "reset_after": "1h"},
+        }],
+        "health_check": {"enabled": False},
+    })
+    crashes = {"n": 0}
+
+    async def crash_run(self, cancel):
+        crashes["n"] += 1
+        raise RuntimeError("injected stream crash")
+
+    monkeypatch.setattr(engine_mod.Stream, "run", crash_run)
+    engine = engine_mod.Engine(cfg)
+    asyncio.run(asyncio.wait_for(engine.run(), 30))
+    assert crashes["n"] == 2  # initial run + exactly one retry
+
+
+def test_restart_rebuild_failure_then_recovery(monkeypatch):
+    """A rebuild failure consumes a retry but a later rebuild succeeds and
+    the stream runs to completion."""
+    import arkflow_tpu.runtime.engine as engine_mod
+    from arkflow_tpu.config import EngineConfig
+
+    cfg = EngineConfig.from_mapping({
+        "streams": [{
+            "name": "recovers",
+            "input": {"type": "memory", "messages": ["a", "b"]},
+            "pipeline": {"thread_num": 1, "processors": []},
+            "output": {"type": "drop"},
+            "restart": {"max_retries": 3, "backoff": "10ms"},
+        }],
+        "health_check": {"enabled": False},
+    })
+    real_run = engine_mod.Stream.run
+    crashes = {"n": 0}
+
+    async def flaky_run(self, cancel):
+        if crashes["n"] < 1:
+            crashes["n"] += 1
+            raise RuntimeError("injected stream crash")
+        await real_run(self, cancel)
+
+    monkeypatch.setattr(engine_mod.Stream, "run", flaky_run)
+    real_build = engine_mod.build_stream
+    builds = {"n": 0}
+
+    def flaky_build(cfg, name=None):
+        builds["n"] += 1
+        if builds["n"] == 2:  # first rebuild attempt fails
+            raise RuntimeError("injected rebuild failure")
+        return real_build(cfg, name=name)
+
+    monkeypatch.setattr(engine_mod, "build_stream", flaky_build)
+    engine = engine_mod.Engine(cfg)
+    asyncio.run(asyncio.wait_for(engine.run(), 30))
+    assert builds["n"] == 3  # initial + failed rebuild + successful rebuild
+    assert engine.streams[0].m_rows_out.value == 2  # rebuilt stream completed
+
+
 def test_stream_without_restart_policy_stops_on_crash(monkeypatch):
     import arkflow_tpu.runtime.engine as engine_mod
     from arkflow_tpu.config import EngineConfig

@@ -1,0 +1,360 @@
+"""Chaos suite: seeded fault injection against the hardened delivery path.
+
+End-to-end invariants under injected faults (transient write errors, a
+disconnect, poison-pill batches, failing acks, crash-at-batch-N):
+
+- no loss: every input row is written to the output or quarantined to
+  error_output, exactly once, after at most ``max_delivery_attempts`` tries
+- no early acks: a batch is never acked before its writes succeeded
+- the circuit breaker observably walks closed -> open -> half_open -> closed
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Ack, ensure_plugins_loaded
+from arkflow_tpu.config import StreamConfig
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.plugins.fault.schedule import FaultSchedule, parse_faults
+from arkflow_tpu.plugins.fault.wrappers import (
+    INPUT_KINDS,
+    OUTPUT_KINDS,
+    PROCESSOR_KINDS,
+    FaultInjectingInput,
+    FaultInjectingOutput,
+    FaultInjectingProcessor,
+)
+from arkflow_tpu.plugins.input.memory import MemoryInput
+from arkflow_tpu.plugins.output.drop import DropOutput
+from arkflow_tpu.runtime import Pipeline, Stream, build_stream
+from arkflow_tpu.utils.circuit_breaker import CircuitBreakerConfig
+from arkflow_tpu.utils.retry import RetryConfig
+
+ensure_plugins_loaded()
+
+FAST_RETRY = RetryConfig(max_attempts=3, initial_delay_ms=1, max_delay_ms=5)
+FAST_RECONNECT = RetryConfig(max_attempts=3, initial_delay_ms=1, max_delay_ms=10)
+
+
+class CollectOutput(DropOutput):
+    def __init__(self):
+        super().__init__()
+        self.batches: list[MessageBatch] = []
+
+    async def write(self, batch: MessageBatch) -> None:
+        await super().write(batch)
+        self.batches.append(batch)
+
+
+def payloads_of(sink: CollectOutput) -> list[bytes]:
+    return [p for b in sink.batches for p in b.to_binary()]
+
+
+def sched(faults: list, kinds, family: str, seed: int = 7) -> FaultSchedule:
+    return FaultSchedule(parse_faults(faults, kinds, family), seed=seed)
+
+
+def make_chaos_input(messages, faults, acked, violations, sinks,
+                     redeliver=True) -> FaultInjectingInput:
+    """Fault-wrapped memory input whose inner acks record ordering: an ack
+    firing before its payload reached any sink is an invariant violation."""
+
+    class RecordingAck(Ack):
+        def __init__(self, payload: bytes):
+            self.payload = payload
+
+        async def ack(self) -> None:
+            delivered = {p for s in sinks for p in payloads_of(s)}
+            if self.payload not in delivered:
+                violations.append(self.payload)
+            acked.append(self.payload)
+
+    class Src(MemoryInput):
+        async def read(self):
+            batch, _ = await super().read()
+            return batch, RecordingAck(batch.to_binary()[0])
+
+    return FaultInjectingInput(Src(messages), sched(faults, INPUT_KINDS, "input"),
+                               redeliver_unacked=redeliver)
+
+
+def test_chaos_end_to_end_no_loss_invariants():
+    """The acceptance scenario: transient output failures + a disconnect
+    (with one failing reconnect probe) + one poison-pill batch. Every row is
+    written or quarantined exactly once within max_delivery_attempts, and
+    nothing acks before its write."""
+    messages = [b"m0", b"m1", b"m2", b"poison", b"m4", b"m5", b"m6", b"m7"]
+    acked, violations = [], []
+    sink, err_sink = CollectOutput(), CollectOutput()
+
+    inp = make_chaos_input(
+        messages,
+        [{"kind": "disconnect", "at": 5},
+         {"kind": "reconnect_fail", "at": 1}],
+        acked, violations, [sink, err_sink])
+    proc = FaultInjectingProcessor(
+        None, sched([{"kind": "error", "match": "poison"}], PROCESSOR_KINDS, "processor"))
+    out = FaultInjectingOutput(
+        sink, sched([{"kind": "error", "at": 2, "times": 2}], OUTPUT_KINDS, "output"))
+
+    stream = Stream(inp, Pipeline([proc]), out, error_output=err_sink,
+                    thread_num=1, name="chaos-e2e",
+                    output_retry=FAST_RETRY, reconnect_retry=FAST_RECONNECT,
+                    max_delivery_attempts=3)
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=30))
+
+    ok = [m for m in messages if m != b"poison"]
+    assert inp._reconnects == 2  # probe 1 failed (reconnect_fail), probe 2 healed
+    assert sorted(payloads_of(sink)) == sorted(ok)  # each exactly once
+    assert payloads_of(err_sink) == [b"poison"]  # quarantined exactly once
+    q = err_sink.batches[0]
+    assert q.get_meta("__meta_ext_delivery_attempts") == "3"
+    assert "chaos" in q.get_meta("__meta_ext_error")
+    assert violations == []  # nothing acked before it was written/quarantined
+    assert sorted(acked) == sorted(messages)  # every batch acked exactly once
+    assert stream.m_errors.value == 3  # poison processed max_delivery_attempts times
+    assert stream.m_out_retries.value == 2  # the transient write error healed in place
+    assert stream.m_quarantined.value == 1
+
+
+def test_circuit_breaker_opens_probes_and_recovers():
+    """K consecutive write failures trip the breaker; after the cooldown the
+    half-open probe succeeds and the breaker closes. No rows are lost."""
+    messages = [b"a", b"b", b"c", b"d"]
+    acked, violations = [], []
+    sink = CollectOutput()
+
+    inp = make_chaos_input(messages, [], acked, violations, [sink])
+    out = FaultInjectingOutput(
+        sink, sched([{"kind": "error", "at": 1, "times": 3}], OUTPUT_KINDS, "output"))
+    stream = Stream(inp, Pipeline([]), out, thread_num=1, name="chaos-breaker",
+                    output_retry=FAST_RETRY,
+                    output_breaker=CircuitBreakerConfig(failure_threshold=3,
+                                                        reset_timeout_s=0.05),
+                    max_delivery_attempts=5)
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=30))
+
+    breaker = stream._out_breaker
+    assert breaker.history == ["closed", "open", "half_open", "closed"]
+    assert breaker.trip_counter.value == 1
+    assert breaker.gauge.value == 0  # closed again
+    assert sorted(payloads_of(sink)) == sorted(messages)  # exactly once each
+    assert violations == []
+    assert stream.m_write_errors.value == 1  # one failed delivery, then healed
+
+
+def test_error_output_write_failure_retries_then_delivers():
+    """A transient error_output failure heals via retry instead of dropping
+    the ack on the floor."""
+    acked, violations = [], []
+    err_inner = CollectOutput()
+    err_out = FaultInjectingOutput(
+        err_inner, sched([{"kind": "error", "at": 1, "times": 1}], OUTPUT_KINDS, "output"))
+    sink = CollectOutput()
+    inp = make_chaos_input([b"x"], [], acked, violations, [sink, err_inner])
+    proc = FaultInjectingProcessor(
+        None, sched([{"kind": "error", "every": 1}], PROCESSOR_KINDS, "processor"))
+    stream = Stream(inp, Pipeline([proc]), sink, error_output=err_out,
+                    thread_num=1, name="chaos-errout",
+                    error_output_retry=FAST_RETRY, max_delivery_attempts=1)
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=30))
+    assert payloads_of(err_inner) == [b"x"]
+    assert acked == [b"x"] and violations == []
+    assert stream.m_quarantined.value == 1
+
+
+def test_error_output_persistent_failure_acks_instead_of_wedging():
+    """If error_output keeps failing after retries, the batch is logged and
+    dropped WITH an ack — the stream finishes instead of replaying forever."""
+    acked = []
+    err_out = FaultInjectingOutput(
+        CollectOutput(), sched([{"kind": "error", "every": 1}], OUTPUT_KINDS, "output"))
+    sink = CollectOutput()
+    # violations not asserted here: this path intentionally acks a dropped batch
+    inp = make_chaos_input([b"x", b"y"], [], acked, [], [sink])
+    proc = FaultInjectingProcessor(
+        None, sched([{"kind": "error", "match": "x"}], PROCESSOR_KINDS, "processor"))
+    stream = Stream(inp, Pipeline([proc]), sink, error_output=err_out,
+                    thread_num=1, name="chaos-errout-dead",
+                    error_output_retry=FAST_RETRY, max_delivery_attempts=1)
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=30))
+    assert sorted(acked) == [b"x", b"y"]  # stream drained; no wedge
+    assert payloads_of(sink) == [b"y"]
+    assert stream.m_quarantine_drops.value == 1
+
+
+def test_ack_faults_keep_at_least_once():
+    """A failing ack redelivers (duplicate, never loss); a duplicated ack is
+    harmless."""
+    messages = [b"a", b"b", b"c"]
+    acked = []
+    sink = CollectOutput()
+    inp = make_chaos_input(
+        messages,
+        [{"kind": "ack_fail", "at": 2}, {"kind": "ack_dup", "at": 3}],
+        acked, [], [sink])
+    stream = Stream(inp, Pipeline([]), sink, thread_num=1, name="chaos-acks",
+                    output_retry=FAST_RETRY)
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=30))
+    got = payloads_of(sink)
+    assert set(got) == set(messages)  # no loss
+    assert got.count(b"b") == 2  # ack-failed batch was redelivered (duplicate ok)
+    assert stream.m_ack_failures.value == 1
+
+
+def test_reconnect_uses_backoff_not_fixed_5s():
+    """Disconnection recovery is driven by capped exponential backoff: a
+    reconnect now takes ~100ms by default, not the reference's fixed 5s."""
+    sink = CollectOutput()
+    inp = FaultInjectingInput(
+        MemoryInput([b"1", b"2", b"3"]),
+        sched([{"kind": "disconnect", "at": 2}], INPUT_KINDS, "input"))
+    stream = Stream(inp, Pipeline([]), sink, thread_num=1, name="chaos-reconnect")
+    t0 = time.monotonic()
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=10))
+    assert time.monotonic() - t0 < 4.0  # fixed-delay behavior would take >=5s
+    assert sorted(payloads_of(sink)) == [b"1", b"2", b"3"]
+
+
+def test_crash_at_batch_n_with_restart_policy():
+    """A crash fault escapes the contained error paths, the engine restart
+    policy rebuilds the stream, and the shared fault state keeps the crash
+    one-shot across the rebuild — the replayed stream completes."""
+    from arkflow_tpu.config import EngineConfig
+    from arkflow_tpu.runtime.engine import Engine
+
+    crash_fault = {"kind": "crash", "at": 3}
+    cfg = EngineConfig.from_mapping({
+        "streams": [{
+            "name": "chaos-crash",
+            "input": {"type": "fault",
+                      "inner": {"type": "memory",
+                                "messages": ["c0", "c1", "c2", "c3"]},
+                      "faults": [crash_fault]},
+            "pipeline": {"thread_num": 1, "processors": []},
+            "output": {"type": "drop"},
+            "restart": {"max_retries": 3, "backoff": "10ms"},
+        }],
+        "health_check": {"enabled": False},
+    })
+    engine = Engine(cfg)
+    asyncio.run(asyncio.wait_for(engine.run(), timeout=30))
+    assert crash_fault["_state"]["fired"] == 1  # one-shot across the rebuild
+    live = engine.streams[0]
+    # the rebuilt memory input replays from the start: at-least-once, no loss
+    assert live.m_rows_out.value >= 4
+
+
+def test_chaos_from_config_with_all_knobs():
+    """The new config knobs wire through end to end: fault wrappers, output
+    retry w/ jitter, circuit breaker, max_delivery_attempts, reconnect."""
+    cfg = StreamConfig.from_mapping({
+        "name": "chaos-cfg",
+        "input": {
+            "type": "fault",
+            "redeliver_unacked": True,
+            "reconnect": {"initial_delay_ms": 1, "max_delay_ms": 10},
+            "inner": {"type": "memory",
+                      "messages": ["k0", "k1", "poison", "k3", "k4"]},
+            "faults": [{"kind": "disconnect", "at": 2},
+                       {"kind": "latency", "every": 2, "duration": "2ms"}],
+        },
+        "pipeline": {
+            "thread_num": 1,
+            "max_delivery_attempts": 2,
+            "processors": [
+                {"type": "fault", "faults": [{"kind": "error", "match": "poison"}]},
+            ],
+        },
+        "output": {
+            "type": "fault",
+            "inner": {"type": "drop"},
+            "retry": {"max_attempts": 4, "initial_delay_ms": 1, "jitter": 0.2},
+            "circuit_breaker": {"failure_threshold": 4, "reset_timeout": "50ms"},
+            "faults": [{"kind": "error", "at": 3, "times": 1}],
+        },
+        "error_output": {"type": "drop",
+                         "retry": {"max_attempts": 2, "initial_delay_ms": 1}},
+    })
+    assert cfg.pipeline.max_delivery_attempts == 2
+    assert cfg.output_retry.max_attempts == 4 and cfg.output_retry.jitter == 0.2
+    assert cfg.output_circuit_breaker.failure_threshold == 4
+    assert cfg.error_output_retry.max_attempts == 2
+    assert cfg.input_reconnect.max_delay_ms == 10
+
+    stream = build_stream(cfg)
+    assert isinstance(stream.output, FaultInjectingOutput)
+    assert stream._out_breaker is not None
+    assert stream.max_delivery_attempts == 2
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=30))
+    assert stream.m_rows_out.value == 4  # all non-poison rows delivered
+    assert stream.m_quarantined.value == 1  # poison quarantined after 2 tries
+    assert stream.m_errors.value == 2
+
+
+def test_fault_config_validation():
+    from arkflow_tpu.components import Resource
+    from arkflow_tpu.components.registry import build_component
+
+    res = Resource()
+    with pytest.raises(ConfigError):  # unknown kind
+        build_component("input", {"type": "fault", "inner": {"type": "memory", "messages": []},
+                                  "faults": [{"kind": "explode", "at": 1}]}, res)
+    with pytest.raises(ConfigError):  # missing trigger
+        build_component("output", {"type": "fault", "inner": {"type": "drop"},
+                                   "faults": [{"kind": "error"}]}, res)
+    with pytest.raises(ConfigError):  # fault input requires inner
+        build_component("input", {"type": "fault"}, res)
+    with pytest.raises(ConfigError):  # match can never fire on input reads
+        build_component("input", {"type": "fault", "inner": {"type": "memory", "messages": []},
+                                  "faults": [{"kind": "error", "match": "x"}]}, res)
+    with pytest.raises(ConfigError):  # ack faults are input-only
+        build_component("output", {"type": "fault", "inner": {"type": "drop"},
+                                   "faults": [{"kind": "ack_fail", "at": 1}]}, res)
+    with pytest.raises(ConfigError):
+        StreamConfig.from_mapping({"input": {"type": "memory", "messages": []},
+                                   "output": {"type": "drop"},
+                                   "pipeline": {"max_delivery_attempts": 0}})
+    with pytest.raises(ConfigError):
+        CircuitBreakerConfig.from_config({"failure_threshold": 0})
+    with pytest.raises(ConfigError):
+        RetryConfig.from_config({"jitter": 1.5})
+    # booleans toggle the breaker wholesale
+    assert CircuitBreakerConfig.from_config(None) is None
+    assert CircuitBreakerConfig.from_config(True) == CircuitBreakerConfig()
+
+
+def test_noop_ack_source_quarantines_immediately():
+    """A source with no redelivery (NoopAck) must not lose batches to the
+    nack path: failures quarantine right away even below the attempt budget."""
+    err_sink = CollectOutput()
+    sink = CollectOutput()
+    inp = MemoryInput([b"poison", b"fine"])  # plain NoopAck source
+    proc = FaultInjectingProcessor(
+        None, sched([{"kind": "error", "match": "poison"}], PROCESSOR_KINDS, "processor"))
+    stream = Stream(inp, Pipeline([proc]), sink, error_output=err_sink,
+                    thread_num=1, name="chaos-noopack",
+                    max_delivery_attempts=5)
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=30))
+    assert payloads_of(err_sink) == [b"poison"]  # not silently dropped
+    assert payloads_of(sink) == [b"fine"]
+
+
+def test_reconnect_backoff_attempt_overflow_clamped():
+    """delay_s must survive the unbounded attempt counts of a
+    reconnect-forever loop (2.0**1024 would raise OverflowError)."""
+    rc = RetryConfig(max_delay_ms=5000)
+    assert rc.delay_s(10_000) == 5.0
+
+
+def test_seeded_rate_faults_are_reproducible():
+    def pattern() -> list[bool]:
+        s = sched([{"kind": "error", "rate": 0.3}], OUTPUT_KINDS, "output", seed=42)
+        return [bool(s.due(i)) for i in range(1, 50)]
+
+    a, b = pattern(), pattern()
+    assert any(a) and not all(a)  # fires sometimes, not always
+    assert a == b  # same seed + same op sequence -> same faults

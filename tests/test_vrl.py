@@ -409,6 +409,21 @@ def test_whole_event_assignment_expands_json():
     assert out.column("__meta_source").to_pylist() == ["k", "k"]
 
 
+def test_whole_event_assignment_tolerates_malformed_rows():
+    """One malformed JSON row must not fail the whole batch (a poison record
+    under at-least-once replay would wedge the stream): unparseable rows
+    fall back to {} while the rest decode normally."""
+    import pyarrow as pa
+
+    from arkflow_tpu.batch import MessageBatch as MB
+
+    rb = pa.RecordBatch.from_arrays(
+        [pa.array(['{"a": 1}', 'not json at all', '{"a": 3}'])],
+        names=["message"])
+    out = run_vrl(". = parse_json!(.message)", MB(rb))
+    assert out.column("a").to_pylist() == [1, None, 3]
+
+
 def test_whole_event_assignment_rejects_in_branch_and_non_json():
     with pytest.raises(VrlCompileError, match="if-branches"):
         compile_vrl('if .c { . = parse_json!(.m) }')
