@@ -27,6 +27,7 @@ from __future__ import annotations
 import time
 from typing import Any, Iterable, Mapping, Sequence
 
+import numpy as np
 import pyarrow as pa
 
 from arkflow_tpu.errors import ArkError
@@ -55,6 +56,74 @@ META_COLUMNS = (
 
 def is_meta_column(name: str) -> bool:
     return name in META_COLUMNS or name.startswith(META_EXT_PREFIX)
+
+
+#: Arrow types whose payload lives in an (offsets, values) buffer pair and can
+#: therefore be exposed as flat ndarray views without touching Python objects.
+_VARLEN_TYPES = (
+    pa.types.is_binary, pa.types.is_large_binary,
+    pa.types.is_string, pa.types.is_large_string,
+)
+
+
+def is_varlen_payload(typ: pa.DataType) -> bool:
+    return any(check(typ) for check in _VARLEN_TYPES)
+
+
+def binary_column_view(col: pa.Array) -> tuple[np.ndarray, np.ndarray]:
+    """Zero-copy ``(values, offsets)`` ndarray views over a binary/string column.
+
+    ``values`` is the column's whole uint8 data buffer; ``offsets`` is the
+    ``n+1`` int64 positions of each row's payload inside it (absolute — no
+    base subtraction needed), correctly windowed for sliced arrays. 32-bit
+    offset types pay one O(n) widening copy of the *offsets only*; the payload
+    bytes are never copied and no per-row Python objects are created.
+
+    Null rows are NOT collapsed here (the spec allows them to span garbage
+    bytes); callers that must treat nulls as empty check ``col.null_count``
+    and mask lengths via ``col.is_null()``.
+    """
+    if not is_varlen_payload(col.type):
+        raise ArkError(f"column type {col.type} has no binary payload view")
+    buffers = col.buffers()
+    n = len(col)
+    wide = pa.types.is_large_binary(col.type) or pa.types.is_large_string(col.type)
+    if buffers[1] is None:  # length-0 arrays may carry no offsets buffer
+        offsets = np.zeros(1, np.int64)
+    else:
+        offsets = np.frombuffer(buffers[1], dtype=np.int64 if wide else np.int32)
+        offsets = offsets[col.offset : col.offset + n + 1]
+        if not wide:
+            offsets = offsets.astype(np.int64)
+    if buffers[2] is None:  # all-null column: no data buffer was allocated
+        values = np.empty(0, np.uint8)
+    else:
+        values = np.frombuffer(buffers[2], dtype=np.uint8)
+    return values, offsets
+
+
+def batch_fingerprint(batch: "MessageBatch") -> bytes:
+    """Stable identity of a batch across redeliveries: data + broker
+    provenance columns, excluding per-delivery noise (ingest time, ext
+    metadata the error path itself stamps). The ONE definition shared by the
+    stream's delivery-attempt budget and the coalescer's poison-suspect
+    table — their convergence argument requires identical exclusions.
+
+    Sources that stamp offset metadata (kafka, pulsar, ...) get fully
+    distinct keys; content-only sources emitting byte-identical batches
+    share one key — an accepted approximation, since entries clear on
+    success.
+    """
+    import hashlib
+
+    rb = batch.record_batch
+    keep = [n for n in rb.schema.names
+            if n != META_INGEST_TIME and not n.startswith(META_EXT_PREFIX)]
+    rb = rb.select(keep)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return hashlib.blake2b(sink.getvalue().to_pybytes(), digest_size=16).digest()
 
 
 def _repeat_array(value: Any, typ: pa.DataType, n: int) -> pa.Array:
@@ -145,16 +214,39 @@ class MessageBatch:
 
     # -- binary convention -------------------------------------------------
 
-    def to_binary(self, field: str = DEFAULT_BINARY_VALUE_FIELD) -> list[bytes]:
-        """Extract the opaque payload column as Python bytes (ref lib.rs ``to_binary``)."""
+    def payload_view(self, field: str = DEFAULT_BINARY_VALUE_FIELD) -> tuple[np.ndarray, np.ndarray]:
+        """Zero-copy ``(values, offsets)`` ndarray views of a payload column.
+
+        The vectorized infeed accessor: row ``i``'s payload is
+        ``values[offsets[i]:offsets[i+1]]``. String columns expose their
+        UTF-8 buffer directly, so no per-row encode happens either. Callers
+        that care about nulls-as-empty must check ``col.null_count``
+        themselves (see ``binary_column_view``); ``to_binary`` does.
+        """
         col = self.column(field)
-        if not (pa.types.is_binary(col.type) or pa.types.is_large_binary(col.type)
-                or pa.types.is_string(col.type) or pa.types.is_large_string(col.type)):
+        if not is_varlen_payload(col.type):
             raise ArkError(f"column {field!r} is {col.type}, not binary/string")
-        return [
-            b"" if v is None else (v.encode("utf-8") if isinstance(v, str) else v)
-            for v in col.to_pylist()
-        ]
+        return binary_column_view(col)
+
+    def to_binary(self, field: str = DEFAULT_BINARY_VALUE_FIELD) -> list[bytes]:
+        """Extract the opaque payload column as Python bytes (ref lib.rs ``to_binary``).
+
+        Built on the zero-copy view: one slice of the Arrow data buffer is
+        materialized as ``bytes``, then rows are cheap bytes slices of it —
+        no per-row Arrow scalar boxing, no per-row UTF-8 encode.
+        """
+        values, offsets = self.payload_view(field)
+        n = self.num_rows
+        base = int(offsets[0]) if n else 0
+        buf = values[base : int(offsets[n]) if n else 0].tobytes()
+        col = self.column(field)
+        if col.null_count:
+            valid = ~col.is_null().to_numpy(zero_copy_only=False)
+            return [
+                buf[offsets[i] - base : offsets[i + 1] - base] if valid[i] else b""
+                for i in range(n)
+            ]
+        return [buf[offsets[i] - base : offsets[i + 1] - base] for i in range(n)]
 
     # -- column surgery ----------------------------------------------------
 

@@ -92,6 +92,63 @@ class FnAck(Ack):
         await self._fn()
 
 
+class _SplitState:
+    __slots__ = ("ack", "remaining", "nacked")
+
+    def __init__(self, ack: Ack, parts: int):
+        self.ack = ack
+        self.remaining = parts
+        self.nacked = False
+
+
+class _PartAck(Ack):
+    """One share of a split source ack (see ``split_ack``)."""
+
+    def __init__(self, state: _SplitState):
+        self._state = state
+        self._done = False
+
+    @property
+    def redeliverable(self) -> bool:  # type: ignore[override]
+        return bool(getattr(self._state.ack, "redeliverable", False))
+
+    async def _resolve(self, nack: bool) -> None:
+        if self._done:  # idempotent: a retried ack must not double-count
+            return
+        self._done = True
+        st = self._state
+        st.nacked = st.nacked or nack
+        st.remaining -= 1
+        if st.remaining == 0:
+            if st.nacked:
+                await st.ack.nack()
+            else:
+                await st.ack.ack()
+
+    async def ack(self) -> None:
+        await self._resolve(False)
+
+    async def nack(self) -> None:
+        await self._resolve(True)
+
+
+def split_ack(ack: Ack, parts: int) -> list[Ack]:
+    """Split one source ack into ``parts`` shares, for a batch whose rows are
+    carved across several downstream emissions (bucket-exact coalescing).
+
+    At-least-once semantics: the source ack fires only after EVERY share
+    acked; if any share nacks, the source nacks instead — once all shares
+    resolved — so the whole source batch is redelivered (duplicates of the
+    successfully-delivered rows are the accepted at-least-once cost).
+    """
+    if parts < 1:
+        raise ValueError("split_ack needs at least one part")
+    if parts == 1:
+        return [ack]
+    state = _SplitState(ack, parts)
+    return [_PartAck(state) for _ in range(parts)]
+
+
 @dataclass
 class Resource:
     """Shared build-time context passed to every builder (ref lib.rs:112-116).

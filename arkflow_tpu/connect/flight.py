@@ -235,9 +235,10 @@ class FlightWorker:
                 rows = await loop.run_in_executor(None, cur.fetchmany, batch_rows)
                 if not rows:
                     break
-                cols = list(zip(*rows))
+                # pa.array consumes the zip tuples directly — no per-column
+                # list re-materialization of every value
                 rb = pa.RecordBatch.from_arrays(
-                    [pa.array(list(c)) for c in cols], names=names)
+                    [pa.array(c) for c in zip(*rows)], names=names)
                 if schema is None:
                     if any(pa.types.is_null(f.type) for f in rb.schema) and len(held) < 64:
                         # a leading all-NULL column would freeze as null-typed

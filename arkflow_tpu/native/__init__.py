@@ -142,25 +142,42 @@ def crc32c(data: bytes, crc: int = 0) -> int:
 
 # -- batch hash tokenizer ---------------------------------------------------
 
-def hash_tokenize_batch(texts: list[bytes], max_len: int, vocab_size: int):
-    """Native batch tokenize -> (ids, mask) int32 [n, max_len]; None if no lib."""
+def hash_tokenize_view(values: np.ndarray, offsets: np.ndarray,
+                       max_len: int, vocab_size: int):
+    """Zero-copy native batch tokenize over an Arrow-style buffer pair.
+
+    ``values`` is the concatenated uint8 payload buffer, ``offsets`` the n+1
+    absolute int64 row boundaries inside it — exactly what
+    ``MessageBatch.payload_view`` returns, so the kernel reads the Arrow data
+    buffer in place (no ``b"".join``, no per-row bytes objects).
+    Returns (ids, mask) int32 [n, max_len]; None if no lib.
+    """
     lib = _load()
     if lib is None:
         return None
-    n = len(texts)
-    buf = b"".join(texts)
-    offsets = np.zeros(n + 1, np.int64)
-    np.cumsum([len(t) for t in texts], out=offsets[1:])
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    values = np.ascontiguousarray(values, dtype=np.uint8)
+    n = len(offsets) - 1
     ids = np.zeros((n, max_len), np.int32)
     mask = np.zeros((n, max_len), np.int32)
     lib.ark_hash_tokenize(
-        buf,
+        values.ctypes.data_as(ctypes.c_char_p),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         n, max_len, vocab_size,
         ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return ids, mask
+
+
+def hash_tokenize_batch(texts: list[bytes], max_len: int, vocab_size: int):
+    """Native batch tokenize -> (ids, mask) int32 [n, max_len]; None if no lib."""
+    if _load() is None:
+        return None
+    offsets = np.zeros(len(texts) + 1, np.int64)
+    np.cumsum([len(t) for t in texts], out=offsets[1:])
+    values = np.frombuffer(b"".join(texts), dtype=np.uint8)
+    return hash_tokenize_view(values, offsets, max_len, vocab_size)
 
 
 # -- block compression codecs (Kafka snappy/lz4; framing lives in

@@ -9,13 +9,21 @@ unacked rows replay from the broker after a crash.
 
 This is also the engine's micro-batching stage for TPU inference: it
 right-sizes ragged streaming input into batches near the compiled batch shape
-(see arkflow_tpu.tpu.bucketing for the shape policy).
+(see arkflow_tpu.tpu.bucketing for the shape policy). With ``coalesce``
+configured it goes one step further and emits batches of EXACTLY the largest
+compiled batch bucket (splitting the straddling batch, sharing its ack), so
+steady-state device steps carry zero padding rows; the ``deadline`` bounds how
+long rows wait for a full bucket before the remainder is flushed merged.
 
 Config:
 
     type: memory
-    capacity: 1024      # rows
+    capacity: 1024      # rows (flush threshold; backpressure bound)
     timeout: 100ms
+    # optional bucket-exact coalescing for the TPU infeed:
+    coalesce:
+      batch_buckets: [8, 16, 32, 64]   # the runner's compiled batch buckets
+      deadline: 5ms                    # max wait for a full bucket (default: timeout)
 """
 
 from __future__ import annotations
@@ -26,15 +34,35 @@ from typing import Optional
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Ack, Buffer, Resource, VecAck, register_buffer
 from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.tpu.bucketing import MicroBatchCoalescer
 from arkflow_tpu.utils.duration import parse_duration
 
 
 class MemoryBuffer(Buffer):
-    def __init__(self, capacity: int, timeout_s: Optional[float] = None):
+    def __init__(self, capacity: int, timeout_s: Optional[float] = None,
+                 coalesce_buckets: Optional[list[int]] = None,
+                 coalesce_deadline_s: Optional[float] = None):
         if capacity <= 0:
             raise ConfigError("buffer.capacity must be positive")
         self.capacity = capacity
         self.timeout_s = timeout_s
+        self._coalescer: Optional[MicroBatchCoalescer] = None
+        self._deadline_s = None
+        if coalesce_buckets:
+            self._coalescer = MicroBatchCoalescer(coalesce_buckets)
+            self._deadline_s = (coalesce_deadline_s if coalesce_deadline_s is not None
+                                else timeout_s)
+            if self._deadline_s is None:
+                # without a deadline, sub-bucket rows (and their acks, incl.
+                # split-ack tails) would sit unemitted until shutdown
+                raise ConfigError(
+                    "buffer.coalesce requires 'deadline' (or a buffer 'timeout')")
+            if self._coalescer.target > capacity * self.BACKPRESSURE_FACTOR:
+                raise ConfigError(
+                    f"coalesce bucket {self._coalescer.target} exceeds the "
+                    f"buffer's backpressure bound "
+                    f"{capacity * self.BACKPRESSURE_FACTOR} rows "
+                    f"(raise capacity or shrink batch_buckets)")
         self._held: list[tuple[MessageBatch, Ack]] = []
         self._held_rows = 0
         self._first_write_at: Optional[float] = None
@@ -54,7 +82,10 @@ class MemoryBuffer(Buffer):
                 await self._cond.wait()
             if self._first_write_at is None:
                 self._first_write_at = asyncio.get_running_loop().time()
-            self._held.append((batch, ack))
+            if self._coalescer is not None:
+                self._coalescer.add(batch, ack)
+            else:
+                self._held.append((batch, ack))
             self._held_rows += batch.num_rows
             # always notify: a waiting reader must recompute its timeout deadline
             self._cond.notify_all()
@@ -68,7 +99,28 @@ class MemoryBuffer(Buffer):
         self._cond.notify_all()  # wake writers blocked on backpressure
         return MessageBatch.concat(batches), acks
 
+    def _emit_coalesced_locked(self, *, flush: bool) -> Optional[tuple[MessageBatch, Ack]]:
+        """Bucket-exact emission; ``flush`` (deadline/close) also carves the
+        sub-target tail against the smaller buckets, then the remainder."""
+        if flush:
+            emission = self._coalescer.pop_flush()
+        else:
+            emission = self._coalescer.pop_exact()
+        if emission is None:
+            return None
+        self._held_rows -= emission[0].num_rows
+        if self._coalescer.pending == 0:
+            self._first_write_at = None
+        else:
+            # the held tail's deadline budget restarts, else a long-ago first
+            # write would flush every tail immediately (no coalescing at all)
+            self._first_write_at = asyncio.get_running_loop().time()
+        self._cond.notify_all()  # wake writers blocked on backpressure
+        return emission
+
     async def read(self) -> Optional[tuple[MessageBatch, Ack]]:
+        if self._coalescer is not None:
+            return await self._read_coalesced()
         while True:
             async with self._cond:
                 if self._held_rows >= self.capacity:
@@ -90,6 +142,26 @@ class MemoryBuffer(Buffer):
                     if self._held:
                         return self._emit_locked()
 
+    async def _read_coalesced(self) -> Optional[tuple[MessageBatch, Ack]]:
+        while True:
+            async with self._cond:
+                deadline_over = False
+                timeout = None
+                if self._deadline_s is not None and self._first_write_at is not None:
+                    now = asyncio.get_running_loop().time()
+                    timeout = max(0.0, self._first_write_at + self._deadline_s - now)
+                    deadline_over = timeout <= 0
+                emission = self._emit_coalesced_locked(
+                    flush=self._closed or deadline_over)
+                if emission is not None:
+                    return emission
+                if self._closed:
+                    return None
+                try:
+                    await asyncio.wait_for(self._cond.wait(), timeout=timeout)
+                except asyncio.TimeoutError:
+                    pass  # loop re-evaluates the deadline flush
+
     async def close(self) -> None:
         async with self._cond:
             self._closed = True
@@ -102,7 +174,14 @@ def _build(config: dict, resource: Resource) -> MemoryBuffer:
     if capacity is None:
         raise ConfigError("memory buffer requires 'capacity'")
     timeout = config.get("timeout")
+    coalesce = config.get("coalesce") or {}
+    buckets = coalesce.get("batch_buckets")
+    if coalesce and not buckets:
+        raise ConfigError("buffer.coalesce requires 'batch_buckets'")
+    deadline = coalesce.get("deadline")
     return MemoryBuffer(
         capacity=int(capacity),
         timeout_s=parse_duration(timeout) if timeout is not None else None,
+        coalesce_buckets=[int(b) for b in buckets] if buckets else None,
+        coalesce_deadline_s=parse_duration(deadline) if deadline is not None else None,
     )

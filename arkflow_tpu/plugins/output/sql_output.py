@@ -30,6 +30,24 @@ from arkflow_tpu.components import Output, Resource, register_output
 from arkflow_tpu.errors import ConfigError, WriteError
 
 
+def _batch_rows(batch: MessageBatch, coerce=None) -> list:
+    """Materialize a (metadata-stripped) batch as driver-ready row tuples.
+
+    The single row-materialization site for every SQL driver, so a faster
+    column accessor (e.g. the zero-copy payload view) can later slot in once
+    for all of them. ``coerce`` maps each cell (sqlite needs non-primitive
+    values stringified); without it rows stay raw ``to_pylist`` values.
+    """
+    cols = [c.to_pylist() for c in batch.record_batch.columns]
+    if coerce is None:
+        return [list(row) for row in zip(*cols)]
+    return [tuple(coerce(v) for v in row) for row in zip(*cols)]
+
+
+def _sqlite_cell(v):
+    return v if isinstance(v, (int, float, str, bytes, type(None))) else str(v)
+
+
 def _sqlite_type(t: pa.DataType) -> str:
     if pa.types.is_integer(t) or pa.types.is_boolean(t):
         return "INTEGER"
@@ -69,11 +87,7 @@ class SqliteOutput(Output):
         self._ensure_table(data)
         names = ", ".join(f'"{n}"' for n in data.column_names)
         ph = ", ".join("?" for _ in data.column_names)
-        cols = [c.to_pylist() for c in data.record_batch.columns]
-        rows = [
-            tuple(v if isinstance(v, (int, float, str, bytes, type(None))) else str(v) for v in row)
-            for row in zip(*cols)
-        ]
+        rows = _batch_rows(data, coerce=_sqlite_cell)
         try:
             self._conn.executemany(
                 f'INSERT INTO "{self.table}" ({names}) VALUES ({ph})', rows
@@ -142,8 +156,7 @@ class PostgresOutput(Output):
             return
         await self._ensure_table(data)
         names = data.column_names
-        cols = [c.to_pylist() for c in data.record_batch.columns]
-        rows = [list(row) for row in zip(*cols)]
+        rows = _batch_rows(data)
         try:
             if self.use_copy:
                 await self._client.copy_in(self.table, names, rows)
@@ -204,8 +217,7 @@ class MySqlOutput(Output):
             return
         await self._ensure_table(data)
         names = data.column_names
-        cols = [c.to_pylist() for c in data.record_batch.columns]
-        rows = [list(row) for row in zip(*cols)]
+        rows = _batch_rows(data)
         try:
             await self._client.insert_rows(self.table, names, rows)
         except WriteError:

@@ -65,17 +65,39 @@ class TpuInferenceProcessor(Processor):
         self.outputs = outputs
         self._warmed = not warmup
         self.packing = packing
+        from arkflow_tpu.obs import global_registry
+
+        # extraction/tokenization is the other half of host infeed prep
+        # (the runner's own histogram covers pad/stage); bench sums the two
+        self.m_extract = global_registry().histogram(
+            "arkflow_tpu_extract_seconds",
+            "host-side Arrow->tensor extraction + tokenization per batch",
+            {"model": runner.family.name})
 
     # -- input extraction --------------------------------------------------
+
+    def _encode_texts(self, batch: MessageBatch, max_len: int) -> tuple[np.ndarray, np.ndarray]:
+        """Tokenize the payload column, preferring the zero-copy buffer view
+        (no per-row bytes materialization) over ``to_binary``'s list path."""
+        from arkflow_tpu.errors import ArkError
+
+        col = batch.column(self.text_field)
+        if col.null_count == 0 and hasattr(self.tokenizer, "encode_batch_view"):
+            try:
+                values, offsets = batch.payload_view(self.text_field)
+            except ArkError:
+                pass  # non-varlen payload column: the list path raises clearly
+            else:
+                return self.tokenizer.encode_batch_view(values, offsets, max_len)
+        return self.tokenizer.encode_batch(batch.to_binary(self.text_field), max_len)
 
     def _extract(self, batch: MessageBatch) -> dict[str, np.ndarray]:
         inputs: dict[str, np.ndarray] = {}
         spec = self.runner.spec
         needs_tokens = any(t == ("seq",) for _, t in spec.values()) and "input_ids" in spec
         if needs_tokens:
-            texts = batch.to_binary(self.text_field)
             # bucket sequence length by the longest text in the batch
-            ids, mask = self.tokenizer.encode_batch(texts, self.max_seq)
+            ids, mask = self._encode_texts(batch, self.max_seq)
             used = int(mask.sum(axis=1).max()) if mask.size else 1
             sb = self.runner.buckets.seq_bucket(used)
             inputs["input_ids"] = ids[:, :sb]
@@ -129,7 +151,8 @@ class TpuInferenceProcessor(Processor):
         if self.packing:
             outputs = await self._infer_packed(batch)
         else:
-            inputs = self._extract(batch)
+            with self.m_extract.time():
+                inputs = self._extract(batch)
             outputs = await self.runner.infer(inputs)
         return [self._attach(batch, outputs)]
 
@@ -143,12 +166,11 @@ class TpuInferenceProcessor(Processor):
         def tokenize_and_pack() -> list[dict[str, np.ndarray]]:
             # host-side Python/numpy loops: off the event loop, like the
             # runner's own _prep, so a big batch never stalls other streams
-            texts = batch.to_binary(self.text_field)
-            ids, mask = self.tokenizer.encode_batch(texts, self.max_seq)
+            ids, mask = self._encode_texts(batch, self.max_seq)
             lengths = mask.sum(axis=1).astype(np.int64)
             mb = self.runner.buckets.max_batch()
             chunks = []
-            for i in range(0, len(texts), mb):
+            for i in range(0, len(ids), mb):
                 sub_len = lengths[i:i + mb]
                 sb = self.runner.buckets.seq_bucket(int(sub_len.max()) if len(sub_len) else 1)
                 pk = pack_tokens(ids[i:i + mb], sub_len, sb)
@@ -161,8 +183,12 @@ class TpuInferenceProcessor(Processor):
                 })
             return chunks
 
+        def timed_tokenize_and_pack() -> list[dict[str, np.ndarray]]:
+            with self.m_extract.time():
+                return tokenize_and_pack()
+
         loop = asyncio.get_running_loop()
-        chunks = await loop.run_in_executor(None, tokenize_and_pack)
+        chunks = await loop.run_in_executor(None, timed_tokenize_and_pack)
         outs = await asyncio.gather(*[self.runner.infer(c) for c in chunks])
         if len(outs) == 1:
             return outs[0]

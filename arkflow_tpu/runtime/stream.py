@@ -28,15 +28,12 @@ crates/arkflow-core/src/stream/mod.rs:79-398), re-expressed for asyncio:
 from __future__ import annotations
 
 import asyncio
-import hashlib
 import logging
 import time
 from dataclasses import dataclass
 from typing import Optional
 
-import pyarrow as pa
-
-from arkflow_tpu.batch import META_EXT_PREFIX, META_INGEST_TIME, MessageBatch
+from arkflow_tpu.batch import MessageBatch, batch_fingerprint
 from arkflow_tpu.components.base import Ack, Buffer, Input, Output, Resource, Temporary
 from arkflow_tpu.components.registry import build_component
 from arkflow_tpu.config import StreamConfig
@@ -361,23 +358,12 @@ class Stream:
 
     @staticmethod
     def _fingerprint(batch: MessageBatch) -> bytes:
-        """Stable identity of a batch across redeliveries: data + broker
-        provenance columns, excluding per-delivery noise (ingest time, ext
-        metadata the error path itself stamps). Sources that stamp offset
-        metadata (kafka, pulsar, ...) get fully distinct keys; content-only
-        sources emitting byte-identical batches share one attempt counter —
-        an accepted approximation, since entries clear on success. Computed
-        on failure paths, plus on successes only while failures are being
-        tracked (the table is non-empty); the all-healthy hot path never
-        pays for it."""
-        rb = batch.record_batch
-        keep = [n for n in rb.schema.names
-                if n != META_INGEST_TIME and not n.startswith(META_EXT_PREFIX)]
-        rb = rb.select(keep)
-        sink = pa.BufferOutputStream()
-        with pa.ipc.new_stream(sink, rb.schema) as w:
-            w.write_batch(rb)
-        return hashlib.blake2b(sink.getvalue().to_pybytes(), digest_size=16).digest()
+        """Stable batch identity for the delivery-attempt budget — the
+        shared ``batch_fingerprint`` definition, which the coalescer's
+        poison-suspect table must match exactly. Computed on failure paths,
+        plus on successes only while failures are being tracked (the table
+        is non-empty); the all-healthy hot path never pays for it."""
+        return batch_fingerprint(batch)
 
     def _bump_attempts(self, batch: MessageBatch) -> int:
         key = self._fingerprint(batch)

@@ -216,8 +216,12 @@ def _merge(args, n):
 
 def _encode_json(args, n):
     """encode_json(x) -> JSON text per row: lists/structs/scalars serialize,
-    NULL stays NULL (VRL's encode_json; row-wise host pass, not hot-path)."""
+    NULL stays NULL (VRL's encode_json). Integer/boolean columns vectorize
+    through ``pc.cast`` (their Arrow string form IS their JSON form); every
+    other type takes the row-wise reference pass."""
     arr = as_array(args[0], n)
+    if pa.types.is_boolean(arr.type) or pa.types.is_integer(arr.type):
+        return pc.cast(arr, pa.string())
 
     def debytes(pv):
         # bytes can hide anywhere (binary columns split to list<binary>):
@@ -502,6 +506,10 @@ def _parse_syslog(args, n):
         raise UnsupportedSql("parse_syslog part must be a literal")
     key = str(key)
 
+    fast = _parse_syslog_vector(s, key)
+    if fast is not None:
+        return fast
+
     def one(v):
         # fallible-parser contract: a bad row (wrong type, no match) yields
         # NULL, never aborts the batch
@@ -527,6 +535,46 @@ def _parse_syslog(args, n):
         return None if val in (None, "-") else val
 
     return pa.array([one(v.as_py()) for v in s])
+
+
+def _parse_syslog_vector(s: pa.Array, key: str):
+    """Vectorized parse_syslog: one ``pc.extract_regex`` (RE2) pass per
+    pattern over the whole column instead of a Python match per row. Returns
+    None when the kernels can't serve the input (non-UTF-8 binary, exotic
+    type, old pyarrow) — the caller falls back to the row-wise reference."""
+    try:
+        if pa.types.is_binary(s.type) or pa.types.is_large_binary(s.type):
+            s = pc.cast(s, pa.string())  # strict: invalid UTF-8 -> fallback
+        elif not (pa.types.is_string(s.type) or pa.types.is_large_string(s.type)):
+            return None
+        # (?s) = DOTALL, matching the compiled Python patterns' flag
+        m5424 = pc.extract_regex(s, pattern="(?s)" + _SYSLOG_5424.pattern)
+        m3164 = pc.extract_regex(s, pattern="(?s)" + _SYSLOG_3164.pattern)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError, AttributeError):
+        return None
+    is5424 = pc.is_valid(m5424)
+    if key in ("severity", "facility"):
+        pri = pc.if_else(is5424, pc.struct_field(m5424, "pri"),
+                         pc.struct_field(m3164, "pri"))
+        pri = pc.cast(pri, pa.int64())
+        return pc.bit_wise_and(pri, 7) if key == "severity" else pc.shift_right(pri, 3)
+    if key == "version":  # RFC 3164 lines carry no version
+        return pc.cast(pc.struct_field(m5424, "version"), pa.int64())
+    in5424 = key in _SYSLOG_5424.groupindex
+    in3164 = key in _SYSLOG_3164.groupindex
+    if not (in5424 or in3164):
+        return pa.nulls(len(s), pa.string())
+    nulls = pa.nulls(len(s), pa.string())
+    val = pc.if_else(
+        is5424,
+        pc.struct_field(m5424, key) if in5424 else nulls,
+        pc.struct_field(m3164, key) if in3164 else nulls,
+    )
+    if key == "procid":
+        # RE2 reports the unmatched optional 3164 group as "", Python as None
+        val = pc.if_else(pc.equal(val, ""), pa.scalar(None, pa.string()), val)
+    # the RFC 5424 nil value "-" reads as NULL, like the row-wise path
+    return pc.if_else(pc.equal(val, "-"), pa.scalar(None, pa.string()), val)
 
 
 def _parse_url(args, n):

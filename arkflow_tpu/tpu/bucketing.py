@@ -12,12 +12,17 @@ is bounded by 50% and the executable count stays logarithmic.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import TYPE_CHECKING, Optional, Sequence
 
 import numpy as np
 
 from arkflow_tpu.errors import ConfigError
+
+if TYPE_CHECKING:
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components.base import Ack
 
 
 def pow2_buckets(lo: int, hi: int) -> list[int]:
@@ -65,6 +70,189 @@ class BucketPolicy:
 
     def max_batch(self) -> int:
         return self.batch_buckets[-1]
+
+
+class MicroBatchCoalescer:
+    """Merges sub-bucket micro-batches into bucket-exact emissions.
+
+    Streaming sources emit whatever batch size the broker delivered; padding
+    each one to its compiled bucket alone wastes MXU cycles on zero rows
+    (``arkflow_padding_waste_frac``). The coalescer holds written
+    ``(batch, ack)`` pairs and carves emissions of EXACTLY the largest
+    compiled batch bucket — splitting the batch that straddles the boundary
+    and sharing its ack across the two emissions via ``split_ack`` — so
+    steady-state device steps run at fill ratio 1.0. The caller (the memory
+    buffer plugin) owns the deadline that bounds how long rows wait for a
+    full bucket; ``pop_flush`` carves the remainder bucket-exact on
+    deadline/close.
+
+    At-least-once is preserved: every emission carries a composite ack over
+    the source acks (or their split shares), so a quarantined merged batch
+    acks exactly the source batches whose rows it contained, and a nacked
+    one redelivers them.
+
+    Poison isolation: the stream counts delivery attempts per MERGED batch
+    fingerprint, so a poison source batch whose redeliveries kept regrouping
+    with fresh traffic would mint a new fingerprint every round and nack-loop
+    forever. The coalescer therefore watches its own emission acks — sources
+    of a nacked emission are marked suspect, and a suspect batch re-arriving
+    is emitted SOLO (stable fingerprint), so the stream's attempt budget
+    converges and quarantine fires. A suspect that then succeeds is cleared.
+    """
+
+    #: bound on the suspect table; entries clear on ack, so this only
+    #: matters with thousands of concurrently failing source batches
+    MAX_SUSPECTS = 1024
+
+    def __init__(self, batch_buckets: Sequence[int]):
+        buckets = tuple(sorted(int(b) for b in batch_buckets))
+        if not buckets or buckets[0] <= 0:
+            raise ConfigError("coalesce batch_buckets must be non-empty positive ints")
+        self.buckets = buckets
+        self.target = buckets[-1]
+        self._held: deque[tuple["MessageBatch", "Ack"]] = deque()
+        #: suspect (previously-nacked) batches, emitted alone and first
+        self._solo: deque[tuple["MessageBatch", "Ack"]] = deque()
+        #: fingerprint -> row count of each currently-suspect source batch
+        self._suspects: dict[bytes, int] = {}
+        #: cheap prefilter so healthy adds/acks skip hashing: row counts of
+        #: current suspects (hash only on a row-count match)
+        self._suspect_rows: set[int] = set()
+        self._rows = 0
+
+    @property
+    def rows(self) -> int:
+        return self._rows
+
+    @property
+    def pending(self) -> int:
+        """Held entries — covers zero-row batches whose acks still await."""
+        return len(self._held) + len(self._solo)
+
+    # -- suspect tracking (hashing only on failure paths, plus on adds/acks
+    # -- that pass the row-count prefilter while failures are outstanding —
+    # -- the all-healthy pipeline never serializes a batch) ----------------
+
+    @staticmethod
+    def _fingerprint(batch: "MessageBatch") -> bytes:
+        """Shared with the stream's attempt budget (``batch_fingerprint``):
+        solo-emission convergence requires the two to hash identically."""
+        from arkflow_tpu.batch import batch_fingerprint
+
+        return batch_fingerprint(batch)
+
+    def _mark_suspect(self, batch: "MessageBatch") -> None:
+        key = self._fingerprint(batch)
+        if key not in self._suspects and len(self._suspects) >= self.MAX_SUSPECTS:
+            self._suspects.pop(next(iter(self._suspects)))
+        self._suspects[key] = batch.num_rows
+        self._suspect_rows.add(batch.num_rows)
+
+    def _clear_suspect(self, batch: "MessageBatch") -> None:
+        if batch.num_rows not in self._suspect_rows:
+            return  # prefilter: healthy acks never pay the hash either
+        if self._suspects.pop(self._fingerprint(batch), None) is not None:
+            self._suspect_rows = set(self._suspects.values())
+
+    def _observed(self, batch: "MessageBatch", ack: "Ack") -> "Ack":
+        """Wrap a source ack so emission outcomes feed the suspect table."""
+        return _SuspectObserverAck(self, batch, ack)
+
+    def add(self, batch: "MessageBatch", ack: "Ack") -> None:
+        ack = self._observed(batch, ack)
+        if (batch.num_rows in self._suspect_rows
+                and self._fingerprint(batch) in self._suspects):
+            self._solo.append((batch, ack))
+        else:
+            self._held.append((batch, ack))
+        self._rows += batch.num_rows
+
+    def _carve(self, rows: int) -> tuple["MessageBatch", "Ack"]:
+        """Take exactly ``rows`` held rows as one merged emission, splitting
+        the boundary batch (its source ack is shared across both emissions)."""
+        from arkflow_tpu.batch import MessageBatch
+        from arkflow_tpu.components.base import VecAck, split_ack
+
+        parts: list["MessageBatch"] = []
+        acks: list["Ack"] = []
+        need = rows
+        while need > 0:
+            batch, ack = self._held.popleft()
+            if batch.num_rows <= need:
+                parts.append(batch)
+                acks.append(ack)
+                need -= batch.num_rows
+            else:
+                head_ack, tail_ack = split_ack(ack, 2)
+                parts.append(batch.slice(0, need))
+                acks.append(head_ack)
+                self._held.appendleft((batch.slice(need), tail_ack))
+                need = 0
+        self._rows -= rows
+        return MessageBatch.concat(parts), VecAck(acks)
+
+    def pop_exact(self) -> Optional[tuple["MessageBatch", "Ack"]]:
+        """Next emission: a suspect batch alone (stable fingerprint for the
+        stream's attempt budget), else exactly ``target`` carved rows."""
+        if self._solo:
+            batch, ack = self._solo.popleft()
+            self._rows -= batch.num_rows
+            return batch, ack
+        if self._rows < self.target:
+            return None
+        return self._carve(self.target)
+
+    def pop_flush(self) -> Optional[tuple["MessageBatch", "Ack"]]:
+        """Deadline/close flush, one emission per call: carve the LARGEST
+        bucket that the held rows fill exactly (so a 40-row flush against
+        buckets [8,16,32] emits 32 then 8, zero padding), and only the
+        sub-minimum remainder emits unpadded-to-bucket as one merged batch.
+        Suspects drain through ``pop_exact`` first."""
+        from arkflow_tpu.batch import MessageBatch
+        from arkflow_tpu.components.base import VecAck
+
+        emission = self.pop_exact()
+        if emission is not None:
+            return emission
+        if not self._held:
+            return None
+        held_rows = self._rows
+        fitting = [b for b in self.buckets if b <= held_rows]
+        if fitting:
+            return self._carve(fitting[-1])
+        parts = [b for b, _ in self._held]
+        acks = VecAck([a for _, a in self._held])
+        self._held.clear()
+        self._rows = 0
+        return MessageBatch.concat(parts), acks
+
+
+class _SuspectObserverAck:
+    """Source-ack wrapper feeding emission outcomes back to the coalescer's
+    suspect table: a nack marks the batch suspect (its redelivery emits
+    solo), a final ack — delivered or quarantined — clears it."""
+
+    __slots__ = ("_coalescer", "_batch", "_inner")
+
+    def __init__(self, coalescer: MicroBatchCoalescer, batch: "MessageBatch",
+                 inner: "Ack"):
+        self._coalescer = coalescer
+        self._batch = batch
+        self._inner = inner
+
+    @property
+    def redeliverable(self) -> bool:
+        return bool(getattr(self._inner, "redeliverable", False))
+
+    async def ack(self) -> None:
+        self._coalescer._clear_suspect(self._batch)
+        await self._inner.ack()
+
+    async def nack(self) -> None:
+        # mark BEFORE the inner nack: the broker may requeue synchronously,
+        # and the redelivered write must already see the suspicion
+        self._coalescer._mark_suspect(self._batch)
+        await self._inner.nack()
 
 
 def pad_batch_dim(arr: np.ndarray, target: int) -> np.ndarray:

@@ -1,14 +1,80 @@
 """Arrow column -> model-input ndarray extraction, shared by the device
 processors (tpu_inference / tpu_train) so the list/binary/scalar handling
-can't drift between them."""
+can't drift between them.
+
+This is the host side of the infeed hot path, so every column kind has a
+vectorized, allocation-lean implementation: binary payloads are gathered
+straight out of the Arrow values buffer with offset arithmetic (one ragged
+numpy gather builds the whole ``[B, prod(want)]`` matrix — no per-row
+``as_py()``/``np.pad``/``np.stack``), and (nested) list columns reshape
+zero-copy views of their flattened values.
+"""
 
 from __future__ import annotations
 
 import numpy as np
 import pyarrow as pa
 
-from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.batch import MessageBatch, binary_column_view
 from arkflow_tpu.errors import ProcessError
+
+
+#: below this mean payload size the flat fancy-index gather beats a per-row
+#: slice-copy loop (index arithmetic amortizes; loop overhead dominates);
+#: above it each row is one bulk memcpy and the loop wins (measured on the
+#: 4096x784 image and 8192x20 sensor shapes)
+_GATHER_MAX_MEAN_LEN = 128
+
+
+def _binary_matrix(col: pa.Array, n: int, size: int) -> np.ndarray:
+    """Binary column -> ``[n, size]`` uint8, zero-padded/truncated per row.
+
+    Works on the Arrow buffers directly (no per-row ``as_py``/``np.pad``):
+
+    - uniform row length (the image-payload case): the values buffer IS the
+      matrix — one ``reshape`` view, zero copies (or one bulk memcpy when
+      rows are shorter than ``size``);
+    - ragged short rows: one flat fancy-index gather, O(total bytes);
+    - ragged long rows: per-row numpy slice copies (bulk memcpy each).
+    """
+    values, offsets = binary_column_view(col)
+    starts = offsets[:-1]
+    lens = offsets[1:] - starts
+    if n == 0:
+        return np.zeros((0, size), np.uint8)
+    if col.null_count:
+        # nulls read as empty payloads (matches the old ``as_py() or b""``)
+        lens = np.where(col.is_null().to_numpy(zero_copy_only=False), 0, lens)
+    elif lens.min() == lens.max():
+        # uniform rows sit back-to-back in the values buffer (Arrow offsets
+        # leave no gaps): the whole [n, L] matrix is a reshape of the buffer
+        length = int(lens[0])
+        base = int(offsets[0])
+        mat = values[base : base + n * length].reshape(n, length)
+        if length >= size:
+            return mat[:, :size]  # truncation: a strided view, still no copy
+        out = np.zeros((n, size), np.uint8)
+        out[:, :length] = mat
+        return out
+    lens = np.minimum(lens, size)  # truncation: only the first ``size`` bytes land
+    out = np.zeros((n, size), np.uint8)
+    total = int(lens.sum())
+    if not total:
+        return out
+    if total <= n * _GATHER_MAX_MEAN_LEN:
+        # ragged gather: for each row i, copy values[starts[i] : starts[i]+lens[i]]
+        # into out[i, :lens[i]] — expressed as one flat src/dst index pair
+        row_of = np.repeat(np.arange(n, dtype=np.int64), lens)
+        within = np.arange(total, dtype=np.int64) - np.repeat(
+            np.concatenate(([0], np.cumsum(lens[:-1]))), lens)
+        out.reshape(-1)[row_of * size + within] = values[
+            np.repeat(starts, lens) + within]
+    else:
+        for i in range(n):
+            length = lens[i]
+            start = starts[i]
+            out[i, :length] = values[start : start + length]
+    return out
 
 
 def extract_tensor(batch: MessageBatch, field: str, name: str, dtype: str,
@@ -27,31 +93,33 @@ def extract_tensor(batch: MessageBatch, field: str, name: str, dtype: str,
     want = tuple(int(d) for d in want)
     if pa.types.is_binary(col.type) or pa.types.is_large_binary(col.type):
         size = int(np.prod(want))
-        rows = []
-        for v in col:
-            buf = v.as_py() or b""
-            arr = np.frombuffer(buf, dtype=np.uint8)
-            if arr.size < size:
-                arr = np.pad(arr, (0, size - arr.size))
-            rows.append(arr[:size].reshape(want).astype(dtype))
-        out = np.stack(rows) if rows else np.zeros((0, *want), dtype)
+        out = _binary_matrix(col, n, size).reshape(n, *want)
         if dtype == "float32":
-            out = out / np.float32(255.0)
-        return out
+            # uint8/f32 divides straight to float32 (identical values to
+            # astype-then-divide) — skips a whole intermediate copy
+            return out / np.float32(255.0)
+        # copy=False keeps the uniform-payload case a true zero-copy view of
+        # the Arrow buffer end to end (consumers only read model inputs)
+        return out.astype(dtype, copy=False)
     if (pa.types.is_list(col.type) or pa.types.is_fixed_size_list(col.type)
             or pa.types.is_large_list(col.type)):
         flat = col.flatten()
         while isinstance(flat, (pa.ListArray, pa.LargeListArray,
                                 pa.FixedSizeListArray)):
             flat = flat.flatten()
-        arr = flat.to_numpy(zero_copy_only=False).astype(dtype)
+        try:
+            # nullless numeric values come back as a zero-copy buffer view
+            arr = flat.to_numpy(zero_copy_only=True)
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            arr = flat.to_numpy(zero_copy_only=False)
+        arr = arr.astype(dtype, copy=False)
         try:
             return arr.reshape(n, *want)
         except ValueError as e:
             raise ProcessError(
                 f"{who}: column {field!r} does not reshape to {want} per row: {e}"
             ) from e
-    arr = col.to_numpy(zero_copy_only=False).astype(dtype)
+    arr = col.to_numpy(zero_copy_only=False).astype(dtype, copy=False)
     if want and int(np.prod(want)) != 1:
         raise ProcessError(
             f"{who}: column {field!r} is scalar per row but input {name!r} wants {want}"

@@ -70,6 +70,37 @@ class _nullcontext:
         return False
 
 
+class _StagingPool:
+    """Recycled host-side staging buffers, keyed by padded shape signature.
+
+    ``np.pad`` allocates a fresh bucket-sized array per input per step; in
+    steady state every step lands in an already-seen bucket, so the padded
+    arrays are recycled instead — zero fresh allocations on the hot path.
+    Buffers are checked out during prep and returned only after the step
+    fully completes (outputs fetched), so on backends where ``device_put``
+    may alias host memory a recycled buffer can never race an in-flight
+    transfer. Thread-safe: prep runs on executor threads.
+    """
+
+    def __init__(self, max_per_key: int):
+        import threading
+
+        self._free: dict[tuple, list[dict[str, np.ndarray]]] = {}
+        self._max = max_per_key
+        self._lock = threading.Lock()
+
+    def acquire(self, key: tuple) -> Optional[dict[str, np.ndarray]]:
+        with self._lock:
+            stack = self._free.get(key)
+            return stack.pop() if stack else None
+
+    def release(self, key: tuple, bufs: dict[str, np.ndarray]) -> None:
+        with self._lock:
+            stack = self._free.setdefault(key, [])
+            if len(stack) < self._max:
+                stack.append(bufs)
+
+
 class ModelRunner:
     def __init__(
         self,
@@ -154,6 +185,7 @@ class ModelRunner:
             )
 
         self.mesh = None
+        self._device = None
         axes: dict[str, str] = {}
         if mesh_spec is not None and mesh_spec.num_devices > 1:
             self.mesh = create_mesh(mesh_spec, devices=devices)
@@ -170,8 +202,27 @@ class ModelRunner:
         else:
             target = (devices[0] if devices else jax.devices()[0])
             params = jax.device_put(params, target)
+            self._device = target
         self.params = params
         self._axes = axes
+        #: donate padded inputs to the jitted call so XLA reuses their HBM
+        #: for outputs (input-output aliasing). Accelerator-only: the CPU
+        #: backend has no donation and would warn per compile.
+        #: ARKFLOW_DONATE=0 is the operator kill switch.
+        self._donate = (
+            self._device is not None
+            and self._device.platform in ("tpu", "gpu")
+            and os.environ.get("ARKFLOW_DONATE", "1") != "0"
+        )
+        #: eager host->device prefetch (see _to_device): accelerator-only —
+        #: on the CPU backend there is no transfer/compute overlap to win,
+        #: only an extra executor hop per step. ARKFLOW_PREFETCH=1/0 forces.
+        prefetch_env = os.environ.get("ARKFLOW_PREFETCH")
+        self._prefetch = (
+            self._device is not None
+            and prefetch_env != "0"
+            and (self._device.platform in ("tpu", "gpu") or prefetch_env == "1")
+        )
 
         if getattr(self.cfg, "use_ring_attention", False) and "sp" not in axes:
             raise ConfigError(
@@ -205,6 +256,15 @@ class ModelRunner:
         self.m_stall_s = reg.counter(
             "arkflow_tpu_infeed_stall_seconds_total",
             "wall seconds the device sat idle between steps (host-bound)", labels)
+        self.m_prep = reg.histogram(
+            "arkflow_tpu_infeed_prep_seconds",
+            "host-side infeed prep (pad/stage/validate) per step", labels)
+        self.m_waste = reg.histogram(
+            "arkflow_padding_waste_frac",
+            "padding fraction of each dispatched bucket (pad rows / bucket rows; "
+            "token padding frac for packed runners)", labels,
+            buckets=[0.0, 0.125, 0.25, 0.5, 0.75, 0.9, 1.0],
+        )
         self._seen_shapes: set[tuple] = set()
         self._in_warmup = False
         #: device queue depth. 2 = double buffering (prep/dispatch n+1
@@ -220,9 +280,20 @@ class ModelRunner:
             raise ConfigError(f"max_in_flight must be >= 1, got {max_in_flight}")
         self.max_in_flight = max_in_flight
         self._inflight_sem: Optional[asyncio.Semaphore] = None
+        #: bounds DEVICE-RESIDENT prefetched input batches (held across the
+        #: whole step): one more than the in-flight depth, so exactly one
+        #: batch sits staged ahead of the compute queue — otherwise every
+        #: stream worker could park a padded batch in HBM
+        self._prefetch_sem: Optional[asyncio.Semaphore] = None
         self._inflight = 0
         self._busy_start = 0.0
         self._last_idle_start: Optional[float] = None
+        #: per-bucket recycled host staging buffers (unpacked path only —
+        #: packed layouts have data-dependent shapes). One set per possible
+        #: concurrent step plus one in prep. ARKFLOW_STAGING=0 disables.
+        self._staging: Optional[_StagingPool] = None
+        if not packed and os.environ.get("ARKFLOW_STAGING", "1") != "0":
+            self._staging = _StagingPool(max_per_key=self.max_in_flight + 1)
 
     @staticmethod
     def _resolve_auto_flags(cfg, devices, mesh_spec, packed: bool = False):
@@ -322,7 +393,10 @@ class ModelRunner:
         def run(params, inputs):
             return apply_fn(params, cfg, **inputs, **extra_kwargs)
 
-        self._jitted = jax.jit(run)
+        # donate the padded inputs (argnum 1, never the params): XLA's
+        # input-output aliasing reuses their device buffers for outputs,
+        # trimming steady-state HBM churn on accelerator backends
+        self._jitted = jax.jit(run, donate_argnums=(1,)) if self._donate else jax.jit(run)
 
     def _disable_flash(self) -> None:
         """Auto-fallback: serve with XLA attention from now on (one
@@ -385,17 +459,27 @@ class ModelRunner:
         true_tokens = int((np.asarray(inputs["segment_ids"]) > 0).sum())
         if not self._in_warmup:  # warmup shapes are not traffic
             self.m_pad.inc(pb - p)
-            self.m_fill.observe(true_tokens / (pb * sb) if pb * sb else 0.0)
+            fill = true_tokens / (pb * sb) if pb * sb else 0.0
+            self.m_fill.observe(fill)
+            self.m_waste.observe(1.0 - fill)
             self.m_exec_rows.inc(pb)
         return out, e
 
     def _pad_inputs(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
-        """Pad every input to its bucket; returns (padded, true_batch)."""
+        """Pad every input to its bucket; returns (padded, true_batch).
+
+        Allocation-free in steady state: the padded arrays come from the
+        per-bucket staging pool and are filled in place (rows, then zeroed
+        padding regions); ``np.pad``'s fresh bucket-sized allocations only
+        happen the first few times a bucket is seen. The buffers go back to
+        the pool via ``_release_staging`` after the step completes.
+        """
         if self.packed:
             return self._pad_inputs_packed(inputs)
         n = next(iter(inputs.values())).shape[0]
         bb = self.buckets.batch_bucket(n)
-        out = {}
+        arrs: dict[str, np.ndarray] = {}
+        shapes: dict[str, tuple] = {}
         for name, (dtype, trailing) in self.spec.items():
             arr = inputs.get(name)
             if arr is None:
@@ -403,14 +487,54 @@ class ModelRunner:
             arr = np.asarray(arr, dtype=dtype)
             if "seq" in trailing:
                 sb = self.buckets.seq_bucket(arr.shape[1])
-                arr = pad_seq_dim(arr, sb, axis=1)
-            arr = pad_batch_dim(arr, bb)
-            out[name] = arr
+                if arr.shape[1] > sb:  # over-long rows truncate to the top bucket
+                    arr = pad_seq_dim(arr, sb, axis=1)
+                shapes[name] = (bb, sb, *arr.shape[2:])
+            else:
+                shapes[name] = (bb, *arr.shape[1:])
+            if arr.shape[0] > bb:
+                raise ValueError(f"batch {arr.shape[0]} exceeds bucket {bb}")
+            arrs[name] = arr
+        out = self._acquire_staging(shapes)
+        for name, arr in arrs.items():
+            buf = out[name]
+            if arr.ndim >= 2 and arr.shape[1] < buf.shape[1]:
+                buf[:n, : arr.shape[1]] = arr
+                buf[:n, arr.shape[1]:] = 0
+            else:
+                buf[:n] = arr
+            buf[n:] = 0
         if not self._in_warmup:  # warmup shapes are not traffic
             self.m_pad.inc(bb - n)
             self.m_fill.observe(n / bb)
+            self.m_waste.observe((bb - n) / bb if bb else 0.0)
             self.m_exec_rows.inc(bb)
         return out, n
+
+    # -- staging buffer recycling ------------------------------------------
+
+    @staticmethod
+    def _staging_key(shapes: dict[str, tuple]) -> tuple:
+        return tuple(sorted(shapes.items()))
+
+    def _acquire_staging(self, shapes: dict[str, tuple]) -> dict[str, np.ndarray]:
+        if self._staging is not None:
+            bufs = self._staging.acquire(self._staging_key(shapes))
+            if bufs is not None:
+                return bufs
+        return {name: np.empty(shape, dtype=self.spec[name][0])
+                for name, shape in shapes.items()}
+
+    def _release_staging(self, padded: dict[str, Any]) -> None:
+        """Return a step's staging buffers once nothing can still read them
+        (the step's outputs were fetched). No-op for packed layouts and for
+        dicts whose values were swapped for device arrays upstream."""
+        if self._staging is None or self.packed or not padded:
+            return
+        if not all(isinstance(v, np.ndarray) for v in padded.values()):
+            return
+        self._staging.release(
+            self._staging_key({k: v.shape for k, v in padded.items()}), padded)
 
     def _shape_key(self, padded: dict[str, np.ndarray]) -> tuple:
         return tuple((k, v.shape) for k, v in sorted(padded.items()))
@@ -442,7 +566,12 @@ class ModelRunner:
             self._seen_shapes.add(key)
             self.m_compiles.inc()
         t0 = time.perf_counter()
-        out = jax.device_get(self._dispatch(padded))
+        try:
+            out = jax.device_get(self._dispatch(padded))
+        finally:
+            # outputs fetched => the device consumed the inputs; the staging
+            # buffers are safe to recycle for the next step
+            self._release_staging(padded)
         if not self._in_warmup:  # warmup compiles are not traffic latency
             self.m_infer.observe(time.perf_counter() - t0)
             self.m_rows.inc(n)
@@ -450,6 +579,16 @@ class ModelRunner:
 
     def _prep(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
         """Host-side stage: pad to buckets + validate masks (CPU only)."""
+        import time
+
+        t0 = time.perf_counter()
+        try:
+            return self._prep_inner(inputs)
+        finally:
+            if not self._in_warmup:
+                self.m_prep.observe(time.perf_counter() - t0)
+
+    def _prep_inner(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
         padded, n = self._pad_inputs(inputs)
         if getattr(self.cfg, "use_flash_attention", False) and "attention_mask" in padded:
             # sub-floor buckets compile the XLA path (models gate on the
@@ -483,6 +622,16 @@ class ModelRunner:
             with self.mesh:
                 return self._jitted(self.params, padded)
         return self._jitted(self.params, padded)
+
+    def _to_device(self, padded: dict[str, Any]) -> dict[str, Any]:
+        """Eager host->device transfer of a prepped batch (single-device
+        serving): runs on an executor thread BEFORE the in-flight semaphore,
+        so batch n+1's infeed overlaps batch n's compute instead of paying
+        the transfer inside its own device window. Waits for the copies so
+        the subsequent dispatch never blocks on them."""
+        dev = jax.device_put(padded, self._device)
+        jax.block_until_ready(dev)
+        return dev
 
     # -- in-flight accounting (duty cycle / infeed stall) -------------------
 
@@ -534,23 +683,46 @@ class ModelRunner:
         if key not in self._seen_shapes:
             self._seen_shapes.add(key)
             self.m_compiles.inc()
-        if self._inflight_sem is None:
-            self._inflight_sem = asyncio.Semaphore(self.max_in_flight)
-        async with self._inflight_sem:
-            t0 = time.perf_counter()
-            self._track_dispatch(t0)
-            try:
-                # dispatch always runs in the executor: warm shapes cost one
-                # sub-ms thread hop, cold shapes (or a jit swapped mid-flight
-                # by _disable_flash) compile for seconds-to-minutes on remote
-                # backends — never on the event loop, where a compile would
-                # stall every stream plus the health/metrics endpoints
-                out = await loop.run_in_executor(None, self._dispatch, padded)
-                out = await loop.run_in_executor(None, jax.device_get, out)
-            finally:
-                t1 = time.perf_counter()
-                self._track_complete(t1)
-            self.m_infer.observe(t1 - t0)
+        staged = padded  # host staging buffers, recycled once the step ends
+
+        async def step(padded):
+            if self._inflight_sem is None:
+                self._inflight_sem = asyncio.Semaphore(self.max_in_flight)
+            async with self._inflight_sem:
+                t0 = time.perf_counter()
+                self._track_dispatch(t0)
+                try:
+                    # dispatch always runs in the executor: warm shapes cost one
+                    # sub-ms thread hop, cold shapes (or a jit swapped mid-flight
+                    # by _disable_flash) compile for seconds-to-minutes on remote
+                    # backends — never on the event loop, where a compile would
+                    # stall every stream plus the health/metrics endpoints
+                    out = await loop.run_in_executor(None, self._dispatch, padded)
+                    out = await loop.run_in_executor(None, jax.device_get, out)
+                finally:
+                    t1 = time.perf_counter()
+                    self._track_complete(t1)
+                self.m_infer.observe(t1 - t0)
+                return out
+
+        try:
+            if self._prefetch and self.mesh is None:
+                # eager infeed: batch n+1's host->device copies run here,
+                # outside the in-flight semaphore, overlapping batch n's
+                # compute. The prefetch semaphore (in_flight + 1 permits,
+                # held through the step) caps how many padded batches can
+                # sit in device memory ahead of the compute queue.
+                if self._prefetch_sem is None:
+                    self._prefetch_sem = asyncio.Semaphore(self.max_in_flight + 1)
+                async with self._prefetch_sem:
+                    padded = await loop.run_in_executor(None, self._to_device, padded)
+                    out = await step(padded)
+            else:
+                out = await step(padded)
+        finally:
+            # after device_get nothing can still read the host buffers —
+            # even a CPU backend that aliased them zero-copy is done
+            self._release_staging(staged)
         self.m_rows.inc(n)
         return {k: np.asarray(v)[:n] for k, v in out.items()}
 

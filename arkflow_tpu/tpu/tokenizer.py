@@ -53,6 +53,25 @@ class HashTokenizer:
         nat = native.hash_tokenize_batch(raw, max_len, self.vocab_size)
         if nat is not None:
             return nat
+        return self._encode_rows(raw, max_len)
+
+    def encode_batch_view(self, values: np.ndarray, offsets: np.ndarray,
+                          max_len: int) -> tuple[np.ndarray, np.ndarray]:
+        """Tokenize straight off an Arrow payload view (``MessageBatch.
+        payload_view``): the native kernel reads the values buffer in place —
+        zero per-row Python objects on the fast path. The pure-Python
+        fallback slices rows out of the buffer lazily."""
+        nat = native.hash_tokenize_view(values, offsets, max_len, self.vocab_size)
+        if nat is not None:
+            return nat
+        n = len(offsets) - 1
+        base = int(offsets[0]) if n else 0
+        buf = values[base : int(offsets[n]) if n else 0].tobytes()
+        return self._encode_rows(
+            [buf[offsets[i] - base : offsets[i + 1] - base] for i in range(n)],
+            max_len)
+
+    def _encode_rows(self, raw: Sequence[bytes], max_len: int) -> tuple[np.ndarray, np.ndarray]:
         n = len(raw)
         ids = np.zeros((n, max_len), np.int32)
         mask = np.zeros((n, max_len), np.int32)
@@ -83,6 +102,25 @@ class HFTokenizer:
             return_tensors="np", return_attention_mask=True,
         )
         return enc["input_ids"].astype(np.int32), enc["attention_mask"].astype(np.int32)
+
+    def encode_batch_view(self, values: np.ndarray, offsets: np.ndarray,
+                          max_len: int) -> tuple[np.ndarray, np.ndarray]:
+        """HF tokenizers want ``str`` rows; decode them off the buffer view
+        (one big decode + string slicing beats per-row bytes round trips).
+        Only the window the rows reference is materialized (sliced batches
+        share a larger parent buffer)."""
+        n = len(offsets) - 1
+        base = int(offsets[0]) if n else 0
+        buf = values[base : int(offsets[n]) if n else 0].tobytes()
+        text = buf.decode("utf-8", "replace")
+        # byte offsets only index the decoded str when every byte decoded to
+        # one char (pure ASCII); otherwise decode per row
+        if len(text) == len(buf):
+            rows = [text[offsets[i] - base : offsets[i + 1] - base] for i in range(n)]
+        else:
+            rows = [buf[offsets[i] - base : offsets[i + 1] - base].decode("utf-8", "replace")
+                    for i in range(n)]
+        return self.encode_batch(rows, max_len)
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)

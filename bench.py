@@ -101,7 +101,13 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
             "interval": 0,
             "batch_size": batch,
         },
-        "buffer": {"type": "memory", "capacity": batch, "timeout": "5ms"},
+        # BENCH_COALESCE=1: bucket-exact coalescing in the buffer — merged
+        # emissions land exactly on the compiled batch bucket, so the device
+        # never runs padding rows (watch padding_waste_frac in the detail)
+        "buffer": ({"type": "memory", "capacity": batch, "timeout": "5ms",
+                    "coalesce": {"batch_buckets": [batch], "deadline": "5ms"}}
+                   if os.environ.get("BENCH_COALESCE", "0") == "1"
+                   else {"type": "memory", "capacity": batch, "timeout": "5ms"}),
         "pipeline": {
             # workers must cover the device queue depth or the semaphore
             # can't fill: each in-flight step is held by one processor call
@@ -337,6 +343,7 @@ def main() -> None:
             pass
         seconds = float(os.environ.get("BENCH_SECONDS", "15"))
         batch = int(os.environ.get("BENCH_BATCH", "1024"))
+        infeed0 = _infeed_host_metrics()
         res = asyncio.run(run_bench(seconds, batch, 0, True, mode="sql"))
         _emit(
             {
@@ -345,7 +352,9 @@ def main() -> None:
                 "unit": "rows/s",
                 "vs_baseline": 0.0,
                 "detail": {"rows": res["rows"], "elapsed_s": round(res["elapsed_s"], 2),
-                           "batch": batch, "backend": _backend()},
+                           "batch": batch, "backend": _backend(),
+                           # no device infeed in the SQL anchor: both report 0
+                           **_infeed_detail(infeed0, _infeed_host_metrics())},
             }
         )
         return
@@ -389,9 +398,12 @@ def main() -> None:
     # duty cycle is this phase's DELTA (the latency phase idles on purpose)
     busy0, stall0 = _busy_stall_from_registry()
     exec0, exrows0 = _exec_and_example_rows()
+    infeed0 = _infeed_host_metrics()
     res = asyncio.run(run_bench(seconds, batch, seq, tiny))
     busy1, stall1 = _busy_stall_from_registry()
     exec1, exrows1 = _exec_and_example_rows()
+    infeed1 = _infeed_host_metrics()
+    infeed_detail = _infeed_detail(infeed0, infeed1)
     # examples/s -> device-rows/s via the phase's exec/example ratio (both
     # deltas span the same phase, so the ratio is window-independent)
     exec_ratio = (exec1 - exec0) / (exrows1 - exrows0) if exrows1 > exrows0 else 1.0
@@ -402,8 +414,8 @@ def main() -> None:
         # compiles can outlive an external kill, and the last printed JSON
         # line must survive as the headline either way (it is re-printed,
         # with latency detail, after a successful latency phase)
-        _print_headline(res, tiny, batch, seq, busy1 - busy0, stall1 - stall0, {},
-                        exec_rate)
+        _print_headline(res, tiny, batch, seq, busy1 - busy0, stall1 - stall0,
+                        dict(infeed_detail), exec_rate)
         lat_seconds = float(os.environ.get("BENCH_LAT_SECONDS", "10"))
         lat = asyncio.run(run_bench(lat_seconds, 8, seq, tiny, mode="latency"))
 
@@ -456,7 +468,7 @@ def main() -> None:
         except OSError:
             pass
     _print_headline(res, tiny, batch, seq, busy1 - busy0, stall1 - stall0,
-                    lat_detail, exec_rate)
+                    {**infeed_detail, **lat_detail}, exec_rate)
 
     # Opportunistic packed phase (chip runs only): the padded headline above
     # is banked (printed + BENCH_RESULT.json); if token packing does better
@@ -472,9 +484,11 @@ def main() -> None:
             os.environ["BENCH_PACKING"] = "1"
             busy2, stall2 = _busy_stall_from_registry()
             exec2, exrows2 = _exec_and_example_rows()
+            infeed2 = _infeed_host_metrics()
             res_p = asyncio.run(run_bench(seconds, batch, seq, tiny))
             busy3, stall3 = _busy_stall_from_registry()
             exec3, exrows3 = _exec_and_example_rows()
+            infeed_p = _infeed_detail(infeed2, _infeed_host_metrics())
             ratio_p = ((exec3 - exec2) / (exrows3 - exrows2)
                        if exrows3 > exrows2 else 1.0)
             print(f"bench: packed phase: {res_p['rows_per_sec']:.0f} rows/s "
@@ -485,7 +499,8 @@ def main() -> None:
                 # artifact self-describes instead of implying otherwise
                 _print_headline(res_p, tiny, batch, seq, busy3 - busy2,
                                 stall3 - stall2,
-                                dict(lat_detail, latency_phase="unpacked"),
+                                dict(lat_detail, latency_phase="unpacked",
+                                     **infeed_p),
                                 res_p["rows_per_sec"] * ratio_p)
         except Exception as e:  # never lose the banked padded headline
             print(f"bench: packed phase failed ({e}); padded headline stands",
@@ -676,6 +691,42 @@ def _flops_detail(rows_per_sec: float, exec_rate: float, seq: int,
         # padded-row ceiling; packed examples/s can legitimately exceed it
         out["roofline_rows_per_sec"] = round(peak * 1e12 / fpr, 1)
     return out
+
+
+def _infeed_host_metrics() -> tuple[float, float, float, float]:
+    """(prep_s_sum, prep_steps, extract_s_sum, waste_sum) totals across all
+    runners/processors this process ran. prep covers the runner's pad/stage
+    stage, extract the processor's Arrow->tensor + tokenize stage; waste_sum
+    is the per-step padding fraction summed over prep_steps dispatches."""
+    from arkflow_tpu.obs import global_registry
+
+    prep_s = prep_n = extract_s = waste = 0.0
+    for m in global_registry().collect():
+        name = getattr(m, "name", "")
+        if name == "arkflow_tpu_infeed_prep_seconds":
+            prep_s += m.sum
+            prep_n += m.count
+        elif name == "arkflow_tpu_extract_seconds":
+            extract_s += m.sum
+        elif name == "arkflow_padding_waste_frac":
+            waste += m.sum
+    return prep_s, prep_n, extract_s, waste
+
+
+def _infeed_detail(before: tuple, after: tuple) -> dict:
+    """Phase-delta infeed numbers for the JSON detail: mean host prep ms per
+    dispatched step (pad/stage + extract/tokenize) and mean padding fraction
+    of the dispatched buckets."""
+    d_prep_s = after[0] - before[0]
+    d_steps = after[1] - before[1]
+    d_extract_s = after[2] - before[2]
+    d_waste = after[3] - before[3]
+    if d_steps <= 0:
+        return {"infeed_prep_ms": 0.0, "padding_waste_frac": 0.0}
+    return {
+        "infeed_prep_ms": round((d_prep_s + d_extract_s) / d_steps * 1000.0, 3),
+        "padding_waste_frac": round(d_waste / d_steps, 4),
+    }
 
 
 def _busy_stall_from_registry() -> tuple[float, float]:

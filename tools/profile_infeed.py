@@ -1,0 +1,103 @@
+"""cProfile the host-side infeed path: where does prep time actually go?
+
+Runs a tiny BERT classifier on CPU and pushes payload batches through the
+real ``tpu_inference`` processor (tokenize -> extract -> pad/stage ->
+dispatch), then prints:
+
+  1. ONE summary JSON line: per-step breakdown in ms (tokenize+extract,
+     pad/stage prep, device step) read from the runner/processor histograms,
+     plus a ``rowwise_hotpath`` flag — True would mean per-row Python
+     (``as_py`` / per-row ``np.pad``) crept back into the vectorized paths.
+  2. A cumulative-time profile table (stderr) filtered to arkflow frames,
+     so a regression to per-row Python is visible as a hot loop immediately.
+
+    python tools/profile_infeed.py                   # 256 rows x 20 steps
+    PROF_ROWS=64 PROF_STEPS=5 python tools/profile_infeed.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import cProfile
+import io
+import json
+import os
+import pstats
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+#: frames that must never appear in the infeed profile: per-row Arrow scalar
+#: boxing inside the extraction/tokenization hot path
+_ROWWISE_MARKERS = ("as_py",)
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    rows = int(os.environ.get("PROF_ROWS", "256"))
+    steps = int(os.environ.get("PROF_STEPS", "20"))
+    seq = int(os.environ.get("PROF_SEQ", "32"))
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
+
+    ensure_plugins_loaded()
+    proc = build_component(
+        "processor",
+        {"type": "tpu_inference", "model": "bert_classifier",
+         "model_config": {"vocab_size": 512, "hidden": 32, "layers": 2,
+                          "heads": 4, "ffn": 64, "max_positions": 64,
+                          "num_labels": 2},
+         "max_seq": seq, "batch_buckets": [rows], "seq_buckets": [seq],
+         "outputs": ["label"], "warmup": True},
+        Resource(),
+    )
+    payloads = [f"sensor event {i} nominal reading no anomaly".encode()
+                for i in range(rows)]
+    batch = MessageBatch.new_binary(payloads)
+
+    async def drive() -> None:
+        await proc.process(batch)  # first call: connect + warmup compiles
+
+        async def run() -> None:
+            for _ in range(steps):
+                await proc.process(batch)
+
+        prof = cProfile.Profile()
+        prof.enable()
+        await run()
+        prof.disable()
+
+        stats = pstats.Stats(prof, stream=io.StringIO())
+        rowwise = [
+            f"{fn[0]}:{fn[1]}:{fn[2]}" for fn in stats.stats
+            if any(m in fn[2] for m in _ROWWISE_MARKERS)
+            and "arkflow_tpu" in fn[0]
+        ]
+        runner = proc.runner
+        n_prep = max(1, runner.m_prep.count)
+        n_extract = max(1, proc.m_extract.count)
+        print(json.dumps({
+            "metric": "infeed_prep_breakdown",
+            "rows": rows, "steps": steps, "seq": seq,
+            "extract_tokenize_ms_per_step": round(
+                proc.m_extract.sum / n_extract * 1000.0, 3),
+            "pad_stage_ms_per_step": round(runner.m_prep.sum / n_prep * 1000.0, 3),
+            "device_step_ms": round(
+                runner.m_infer.sum / max(1, runner.m_infer.count) * 1000.0, 3),
+            "padding_waste_frac": round(
+                runner.m_waste.sum / max(1, runner.m_waste.count), 4),
+            "rowwise_hotpath": bool(rowwise),
+            "rowwise_frames": rowwise,
+        }), flush=True)
+
+        out = io.StringIO()
+        ps = pstats.Stats(prof, stream=out).sort_stats("cumulative")
+        ps.print_stats("arkflow_tpu", 25)
+        print(out.getvalue(), file=sys.stderr)
+
+    asyncio.run(drive())
+
+
+if __name__ == "__main__":
+    main()
