@@ -100,6 +100,11 @@ def quantize_param_specs(specs):
                     out["b"] = node["b"]
                 return out
             return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, P):
+            # PartitionSpec subclasses tuple: the container branch below
+            # would rebuild it as P(<generator>,) — a malformed spec that
+            # only detonates at NamedSharding validation under a mesh
+            return node
         if isinstance(node, (list, tuple)):
             return type(node)(walk(v) for v in node)
         return node

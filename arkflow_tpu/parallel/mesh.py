@@ -73,3 +73,24 @@ def shard_params(params, specs, mesh: Mesh):
 
 def named(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
+
+
+def dp_size(mesh: Mesh) -> int:
+    """Size of the data-parallel axis (1 when the mesh has no ``dp``)."""
+    return int(dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for model INPUTS/OUTPUTS under serving: leading (batch) dim
+    split over ``dp``, everything else replicated. On a mesh without a dp
+    axis (or dp=1) this degenerates to full replication, which is exactly
+    what tensor-parallel-only serving wants for its activations' batch dim."""
+    return NamedSharding(mesh, P("dp") if dp_size(mesh) > 1 else P())
+
+
+def param_shardings(params):
+    """The sharding each param leaf ALREADY has (post ``shard_params``), as a
+    pytree usable for ``jax.jit``'s ``in_shardings`` — pinning params to
+    their placement keeps a host-numpy input from dragging them through a
+    fresh layout decision on every executable."""
+    return jax.tree_util.tree_map(lambda x: x.sharding, params)

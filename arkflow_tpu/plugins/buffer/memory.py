@@ -24,6 +24,11 @@ Config:
     coalesce:
       batch_buckets: [8, 16, 32, 64]   # the runner's compiled batch buckets
       deadline: 5ms                    # max wait for a full bucket (default: timeout)
+      dp: 4                            # dp-sharded serving: scale every bucket
+                                       # by dp, matching the runner's dp-scaled
+                                       # grid (global bucket = per-chip bucket
+                                       # x dp), so emissions stay bucket-exact
+                                       # on the sharded executable too
 """
 
 from __future__ import annotations
@@ -178,10 +183,18 @@ def _build(config: dict, resource: Resource) -> MemoryBuffer:
     buckets = coalesce.get("batch_buckets")
     if coalesce and not buckets:
         raise ConfigError("buffer.coalesce requires 'batch_buckets'")
+    if buckets:
+        # dp-sharded serving: the runner scales its compiled grid by dp
+        # (tpu/bucketing.py BucketPolicy.dp_scaled), so the coalescer must
+        # target the same dp-scaled global buckets to stay bucket-exact
+        dp = int(coalesce.get("dp", 1))
+        if dp < 1:
+            raise ConfigError(f"buffer.coalesce dp must be >= 1, got {dp}")
+        buckets = [int(b) * dp for b in buckets]
     deadline = coalesce.get("deadline")
     return MemoryBuffer(
         capacity=int(capacity),
         timeout_s=parse_duration(timeout) if timeout is not None else None,
-        coalesce_buckets=[int(b) for b in buckets] if buckets else None,
+        coalesce_buckets=buckets or None,
         coalesce_deadline_s=parse_duration(deadline) if deadline is not None else None,
     )

@@ -45,4 +45,48 @@ def encode_batch(batch: MessageBatch, codec: Optional[Codec]) -> list[bytes]:
     if batch.has_column(DEFAULT_BINARY_VALUE_FIELD):
         return batch.to_binary()
     # no codec + no raw column: emit one JSON doc per row (pragmatic default)
+    rows = _encode_rows_json(batch)
+    if rows is not None:
+        return rows
     return [json.dumps(row, default=str).encode() for row in batch.record_batch.to_pylist()]
+
+
+def _encode_rows_json(batch: MessageBatch) -> Optional[list[bytes]]:
+    """Vectorized default row-JSON: each column encodes to its JSON text via
+    the SQL tier's ``encode_json`` (int/bool columns are a single ``pc.cast``;
+    other types take its row-wise pass), then one Arrow join kernel stitches
+    the ``{"col": value, ...}`` objects — instead of materializing every row
+    as a Python dict for ``json.dumps``. Returns None when a column resists
+    (exotic nesting), sending the caller to the reference row-wise path."""
+    import pyarrow.compute as pc
+
+    import pyarrow as pa
+
+    rb = batch.record_batch
+    if rb.num_columns == 0 or rb.num_rows == 0:
+        return None
+    # binary columns keep the reference path: json.dumps' default=str renders
+    # bytes as "b'..'" while encode_json decodes them to utf-8 — vectorizing
+    # those would silently change the wire format
+    def has_binary(t: pa.DataType) -> bool:
+        if (pa.types.is_binary(t) or pa.types.is_large_binary(t)
+                or pa.types.is_fixed_size_binary(t)):
+            return True
+        return any(has_binary(t.field(i).type) for i in range(t.num_fields))
+
+    if any(has_binary(f.type) for f in rb.schema):
+        return None
+    try:
+        from arkflow_tpu.sql.functions import encode_json_array
+
+        parts: list = []
+        for i, name in enumerate(rb.schema.names):
+            # key prefixes mirror json.dumps' default separators (", ", ": ")
+            parts.append(("{" if i == 0 else ", ") + json.dumps(name) + ": ")
+            parts.append(encode_json_array(rb.column(i)))
+        parts.append("}")
+        joined = pc.binary_join_element_wise(
+            *parts, "", null_handling="replace", null_replacement="null")
+        return [s.encode() for s in joined.to_pylist()]
+    except Exception:
+        return None

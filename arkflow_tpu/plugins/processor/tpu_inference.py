@@ -25,7 +25,15 @@ Config:
     outputs: [label, score]        # default: all rank-1 outputs
     batch_buckets: [8, 32, 128]    # default pow2 grid
     seq_buckets: [32, 64, 128]
-    mesh: {dp: 1, tp: 4}           # optional multi-chip serving
+    mesh: {dp: 1, tp: 4}           # optional multi-chip serving (GSPMD: one
+                                   # sharded program; dp splits the batch dim
+                                   # and scales every batch bucket by dp)
+    device_pool: 4                 # ALTERNATIVE multi-chip serving: 4
+                                   # independent single-device runners with
+                                   # replicated params behind a least-loaded
+                                   # dispatcher — no collectives, best for
+                                   # small-bucket / latency-bound traffic
+                                   # (mutually exclusive with mesh)
     checkpoint: /path/to/orbax     # optional
     warmup: false                  # precompile bucket grid at connect
     serving_dtype: bfloat16        # float32 | bfloat16 | float16 | int8
@@ -213,11 +221,14 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         mesh_spec = MeshSpec(dp=int(mesh_cfg.get("dp", 1)), tp=int(mesh_cfg.get("tp", 1)),
                              sp=int(mesh_cfg.get("sp", 1)))
     packing = bool(config.get("packing", False))
-    runner = ModelRunner(
-        model,
-        config.get("model_config"),
+    pool_size = int(config.get("device_pool", 0) or 0)
+    if pool_size and mesh_cfg:
+        raise ConfigError(
+            "tpu_inference: 'device_pool' and 'mesh' are mutually exclusive "
+            "(a pool member is a single-device runner; pick sharded dispatch "
+            "OR replicated serving)")
+    common = dict(
         buckets=buckets,
-        mesh_spec=mesh_spec,
         checkpoint=config.get("checkpoint"),
         seed=int(config.get("seed", 0)),
         serving_dtype=config.get("serving_dtype"),
@@ -225,6 +236,14 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
                        if config.get("max_in_flight") is not None else None),
         packed=packing,
     )
+    if pool_size > 1:
+        from arkflow_tpu.tpu.pool import ModelRunnerPool
+
+        runner = ModelRunnerPool(
+            model, config.get("model_config"), pool_size=pool_size, **common)
+    else:  # device_pool: 1 is just single-device serving
+        runner = ModelRunner(
+            model, config.get("model_config"), mesh_spec=mesh_spec, **common)
     vocab = getattr(runner.cfg, "vocab_size", 30522)
     tokenizer = build_tokenizer(config.get("tokenizer"), vocab_size=vocab)
     return TpuInferenceProcessor(

@@ -214,6 +214,14 @@ def _merge(args, n):
     return pa.array(out, type=pa.string())
 
 
+def encode_json_array(arr: pa.Array) -> pa.Array:
+    """Public vectorized entry for ``encode_json`` over one Arrow array:
+    JSON text per row, NULL stays NULL. Shared with the codec layer's
+    default row-JSON encoding (plugins/codec/helper.py) so both tiers ride
+    the same cast-vectorized int/bool fast path."""
+    return _encode_json([arr], len(arr))
+
+
 def _encode_json(args, n):
     """encode_json(x) -> JSON text per row: lists/structs/scalars serialize,
     NULL stays NULL (VRL's encode_json). Integer/boolean columns vectorize

@@ -71,6 +71,21 @@ class BucketPolicy:
     def max_batch(self) -> int:
         return self.batch_buckets[-1]
 
+    def dp_scaled(self, dp: int) -> "BucketPolicy":
+        """The policy for dp-sharded dispatch: every batch bucket times
+        ``dp``, so each global bucket splits into per-chip shards that land
+        EXACTLY on this policy's original grid (a [8,16,32] policy at dp=4
+        compiles global buckets [32,64,128] = per-chip [8,16,32]). Scaling by
+        multiplication — rather than rounding up to a multiple — keeps
+        per-chip shapes bucket-exact and makes divisibility by dp structural
+        rather than checked per dispatch."""
+        if dp < 1:
+            raise ConfigError(f"dp must be >= 1, got {dp}")
+        if dp == 1:
+            return self
+        return BucketPolicy(tuple(b * dp for b in self.batch_buckets),
+                            self.seq_buckets)
+
 
 class MicroBatchCoalescer:
     """Merges sub-bucket micro-batches into bucket-exact emissions.

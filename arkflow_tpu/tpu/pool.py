@@ -1,0 +1,209 @@
+"""Replicated device pool: N independent single-device runners, one dispatcher.
+
+The dp-mesh path (``ModelRunner`` + ``mesh: {dp: N}``) scales throughput by
+splitting every batch over the chips with GSPMD — ideal for large buckets,
+but every step pays collective/partitioning overhead and the whole pool runs
+in lockstep. Small-bucket / latency-bound traffic scales better the dumb way:
+``device_pool: N`` builds N fully independent single-device ``ModelRunner``s
+with REPLICATED params (one host init/restore, N one-hop transfers) behind a
+least-loaded round-robin dispatcher. No collectives, no GSPMD — each member
+keeps flash attention, staging pools, input donation, and eager prefetch
+exactly as in single-device serving, and concurrent stream workers fan out
+across chips instead of queueing on one.
+
+Failover preserves at-least-once delivery: a member that throws mid-step is
+skipped for that batch and the batch retries on the remaining members; only
+when EVERY member fails does the error propagate (and the stream nacks, so
+the source redelivers). Deterministic config errors (bad input spec) are NOT
+retried — they would fail identically on every chip.
+
+Per-chip observability: each member's runner metrics carry a ``device`` label
+(``arkflow_tpu_device_busy_seconds_total{device="3"}`` ...), and the pool adds
+dispatch/failover counters so imbalance or a limping chip shows up directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import numpy as np
+
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.obs import global_registry
+from arkflow_tpu.tpu.bucketing import BucketPolicy
+from arkflow_tpu.tpu.runner import ModelRunner, convert_for_serving, init_host_params
+
+logger = logging.getLogger("arkflow.tpu")
+
+
+class ModelRunnerPool:
+    """Drop-in for ``ModelRunner`` over N replicated single-device members.
+
+    Exposes the same surface the ``tpu_inference`` processor uses (``spec``,
+    ``buckets``, ``cfg``, ``family``, ``infer``/``infer_sync``/``warmup``),
+    so processors don't branch on pool-vs-single beyond construction.
+    """
+
+    def __init__(
+        self,
+        model: str,
+        model_config: Optional[dict] = None,
+        *,
+        pool_size: int,
+        buckets: Optional[BucketPolicy] = None,
+        checkpoint: Optional[str] = None,
+        seed: int = 0,
+        devices=None,
+        serving_dtype: Optional[str] = None,
+        max_in_flight: Optional[int] = None,
+        packed: bool = False,
+    ):
+        import jax
+
+        if pool_size < 1:
+            raise ConfigError(f"device_pool must be >= 1, got {pool_size}")
+        devices = list(devices) if devices is not None else jax.devices()
+        if pool_size > len(devices):
+            raise ConfigError(
+                f"device_pool: {pool_size} runners requested, "
+                f"{len(devices)} devices visible")
+        # one host-side init + checkpoint restore + dtype convert (bf16 cast /
+        # int8 quantization); every member transfers the SAME finished tree to
+        # its own chip — replication by construction, and the expensive
+        # full-tree walks happen once instead of N times
+        from arkflow_tpu.models import get_model
+
+        family = get_model(model)
+        cfg = family.make_config(**(model_config or {}))
+        host_params = convert_for_serving(
+            init_host_params(family, cfg, seed, checkpoint),
+            serving_dtype, family.name)
+        self.members: list[ModelRunner] = [
+            ModelRunner(
+                model,
+                model_config,
+                buckets=buckets,
+                seed=seed,
+                devices=[devices[i]],
+                serving_dtype=serving_dtype,
+                max_in_flight=max_in_flight,
+                packed=packed,
+                host_params=host_params,
+                device_label=str(i),
+            )
+            for i in range(pool_size)
+        ]
+        self.pool_size = pool_size
+        #: outstanding infer calls per member (the least-loaded signal)
+        self._loads = [0] * pool_size
+        self._rr = 0  # round-robin cursor for ties
+
+        reg = global_registry()
+        self.m_dispatch = [
+            reg.counter(
+                "arkflow_tpu_pool_dispatch_total",
+                "batches dispatched to this pool member",
+                {"model": model, "device": str(i)})
+            for i in range(pool_size)
+        ]
+        self.m_failover = reg.counter(
+            "arkflow_tpu_pool_failover_total",
+            "batches retried on another member after a member error",
+            {"model": model})
+
+    # -- ModelRunner surface (delegated) -----------------------------------
+
+    @property
+    def family(self):
+        return self.members[0].family
+
+    @property
+    def cfg(self):
+        return self.members[0].cfg
+
+    @property
+    def spec(self):
+        return self.members[0].spec
+
+    @property
+    def buckets(self) -> BucketPolicy:
+        return self.members[0].buckets
+
+    @property
+    def packed(self) -> bool:
+        return self.members[0].packed
+
+    @property
+    def max_in_flight(self) -> int:
+        # aggregate device-queue depth across the pool (bench worker sizing)
+        return sum(m.max_in_flight for m in self.members)
+
+    def duty_cycle(self) -> float:
+        cycles = [m.duty_cycle() for m in self.members]
+        return sum(cycles) / len(cycles) if cycles else 0.0
+
+    def warmup(self, seq_lens: Optional[list[int]] = None) -> int:
+        """Precompile every member's bucket grid. Serial on purpose: member 0
+        pays the real compiles, members 1..N-1 replay them from the
+        persistent compile cache (identical shapes, identical HLO)."""
+        return sum(m.warmup(seq_lens) for m in self.members)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _pick(self, exclude: set[int]) -> Optional[int]:
+        """Least-loaded member, round-robin among ties (the cursor advances
+        every pick, so equal-load members take strict turns)."""
+        best: Optional[int] = None
+        n = self.pool_size
+        for off in range(n):
+            i = (self._rr + off) % n
+            if i in exclude:
+                continue
+            if best is None or self._loads[i] < self._loads[best]:
+                best = i
+        if best is not None:
+            self._rr = (self._rr + 1) % n
+        return best
+
+    def infer_sync(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        i = self._pick(set())
+        self._loads[i] += 1
+        self.m_dispatch[i].inc()
+        try:
+            return self.members[i].infer_sync(inputs)
+        finally:
+            self._loads[i] -= 1
+
+    async def infer(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        """Route one batch to the least-loaded member; fail over to the
+        remaining members on a member error (at-least-once: the batch either
+        completes on SOME chip or the error propagates and the stream nacks).
+        """
+        tried: set[int] = set()
+        last_err: Exception = RuntimeError("device pool has no members")
+        while True:
+            i = self._pick(tried)
+            if i is None:  # every member failed this batch
+                raise last_err
+            self._loads[i] += 1
+            self.m_dispatch[i].inc()
+            try:
+                return await self.members[i].infer(inputs)
+            except (asyncio.CancelledError, ConfigError):
+                # cancellation is not a chip fault; ConfigError is
+                # deterministic (bad input/spec) and would fail on every chip
+                raise
+            except Exception as e:
+                last_err = e
+                tried.add(i)
+                if len(tried) >= self.pool_size:
+                    raise
+                self.m_failover.inc()
+                logger.warning(
+                    "device pool: member %d failed a step (%s); retrying on "
+                    "another member (%d/%d tried)",
+                    i, e, len(tried), self.pool_size)
+            finally:
+                self._loads[i] -= 1

@@ -7,7 +7,11 @@ model -> unpad -> batch.
 
 Responsibilities:
 - Resolve a model family + config, init or restore params.
-- Optionally shard params over a ``Mesh`` (tensor parallel serving).
+- Optionally shard params over a ``Mesh`` (tensor parallel serving). With a
+  ``dp`` axis the dispatch is data-parallel for real: inputs/outputs carry
+  explicit ``NamedSharding``s splitting the batch dim over dp, buckets scale
+  by dp so per-chip shards stay bucket-exact, and the single-device wins
+  (eager sharded prefetch, input donation) stay enabled under the mesh.
 - Keep one compiled executable per (batch, seq) bucket warm; ``jax.jit``
   owns the cache, ``warmup()`` precompiles the bucket grid so steady-state
   never hits a compile.
@@ -34,7 +38,14 @@ import numpy as np
 from arkflow_tpu.errors import ConfigError
 from arkflow_tpu.models import get_model
 from arkflow_tpu.obs import global_registry
-from arkflow_tpu.parallel.mesh import MeshSpec, create_mesh, shard_params
+from arkflow_tpu.parallel.mesh import (
+    MeshSpec,
+    batch_sharding,
+    create_mesh,
+    dp_size,
+    param_shardings,
+    shard_params,
+)
 from arkflow_tpu.tpu.bucketing import BucketPolicy, pad_batch_dim, pad_seq_dim
 
 logger = logging.getLogger("arkflow.tpu")
@@ -68,6 +79,62 @@ class _nullcontext:
 
     def __exit__(self, *a):
         return False
+
+
+def convert_for_serving(params, serving_dtype: Optional[str], family_name: str = ""):
+    """Cast/quantize a host param tree for the serving dtype.
+
+    - ``int8``: W8A8 dynamic quantization — dense weights to per-channel int8
+      (doubles the MXU roofline vs bf16), everything else to bf16.
+    - ``bfloat16``/``float16``: full-tree float cast — halves param HBM +
+      host->device transfer and keeps matmuls on the MXU's native dtype;
+      logits/softmax layers still accumulate/cast to f32 inside the model.
+
+    Shared by ``ModelRunner`` and the device pool, which converts ONCE and
+    hands the result to N members (the walk over a large checkpoint is the
+    expensive part, not the per-member device transfer)."""
+    if serving_dtype == "int8":
+        from arkflow_tpu.models.quantize import quantize_for_serving
+
+        params, n_q = quantize_for_serving(params)
+        logger.info("[%s] int8 serving: %d dense layers quantized",
+                    family_name, n_q)
+    elif serving_dtype and serving_dtype != "float32":
+        import jax.numpy as jnp
+
+        target = getattr(jnp, serving_dtype)
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(target)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            params,
+        )
+    return params
+
+
+def init_host_params(family, cfg, seed: int, checkpoint: Optional[str] = None):
+    """Init (and optionally restore) a param tree on host CPU — op-by-op init
+    over a remote-TPU tunnel is pathological, so the tree is built locally
+    and transferred to the execution device(s) in one hop. Shared by
+    ``ModelRunner`` and the device pool (which inits once for N members)."""
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu = None
+    with jax.default_device(cpu) if cpu is not None else _nullcontext():
+        params = family.init(jax.random.PRNGKey(seed), cfg)
+    if checkpoint:
+        from arkflow_tpu.tpu.checkpoint import restore
+
+        try:
+            params = restore(checkpoint, params)
+            logger.info("restored checkpoint from %s", checkpoint)
+        except ConfigError:
+            raise
+        except Exception as e:
+            raise ConfigError(
+                f"failed to restore checkpoint {checkpoint!r}: {e}") from e
+    return params
 
 
 class _StagingPool:
@@ -115,6 +182,8 @@ class ModelRunner:
         serving_dtype: Optional[str] = None,
         max_in_flight: Optional[int] = None,
         packed: bool = False,
+        host_params=None,
+        device_label: Optional[str] = None,
     ):
         from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
 
@@ -152,40 +221,20 @@ class ModelRunner:
                 "(float32/bfloat16/float16/int8)")
         self.serving_dtype = serving_dtype
 
-        # init on host CPU (op-by-op init over a remote-TPU tunnel is pathological),
-        # then transfer to the execution device(s) in one hop
-        try:
-            cpu = jax.devices("cpu")[0]
-        except RuntimeError:
-            cpu = None
-        with jax.default_device(cpu) if cpu is not None else _nullcontext():
-            params = self.family.init(jax.random.PRNGKey(seed), self.cfg)
-        if checkpoint:
-            params = self._restore(checkpoint, params)
-        if self.serving_dtype == "int8":
-            # W8A8 dynamic quantization: dense weights to per-channel int8
-            # (doubles the MXU roofline vs bf16), everything else to bf16
-            from arkflow_tpu.models.quantize import quantize_for_serving
-
-            params, n_q = quantize_for_serving(params)
-            logger.info("[%s] int8 serving: %d dense layers quantized",
-                        self.family.name, n_q)
-        elif self.serving_dtype and self.serving_dtype != "float32":
-            # bf16 serving cast: halves param HBM + host->device transfer and
-            # keeps matmuls on the MXU's native dtype; logits/softmax layers
-            # still accumulate/cast to f32 inside the model
-            import jax.numpy as jnp
-
-            target = getattr(jnp, self.serving_dtype)
-            params = jax.tree_util.tree_map(
-                lambda a: a.astype(target)
-                if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
-                else a,
-                params,
-            )
+        if host_params is not None:
+            # shared host tree (device pool): the pool inits/restores AND
+            # dtype-converts once; every member transfers the SAME finished
+            # weights to its own chip — replication by construction, and no
+            # N-fold init or full-tree cast/quantize walks
+            params = host_params
+        else:
+            params = convert_for_serving(
+                init_host_params(self.family, self.cfg, seed, checkpoint),
+                self.serving_dtype, self.family.name)
 
         self.mesh = None
         self._device = None
+        self._input_sharding = None
         axes: dict[str, str] = {}
         if mesh_spec is not None and mesh_spec.num_devices > 1:
             self.mesh = create_mesh(mesh_spec, devices=devices)
@@ -199,29 +248,37 @@ class ModelRunner:
 
                 pspecs = quantize_param_specs(pspecs)
             params = shard_params(params, pspecs, self.mesh)
+            # dp-sharded dispatch: the batch dim splits over the dp axis, so
+            # every GLOBAL bucket scales by dp — per-chip shards stay exactly
+            # on the configured bucket grid, and divisibility is structural
+            self.buckets = self.buckets.dp_scaled(dp_size(self.mesh))
+            self._input_sharding = batch_sharding(self.mesh)
+            platform = next(iter(self.mesh.devices.flat)).platform
         else:
             target = (devices[0] if devices else jax.devices()[0])
             params = jax.device_put(params, target)
             self._device = target
+            platform = target.platform
         self.params = params
         self._axes = axes
         #: donate padded inputs to the jitted call so XLA reuses their HBM
-        #: for outputs (input-output aliasing). Accelerator-only: the CPU
-        #: backend has no donation and would warn per compile.
+        #: for outputs (input-output aliasing) — under a mesh the sharded
+        #: input buffers donate per-chip the same way. Accelerator-only: the
+        #: CPU backend has no donation and would warn per compile.
         #: ARKFLOW_DONATE=0 is the operator kill switch.
         self._donate = (
-            self._device is not None
-            and self._device.platform in ("tpu", "gpu")
+            platform in ("tpu", "gpu")
             and os.environ.get("ARKFLOW_DONATE", "1") != "0"
         )
         #: eager host->device prefetch (see _to_device): accelerator-only —
         #: on the CPU backend there is no transfer/compute overlap to win,
-        #: only an extra executor hop per step. ARKFLOW_PREFETCH=1/0 forces.
+        #: only an extra executor hop per step. Under a mesh the prefetch is
+        #: a sharded device_put (each chip receives only its dp shard).
+        #: ARKFLOW_PREFETCH=1/0 forces.
         prefetch_env = os.environ.get("ARKFLOW_PREFETCH")
         self._prefetch = (
-            self._device is not None
-            and prefetch_env != "0"
-            and (self._device.platform in ("tpu", "gpu") or prefetch_env == "1")
+            prefetch_env != "0"
+            and (platform in ("tpu", "gpu") or prefetch_env == "1")
         )
 
         if getattr(self.cfg, "use_ring_attention", False) and "sp" not in axes:
@@ -234,8 +291,11 @@ class ModelRunner:
         reg = global_registry()
         # packed runners get their own metric family: fill/padding have
         # different semantics (token fill vs row fill), and sharing a
-        # reservoir with an unpacked runner would mix the distributions
-        labels = {"model": model, **({"packed": "1"} if packed else {})}
+        # reservoir with an unpacked runner would mix the distributions.
+        # Device-pool members add a ``device`` label so duty-cycle / stall /
+        # throughput read PER CHIP instead of summing the pool into one line.
+        labels = {"model": model, **({"packed": "1"} if packed else {}),
+                  **({"device": device_label} if device_label is not None else {})}
         self.m_infer = reg.histogram("arkflow_tpu_infer_seconds", "device step latency", labels)
         self.m_rows = reg.counter("arkflow_tpu_rows_total", "rows inferred", labels)
         self.m_pad = reg.counter("arkflow_tpu_pad_rows_total", "padding rows (waste)", labels)
@@ -265,6 +325,17 @@ class ModelRunner:
             "token padding frac for packed runners)", labels,
             buckets=[0.0, 0.125, 0.25, 0.5, 0.75, 0.9, 1.0],
         )
+        # 0/1 gauges so "are the PR-2 wins actually on?" is answerable from
+        # the metrics endpoint (and asserted by bench/tests) instead of
+        # re-deriving the env/platform gates by hand
+        self.m_prefetch_on = reg.gauge(
+            "arkflow_tpu_prefetch_active",
+            "1 when eager host->device prefetch is enabled for this runner", labels)
+        self.m_prefetch_on.set(1 if self._prefetch else 0)
+        self.m_donate_on = reg.gauge(
+            "arkflow_tpu_donate_active",
+            "1 when input donation (input-output aliasing) is enabled", labels)
+        self.m_donate_on.set(1 if self._donate else 0)
         self._seen_shapes: set[tuple] = set()
         self._in_warmup = False
         #: device queue depth. 2 = double buffering (prep/dispatch n+1
@@ -280,6 +351,10 @@ class ModelRunner:
             raise ConfigError(f"max_in_flight must be >= 1, got {max_in_flight}")
         self.max_in_flight = max_in_flight
         self._inflight_sem: Optional[asyncio.Semaphore] = None
+        #: loop the semaphores are bound to: a runner outliving its loop
+        #: (bench/profile phases, engine restarts) must rebuild them, or the
+        #: next infer() dies with "bound to a different event loop"
+        self._sem_loop: Optional[asyncio.AbstractEventLoop] = None
         #: bounds DEVICE-RESIDENT prefetched input batches (held across the
         #: whole step): one more than the in-flight depth, so exactly one
         #: batch sits staged ahead of the compute queue — otherwise every
@@ -396,7 +471,21 @@ class ModelRunner:
         # donate the padded inputs (argnum 1, never the params): XLA's
         # input-output aliasing reuses their device buffers for outputs,
         # trimming steady-state HBM churn on accelerator backends
-        self._jitted = jax.jit(run, donate_argnums=(1,)) if self._donate else jax.jit(run)
+        jit_kwargs: dict[str, Any] = {}
+        if self._donate:
+            jit_kwargs["donate_argnums"] = (1,)
+        if self.mesh is not None:
+            # dp-sharded dispatch: pin params to their placed shardings and
+            # split every input/output batch dim over dp explicitly — host
+            # numpy fed to jit is otherwise fully replicated, so each chip
+            # would redundantly compute the whole batch. The single
+            # ``_input_sharding`` is a pytree prefix: it broadcasts over the
+            # inputs dict (all model inputs lead with the batch/example dim)
+            # and over every output leaf.
+            jit_kwargs["in_shardings"] = (param_shardings(self.params),
+                                          self._input_sharding)
+            jit_kwargs["out_shardings"] = self._input_sharding
+        self._jitted = jax.jit(run, **jit_kwargs)
 
     def _disable_flash(self) -> None:
         """Auto-fallback: serve with XLA attention from now on (one
@@ -411,20 +500,6 @@ class ModelRunner:
             self.cfg = dataclasses.replace(self.cfg, use_flash_attention=False)
             self._seen_shapes.clear()
             self._build_jitted()
-
-    # -- checkpoint --------------------------------------------------------
-
-    def _restore(self, path: str, like_params):
-        from arkflow_tpu.tpu.checkpoint import restore
-
-        try:
-            restored = restore(path, like_params)
-            logger.info("restored checkpoint from %s", path)
-            return restored
-        except ConfigError:
-            raise
-        except Exception as e:
-            raise ConfigError(f"failed to restore checkpoint {path!r}: {e}") from e
 
     # -- shape plumbing ----------------------------------------------------
 
@@ -539,6 +614,18 @@ class ModelRunner:
     def _shape_key(self, padded: dict[str, np.ndarray]) -> tuple:
         return tuple((k, v.shape) for k, v in sorted(padded.items()))
 
+    def _note_shape(self, padded: dict[str, Any]) -> None:
+        """First-seen-shape accounting for the compile counter. Guarded by
+        the flash lock: ``infer_sync`` (executor threads) and ``infer`` (the
+        event loop) race here, and an unsynchronized check-then-add both
+        double-counts compiles and can miss ``_disable_flash``'s concurrent
+        ``_seen_shapes.clear()`` (which holds the same lock)."""
+        key = self._shape_key(padded)
+        with self._flash_lock:
+            if key not in self._seen_shapes:
+                self._seen_shapes.add(key)
+                self.m_compiles.inc()
+
     # -- execution ---------------------------------------------------------
 
     def infer_sync(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
@@ -561,10 +648,7 @@ class ModelRunner:
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
 
         padded, n = self._prep(inputs)
-        key = self._shape_key(padded)
-        if key not in self._seen_shapes:
-            self._seen_shapes.add(key)
-            self.m_compiles.inc()
+        self._note_shape(padded)
         t0 = time.perf_counter()
         try:
             out = jax.device_get(self._dispatch(padded))
@@ -624,12 +708,16 @@ class ModelRunner:
         return self._jitted(self.params, padded)
 
     def _to_device(self, padded: dict[str, Any]) -> dict[str, Any]:
-        """Eager host->device transfer of a prepped batch (single-device
-        serving): runs on an executor thread BEFORE the in-flight semaphore,
-        so batch n+1's infeed overlaps batch n's compute instead of paying
-        the transfer inside its own device window. Waits for the copies so
-        the subsequent dispatch never blocks on them."""
-        dev = jax.device_put(padded, self._device)
+        """Eager host->device transfer of a prepped batch: runs on an
+        executor thread BEFORE the in-flight semaphore, so batch n+1's
+        infeed overlaps batch n's compute instead of paying the transfer
+        inside its own device window. Under a mesh this is a SHARDED
+        device_put — each chip receives only its dp shard of the batch dim
+        (the dp-scaled buckets guarantee divisibility), and the dispatch
+        then consumes already-placed arrays with zero re-layout. Waits for
+        the copies so the subsequent dispatch never blocks on them."""
+        target = self._input_sharding if self.mesh is not None else self._device
+        dev = jax.device_put(padded, target)
         jax.block_until_ready(dev)
         return dev
 
@@ -656,6 +744,14 @@ class ModelRunner:
         total = busy + stall
         return busy / total if total > 0 else 0.0
 
+    def _ensure_sems(self) -> None:
+        """(Re)bind the in-flight/prefetch semaphores to the CURRENT loop."""
+        loop = asyncio.get_running_loop()
+        if self._sem_loop is not loop:
+            self._inflight_sem = asyncio.Semaphore(self.max_in_flight)
+            self._prefetch_sem = asyncio.Semaphore(self.max_in_flight + 1)
+            self._sem_loop = loop
+
     async def infer(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
         """Pipelined inference: host prep off-loop, bounded async dispatch.
 
@@ -679,15 +775,12 @@ class ModelRunner:
             ])
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
         padded, n = await loop.run_in_executor(None, self._prep, inputs)
-        key = self._shape_key(padded)
-        if key not in self._seen_shapes:
-            self._seen_shapes.add(key)
-            self.m_compiles.inc()
+        self._note_shape(padded)
         staged = padded  # host staging buffers, recycled once the step ends
 
+        self._ensure_sems()
+
         async def step(padded):
-            if self._inflight_sem is None:
-                self._inflight_sem = asyncio.Semaphore(self.max_in_flight)
             async with self._inflight_sem:
                 t0 = time.perf_counter()
                 self._track_dispatch(t0)
@@ -706,14 +799,13 @@ class ModelRunner:
                 return out
 
         try:
-            if self._prefetch and self.mesh is None:
+            if self._prefetch:
                 # eager infeed: batch n+1's host->device copies run here,
                 # outside the in-flight semaphore, overlapping batch n's
-                # compute. The prefetch semaphore (in_flight + 1 permits,
-                # held through the step) caps how many padded batches can
-                # sit in device memory ahead of the compute queue.
-                if self._prefetch_sem is None:
-                    self._prefetch_sem = asyncio.Semaphore(self.max_in_flight + 1)
+                # compute (sharded per-chip copies under a mesh). The
+                # prefetch semaphore (in_flight + 1 permits, held through
+                # the step) caps how many padded batches can sit in device
+                # memory ahead of the compute queue.
                 async with self._prefetch_sem:
                     padded = await loop.run_in_executor(None, self._to_device, padded)
                     out = await step(padded)

@@ -86,6 +86,20 @@ class HashTokenizer:
         """Hashing has no inverse vocabulary; render ids as text verbatim."""
         return " ".join(str(i) for i in ids)
 
+    def decode_column(self, flat: np.ndarray, offsets: np.ndarray):
+        """Vectorized decode of a ragged id column (flat values + offsets,
+        the shape ``tpu_generate``'s flat gather produces): ids cast to
+        their decimal strings and space-joined per row with two Arrow
+        kernels — zero per-row Python. HF tokenizers have a real inverse
+        vocabulary and decode row-wise instead (no ``decode_column``)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        lst = pa.ListArray.from_arrays(
+            pa.array(np.asarray(offsets, np.int32), pa.int32()),
+            pc.cast(pa.array(np.asarray(flat)), pa.string()))
+        return pc.binary_join(lst, " ")
+
 
 class HFTokenizer:
     """transformers fast-tokenizer wrapper (local files only)."""

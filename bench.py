@@ -10,7 +10,10 @@ BENCH_TINY=1 for a CPU-sized smoke run, BENCH_MODE=sql for the CPU reference
 anchor (BASELINE.json config 1: generate -> json_to_arrow -> sql filter),
 BENCH_PACKING=1 for token-packed execution (tpu/packing.py: several examples
 per model row, effective rows/s tracks real token count), BENCH_RAGGED=1 for
-a mixed short/long payload distribution (the realistic packing workload).
+a mixed short/long payload distribution (the realistic packing workload),
+BENCH_MODE=multichip for the data-parallel scaling phase (1 chip vs
+BENCH_MC_DEVICES chips on a forced host mesh; BENCH_MC_STYLE=dp|pool picks
+dp-sharded dispatch vs replicated device pool; emits scaling_efficiency).
 """
 
 from __future__ import annotations
@@ -192,7 +195,7 @@ def build_latency_config(seq: int, tiny: bool) -> dict:
 
 
 async def run_bench(seconds: float, batch: int, seq: int, tiny: bool,
-                    mode: str = "bert") -> dict:
+                    mode: str = "bert", cfg_map: dict | None = None) -> dict:
     from arkflow_tpu.components import ensure_plugins_loaded
     from arkflow_tpu.config import StreamConfig
     from arkflow_tpu.obs import global_registry
@@ -201,7 +204,9 @@ async def run_bench(seconds: float, batch: int, seq: int, tiny: bool,
     import sys
 
     ensure_plugins_loaded()
-    if mode == "sql":
+    if cfg_map is not None:
+        pass  # caller-built config (multichip phases)
+    elif mode == "sql":
         cfg_map = build_sql_config(batch)
     elif mode == "latency":
         cfg_map = build_latency_config(seq, tiny)
@@ -309,6 +314,9 @@ def main() -> None:
     mode = os.environ.get("BENCH_MODE", "bert")
     from arkflow_tpu.utils.cleanenv import axon_hook_present, cpu_child_env
 
+    if mode == "multichip":
+        _run_multichip_bench()
+        return
     if mode == "generate":
         if tiny or (axon_hook_present() and os.environ.get("JAX_PLATFORMS") != "cpu"
                     and not _tpu_reachable()):
@@ -577,6 +585,171 @@ def _packing_detail() -> dict:
                     pass
                 break
     return out
+
+
+def build_multichip_config(batch: int, seq: int, n: int, style: str) -> dict:
+    """One phase of the multichip bench: the tiny classifier served over
+    ``n`` chips — ``style="pool"`` (replicated device pool, no collectives)
+    or ``style="dp"`` (dp-sharded GSPMD dispatch). ``n=1`` is the
+    single-chip reference phase the efficiency is computed against."""
+    model_config = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+                    "ffn": 64, "max_positions": 64, "num_labels": 2}
+    proc: dict = {
+        "type": "tpu_inference",
+        "model": "bert_classifier",
+        "model_config": model_config,
+        "max_seq": seq,
+        "batch_buckets": [batch],  # per-chip bucket; dp scales it by n
+        "seq_buckets": [seq],
+        "outputs": ["label", "score"],
+        "warmup": True,
+        "max_in_flight": int(os.environ.get("BENCH_MC_INFLIGHT", "2")),
+    }
+    coalesce: dict = {"batch_buckets": [batch], "deadline": "5ms"}
+    if n > 1:
+        if style == "dp":
+            proc["mesh"] = {"dp": n}
+            # the runner compiles the dp-scaled global bucket (batch*n);
+            # coalesce targets the same grid so emissions stay bucket-exact
+            coalesce["dp"] = n
+        else:
+            proc["device_pool"] = n
+    capacity = batch * (n if style == "dp" else 1)
+    return {
+        # per-phase stream name: rows/e2e metrics are labeled by stream, so
+        # the 1-chip and n-chip phases never share counters
+        "name": f"bench-mc{n}-{style}",
+        "input": {"type": "generate",
+                  "payload": "stream processing on tpu: sensor reading "
+                             "nominal, no anomaly detected",
+                  "interval": 0, "batch_size": batch},
+        "buffer": {"type": "memory", "capacity": capacity, "timeout": "5ms",
+                   "coalesce": coalesce},
+        "pipeline": {
+            # workers must cover the whole pool's queue depth (n members x
+            # max_in_flight each) or the extra chips just idle
+            "thread_num": max(4, 2 * n + 2),
+            "processors": [proc],
+        },
+        "output": {"type": "drop"},
+    }
+
+
+def _per_device_busy_stall() -> dict[str, tuple[float, float]]:
+    """(busy_s, stall_s) per ``device`` label ('' = unlabeled runner)."""
+    from arkflow_tpu.obs import global_registry
+
+    out: dict[str, list[float]] = {}
+    for m in global_registry().collect():
+        name = getattr(m, "name", "")
+        if name in ("arkflow_tpu_device_busy_seconds_total",
+                    "arkflow_tpu_infeed_stall_seconds_total"):
+            dev = getattr(m, "labels", {}).get("device", "")
+            slot = out.setdefault(dev, [0.0, 0.0])
+            slot[0 if name.endswith("busy_seconds_total") else 1] += m.value
+    return {k: (v[0], v[1]) for k, v in out.items()}
+
+
+def _feature_gauges() -> tuple[bool, bool]:
+    """(prefetch_active, donate_active): True when EVERY runner built so far
+    reports the feature on — the assertable form of "the PR-2 wins stayed
+    enabled under the mesh/pool"."""
+    from arkflow_tpu.obs import global_registry
+
+    prefetch, donate = [], []
+    for m in global_registry().collect():
+        name = getattr(m, "name", "")
+        if name == "arkflow_tpu_prefetch_active":
+            prefetch.append(m.value)
+        elif name == "arkflow_tpu_donate_active":
+            donate.append(m.value)
+    return (bool(prefetch) and all(v == 1 for v in prefetch),
+            bool(donate) and all(v == 1 for v in donate))
+
+
+def _run_multichip_bench() -> None:
+    """BENCH_MODE=multichip: data-parallel scaling on an n-device mesh.
+
+    Phase 1 serves the workload on ONE device, phase 2 on all n (dp-sharded
+    GSPMD dispatch by default; BENCH_MC_STYLE=pool for the replicated device
+    pool, which wins on real chips for small-bucket/latency-bound traffic
+    but is bounded by host cores on a virtual mesh),
+    and the headline is ``scaling_efficiency`` = rows/s(n) / (n x rows/s(1))
+    — 1.0 is linear scaling, and anything is more honest than the old
+    MULTICHIP artifacts, which benched n chips each redundantly computing
+    the full batch. Always re-execs into a clean forced-host-device child
+    env (the phase validates SCALING MECHANICS hermetically; real-chip
+    absolute numbers come from the main bench). NOTE: virtual host devices
+    share the machine's physical cores, so CPU efficiency is bounded by
+    cores/n, not by the serving stack — on a real n-chip slice each device
+    is its own silicon and the same number reads as true scaling.
+    """
+    import subprocess
+    import sys
+
+    n = int(os.environ.get("BENCH_MC_DEVICES", "8"))
+    style = os.environ.get("BENCH_MC_STYLE", "dp")
+    if style not in ("pool", "dp"):
+        print(f"bench: BENCH_MC_STYLE must be pool|dp, got {style!r}",
+              file=sys.stderr)
+        sys.exit(2)
+    if os.environ.get("ARKFLOW_MC_CHILD") != "1":
+        from arkflow_tpu.utils.cleanenv import cpu_child_env
+
+        env = cpu_child_env(n_devices=n)
+        env["ARKFLOW_MC_CHILD"] = "1"
+        env["ARKFLOW_BENCH_CHILD"] = "1"
+        # prefetch is platform-gated off on CPU; force it so the sharded
+        # eager device_put path actually runs (and the gauge asserts it)
+        env.setdefault("ARKFLOW_PREFETCH", "1")
+        res = subprocess.run([sys.executable, __file__], env=env,
+                             capture_output=True)
+        _relay_child(res)
+        sys.exit(res.returncode)
+
+    seconds = float(os.environ.get("BENCH_MC_SECONDS", "6"))
+    batch = int(os.environ.get("BENCH_MC_BATCH", "64"))
+    seq = int(os.environ.get("BENCH_MC_SEQ", "32"))
+
+    r1 = asyncio.run(run_bench(
+        seconds, batch, seq, True,
+        cfg_map=build_multichip_config(batch, seq, 1, style)))
+    bs0 = _per_device_busy_stall()
+    rn = asyncio.run(run_bench(
+        seconds, batch, seq, True,
+        cfg_map=build_multichip_config(batch, seq, n, style)))
+    bs1 = _per_device_busy_stall()
+
+    duty = {}
+    for dev, (busy1, stall1) in bs1.items():
+        busy0, stall0 = bs0.get(dev, (0.0, 0.0))
+        d_busy, d_stall = busy1 - busy0, stall1 - stall0
+        if d_busy + d_stall > 0:
+            duty[dev or "mesh"] = round(d_busy / (d_busy + d_stall), 4)
+    prefetch_on, donate_on = _feature_gauges()
+    eff = (rn["rows_per_sec"] / (n * r1["rows_per_sec"])
+           if r1["rows_per_sec"] > 0 else 0.0)
+    _emit({
+        "metric": "multichip_scaling_efficiency",
+        "value": round(eff, 4),
+        "unit": "ratio",
+        # floor: 0.5 (half-linear scaling); >1.0 beats it
+        "vs_baseline": round(eff / 0.5, 4),
+        "detail": {
+            "n_devices": n,
+            "style": style,
+            "rows_per_sec_1chip": round(r1["rows_per_sec"], 1),
+            "rows_per_sec_nchip": round(rn["rows_per_sec"], 1),
+            "batch_per_chip": batch,
+            "seq": seq,
+            "elapsed_s": round(r1["elapsed_s"] + rn["elapsed_s"], 2),
+            "per_device_duty_cycle": duty,
+            "prefetch_active": prefetch_on,
+            "donate_active": donate_on,
+            "backend": _backend(),
+            "host_cores": os.cpu_count(),
+        },
+    })
 
 
 def _run_generate_bench(tiny: bool) -> None:

@@ -12,6 +12,14 @@ host time < device time means the device never starves).
 
     python tools/profile_step.py            # BERT-base bf16 b1024 s32
     PROF_BATCH=256 PROF_SEQ=128 PROF_DTYPE=int8 python tools/profile_step.py
+
+``--devices N`` (or PROF_DEVICES=N) switches to host-mesh mode: the tool
+re-execs itself onto a forced N-device virtual CPU platform, serves the same
+batch stream through a 1-member and an N-member replicated device pool
+(tpu/pool.py), and prints per-chip duty cycle + scaling efficiency
+(rows/s at N / (N x rows/s at 1)). Host-mesh mode defaults to the tiny
+classifier (PROF_TINY=0 for BERT-base — slow on CPU); PROF_STEPS bounds the
+measured steps per phase.
 """
 
 from __future__ import annotations
@@ -34,7 +42,99 @@ def _median_ms(fn, reps: int = 20) -> float:
     return ts[len(ts) // 2]
 
 
+def _cli_devices() -> int:
+    if "--devices" in sys.argv:
+        return int(sys.argv[sys.argv.index("--devices") + 1])
+    return int(os.environ.get("PROF_DEVICES", "0"))
+
+
+def _main_multichip(n: int) -> None:
+    """Host-mesh mode: per-chip duty cycle + scaling efficiency at N devices."""
+    import subprocess
+
+    if os.environ.get("_ARKFLOW_PROF_CHILD") != "1":
+        # the axon sitecustomize hijacks in-process jax init, and the forced
+        # host device count only takes effect pre-import — always re-exec
+        # into a clean N-device CPU child (same recipe as dryrun_multichip)
+        from arkflow_tpu.utils.cleanenv import cpu_child_env
+
+        env = cpu_child_env(n_devices=n)
+        env["_ARKFLOW_PROF_CHILD"] = "1"
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--devices", str(n)],
+            env=env, timeout=900)
+        sys.exit(res.returncode)
+
+    import asyncio
+
+    import jax
+    import numpy as np
+
+    from arkflow_tpu.tpu.bucketing import BucketPolicy
+    from arkflow_tpu.tpu.pool import ModelRunnerPool
+
+    tiny = os.environ.get("PROF_TINY", "1") == "1"
+    model_config = (
+        {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+         "ffn": 64, "max_positions": 64, "num_labels": 2} if tiny else {})
+    batch = int(os.environ.get("PROF_BATCH", "64"))
+    seq = int(os.environ.get("PROF_SEQ", "32"))
+    steps = int(os.environ.get("PROF_STEPS", "16"))
+    print(f"# host-mesh: devices={len(jax.devices())} n={n} batch={batch} "
+          f"seq={seq} tiny={tiny}", file=sys.stderr, flush=True)
+
+    pool = ModelRunnerPool(
+        "bert_classifier", model_config, pool_size=n,
+        buckets=BucketPolicy((batch,), (seq,)))
+    pool.warmup()
+    rng = np.random.RandomState(0)
+    inputs = {
+        "input_ids": rng.randint(1, 500 if tiny else 30000,
+                                 (batch, seq)).astype(np.int32),
+        "attention_mask": np.ones((batch, seq), np.int32),
+    }
+
+    def busy_stall():
+        return [(m.m_busy_s.value, m.m_stall_s.value) for m in pool.members]
+
+    async def drive(infer, k: int) -> float:
+        t0 = time.perf_counter()
+        await asyncio.gather(*[infer(inputs) for _ in range(k)])
+        return time.perf_counter() - t0
+
+    # phase 1: one member only (its in-flight semaphore still pipelines)
+    t1 = asyncio.run(drive(pool.members[0].infer, steps))
+    bs0 = busy_stall()
+    tn = asyncio.run(drive(pool.infer, steps * n))
+    bs1 = busy_stall()
+
+    r1 = steps * batch / t1 if t1 > 0 else 0.0
+    rn = steps * n * batch / tn if tn > 0 else 0.0
+    duty = []
+    for (b0, s0), (b1, s1) in zip(bs0, bs1):
+        d_busy, d_stall = b1 - b0, s1 - s0
+        duty.append(round(d_busy / (d_busy + d_stall), 4)
+                    if d_busy + d_stall > 0 else 0.0)
+    print(json.dumps({
+        "devices": n,
+        "batch": batch,
+        "seq": seq,
+        "steps_per_phase": steps,
+        "rows_per_sec_1chip": round(r1, 1),
+        "rows_per_sec_nchip": round(rn, 1),
+        "scaling_efficiency": round(rn / (n * r1), 4) if r1 > 0 else 0.0,
+        "per_chip_duty_cycle": duty,
+        "dispatch_per_chip": [int(c.value) for c in pool.m_dispatch],
+        "host_cores": os.cpu_count(),
+    }), flush=True)
+
+
 def main() -> None:
+    n_devices = _cli_devices()
+    if n_devices > 1:
+        _main_multichip(n_devices)
+        return
+
     import jax
     import jax.numpy as jnp
     import numpy as np
