@@ -55,5 +55,16 @@ class Disconnection(ArkError):
         super().__init__(msg)
 
 
+class StepDeadlineExceeded(ArkError):
+    """A device step missed its ``step_deadline``: the runner treats the
+    device as hung (UNHEALTHY), abandons the in-flight step, and the stream
+    nacks the batch so the source redelivers (at-least-once preserved)."""
+
+
+class RunnerDead(ArkError):
+    """A runner (or every member of a device pool) exhausted its recovery
+    probes and was marked DEAD; batches can no longer be served by it."""
+
+
 class UnsupportedSql(ArkError):
     """Raised by the Arrow-native SQL planner when a query needs the fallback engine."""

@@ -155,6 +155,13 @@ class MetricsRegistry:
     def collect(self) -> list[object]:
         return list(self._metrics.values())
 
+    def sum_values(self, name: str) -> float:
+        """Sum of a counter/gauge family across ALL label sets (e.g. every
+        pool member's ``arkflow_tpu_step_deadline_misses``) — what chaos
+        tests and the soak harness assert against."""
+        return sum(m.value for m in self._metrics.values()
+                   if isinstance(m, (Counter, Gauge)) and m.name == name)
+
     # -- Prometheus text exposition ---------------------------------------
 
     @staticmethod

@@ -39,7 +39,7 @@ from typing import Optional
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Ack, Buffer, Resource, VecAck, register_buffer
 from arkflow_tpu.errors import ConfigError
-from arkflow_tpu.tpu.bucketing import MicroBatchCoalescer
+from arkflow_tpu.tpu.bucketing import MicroBatchCoalescer, bucket_cap_bus
 from arkflow_tpu.utils.duration import parse_duration
 
 
@@ -55,6 +55,10 @@ class MemoryBuffer(Buffer):
         self._deadline_s = None
         if coalesce_buckets:
             self._coalescer = MicroBatchCoalescer(coalesce_buckets)
+            # device OOM degradation: when a runner proves the device can't
+            # hold a bucket, the announced cap shrinks this coalescer's grid
+            # so we stop merging emissions that would just OOM again
+            bucket_cap_bus().register(self._coalescer)
             self._deadline_s = (coalesce_deadline_s if coalesce_deadline_s is not None
                                 else timeout_s)
             if self._deadline_s is None:

@@ -11,6 +11,12 @@ write / process call) of the wrapper that owns it. Triggers:
                   content-deterministic poison pills that survive redelivery
                   reordering (output/processor faults only)
 
+Device-fault kinds (processor family only): ``hang`` wedges the next device
+step for ``duration`` (default 30s) so the runner's step-deadline watchdog
+fires; ``oom`` makes the next step raise a RESOURCE_EXHAUSTED so the bucket
+degradation path runs. Both are armed on the wrapped processor's runner when
+it has one, and fall back to in-wrapper stall/error otherwise.
+
 ``times`` bounds the total number of firings (0 = unlimited; defaults to 1
 for ``at`` triggers, unlimited otherwise). Firing state lives inside the
 spec's own config dict (``_state``), which the engine shares across stream
@@ -86,6 +92,10 @@ def parse_faults(cfg_list: Any, allowed_kinds: frozenset[str],
         if not isinstance(times, int) or times < 0:
             raise ConfigError(f"fault {family}: 'times' must be an int >= 0")
         duration = raw.get("duration")
+        if kind == "hang" and duration is None:
+            # an unbounded hang would wedge chaos runs with no deadline
+            # configured; 30s is "long enough to trip any sane watchdog"
+            duration = "30s"
         spec = FaultSpec(
             kind=kind,
             at=at,

@@ -31,6 +31,12 @@ reproducible faults on its operation stream:
         inner: {type: python, ...}            # optional; identity when absent
         faults:
           - {kind: error, match: poison}      # content-deterministic poison pill
+          - {kind: hang, at: 3, duration: 5s} # wedge the inner runner's next
+                                              # DEVICE step (step-deadline
+                                              # watchdog coverage)
+          - {kind: oom, at: 5}                # next device step raises
+                                              # RESOURCE_EXHAUSTED (bucket
+                                              # degradation coverage)
 
 Crash faults raise a plain RuntimeError (not ArkError) so they escape the
 stream's contained error paths and exercise the engine restart policy; their
@@ -71,7 +77,12 @@ from arkflow_tpu.plugins.fault.schedule import FaultSchedule, FaultSpec, parse_f
 INPUT_KINDS = frozenset(
     {"latency", "disconnect", "error", "crash", "ack_fail", "ack_dup", "reconnect_fail"})
 OUTPUT_KINDS = frozenset({"latency", "error", "crash"})
-PROCESSOR_KINDS = frozenset({"latency", "error", "crash"})
+PROCESSOR_KINDS = frozenset({"latency", "error", "crash", "hang", "oom"})
+
+#: device-step faults: armed on the wrapped processor's runner (the fault
+#: fires INSIDE the next device step, exercising the real watchdog / OOM
+#: degradation machinery) — or emulated in-wrapper when there is no runner
+_STEP_KINDS = frozenset({"hang", "oom"})
 
 #: faults applied before the inner read (they replace the read, losing no data)
 _PRE_READ_KINDS = frozenset({"latency", "disconnect", "error", "crash"})
@@ -268,12 +279,21 @@ class FaultInjectingProcessor(Processor):
         if self._inner is not None:
             await self._inner.connect()
 
+    @property
+    def runner(self):
+        """The inner processor's device runner (None for non-device inners):
+        chaos wrapping must not hide per-runner health from the engine's
+        ``/health`` introspection."""
+        return getattr(self._inner, "runner", None)
+
     async def process(self, batch: MessageBatch) -> list[MessageBatch]:
         self._calls += 1
         payload = _batch_bytes(batch) if self._needs_payload else None
         for spec in self._sched.due(self._calls, payload=payload):
             if spec.kind == "latency":
                 await asyncio.sleep(spec.duration_s)
+            elif spec.kind in _STEP_KINDS:
+                await self._apply_step_fault(spec)
             elif spec.kind == "error":
                 raise ProcessError(spec.message)
             elif spec.kind == "crash":
@@ -281,6 +301,23 @@ class FaultInjectingProcessor(Processor):
         if self._inner is None:
             return [batch]
         return await self._inner.process(batch)
+
+    async def _apply_step_fault(self, spec: FaultSpec) -> None:
+        """Arm a ``hang``/``oom`` on the inner processor's device runner so
+        the fault fires INSIDE its next step — the runner's step-deadline
+        watchdog and OOM-degradation machinery see a real device incident.
+        Processors without a runner get the closest emulation: a hang is an
+        in-wrapper stall, an oom raises with the RESOURCE_EXHAUSTED
+        signature."""
+        runner = getattr(self._inner, "runner", None)
+        inject = getattr(runner, "inject_step_fault", None)
+        if inject is not None:
+            inject(spec.kind, spec.duration_s)
+            return
+        if spec.kind == "hang":
+            await asyncio.sleep(spec.duration_s if spec.duration_s > 0 else 30.0)
+        else:
+            raise ProcessError(f"RESOURCE_EXHAUSTED: {spec.message}")
 
     async def close(self) -> None:
         if self._inner is not None:

@@ -41,6 +41,16 @@ Config:
     packing: true                  # token packing (tpu/packing.py): bin-pack
                                    # short examples into dense model rows so
                                    # flops/row tracks real token count
+    step_deadline: 2s              # self-healing: per-step watchdog — a step
+                                   # exceeding it is abandoned, the runner
+                                   # goes UNHEALTHY (recovery probes re-admit
+                                   # it) and the batch nacks for redelivery
+    step_deadline_first: 60s       # budget for first-compile steps
+                                   # (default: 10x step_deadline)
+    health:                        # recovery-probe schedule (tpu/health.py)
+      probe_backoff: 500ms         # first probe delay; doubles per incident
+      probe_backoff_cap: 30s
+      dead_after: 8                # consecutive incidents -> DEAD (0: never)
 """
 
 from __future__ import annotations
@@ -227,6 +237,11 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
             "tpu_inference: 'device_pool' and 'mesh' are mutually exclusive "
             "(a pool member is a single-device runner; pick sharded dispatch "
             "OR replicated serving)")
+    from arkflow_tpu.tpu.health import HealthConfig
+    from arkflow_tpu.utils.duration import parse_duration
+
+    step_deadline = config.get("step_deadline")
+    step_deadline_first = config.get("step_deadline_first")
     common = dict(
         buckets=buckets,
         checkpoint=config.get("checkpoint"),
@@ -235,6 +250,11 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         max_in_flight=(int(config["max_in_flight"])
                        if config.get("max_in_flight") is not None else None),
         packed=packing,
+        step_deadline_s=(parse_duration(step_deadline)
+                         if step_deadline is not None else None),
+        step_deadline_first_s=(parse_duration(step_deadline_first)
+                               if step_deadline_first is not None else None),
+        health_config=HealthConfig.from_config(config.get("health")),
     )
     if pool_size > 1:
         from arkflow_tpu.tpu.pool import ModelRunnerPool

@@ -38,6 +38,43 @@ class Engine:
         self.streams: list[Stream] = []
         self._ready = False
         self._runner: Optional[web.AppRunner] = None
+        #: per-stream restart accounting surfaced on /health: cumulative
+        #: restarts plus the remaining budget of the CURRENT crash window
+        #: (the budget re-earns after reset_after_s of healthy run)
+        self._restart_stats: dict[str, dict] = {}
+
+    # -- introspection (health/readiness payloads) -------------------------
+
+    @staticmethod
+    def _stream_runner_reports(stream: Stream) -> list[dict]:
+        """Per-runner health snapshots for every device-backed processor of
+        a stream (``ModelRunner.health_report`` returns one dict, a pool
+        returns one per member); non-device processors contribute nothing."""
+        reports: list[dict] = []
+        for proc in getattr(stream.pipeline, "processors", None) or []:
+            runner = getattr(proc, "runner", None)
+            report = getattr(runner, "health_report", None)
+            if report is None:
+                continue
+            try:
+                rep = report()
+            except Exception:  # a sick runner must not break /health itself
+                logger.exception("health_report failed for stream %s", stream.name)
+                continue
+            reports.extend(rep if isinstance(rep, list) else [rep])
+        return reports
+
+    def stream_health(self) -> dict:
+        """Restart accounting + per-runner device health, per stream."""
+        out: dict[str, dict] = {}
+        for s in self.streams:
+            info = dict(self._restart_stats.get(
+                s.name, {"restarts": 0, "restart_budget_remaining": None}))
+            runners = self._stream_runner_reports(s)
+            if runners:
+                info["runners"] = runners
+            out[s.name] = info
+        return out
 
     # -- health/metrics server (ref engine/mod.rs:99-209) ------------------
 
@@ -49,13 +86,34 @@ class Engine:
 
         def health(_req):
             body = {"status": "ok" if not self.cancel.is_set() else "shutting_down",
-                    "streams": len(self.streams)}
+                    "streams": len(self.streams),
+                    "stream_health": self.stream_health()}
             return web.Response(text=json.dumps(body), content_type="application/json")
 
         def readiness(_req):
-            if self._ready:
-                return web.Response(text='{"status":"ready"}', content_type="application/json")
-            return web.Response(status=503, text='{"status":"not_ready"}', content_type="application/json")
+            if not self._ready:
+                return web.Response(status=503, text='{"status":"not_ready"}',
+                                    content_type="application/json")
+            # per-runner health instead of a binary flag: a stream whose
+            # device runners are ALL dead cannot serve — report not_ready so
+            # the orchestrator rotates this replica out
+            dead = {}
+            runners = {}
+            for s in self.streams:
+                reports = self._stream_runner_reports(s)
+                if not reports:
+                    continue
+                runners[s.name] = [r.get("state") for r in reports]
+                if all(r.get("state") == "dead" for r in reports):
+                    dead[s.name] = len(reports)
+            if dead:
+                body = {"status": "not_ready", "dead_runner_streams": dead,
+                        "runners": runners}
+                return web.Response(status=503, text=json.dumps(body),
+                                    content_type="application/json")
+            body = {"status": "ready", **({"runners": runners} if runners else {})}
+            return web.Response(text=json.dumps(body),
+                                content_type="application/json")
 
         def liveness(_req):
             return web.Response(text='{"status":"alive"}', content_type="application/json")
@@ -153,6 +211,10 @@ class Engine:
             else:
                 policy = {}
             retries = 0
+            stats = {"restarts": 0,
+                     "restart_budget_remaining": (policy["max_retries"]
+                                                  if policy else None)}
+            self._restart_stats[name] = stats
             while True:
                 run_started = _time.monotonic()
                 try:
@@ -171,11 +233,16 @@ class Engine:
                 # FRESH instance — the crashed one's components are closed
                 # and may hold broken connections, so it is never re-run
                 while True:
+                    stats["restart_budget_remaining"] = max(
+                        0, policy["max_retries"] - retries)
                     if retries >= policy["max_retries"]:
                         logger.error("[%s] restart budget exhausted (%d)", name,
                                      policy["max_retries"])
                         return
                     retries += 1
+                    stats["restarts"] += 1
+                    stats["restart_budget_remaining"] = max(
+                        0, policy["max_retries"] - retries)
                     logger.warning("[%s] restarting (%d/%d) in %.1fs", name,
                                    retries, policy["max_retries"], policy["backoff_s"])
                     if not await backoff(policy["backoff_s"]):

@@ -201,18 +201,22 @@ class Stream:
 
     async def _close_all(self) -> None:
         # ordered close: input -> buffer -> pipeline -> output (ref :400-437)
-        for closer in (
-            self.input.close,
-            *((self.buffer.close,) if self.buffer else ()),
-            self.pipeline.close,
-            *(t.close for t in self.temporaries.values()),
-            *((self.error_output.close,) if self.error_output else ()),
-            self.output.close,
+        for stage, closer in (
+            ("input", self.input.close),
+            *((("buffer", self.buffer.close),) if self.buffer else ()),
+            ("pipeline", self.pipeline.close),
+            *((f"temporary:{name}", t.close)
+              for name, t in self.temporaries.items()),
+            *((("error_output", self.error_output.close),)
+              if self.error_output else ()),
+            ("output", self.output.close),
         ):
             try:
                 await closer()
             except Exception:
-                logger.exception("error during close")
+                comp = type(getattr(closer, "__self__", closer)).__name__
+                logger.exception("[%s] error during close of %s (%s)",
+                                 self.name, stage, comp)
 
     # -- stages ------------------------------------------------------------
 
@@ -341,7 +345,15 @@ class Stream:
                 done_workers += 1
                 if done_workers >= total_workers:
                     if reorder:
-                        logger.error("[%s] %d batches stuck in reorder at shutdown", self.name, len(reorder))
+                        # a seq gap at shutdown (worker died mid-batch):
+                        # nack the stuck batches so their sources redeliver
+                        # NOW instead of waiting out broker ack timeouts
+                        logger.error(
+                            "[%s] %d batches stuck in reorder at shutdown; "
+                            "nacking for redelivery", self.name, len(reorder))
+                        for seq in sorted(reorder):
+                            item, _results, _err = reorder.pop(seq)
+                            await self._safe_nack(item.ack)
                     return
                 continue
             seq, item, results, err = msg

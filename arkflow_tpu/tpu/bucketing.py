@@ -71,6 +71,16 @@ class BucketPolicy:
     def max_batch(self) -> int:
         return self.batch_buckets[-1]
 
+    def capped(self, below: int) -> Optional["BucketPolicy"]:
+        """OOM degradation: the grid with only batch buckets strictly below
+        ``below`` (the bucket the device just failed to hold). ``None`` when
+        no smaller bucket exists — the caller can't degrade further and must
+        surface the failure instead."""
+        smaller = tuple(b for b in self.batch_buckets if b < below)
+        if not smaller:
+            return None
+        return BucketPolicy(smaller, self.seq_buckets)
+
     def dp_scaled(self, dp: int) -> "BucketPolicy":
         """The policy for dp-sharded dispatch: every batch bucket times
         ``dp``, so each global bucket splits into per-chip shards that land
@@ -85,6 +95,61 @@ class BucketPolicy:
             return self
         return BucketPolicy(tuple(b * dp for b in self.batch_buckets),
                             self.seq_buckets)
+
+
+class BucketCapBus:
+    """Process-wide fanout of device OOM bucket caps to live coalescers.
+
+    The runner and the memory buffer's coalescer are independent components
+    wired from different config sections; when the device proves it cannot
+    hold a bucket (``RESOURCE_EXHAUSTED``), the runner caps its own grid AND
+    announces the cap here so every registered coalescer stops merging
+    emissions the device will just OOM on again. Process-global on purpose:
+    one host serves one device topology, and a cap is a statement about the
+    device, not about any single stream.
+
+    Thread-tolerant: ``announce`` runs on runner executor threads while
+    coalescers live on the event loop — ``cap()`` only shrinks a tuple and an
+    int, both atomic reassignments, so the worst case is one more emission at
+    the old target (which the runner then splits, not loses).
+    """
+
+    def __init__(self) -> None:
+        import threading
+        import weakref
+
+        self._lock = threading.Lock()
+        self._coalescers: "weakref.WeakSet[MicroBatchCoalescer]" = weakref.WeakSet()
+        self._cap: Optional[int] = None
+
+    @property
+    def cap(self) -> Optional[int]:
+        return self._cap
+
+    def register(self, coalescer: "MicroBatchCoalescer") -> None:
+        with self._lock:
+            self._coalescers.add(coalescer)
+            if self._cap is not None:
+                coalescer.cap(self._cap)
+
+    def announce(self, cap: int) -> None:
+        with self._lock:
+            self._cap = cap if self._cap is None else min(self._cap, cap)
+            for c in list(self._coalescers):
+                c.cap(self._cap)
+
+    def reset(self) -> None:
+        """Test hook: forget the cap (coalescers already shrunk stay shrunk)."""
+        with self._lock:
+            self._cap = None
+            self._coalescers.clear()
+
+
+_GLOBAL_CAP_BUS = BucketCapBus()
+
+
+def bucket_cap_bus() -> BucketCapBus:
+    return _GLOBAL_CAP_BUS
 
 
 class MicroBatchCoalescer:
@@ -143,6 +208,20 @@ class MicroBatchCoalescer:
     def pending(self) -> int:
         """Held entries — covers zero-row batches whose acks still await."""
         return len(self._held) + len(self._solo)
+
+    def cap(self, max_bucket: int) -> None:
+        """Shrink the target grid after a device OOM (see ``BucketCapBus``):
+        drop buckets above ``max_bucket`` so future emissions stay within
+        what the device can actually hold. If even the smallest bucket is
+        above the cap, the cap itself becomes the only bucket. Already-held
+        rows simply drain at the new, smaller target."""
+        fitting = tuple(b for b in self.buckets if b <= max_bucket)
+        if not fitting:
+            fitting = (max(1, int(max_bucket)),)
+        if fitting == self.buckets:
+            return
+        self.buckets = fitting
+        self.target = fitting[-1]
 
     # -- suspect tracking (hashing only on failure paths, plus on adds/acks
     # -- that pass the row-count prefilter while failures are outstanding —

@@ -1,0 +1,249 @@
+"""Runner health state machine: the device-path analogue of the circuit breaker.
+
+PRs 1-3 made the I/O path fail gracefully (retries, breakers, reconnect); this
+module gives the DEVICE path the same property. Every ``ModelRunner`` owns a
+``RunnerHealth`` that tracks whether its chip is trustworthy:
+
+    HEALTHY   -- serving normally
+    DEGRADED  -- serving, but at reduced capability (e.g. the bucket grid was
+                 capped after a device OOM); transient — the next successful
+                 step promotes back to HEALTHY (the permanent cap is visible
+                 on the ``arkflow_tpu_bucket_cap`` gauge instead)
+    UNHEALTHY -- a step hung past its deadline or kept failing; the runner is
+                 skipped by pool dispatch until a recovery probe is due, with
+                 exponential backoff between probes
+    DEAD      -- ``dead_after`` consecutive incidents without one success;
+                 terminal — never probed again, reported on ``/health``
+
+Transitions are driven by step outcomes (``mark_success`` / ``mark_unhealthy``
+/ ``mark_degraded``); recovery probes are REAL traffic batches: when a probe
+is due, dispatch routes one batch to the suspect runner (``try_begin_probe``
+claims the slot so concurrent workers don't pile on), and that batch's own
+step deadline bounds the damage if the device is still hung — at-least-once
+delivery is preserved because a failed probe batch nacks like any other
+failure.
+
+The state is exported on the ``arkflow_tpu_runner_health`` gauge
+(0 healthy / 1 degraded / 2 unhealthy / 3 dead) so "which chip is limping"
+is answerable from the metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from arkflow_tpu.errors import ConfigError
+
+logger = logging.getLogger("arkflow.tpu.health")
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+UNHEALTHY = "unhealthy"
+DEAD = "dead"
+
+#: gauge encoding for ``arkflow_tpu_runner_health``
+GAUGE_VALUE = {HEALTHY: 0, DEGRADED: 1, UNHEALTHY: 2, DEAD: 3}
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    """Knobs for the recovery-probe schedule (config: ``health:`` on the
+    ``tpu_inference`` processor)."""
+
+    #: first probe delay after an incident; doubles per consecutive incident
+    probe_backoff_s: float = 0.5
+    #: cap on the probe backoff
+    probe_backoff_cap_s: float = 30.0
+    #: consecutive incidents (no success in between) before the runner is
+    #: declared DEAD; 0 = never give up
+    dead_after: int = 8
+
+    @classmethod
+    def from_config(cls, cfg: Optional[dict]) -> "HealthConfig":
+        if not cfg:
+            return cls()
+        if not isinstance(cfg, dict):
+            raise ConfigError("tpu_inference 'health' must be a mapping")
+        from arkflow_tpu.utils.duration import parse_duration
+
+        def dur(key: str, default: float) -> float:
+            raw = cfg.get(key)
+            if raw is None:
+                return default
+            val = parse_duration(raw)
+            if val <= 0:
+                raise ConfigError(f"health.{key} must be positive")
+            return val
+
+        dead_after = cfg.get("dead_after", cls.dead_after)
+        if not isinstance(dead_after, int) or dead_after < 0:
+            raise ConfigError("health.dead_after must be an int >= 0")
+        return cls(
+            probe_backoff_s=dur("probe_backoff", cls.probe_backoff_s),
+            probe_backoff_cap_s=dur("probe_backoff_cap", cls.probe_backoff_cap_s),
+            dead_after=dead_after,
+        )
+
+
+class RunnerHealth:
+    """Thread-safe health tracker (marks arrive from executor threads and the
+    event loop alike). ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, config: Optional[HealthConfig] = None, *,
+                 gauge=None, name: str = "runner",
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = config or HealthConfig()
+        self.name = name
+        self._clock = clock
+        self._gauge = gauge
+        self._lock = threading.Lock()
+        self._state = HEALTHY
+        self._consecutive_failures = 0
+        self._next_probe_at = 0.0
+        self._probing = False
+        #: set when a dispatcher (pool ``_pick``) claimed the probe for a
+        #: batch that will re-enter through the runner's own gate — exactly
+        #: ONE joiner may consume the claim; everyone else waits
+        self._probe_handoff = False
+        self._last_reason = ""
+        if gauge is not None:
+            gauge.set(GAUGE_VALUE[HEALTHY])
+
+    # -- inspection --------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def report(self) -> dict:
+        """JSON-able snapshot for ``/health``."""
+        with self._lock:
+            rep = {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+            }
+            if self._last_reason:
+                rep["last_reason"] = self._last_reason
+            if self._state == UNHEALTHY:
+                rep["next_probe_in_s"] = round(
+                    max(0.0, self._next_probe_at - self._clock()), 3)
+            return rep
+
+    def probe_due(self, now: Optional[float] = None) -> bool:
+        return (self._state == UNHEALTHY
+                and (self._clock() if now is None else now) >= self._next_probe_at)
+
+    def seconds_until_probe(self, now: Optional[float] = None) -> float:
+        with self._lock:
+            if self._state != UNHEALTHY:
+                return 0.0
+            return max(0.0, self._next_probe_at - (self._clock() if now is None else now))
+
+    def available(self, now: Optional[float] = None) -> bool:
+        """May a batch be dispatched here right now? HEALTHY/DEGRADED always;
+        UNHEALTHY only when a probe is due and nobody is already probing."""
+        s = self._state
+        if s in (HEALTHY, DEGRADED):
+            return True
+        if s == UNHEALTHY:
+            return not self._probing and self.probe_due(now)
+        return False  # DEAD
+
+    # -- transitions -------------------------------------------------------
+
+    def _set(self, state: str) -> None:
+        self._state = state
+        if self._gauge is not None:
+            self._gauge.set(GAUGE_VALUE[state])
+
+    def try_begin_probe(self, now: Optional[float] = None) -> bool:
+        """Claim the recovery-probe slot. True when the caller should
+        dispatch now: the runner is serving normally, or it just claimed the
+        due probe. False while DEAD, mid-backoff, or already being probed."""
+        with self._lock:
+            if self._state in (HEALTHY, DEGRADED):
+                return True
+            if self._state == DEAD:
+                return False
+            now = self._clock() if now is None else now
+            if self._probing or now < self._next_probe_at:
+                return False
+            self._probing = True
+            self._probe_handoff = True
+            return True
+
+    def join_or_begin_probe(self, now: Optional[float] = None) -> bool:
+        """Like ``try_begin_probe`` but honors an upstream claim: when pool
+        dispatch claimed the probe for the very batch now arriving at the
+        runner's own gate, that ONE batch joins; every other concurrent
+        caller waits instead of piling onto a maybe-still-hung device (a
+        pile-up would blow N deadlines at once and race the incident
+        counter toward DEAD)."""
+        with self._lock:
+            if self._state in (HEALTHY, DEGRADED):
+                return True
+            if self._state == DEAD:
+                return False
+            if self._probing:
+                if self._probe_handoff:
+                    self._probe_handoff = False
+                    return True
+                return False
+            now = self._clock() if now is None else now
+            if now < self._next_probe_at:
+                return False
+            self._probing = True
+            return True
+
+    def mark_success(self) -> None:
+        """A step completed: clear the incident streak; re-admit a suspect."""
+        with self._lock:
+            if self._state == DEAD:
+                return  # terminal
+            self._probing = False
+            self._probe_handoff = False
+            self._consecutive_failures = 0
+            if self._state != HEALTHY:
+                logger.info("[%s] runner recovered -> HEALTHY", self.name)
+                self._last_reason = ""
+                self._set(HEALTHY)
+
+    def mark_degraded(self, reason: str) -> None:
+        """Serving continues at reduced capability (bucket grid capped)."""
+        with self._lock:
+            if self._state == HEALTHY:
+                logger.warning("[%s] runner DEGRADED: %s", self.name, reason)
+                self._last_reason = reason
+                self._set(DEGRADED)
+
+    def mark_unhealthy(self, reason: str) -> None:
+        """An incident (deadline miss, repeated step failure): stop receiving
+        traffic, schedule a recovery probe with exponential backoff."""
+        with self._lock:
+            if self._state == DEAD:
+                return
+            self._probing = False
+            self._probe_handoff = False
+            self._consecutive_failures += 1
+            self._last_reason = reason
+            if (self.cfg.dead_after
+                    and self._consecutive_failures >= self.cfg.dead_after):
+                logger.error("[%s] runner DEAD after %d consecutive incidents "
+                             "(last: %s)", self.name,
+                             self._consecutive_failures, reason)
+                self._set(DEAD)
+                return
+            backoff = min(
+                self.cfg.probe_backoff_s
+                * (2.0 ** min(self._consecutive_failures - 1, 32)),
+                self.cfg.probe_backoff_cap_s,
+            )
+            self._next_probe_at = self._clock() + backoff
+            logger.warning("[%s] runner UNHEALTHY (%s); probe in %.2fs "
+                           "(incident %d)", self.name, reason, backoff,
+                           self._consecutive_failures)
+            self._set(UNHEALTHY)

@@ -17,23 +17,37 @@ when EVERY member fails does the error propagate (and the stream nacks, so
 the source redelivers). Deterministic config errors (bad input spec) are NOT
 retried — they would fail identically on every chip.
 
+Health-aware dispatch (the self-healing layer): every member carries a
+``RunnerHealth`` state machine. ``_pick`` skips UNHEALTHY/DEAD members, and
+when a suspect's recovery probe is due it is re-admitted by routing ONE real
+batch to it first (claimed via ``try_begin_probe`` so concurrent workers
+don't pile onto a maybe-still-hung chip); a successful probe promotes the
+member back to HEALTHY, a failed one backs the probe schedule off further.
+When nothing is dispatchable — every member mid-backoff — the dispatcher
+waits for the earliest probe window instead of failing, so transient
+whole-pool incidents heal without losing batches.
+
 Per-chip observability: each member's runner metrics carry a ``device`` label
 (``arkflow_tpu_device_busy_seconds_total{device="3"}`` ...), and the pool adds
-dispatch/failover counters so imbalance or a limping chip shows up directly.
+dispatch/failover/skip/probe counters so imbalance or a limping chip shows up
+directly.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional
 
 import numpy as np
 
-from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.errors import ConfigError, RunnerDead, StepDeadlineExceeded
+from arkflow_tpu.tpu.health import DEAD, DEGRADED, HEALTHY, UNHEALTHY
 from arkflow_tpu.obs import global_registry
 from arkflow_tpu.tpu.bucketing import BucketPolicy
-from arkflow_tpu.tpu.runner import ModelRunner, convert_for_serving, init_host_params
+from arkflow_tpu.tpu.runner import (ModelRunner, convert_for_serving,
+                                    init_host_params, is_oom_error)
 
 logger = logging.getLogger("arkflow.tpu")
 
@@ -59,6 +73,9 @@ class ModelRunnerPool:
         serving_dtype: Optional[str] = None,
         max_in_flight: Optional[int] = None,
         packed: bool = False,
+        step_deadline_s: Optional[float] = None,
+        step_deadline_first_s: Optional[float] = None,
+        health_config=None,
     ):
         import jax
 
@@ -92,6 +109,9 @@ class ModelRunnerPool:
                 packed=packed,
                 host_params=host_params,
                 device_label=str(i),
+                step_deadline_s=step_deadline_s,
+                step_deadline_first_s=step_deadline_first_s,
+                health_config=health_config,
             )
             for i in range(pool_size)
         ]
@@ -99,6 +119,7 @@ class ModelRunnerPool:
         #: outstanding infer calls per member (the least-loaded signal)
         self._loads = [0] * pool_size
         self._rr = 0  # round-robin cursor for ties
+        self._chaos_rr = 0  # separate cursor for injected step faults
 
         reg = global_registry()
         self.m_dispatch = [
@@ -111,6 +132,14 @@ class ModelRunnerPool:
         self.m_failover = reg.counter(
             "arkflow_tpu_pool_failover_total",
             "batches retried on another member after a member error",
+            {"model": model})
+        self.m_skipped = reg.counter(
+            "arkflow_tpu_pool_skipped_unhealthy_total",
+            "dispatch decisions that passed over >=1 unhealthy/dead member",
+            {"model": model})
+        self.m_probes = reg.counter(
+            "arkflow_tpu_pool_probes_total",
+            "recovery probes dispatched to unhealthy members",
             {"model": model})
 
     # -- ModelRunner surface (delegated) -----------------------------------
@@ -150,43 +179,122 @@ class ModelRunnerPool:
         persistent compile cache (identical shapes, identical HLO)."""
         return sum(m.warmup(seq_lens) for m in self.members)
 
+    def inject_step_fault(self, kind: str, duration_s: float = 0.0) -> None:
+        """Chaos hook (fault plugin): arm a one-shot device-step fault on one
+        member, round-robin across calls so repeated faults spread over the
+        pool the way real per-chip incidents would."""
+        i = self._chaos_rr % self.pool_size
+        self._chaos_rr += 1
+        self.members[i].inject_step_fault(kind, duration_s)
+
+    def health_report(self) -> list[dict]:
+        """Per-member health snapshots for the engine's ``/health``."""
+        return [m.health_report() for m in self.members]
+
     # -- dispatch ----------------------------------------------------------
 
     def _pick(self, exclude: set[int]) -> Optional[int]:
-        """Least-loaded member, round-robin among ties (the cursor advances
-        every pick, so equal-load members take strict turns)."""
+        """Health-aware least-loaded pick, round-robin among ties (the
+        cursor advances every pick, so equal-load members take strict
+        turns). UNHEALTHY/DEAD members are skipped — except that an
+        UNHEALTHY member whose recovery probe is due takes priority (one
+        batch re-admits it on success); ``None`` when nothing is
+        dispatchable right now."""
         best: Optional[int] = None
+        probe: Optional[int] = None
+        skipped = False
+        now = time.monotonic()
         n = self.pool_size
         for off in range(n):
             i = (self._rr + off) % n
             if i in exclude:
                 continue
-            if best is None or self._loads[i] < self._loads[best]:
-                best = i
+            h = self.members[i].health
+            state = h.state
+            if state in (HEALTHY, DEGRADED):
+                if best is None or self._loads[i] < self._loads[best]:
+                    best = i
+            elif state == UNHEALTHY:
+                skipped = True
+                if probe is None and h.probe_due(now):
+                    probe = i
+            else:  # DEAD
+                skipped = True
+        if probe is not None and self.members[probe].health.try_begin_probe(now):
+            # the probe outranks healthy members: without routing one real
+            # batch at it, a recovered chip would never be re-admitted
+            self.m_probes.inc()
+            self._rr = (self._rr + 1) % n
+            return probe
+        if skipped and best is not None:
+            self.m_skipped.inc()
         if best is not None:
             self._rr = (self._rr + 1) % n
         return best
 
+    def _all_dead(self, exclude: set[int]) -> bool:
+        return all(self.members[i].health.state == DEAD
+                   for i in range(self.pool_size) if i not in exclude)
+
+    def _probe_wait_s(self, exclude: set[int]) -> float:
+        """Time until the earliest untried member may be probed again."""
+        waits = [self.members[i].health.seconds_until_probe()
+                 for i in range(self.pool_size)
+                 if i not in exclude and self.members[i].health.state == UNHEALTHY]
+        return min(waits) if waits else 0.05
+
+    def _note_member_failure(self, i: int, e: Exception) -> None:
+        """Health bookkeeping for a member step that raised. Deadline misses
+        and OOMs self-mark inside the runner (which also releases a probe
+        claim); anything else — a raw XLA fault, a generic probe failure —
+        must mark HERE, unconditionally: ``mark_unhealthy`` both stops
+        dispatch feeding the chip and clears the probing flag, so a FAILED
+        probe re-arms its backoff instead of fencing the member forever."""
+        if isinstance(e, (StepDeadlineExceeded, RunnerDead)) or is_oom_error(e):
+            return
+        self.members[i].health.mark_unhealthy(f"step failed: {e}")
+
     def infer_sync(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
-        i = self._pick(set())
+        while True:
+            i = self._pick(set())
+            if i is not None:
+                break
+            if self._all_dead(set()):
+                raise RunnerDead("device pool: every member is DEAD")
+            time.sleep(max(self._probe_wait_s(set()), 0.01))
         self._loads[i] += 1
         self.m_dispatch[i].inc()
         try:
             return self.members[i].infer_sync(inputs)
+        except ConfigError:
+            raise  # deterministic (bad input/spec), not a chip fault
+        except Exception as e:
+            self._note_member_failure(i, e)
+            raise
         finally:
             self._loads[i] -= 1
 
     async def infer(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
-        """Route one batch to the least-loaded member; fail over to the
-        remaining members on a member error (at-least-once: the batch either
-        completes on SOME chip or the error propagates and the stream nacks).
+        """Route one batch to the least-loaded healthy member; fail over to
+        the remaining members on a member error (at-least-once: the batch
+        either completes on SOME chip or the error propagates and the stream
+        nacks). When every untried member is mid-probe-backoff the dispatch
+        waits for the earliest probe window rather than failing the batch.
         """
         tried: set[int] = set()
         last_err: Exception = RuntimeError("device pool has no members")
         while True:
             i = self._pick(tried)
-            if i is None:  # every member failed this batch
-                raise last_err
+            if i is None:
+                if len(tried) >= self.pool_size:
+                    raise last_err  # every member failed this batch
+                if self._all_dead(tried):
+                    raise RunnerDead(
+                        "device pool: every remaining member is DEAD")
+                # all untried members are unhealthy mid-backoff: wait for the
+                # earliest probe window instead of dropping the batch
+                await asyncio.sleep(max(self._probe_wait_s(tried), 0.01))
+                continue
             self._loads[i] += 1
             self.m_dispatch[i].inc()
             try:
@@ -198,6 +306,7 @@ class ModelRunnerPool:
             except Exception as e:
                 last_err = e
                 tried.add(i)
+                self._note_member_failure(i, e)
                 if len(tried) >= self.pool_size:
                     raise
                 self.m_failover.inc()

@@ -29,13 +29,14 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+from collections import deque
 from functools import partial
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
-from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.errors import ConfigError, RunnerDead, StepDeadlineExceeded
 from arkflow_tpu.models import get_model
 from arkflow_tpu.obs import global_registry
 from arkflow_tpu.parallel.mesh import (
@@ -46,9 +47,45 @@ from arkflow_tpu.parallel.mesh import (
     param_shardings,
     shard_params,
 )
-from arkflow_tpu.tpu.bucketing import BucketPolicy, pad_batch_dim, pad_seq_dim
+from arkflow_tpu.tpu.bucketing import BucketPolicy, bucket_cap_bus, pad_batch_dim, pad_seq_dim
+from arkflow_tpu.tpu.health import HealthConfig, RunnerHealth
+from arkflow_tpu.tpu.health import DEAD as HEALTH_DEAD
+from arkflow_tpu.tpu.health import UNHEALTHY as HEALTH_UNHEALTHY
 
 logger = logging.getLogger("arkflow.tpu")
+
+#: an unseen (batch, seq) shape compiles before it executes; the watchdog
+#: scales the step deadline by this factor unless ``step_deadline_first``
+#: pins an absolute budget for first-compile steps
+FIRST_COMPILE_DEADLINE_SCALE = 10.0
+
+
+class InjectedOom(RuntimeError):
+    """Chaos-injected device OOM (``inject_step_fault('oom')``): carries the
+    RESOURCE_EXHAUSTED signature so it walks the real degradation path."""
+
+    def __init__(self, msg: str = "RESOURCE_EXHAUSTED: chaos: injected device OOM"):
+        super().__init__(msg)
+
+
+#: substrings identifying an XLA allocation failure across backends/versions
+_OOM_SIGNATURES = ("resource_exhausted", "resource exhausted", "out of memory", "oom")
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """Device allocation failure? Matched on the message because jaxlib's
+    ``XlaRuntimeError`` carries the gRPC status only as text (and the chaos
+    layer fabricates the same signature). Word-boundary match: a bare
+    substring test would classify any message containing e.g. "boom" as an
+    OOM and route it into the degradation path."""
+    if isinstance(e, InjectedOom):
+        return True
+    if isinstance(e, MemoryError):
+        return True
+    import re
+
+    msg = str(e).lower()
+    return any(re.search(rf"\b{re.escape(sig)}\b", msg) for sig in _OOM_SIGNATURES)
 
 
 def _env_int(name: str, default: int, minimum: Optional[int] = None) -> int:
@@ -184,6 +221,9 @@ class ModelRunner:
         packed: bool = False,
         host_params=None,
         device_label: Optional[str] = None,
+        step_deadline_s: Optional[float] = None,
+        step_deadline_first_s: Optional[float] = None,
+        health_config: Optional[HealthConfig] = None,
     ):
         from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
 
@@ -369,6 +409,60 @@ class ModelRunner:
         self._staging: Optional[_StagingPool] = None
         if not packed and os.environ.get("ARKFLOW_STAGING", "1") != "0":
             self._staging = _StagingPool(max_per_key=self.max_in_flight + 1)
+
+        # -- self-healing device layer (step deadlines / OOM degradation /
+        # -- health state machine) ------------------------------------------
+        if step_deadline_s is not None and step_deadline_s <= 0:
+            raise ConfigError(f"step_deadline must be positive, got {step_deadline_s}")
+        if step_deadline_first_s is not None and step_deadline_first_s <= 0:
+            raise ConfigError(
+                f"step_deadline_first must be positive, got {step_deadline_first_s}")
+        self.step_deadline_s = step_deadline_s
+        #: first-compile steps trace + compile before executing; they get
+        #: their own (much larger) budget so a cold bucket isn't misread as a
+        #: hung device
+        self.step_deadline_first_s = (
+            step_deadline_first_s
+            if step_deadline_first_s is not None
+            else (step_deadline_s * FIRST_COMPILE_DEADLINE_SCALE
+                  if step_deadline_s is not None else None))
+        self.device_label = device_label
+        health_name = f"{model}" + (f"[dev {device_label}]" if device_label else "")
+        self.health = RunnerHealth(
+            health_config,
+            gauge=reg.gauge(
+                "arkflow_tpu_runner_health",
+                "runner health state (0 healthy, 1 degraded, 2 unhealthy, 3 dead)",
+                labels),
+            name=health_name)
+        self.m_deadline_miss = reg.counter(
+            "arkflow_tpu_step_deadline_misses",
+            "device steps abandoned after exceeding step_deadline", labels)
+        self.m_oom = reg.counter(
+            "arkflow_tpu_oom_total",
+            "device RESOURCE_EXHAUSTED / OOM failures observed in steps", labels)
+        self.m_rebuilds = reg.counter(
+            "arkflow_tpu_runner_rebuilds_total",
+            "jitted-step rebuilds after a deadline miss", labels)
+        #: largest batch bucket this runner will still dispatch; shrinks
+        #: permanently when the device OOMs on a bucket
+        self.m_bucket_cap = reg.gauge(
+            "arkflow_tpu_bucket_cap",
+            "largest batch bucket currently served (shrinks after device OOM)",
+            labels)
+        self.m_bucket_cap.set(self.buckets.max_batch())
+        #: armed chaos faults consumed by the next device steps (fault plugin)
+        self._chaos: deque = deque()
+        #: set on a deadline miss: the jitted step is rebuilt before the next
+        #: dispatch (stale executables on a wedged device are not trusted)
+        self._needs_rebuild = False
+        #: recycled single-thread watchdog executors for deadlined steps —
+        #: NEVER the shared default executor: an abandoned (hung) step would
+        #: wedge a thread that _prep and every other runner also need. A
+        #: miss discards the executor with its wedged thread; the no-miss
+        #: path reuses them, so steady state costs one submit per step.
+        self._watchdog_free: list = []
+        self._watchdog_lock = threading.Lock()
 
     @staticmethod
     def _resolve_auto_flags(cfg, devices, mesh_spec, packed: bool = False):
@@ -614,17 +708,161 @@ class ModelRunner:
     def _shape_key(self, padded: dict[str, np.ndarray]) -> tuple:
         return tuple((k, v.shape) for k, v in sorted(padded.items()))
 
-    def _note_shape(self, padded: dict[str, Any]) -> None:
-        """First-seen-shape accounting for the compile counter. Guarded by
-        the flash lock: ``infer_sync`` (executor threads) and ``infer`` (the
-        event loop) race here, and an unsynchronized check-then-add both
-        double-counts compiles and can miss ``_disable_flash``'s concurrent
+    def _note_shape(self, padded: dict[str, Any]) -> bool:
+        """First-seen-shape accounting for the compile counter; returns True
+        when the shape is new (the step will compile — the deadline watchdog
+        grants it the first-compile budget). Guarded by the flash lock:
+        ``infer_sync`` (executor threads) and ``infer`` (the event loop) race
+        here, and an unsynchronized check-then-add both double-counts
+        compiles and can miss ``_disable_flash``'s concurrent
         ``_seen_shapes.clear()`` (which holds the same lock)."""
         key = self._shape_key(padded)
         with self._flash_lock:
             if key not in self._seen_shapes:
                 self._seen_shapes.add(key)
                 self.m_compiles.inc()
+                return True
+        return False
+
+    # -- self-healing: chaos hook / watchdog / OOM degradation --------------
+
+    def inject_step_fault(self, kind: str, duration_s: float = 0.0) -> None:
+        """Arm a one-shot fault consumed by the NEXT device step: ``hang``
+        wedges the step for ``duration_s`` of dead time (as a stuck device
+        sync would) so the deadline watchdog fires; ``oom`` raises a
+        fabricated RESOURCE_EXHAUSTED so the degradation path runs. Driven by
+        the fault plugin's processor wrapper (kinds ``hang`` / ``oom``)."""
+        if kind not in ("hang", "oom"):
+            raise ConfigError(f"unknown step fault kind {kind!r} (hang/oom)")
+        self._chaos.append((kind, float(duration_s)))
+
+    def _apply_chaos(self) -> None:
+        """Executor-thread side of ``inject_step_fault``."""
+        try:
+            kind, duration_s = self._chaos.popleft()
+        except IndexError:
+            return
+        if kind == "hang":
+            import time
+
+            time.sleep(duration_s if duration_s > 0 else 30.0)
+        else:
+            raise InjectedOom()
+
+    def _step_blocking(self, padded: dict[str, Any]):
+        """The full blocking device step (chaos hook -> dispatch -> fetch).
+        Always runs on an executor/watchdog thread: warm shapes cost one
+        sub-ms hop, cold shapes compile for seconds-to-minutes on remote
+        backends — never on the event loop — and the deadline watchdog can
+        abandon the thread if the device wedges."""
+        self._apply_chaos()
+        return jax.device_get(self._dispatch(padded))
+
+    def _deadline_for(self, first_compile: bool) -> Optional[float]:
+        """Per-step watchdog budget; first-compile shapes get the scaled-up
+        budget so a cold bucket isn't misread as a hung device."""
+        if self.step_deadline_s is None:
+            return None
+        return self.step_deadline_first_s if first_compile else self.step_deadline_s
+
+    def _deadline_miss_error(self, fut, staged, deadline: float) -> StepDeadlineExceeded:
+        """Bookkeeping for an abandoned step: count the miss, mark the runner
+        UNHEALTHY (recovery probes re-admit it), schedule a jit rebuild, and
+        wire the zombie future so its staging buffers recycle — and its
+        eventual exception is retrieved — whenever the wedged step ends."""
+        self.m_deadline_miss.inc()
+        self._needs_rebuild = True
+        self.health.mark_unhealthy(f"step exceeded its {deadline:.3g}s deadline")
+
+        def _reap(f) -> None:
+            try:
+                f.exception()
+            except Exception:
+                pass
+            self._release_staging(staged)
+
+        fut.add_done_callback(_reap)
+        return StepDeadlineExceeded(
+            f"device step exceeded its {deadline:.3g}s deadline "
+            "(runner marked unhealthy; batch nacked for redelivery)")
+
+    def _note_oom(self, bucket_rows: int) -> bool:
+        """Device OOM on a ``bucket_rows`` bucket: permanently cap the batch
+        grid below it (``arkflow_tpu_bucket_cap``) and announce the cap so
+        live coalescers stop merging emissions the device can't hold.
+        Returns True when a smaller bucket exists (the caller re-chunks and
+        retries); False when even the smallest bucket OOMs — the runner goes
+        UNHEALTHY and the failure surfaces."""
+        self.m_oom.inc()
+        with self._flash_lock:
+            capped = self.buckets.capped(bucket_rows)
+            if capped is None:
+                self.health.mark_unhealthy(
+                    f"device OOM at the smallest bucket ({bucket_rows} rows)")
+                return False
+            self.buckets = capped
+        cap = capped.max_batch()
+        self.m_bucket_cap.set(cap)
+        bucket_cap_bus().announce(cap)
+        self.health.mark_degraded(f"device OOM: batch buckets capped at {cap}")
+        logger.warning(
+            "[%s] device OOM on a %d-row bucket: batch grid capped at %d; "
+            "splitting the batch and retrying", self.family.name, bucket_rows, cap)
+        return True
+
+    def _rebuild_if_needed(self) -> None:
+        """Rebuild the jitted step after a deadline miss: executables cached
+        across a device hang are not trusted, so the next (probe) step
+        recompiles from scratch. Shares the flash lock with the other
+        cfg-flip/rebuild paths so concurrent probes rebuild once."""
+        if not self._needs_rebuild:
+            return
+        with self._flash_lock:
+            if not self._needs_rebuild:
+                return
+            self._needs_rebuild = False
+            self._seen_shapes.clear()
+            self._build_jitted()
+        self.m_rebuilds.inc()
+        logger.warning("[%s] rebuilt jitted step after a deadline miss",
+                       self.family.name)
+
+    def _heal_gate_sync(self) -> None:
+        """Admission control for the runner's own callers (pool dispatch has
+        its own health-aware pick): DEAD fails fast; UNHEALTHY waits out the
+        probe backoff, claims the probe, and rebuilds if needed — the step
+        that follows IS the recovery probe."""
+        import time
+
+        h = self.health
+        while True:
+            if h.state == HEALTH_DEAD:
+                raise RunnerDead(f"runner {h.name} is DEAD; not serving")
+            if h.join_or_begin_probe():
+                break
+            time.sleep(min(max(h.seconds_until_probe(), 0.01), 0.5))
+        self._rebuild_if_needed()
+
+    async def _heal_gate(self) -> None:
+        """Async twin of ``_heal_gate_sync`` (never blocks the event loop)."""
+        h = self.health
+        while True:
+            if h.state == HEALTH_DEAD:
+                raise RunnerDead(f"runner {h.name} is DEAD; not serving")
+            if h.join_or_begin_probe():
+                break
+            await asyncio.sleep(min(max(h.seconds_until_probe(), 0.01), 0.5))
+        self._rebuild_if_needed()
+
+    def health_report(self) -> dict:
+        """JSON-able health snapshot for the engine's ``/health`` endpoint."""
+        rep = self.health.report()
+        rep["model"] = self.family.name
+        if self.device_label is not None:
+            rep["device"] = self.device_label
+        rep["bucket_cap"] = self.buckets.max_batch()
+        rep["deadline_misses"] = int(self.m_deadline_miss.value)
+        return rep
 
     # -- execution ---------------------------------------------------------
 
@@ -633,6 +871,9 @@ class ModelRunner:
 
         Batches larger than the biggest bucket are chunked and the outputs
         re-concatenated (upstream buffers may over-merge under backpressure).
+        With ``step_deadline`` set the step runs on a watchdog thread and is
+        abandoned on a miss; a device OOM caps the bucket grid and retries
+        the batch split to the next-smaller bucket.
         """
         import time
 
@@ -647,19 +888,74 @@ class ModelRunner:
             ]
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
 
+        self._heal_gate_sync()
         padded, n = self._prep(inputs)
-        self._note_shape(padded)
+        first = self._note_shape(padded)
+        bucket_rows = next(iter(padded.values())).shape[0]
+        deadline = self._deadline_for(first)
         t0 = time.perf_counter()
         try:
-            out = jax.device_get(self._dispatch(padded))
-        finally:
-            # outputs fetched => the device consumed the inputs; the staging
-            # buffers are safe to recycle for the next step
+            if deadline is None:
+                out = self._step_blocking(padded)
+            else:
+                out = self._run_deadlined_sync(padded, deadline)
+        except StepDeadlineExceeded:
+            raise  # the zombie step still owns the staging buffers
+        except Exception as e:
+            # step ended (with an error) => the device consumed the inputs
             self._release_staging(padded)
+            if is_oom_error(e):
+                if not self.packed and self._note_oom(bucket_rows):
+                    return self.infer_sync(inputs)  # re-chunk on the capped grid
+                if self.packed:
+                    # can't re-slice a packed layout here; cap the grid so the
+                    # REDELIVERED batch repacks against servable buckets
+                    self._note_oom(bucket_rows)
+            raise
+        # outputs fetched => the staging buffers are safe to recycle
+        self._release_staging(padded)
         if not self._in_warmup:  # warmup compiles are not traffic latency
             self.m_infer.observe(time.perf_counter() - t0)
             self.m_rows.inc(n)
+        self.health.mark_success()
         return {k: np.asarray(v)[:n] for k, v in out.items()}
+
+    def _borrow_watchdog(self):
+        """A single-thread executor for one deadlined step: reused across
+        steps in the no-miss steady state, discarded (with its wedged
+        thread) on a miss. Concurrent steps each borrow their own, so the
+        watchdog never serializes in-flight work."""
+        import concurrent.futures
+
+        with self._watchdog_lock:
+            if self._watchdog_free:
+                return self._watchdog_free.pop()
+        return concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="arkflow-step-watchdog")
+
+    def _return_watchdog(self, ex) -> None:
+        with self._watchdog_lock:
+            self._watchdog_free.append(ex)
+
+    def _run_deadlined_sync(self, padded: dict[str, Any], deadline: float):
+        """Run the blocking step on a dedicated watchdog thread so a hang can
+        be abandoned (the thread itself cannot be killed — its executor is
+        dropped and the thread left to finish or leak; the shared default
+        executor is never at risk)."""
+        import concurrent.futures
+
+        ex = self._borrow_watchdog()
+        fut = ex.submit(self._step_blocking, padded)
+        try:
+            out = fut.result(timeout=deadline)
+        except concurrent.futures.TimeoutError:
+            ex.shutdown(wait=False)  # abandon: the wedged thread goes with it
+            raise self._deadline_miss_error(fut, padded, deadline) from None
+        except Exception:
+            self._return_watchdog(ex)  # step ended: its thread is idle again
+            raise
+        self._return_watchdog(ex)
+        return out
 
     def _prep(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
         """Host-side stage: pad to buckets + validate masks (CPU only)."""
@@ -774,8 +1070,11 @@ class ModelRunner:
                 for i in range(0, n_total, mb)
             ])
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
+        await self._heal_gate()
         padded, n = await loop.run_in_executor(None, self._prep, inputs)
-        self._note_shape(padded)
+        first = self._note_shape(padded)
+        bucket_rows = next(iter(padded.values())).shape[0]
+        deadline = self._deadline_for(first)
         staged = padded  # host staging buffers, recycled once the step ends
 
         self._ensure_sems()
@@ -785,17 +1084,31 @@ class ModelRunner:
                 t0 = time.perf_counter()
                 self._track_dispatch(t0)
                 try:
-                    # dispatch always runs in the executor: warm shapes cost one
-                    # sub-ms thread hop, cold shapes (or a jit swapped mid-flight
-                    # by _disable_flash) compile for seconds-to-minutes on remote
-                    # backends — never on the event loop, where a compile would
-                    # stall every stream plus the health/metrics endpoints
-                    out = await loop.run_in_executor(None, self._dispatch, padded)
-                    out = await loop.run_in_executor(None, jax.device_get, out)
+                    if deadline is None:
+                        out = await loop.run_in_executor(
+                            None, self._step_blocking, padded)
+                    else:
+                        # the watchdog: wait for the step, not forever, and
+                        # run it on a borrowed DEDICATED thread — abandoning
+                        # a hung step on the shared default executor would
+                        # wedge a thread _prep and every other runner need.
+                        # On a miss the thread cannot be interrupted: its
+                        # executor is dropped with it and the miss handler
+                        # reaps the step's eventual result.
+                        ex = self._borrow_watchdog()
+                        cfut = ex.submit(self._step_blocking, padded)
+                        fut = asyncio.wrap_future(cfut, loop=loop)
+                        done, _ = await asyncio.wait({fut}, timeout=deadline)
+                        if not done:
+                            ex.shutdown(wait=False)
+                            raise self._deadline_miss_error(cfut, staged, deadline)
+                        self._return_watchdog(ex)  # step ended; thread idle
+                        out = fut.result()
                 finally:
-                    t1 = time.perf_counter()
-                    self._track_complete(t1)
-                self.m_infer.observe(t1 - t0)
+                    # an abandoned step counts as complete for duty-cycle
+                    # accounting: the device is no longer doing useful work
+                    self._track_complete(time.perf_counter())
+                self.m_infer.observe(time.perf_counter() - t0)
                 return out
 
         try:
@@ -811,11 +1124,27 @@ class ModelRunner:
                     out = await step(padded)
             else:
                 out = await step(padded)
+        except StepDeadlineExceeded:
+            staged = None  # the abandoned step still owns the buffers; the
+            raise          # miss handler recycles them when it finally ends
+        except Exception as e:
+            if is_oom_error(e):
+                if not self.packed and self._note_oom(bucket_rows):
+                    # the finally below recycles the staging buffers (the
+                    # step ended with an error, so nothing reads them)
+                    return await self.infer(inputs)  # re-chunk on the capped grid
+                if self.packed:
+                    # can't re-slice a packed layout here; cap the grid so the
+                    # REDELIVERED batch repacks against servable buckets
+                    self._note_oom(bucket_rows)
+            raise
         finally:
             # after device_get nothing can still read the host buffers —
             # even a CPU backend that aliased them zero-copy is done
-            self._release_staging(staged)
+            if staged is not None:
+                self._release_staging(staged)
         self.m_rows.inc(n)
+        self.health.mark_success()
         return {k: np.asarray(v)[:n] for k, v in out.items()}
 
     def warmup(self, seq_lens: Optional[list[int]] = None) -> int:

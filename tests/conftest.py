@@ -64,3 +64,14 @@ def pytest_pyfunc_call(pyfuncitem):
         asyncio.run(fn(**kwargs))
         return True
     return None
+
+
+def pytest_runtest_teardown(item):
+    """The bucket-cap bus is process-global (a device OOM in one test must
+    not shrink coalescer grids built by later tests): forget announced caps
+    after every test."""
+    try:
+        from arkflow_tpu.tpu.bucketing import bucket_cap_bus
+    except ImportError:
+        return
+    bucket_cap_bus().reset()

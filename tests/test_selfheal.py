@@ -1,0 +1,690 @@
+"""Self-healing device serving: step deadlines, OOM degradation, health
+state machine, health-aware pool dispatch, and the engine/stream satellites.
+
+Runs on the virtual-CPU platform conftest pins; device faults are injected
+through ``ModelRunner.inject_step_fault`` (the same hook the fault plugin's
+``hang``/``oom`` kinds drive), so every test exercises the REAL watchdog /
+degradation machinery rather than mocks of it.
+"""
+
+import asyncio
+import json
+import time
+
+import numpy as np
+import pytest
+
+from arkflow_tpu.errors import ConfigError, RunnerDead, StepDeadlineExceeded
+from arkflow_tpu.obs import global_registry
+from arkflow_tpu.tpu.bucketing import BucketPolicy, MicroBatchCoalescer, bucket_cap_bus
+from arkflow_tpu.tpu.health import (
+    DEAD,
+    DEGRADED,
+    HEALTHY,
+    UNHEALTHY,
+    HealthConfig,
+    RunnerHealth,
+)
+
+TINY_BERT = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+             "ffn": 64, "max_positions": 64, "num_labels": 2}
+
+FAST_HEALTH = HealthConfig(probe_backoff_s=0.05, probe_backoff_cap_s=0.2)
+
+
+def _tiny_inputs(n=3, seq=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"input_ids": rng.randint(1, 512, (n, seq)).astype(np.int32),
+            "attention_mask": np.ones((n, seq), np.int32)}
+
+
+def _runner(**kw):
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    kw.setdefault("buckets", BucketPolicy((2, 4), (16,)))
+    kw.setdefault("health_config", FAST_HEALTH)
+    return ModelRunner("bert_classifier", TINY_BERT, **kw)
+
+
+# -- health state machine (unit, fake clock) -------------------------------
+
+
+def test_health_state_machine_transitions():
+    now = [100.0]
+    h = RunnerHealth(HealthConfig(probe_backoff_s=1.0, probe_backoff_cap_s=4.0,
+                                  dead_after=3), clock=lambda: now[0])
+    assert h.state == HEALTHY and h.available()
+
+    h.mark_degraded("bucket capped")
+    assert h.state == DEGRADED and h.available()
+    h.mark_success()
+    assert h.state == HEALTHY
+
+    h.mark_unhealthy("hung step")
+    assert h.state == UNHEALTHY
+    assert not h.available()  # mid-backoff
+    assert h.seconds_until_probe() == pytest.approx(1.0)
+    now[0] += 1.1
+    assert h.probe_due() and h.available()
+    assert h.try_begin_probe()
+    assert not h.try_begin_probe()  # exclusive claim
+    assert h.join_or_begin_probe()  # ...but the claimed batch itself joins
+    assert not h.available()  # no piling on mid-probe
+    h.mark_success()
+    assert h.state == HEALTHY
+
+    # consecutive incidents double the backoff, then DEAD at dead_after
+    h.mark_unhealthy("i1")
+    assert h.seconds_until_probe() == pytest.approx(1.0)
+    h.mark_unhealthy("i2")
+    assert h.seconds_until_probe() == pytest.approx(2.0)
+    h.mark_unhealthy("i3")
+    assert h.state == DEAD
+    assert not h.available() and not h.try_begin_probe()
+    h.mark_success()  # terminal
+    assert h.state == DEAD
+    rep = h.report()
+    assert rep["state"] == "dead" and rep["consecutive_failures"] == 3
+
+
+def test_health_gauge_and_report():
+    g = global_registry().gauge("test_selfheal_gauge", "x", {"t": "1"})
+    now = [0.0]
+    h = RunnerHealth(HealthConfig(probe_backoff_s=2.0), gauge=g,
+                     clock=lambda: now[0])
+    assert g.value == 0
+    h.mark_degraded("cap")
+    assert g.value == 1
+    h.mark_unhealthy("hang")
+    assert g.value == 2
+    assert h.report()["next_probe_in_s"] == pytest.approx(2.0)
+    h.mark_success()
+    assert g.value == 0
+
+
+def test_failed_generic_probe_releases_claim_and_rearms_backoff():
+    """Regression: a probe that fails with a generic (non-self-marking)
+    error must release the probe claim via mark_unhealthy — a leaked claim
+    would fence the member forever (try_begin_probe stuck False)."""
+    now = [0.0]
+    h = RunnerHealth(HealthConfig(probe_backoff_s=1.0, probe_backoff_cap_s=8.0,
+                                  dead_after=0), clock=lambda: now[0])
+    h.mark_unhealthy("hang")
+    now[0] = 1.1
+    assert h.try_begin_probe()
+    # the probe batch fails with a raw XLA error: pool dispatch marks here
+    # (exactly what ModelRunnerPool._note_member_failure does)
+    h.mark_unhealthy("step failed: boom")
+    assert not h._probing  # claim released
+    assert not h.try_begin_probe()  # backoff re-armed (2s now)
+    now[0] = 3.3
+    assert h.try_begin_probe()  # probed again — never fenced for good
+    h.mark_success()
+    assert h.state == HEALTHY
+
+
+def test_join_gate_admits_exactly_one_handed_off_batch():
+    """Only the batch whose claim was made upstream joins an in-flight
+    probe; other concurrent callers wait instead of piling onto a
+    maybe-still-hung device."""
+    now = [0.0]
+    h = RunnerHealth(HealthConfig(probe_backoff_s=1.0), clock=lambda: now[0])
+    h.mark_unhealthy("hang")
+    now[0] = 1.1
+    assert h.try_begin_probe()  # pool dispatch claims for batch X
+    assert h.join_or_begin_probe()  # batch X arrives at the runner's gate
+    assert not h.join_or_begin_probe()  # concurrent caller Y: waits
+    assert not h.join_or_begin_probe()  # concurrent caller Z: waits
+    h.mark_success()
+    assert h.join_or_begin_probe()  # healthy again: everyone serves
+    # a gate-begun probe (no upstream claim) admits only its owner
+    h.mark_unhealthy("hang again")
+    now[0] = 3.3
+    assert h.join_or_begin_probe()  # first gate caller begins the probe
+    assert not h.join_or_begin_probe()  # second waits (no handoff pending)
+
+
+def test_pool_note_member_failure_classification():
+    """Self-marking errors (deadline / OOM / dead) must not double-count
+    incidents; generic errors must mark so the claim can't leak."""
+    _need_devices(2)
+    pool = _pool()
+    h = pool.members[0].health
+    pool._note_member_failure(0, StepDeadlineExceeded("missed"))
+    pool._note_member_failure(0, RuntimeError("RESOURCE_EXHAUSTED: big"))
+    pool._note_member_failure(0, RunnerDead("gone"))
+    assert h.state == HEALTHY  # runner would have marked these itself
+    pool._note_member_failure(0, RuntimeError("boom"))
+    assert h.state == UNHEALTHY
+    assert h.report()["consecutive_failures"] == 1
+
+
+def test_health_config_validation():
+    assert HealthConfig.from_config(None) == HealthConfig()
+    cfg = HealthConfig.from_config({"probe_backoff": "100ms", "dead_after": 0})
+    assert cfg.probe_backoff_s == pytest.approx(0.1) and cfg.dead_after == 0
+    with pytest.raises(ConfigError):
+        HealthConfig.from_config({"probe_backoff": "0s"})
+    with pytest.raises(ConfigError):
+        HealthConfig.from_config({"dead_after": -1})
+    with pytest.raises(ConfigError):
+        HealthConfig.from_config([1, 2])
+
+
+def test_health_never_dead_when_dead_after_zero():
+    now = [0.0]
+    h = RunnerHealth(HealthConfig(probe_backoff_s=0.1, probe_backoff_cap_s=1.0,
+                                  dead_after=0), clock=lambda: now[0])
+    for _ in range(50):
+        h.mark_unhealthy("x")
+    assert h.state == UNHEALTHY  # backoff capped, never DEAD
+
+
+# -- bucket capping (policy / coalescer / bus) -----------------------------
+
+
+def test_bucket_policy_capped():
+    pol = BucketPolicy((4, 8, 16), (32,))
+    assert pol.capped(16).batch_buckets == (4, 8)
+    assert pol.capped(5).batch_buckets == (4,)
+    assert pol.capped(16).seq_buckets == (32,)
+    assert pol.capped(4) is None  # nothing smaller than the smallest
+
+
+def test_coalescer_cap_shrinks_target():
+    c = MicroBatchCoalescer([4, 8, 16])
+    assert c.target == 16
+    c.cap(8)
+    assert c.buckets == (4, 8) and c.target == 8
+    c.cap(3)  # below the smallest bucket: the cap becomes the only bucket
+    assert c.buckets == (3,) and c.target == 3
+
+
+def test_bucket_cap_bus_fans_out_and_applies_to_late_registrations():
+    bus = bucket_cap_bus()
+    a = MicroBatchCoalescer([2, 4, 8])
+    bus.register(a)
+    bus.announce(4)
+    assert a.target == 4
+    late = MicroBatchCoalescer([2, 4, 8])
+    bus.register(late)  # registered AFTER the cap: still applied
+    assert late.target == 4
+    bus.announce(8)  # caps only ratchet down
+    assert bus.cap == 4 and a.target == 4
+
+
+def test_memory_buffer_coalescer_registers_with_bus():
+    from arkflow_tpu.components import Resource, ensure_plugins_loaded
+    from arkflow_tpu.components.registry import build_component
+
+    ensure_plugins_loaded()
+    buf = build_component(
+        "buffer",
+        {"type": "memory", "capacity": 64, "timeout": "5ms",
+         "coalesce": {"batch_buckets": [2, 4], "deadline": "5ms"}},
+        Resource())
+    bucket_cap_bus().announce(2)
+    assert buf._coalescer.target == 2  # the runner's OOM cap reached it
+
+
+# -- runner: step deadline watchdog ----------------------------------------
+
+
+def test_deadline_miss_marks_unhealthy_then_probe_recovers():
+    r = _runner(step_deadline_s=0.25, step_deadline_first_s=30.0)
+    r.warmup()
+    inputs = _tiny_inputs()
+    misses0 = r.m_deadline_miss.value
+
+    r.inject_step_fault("hang", 2.0)
+    with pytest.raises(StepDeadlineExceeded):
+        asyncio.run(r.infer(inputs))
+    assert r.health.state == UNHEALTHY
+    assert r.m_deadline_miss.value == misses0 + 1
+
+    # the next call waits out the probe backoff, rebuilds the jitted step,
+    # probes with the real batch, and recovers
+    out = asyncio.run(r.infer(inputs))
+    assert out["logits"].shape == (3, 2)
+    assert r.health.state == HEALTHY
+    assert r.m_rebuilds.value >= 1
+
+
+def test_deadline_miss_sync_path():
+    r = _runner(step_deadline_s=0.25, step_deadline_first_s=30.0)
+    r.warmup()
+    r.inject_step_fault("hang", 2.0)
+    with pytest.raises(StepDeadlineExceeded):
+        r.infer_sync(_tiny_inputs())
+    assert r.health.state == UNHEALTHY
+    out = r.infer_sync(_tiny_inputs())  # waits backoff, probes, recovers
+    assert out["logits"].shape == (3, 2)
+    assert r.health.state == HEALTHY
+
+
+def test_first_compile_deadline_scale():
+    """An unseen shape gets the scaled-up budget: a hang longer than
+    step_deadline but shorter than step_deadline_first does NOT miss on the
+    first (compiling) step — and the default first budget is 10x."""
+    r = _runner(step_deadline_s=0.2)
+    assert r.step_deadline_first_s == pytest.approx(2.0)  # 10x default
+    # the metric family is label-shared across runners in this session
+    # (registry dedupes on (name, labels)): assert the DELTA
+    misses0 = r.m_deadline_miss.value
+    r.inject_step_fault("hang", 0.5)
+    out = asyncio.run(r.infer(_tiny_inputs()))  # cold shape: 2.0s budget
+    assert out["logits"].shape == (3, 2)
+    assert r.health.state == HEALTHY and r.m_deadline_miss.value == misses0
+    # same shape again is warm: the same hang now trips the 0.2s deadline
+    r.inject_step_fault("hang", 0.5)
+    with pytest.raises(StepDeadlineExceeded):
+        asyncio.run(r.infer(_tiny_inputs()))
+
+
+def test_step_deadline_validation():
+    with pytest.raises(ConfigError):
+        _runner(step_deadline_s=0.0)
+    with pytest.raises(ConfigError):
+        _runner(step_deadline_s=1.0, step_deadline_first_s=-1.0)
+    with pytest.raises(ConfigError):
+        _runner().inject_step_fault("explode")
+
+
+# -- runner: OOM degradation -----------------------------------------------
+
+
+def test_oom_splits_to_smaller_bucket_and_caps_grid():
+    r = _runner()
+    r.warmup()
+    ref = asyncio.run(r.infer(_tiny_inputs()))
+    caps0 = bucket_cap_bus().cap
+    assert caps0 is None and r.m_bucket_cap.value == 4
+
+    r.inject_step_fault("oom")
+    out = asyncio.run(r.infer(_tiny_inputs()))  # 3 rows -> bucket 4 OOMs
+    # the batch was split to the next-smaller bucket and still served,
+    # byte-identically (row partitioning never changes per-row math)
+    np.testing.assert_array_equal(np.asarray(ref["logits"]),
+                                  np.asarray(out["logits"]))
+    assert r.buckets.batch_buckets == (2,)  # permanently capped
+    assert r.m_bucket_cap.value == 2
+    assert r.m_oom.value >= 1
+    assert bucket_cap_bus().cap == 2  # announced to coalescers
+    assert r.health.state == HEALTHY  # degradation healed by the successful retry
+
+
+def test_oom_at_smallest_bucket_surfaces_and_marks_unhealthy():
+    r = _runner(buckets=BucketPolicy((2,), (16,)))
+    r.warmup()
+    r.inject_step_fault("oom")
+    with pytest.raises(Exception) as ei:
+        asyncio.run(r.infer(_tiny_inputs(n=2)))
+    assert "RESOURCE_EXHAUSTED" in str(ei.value)
+    assert r.health.state == UNHEALTHY
+
+
+def test_oom_sync_path_splits_and_caps():
+    r = _runner()
+    r.warmup()
+    r.inject_step_fault("oom")
+    out = r.infer_sync(_tiny_inputs())
+    assert out["logits"].shape == (3, 2)
+    assert r.buckets.batch_buckets == (2,)
+
+
+def test_is_oom_error_signatures():
+    from arkflow_tpu.tpu.runner import InjectedOom, is_oom_error
+
+    assert is_oom_error(InjectedOom())
+    assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: while allocating"))
+    assert is_oom_error(RuntimeError("Out of memory allocating 2.1G"))
+    assert is_oom_error(MemoryError())
+    assert not is_oom_error(RuntimeError("shape mismatch"))
+
+
+# -- runner: DEAD is terminal ----------------------------------------------
+
+
+def test_runner_dead_after_consecutive_incidents():
+    r = _runner(step_deadline_s=0.1, step_deadline_first_s=10.0,
+                health_config=HealthConfig(probe_backoff_s=0.01,
+                                           probe_backoff_cap_s=0.05,
+                                           dead_after=2))
+    r.warmup()
+    # the rebuild after incident 1 clears the seen-shape set, so the probe
+    # step runs under the FIRST-COMPILE budget — shrink it (post-warmup,
+    # where the real compiles need the generous one) so the hang exceeds
+    # it too and incident 2 fires
+    r.step_deadline_first_s = 0.3
+    for _ in range(2):
+        r.inject_step_fault("hang", 1.0)
+        with pytest.raises(StepDeadlineExceeded):
+            asyncio.run(r.infer(_tiny_inputs()))
+    assert r.health.state == DEAD
+    with pytest.raises(RunnerDead):
+        asyncio.run(r.infer(_tiny_inputs()))
+    with pytest.raises(RunnerDead):
+        r.infer_sync(_tiny_inputs())
+
+
+# -- pool: health-aware dispatch -------------------------------------------
+
+
+def _need_devices(n):
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices")
+
+
+def _pool(**kw):
+    from arkflow_tpu.tpu.pool import ModelRunnerPool
+
+    kw.setdefault("buckets", BucketPolicy((2, 4), (16,)))
+    kw.setdefault("health_config", FAST_HEALTH)
+    return ModelRunnerPool("bert_classifier", TINY_BERT, pool_size=2, **kw)
+
+
+def test_pool_skips_unhealthy_member_and_readmits_after_probe():
+    _need_devices(2)
+    pool = _pool(health_config=HealthConfig(probe_backoff_s=0.3,
+                                            probe_backoff_cap_s=1.0))
+    pool.warmup()
+    inputs = _tiny_inputs(n=2)
+    pool.members[0].health.mark_unhealthy("induced incident")
+
+    skipped0, probes0 = pool.m_skipped.value, pool.m_probes.value
+    d0 = pool.m_dispatch[0].value
+    async def burst(n):
+        return await asyncio.gather(*[pool.infer(inputs) for _ in range(n)])
+    asyncio.run(burst(4))
+    # mid-backoff: every batch went to the healthy member, provably skipping
+    assert pool.m_dispatch[0].value == d0
+    assert pool.m_skipped.value >= skipped0 + 4
+    assert pool.members[0].health.state == UNHEALTHY
+
+    time.sleep(0.35)  # probe window opens
+    asyncio.run(burst(2))
+    assert pool.m_probes.value >= probes0 + 1
+    assert pool.members[0].health.state == HEALTHY  # re-admitted
+    assert pool.m_dispatch[0].value > d0
+
+
+def test_pool_waits_out_whole_pool_backoff_instead_of_failing():
+    _need_devices(2)
+    pool = _pool()
+    pool.warmup()
+    for m in pool.members:
+        m.health.mark_unhealthy("induced")
+    out = asyncio.run(asyncio.wait_for(pool.infer(_tiny_inputs(n=2)), timeout=10))
+    assert out["logits"].shape == (2, 2)
+    assert any(m.health.state == HEALTHY for m in pool.members)
+
+
+def test_pool_generic_member_error_marks_unhealthy():
+    _need_devices(2)
+    pool = _pool()
+    pool.warmup()
+    real = pool.members[0].infer
+    state = {"armed": True}
+
+    async def flaky(inputs):
+        if state["armed"]:
+            state["armed"] = False
+            raise RuntimeError("raw XLA fault")
+        return await real(inputs)
+
+    pool.members[0].infer = flaky
+    pool._rr = 0  # deterministic first pick
+    out = asyncio.run(pool.infer(_tiny_inputs(n=2)))
+    assert out["logits"].shape == (2, 2)
+    assert pool.members[0].health.state == UNHEALTHY  # marked by the pool
+
+
+def test_pool_all_dead_raises_runner_dead():
+    _need_devices(2)
+    pool = _pool(health_config=HealthConfig(probe_backoff_s=0.01, dead_after=1))
+    for m in pool.members:
+        m.health.mark_unhealthy("gone")
+        assert m.health.state == DEAD
+    with pytest.raises(RunnerDead):
+        asyncio.run(pool.infer(_tiny_inputs(n=2)))
+    with pytest.raises(RunnerDead):
+        pool.infer_sync(_tiny_inputs(n=2))
+
+
+# -- stream e2e: deadline miss nacks, redelivery converges ------------------
+
+
+def test_stream_deadline_miss_nacks_and_redelivery_heals():
+    """Single-runner stream (no pool failover to mask the miss): the hung
+    step trips the watchdog, the batch NACKS (at-least-once), and the
+    redelivered batch lands after the probe window — zero loss, HEALTHY."""
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.runtime import build_stream
+
+    cfg = StreamConfig.from_mapping({
+        "name": "sh-deadline",
+        "input": {
+            "type": "fault",
+            "redeliver_unacked": True,
+            "inner": {"type": "memory", "messages": ["r0", "r1", "r2"]},
+        },
+        "pipeline": {
+            "thread_num": 1,
+            "max_delivery_attempts": 5,
+            "processors": [
+                {"type": "fault",
+                 "faults": [{"kind": "hang", "at": 1, "duration": "3s"}],
+                 "inner": {"type": "tpu_inference", "model": "bert_classifier",
+                           "model_config": TINY_BERT, "max_seq": 16,
+                           "batch_buckets": [2], "seq_buckets": [16],
+                           "warmup": True,
+                           "step_deadline": "250ms",
+                           "step_deadline_first": "30s",
+                           "health": {"probe_backoff": "50ms"}}},
+            ],
+        },
+        "output": {"type": "drop"},
+    })
+    stream = build_stream(cfg)
+    runner = stream.pipeline.processors[0]._inner.runner
+    misses0 = runner.m_deadline_miss.value
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=60))
+    assert stream.m_rows_out.value == 3  # nothing lost
+    assert stream.m_errors.value >= 1  # the miss took the nack path
+    assert runner.m_deadline_miss.value == misses0 + 1
+    assert runner.health.state == HEALTHY
+
+
+# -- satellites ------------------------------------------------------------
+
+
+def test_reorder_stuck_batches_nacked_at_shutdown():
+    """Regression (stream.py _do_output): a seq gap at shutdown nacks the
+    stuck batches instead of just logging them."""
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Ack
+    from arkflow_tpu.plugins.input.memory import MemoryInput
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import Pipeline, Stream
+    from arkflow_tpu.runtime.stream import _DONE, _WorkItem
+
+    nacked = []
+
+    class RecAck(Ack):
+        def __init__(self, tag):
+            self.tag = tag
+
+        async def ack(self):
+            pass
+
+        async def nack(self):
+            nacked.append(self.tag)
+
+    stream = Stream(MemoryInput([]), Pipeline([]), DropOutput(),
+                    thread_num=1, name="sh-reorder")
+
+    async def go():
+        q = asyncio.Queue()
+        b = MessageBatch.new_binary([b"stuck"])
+        # seqs 1 and 2 arrive, seq 0 never does (its worker died): both are
+        # stuck behind the gap when the shutdown sentinel lands
+        await q.put((1, _WorkItem(b, RecAck("s1")), [b], None))
+        await q.put((2, _WorkItem(b, RecAck("s2")), [b], None))
+        await q.put(_DONE)
+        await stream._do_output(q)
+
+    asyncio.run(asyncio.wait_for(go(), timeout=10))
+    assert sorted(nacked) == ["s1", "s2"]
+
+
+def test_close_error_log_names_failing_stage(caplog):
+    """Satellite (stream.py _close_all): the 'error during close' line now
+    says WHICH component failed."""
+    import logging
+
+    from arkflow_tpu.plugins.input.memory import MemoryInput
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import Pipeline, Stream
+
+    class BadCloseOutput(DropOutput):
+        async def close(self):
+            raise RuntimeError("boom on close")
+
+    stream = Stream(MemoryInput([b"x"]), Pipeline([]), BadCloseOutput(),
+                    thread_num=1, name="sh-close")
+    with caplog.at_level(logging.ERROR, logger="arkflow.stream"):
+        asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=10))
+    msgs = [rec.getMessage() for rec in caplog.records
+            if "error during close" in rec.getMessage()]
+    assert msgs, "close error was not logged"
+    assert any("output" in m and "BadCloseOutput" in m for m in msgs)
+
+
+def test_engine_health_reports_restarts_and_runner_health():
+    """Satellite (engine /health): per-stream restart counts + remaining
+    budget, plus per-runner device health when a stream has runners."""
+    import aiohttp
+
+    from arkflow_tpu.config import EngineConfig
+    from arkflow_tpu.runtime.engine import Engine
+
+    crash_fault = {"kind": "crash", "at": 2}
+    cfg = EngineConfig.from_mapping({
+        "streams": [{
+            "name": "sh-health",
+            "input": {"type": "fault",
+                      "inner": {"type": "memory",
+                                "messages": ["h0", "h1", "h2"]},
+                      "faults": [crash_fault]},
+            "pipeline": {"thread_num": 1, "processors": []},
+            "output": {"type": "drop"},
+            # generous budget + slow backoff: the stream crash-loops for the
+            # whole polling window instead of exhausting the budget (and
+            # tearing the health server down) before the first poll lands
+            "restart": {"max_retries": 60, "backoff": "500ms"},
+        }],
+        "health_check": {"enabled": True, "host": "127.0.0.1", "port": 18097},
+    })
+    engine = Engine(cfg)
+
+    async def go():
+        run_task = asyncio.create_task(engine.run())
+        try:
+            deadline = time.monotonic() + 20
+            body = None
+            async with aiohttp.ClientSession() as s:
+                while time.monotonic() < deadline:
+                    await asyncio.sleep(0.1)
+                    try:
+                        async with s.get("http://127.0.0.1:18097/health") as r:
+                            body = json.loads(await r.text())
+                    except aiohttp.ClientError:
+                        continue
+                    sh = body.get("stream_health", {}).get("sh-health", {})
+                    if sh.get("restarts", 0) >= 1:
+                        break
+            sh = body["stream_health"]["sh-health"]
+            assert sh["restarts"] >= 1
+            assert sh["restart_budget_remaining"] == 60 - sh["restarts"]
+        finally:
+            engine.shutdown()
+            await asyncio.wait_for(run_task, timeout=15)
+
+    asyncio.run(go())
+
+
+def test_engine_readiness_503_when_all_runners_dead():
+    """Readiness reports per-runner health instead of the old binary flag:
+    a stream whose device runners are all DEAD flips readiness to 503."""
+    import aiohttp
+
+    from arkflow_tpu.config import EngineConfig
+    from arkflow_tpu.runtime.engine import Engine
+
+    cfg = EngineConfig.from_mapping({
+        "streams": [{"name": "unused",
+                     "input": {"type": "memory", "messages": []},
+                     "pipeline": {"thread_num": 1, "processors": []},
+                     "output": {"type": "drop"}}],
+        "health_check": {"enabled": True, "host": "127.0.0.1", "port": 18098},
+    })
+    engine = Engine(cfg)
+    engine._ready = True
+
+    class FakeRunner:
+        def health_report(self):
+            return [{"state": "dead", "device": "0"},
+                    {"state": "dead", "device": "1"}]
+
+    class FakeProc:
+        runner = FakeRunner()
+
+    class FakePipeline:
+        processors = [FakeProc()]
+
+    class FakeStream:
+        name = "dead-pool"
+        pipeline = FakePipeline()
+
+    engine.streams = [FakeStream()]
+    reports = engine._stream_runner_reports(engine.streams[0])
+    assert [r["state"] for r in reports] == ["dead", "dead"]
+    health = engine.stream_health()
+    assert health["dead-pool"]["runners"] == reports
+
+    async def go():
+        await engine._start_health_server()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get("http://127.0.0.1:18098/readiness") as r:
+                    assert r.status == 503
+                    body = json.loads(await r.text())
+            assert body["status"] == "not_ready"
+            assert body["dead_runner_streams"] == {"dead-pool": 2}
+            assert body["runners"]["dead-pool"] == ["dead", "dead"]
+        finally:
+            await engine._runner.cleanup()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=15))
+
+
+def test_chaos_soak_tool_fast_mode_smoke():
+    """Satellite (tools/chaos_soak.py): the seeded soak runner converges in
+    fast mode and emits a PASS verdict with the self-healing evidence."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        from chaos_soak import run_soak
+    finally:
+        sys.path.pop(0)
+
+    verdict = run_soak(seconds=90.0, seed=7, pool=2, fast=True)
+    assert verdict["pass"], verdict
+    assert verdict["missing_rows"] == 0
+    assert verdict["deadline_misses"] >= 1  # the hang fault really fired
+    assert verdict["oom_events"] >= 1  # the oom fault really fired
+    assert all(s in ("healthy", "degraded") for s in verdict["runner_states"])

@@ -1,0 +1,235 @@
+"""Seeded, time-bounded chaos soak for the self-healing device layer.
+
+Builds a pipeline from the SAME config surface production uses — a
+fault-wrapped redelivering broker input, a memory buffer with bucket-exact
+coalescing, and a ``device_pool`` tpu_inference stage whose steps are
+chaos-injected (``hang`` / ``oom`` via the fault plugin's schedule, plus a
+``disconnect`` on the input) — then runs it to completion under a wall-clock
+bound and emits a JSON verdict:
+
+    python tools/chaos_soak.py --fast            # tier-1 smoke (~seconds)
+    python tools/chaos_soak.py --seconds 120 --seed 3 --messages 256
+
+Verdict fields: ``pass`` plus the evidence — delivered/missing/duplicate row
+counts, deadline misses, OOM events, probe/skip counters, and the final
+per-runner health states. PASS means zero message loss AND every runner ended
+HEALTHY/DEGRADED (the at-least-once + self-healing acceptance invariant);
+exit code 1 otherwise. Same seed => same fault schedule => same verdict.
+
+Runs on the virtual-CPU JAX platform by default (no TPU needed); set
+ARKFLOW_SOAK_KEEP_ENV=1 to target whatever backend the environment provides.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _soak_config(seed: int, messages: int, pool: int, fast: bool) -> dict:
+    """The soak pipeline as a plain config mapping (the fault schedule and
+    every knob exercised here are exactly what a YAML stream would use)."""
+    import random
+
+    rng = random.Random(seed)
+    payloads = [f"soak row {i:04d} {rng.randrange(1 << 30):08x}"
+                for i in range(messages)]
+    # fault positions are seeded so a verdict is reproducible bit-for-bit;
+    # fast (smoke) mode pins them early — with only ~12 messages a seeded
+    # position can exceed the total number of processor calls, and a fault
+    # that never fires makes the smoke's "it really fired" assertions flaky
+    if fast:
+        hang_at, oom_at, disconnect_at = 2, 3, 4
+    else:
+        hang_at = rng.randrange(2, max(3, messages // 4))
+        oom_at = hang_at + rng.randrange(2, 5)
+        disconnect_at = rng.randrange(2, max(3, messages // 2))
+    tiny_model = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+                  "ffn": 64, "max_positions": 64, "num_labels": 2}
+    return {
+        "name": "chaos-soak",
+        "input": {
+            "type": "fault",
+            "seed": seed,
+            "redeliver_unacked": True,
+            "reconnect": {"initial_delay_ms": 1, "max_delay_ms": 50},
+            "inner": {"type": "memory", "messages": payloads},
+            "faults": [
+                {"kind": "disconnect", "at": disconnect_at},
+                {"kind": "latency", "every": 7, "duration": "1ms"},
+            ],
+        },
+        "buffer": {
+            "type": "memory",
+            "capacity": 64,
+            "timeout": "20ms",
+            # bucket-exact coalescing: the OOM cap announcement must shrink
+            # this grid mid-run (that's part of what the soak proves)
+            "coalesce": {"batch_buckets": [2, 4], "deadline": "10ms"},
+        },
+        "pipeline": {
+            "thread_num": 2,
+            "max_delivery_attempts": 8,
+            "processors": [{
+                "type": "fault",
+                "seed": seed,
+                "faults": [
+                    {"kind": "hang", "at": hang_at, "duration": "5s"},
+                    {"kind": "oom", "at": oom_at},
+                ] + ([] if fast else [
+                    {"kind": "hang", "rate": 0.02, "times": 2, "duration": "5s"},
+                    {"kind": "oom", "rate": 0.02, "times": 2},
+                ]),
+                "inner": {
+                    "type": "tpu_inference",
+                    "model": "bert_classifier",
+                    "model_config": tiny_model,
+                    "max_seq": 16,
+                    "batch_buckets": [2, 4],
+                    "seq_buckets": [16],
+                    "device_pool": pool,
+                    "warmup": True,  # honest steady-state step deadlines
+                    "step_deadline": "500ms",
+                    "step_deadline_first": "60s",
+                    "health": {"probe_backoff": "100ms",
+                               "probe_backoff_cap": "2s"},
+                },
+            }],
+        },
+        "output": {"type": "drop"},
+    }
+
+
+def run_soak(seconds: float = 60.0, seed: int = 7, messages: int = 48,
+             pool: int = 2, fast: bool = False) -> dict:
+    """Run the soak in-process and return the verdict dict. Importing this
+    function does NOT touch jax; the caller owns platform env setup."""
+    import asyncio
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.obs import global_registry
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import build_stream
+    from arkflow_tpu.tpu.bucketing import bucket_cap_bus
+
+    ensure_plugins_loaded()
+    if fast:
+        messages = min(messages, 12)
+    cfg = StreamConfig.from_mapping(_soak_config(seed, messages, pool, fast))
+    stream = build_stream(cfg)
+
+    delivered: list[bytes] = []
+
+    class _Collect(DropOutput):
+        async def write(self, batch: MessageBatch) -> None:
+            await super().write(batch)
+            delivered.extend(batch.to_binary())
+
+    stream.output = _Collect()
+    pool_runner = stream.pipeline.processors[0]._inner.runner
+
+    async def bounded_run() -> bool:
+        cancel = asyncio.Event()
+        task = asyncio.create_task(stream.run(cancel))
+        done, _ = await asyncio.wait({task}, timeout=seconds)
+        if done:
+            task.result()  # surface a crashed stream as a FAIL with traceback
+            return False
+        cancel.set()  # wall-clock budget exhausted: drain and report wedged
+        try:
+            await asyncio.wait_for(task, timeout=15.0)
+        except (asyncio.TimeoutError, Exception):
+            task.cancel()
+        return True
+
+    async def heal_drain() -> None:
+        """The finite message set may EOF inside a probe-backoff window;
+        live traffic would keep probing, so emulate a few more batches until
+        every member converges (bounded)."""
+        import numpy as np
+
+        members = getattr(pool_runner, "members", [pool_runner])
+        probe_inputs = {"input_ids": np.ones((2, 16), np.int32),
+                        "attention_mask": np.ones((2, 16), np.int32)}
+        deadline = time.monotonic() + 10
+        while (any(m.health.state not in ("healthy", "degraded") for m in members)
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.06)
+            try:
+                await pool_runner.infer(probe_inputs)
+            except Exception:
+                pass  # a failed probe re-arms the backoff; keep draining
+
+    t0 = time.monotonic()
+    try:
+        wedged = asyncio.run(bounded_run())
+        if not wedged:
+            asyncio.run(heal_drain())
+    finally:
+        bucket_cap_bus().reset()  # in-process callers get a clean slate
+    elapsed = time.monotonic() - t0
+
+    expected = {f"soak row {i:04d}".encode() for i in range(messages)}
+    got = [p.split(b" ", 3)[:3] for p in delivered]
+    got_keys = [b" ".join(k) for k in got]
+    missing = sorted(expected - set(got_keys))
+    duplicates = len(got_keys) - len(set(got_keys))
+    reg = global_registry()
+    states = [m.health.state for m in getattr(pool_runner, "members", [pool_runner])]
+    healthy_end = all(s in ("healthy", "degraded") for s in states)
+    verdict = {
+        "pass": bool(not wedged and not missing and healthy_end),
+        "wedged": wedged,
+        "elapsed_s": round(elapsed, 3),
+        "seed": seed,
+        "messages": messages,
+        "delivered_rows": len(got_keys),
+        "missing_rows": len(missing),
+        "duplicate_rows": duplicates,
+        "deadline_misses": reg.sum_values("arkflow_tpu_step_deadline_misses"),
+        "oom_events": reg.sum_values("arkflow_tpu_oom_total"),
+        "rebuilds": reg.sum_values("arkflow_tpu_runner_rebuilds_total"),
+        "pool_failovers": reg.sum_values("arkflow_tpu_pool_failover_total"),
+        "pool_probes": reg.sum_values("arkflow_tpu_pool_probes_total"),
+        "pool_skips": reg.sum_values("arkflow_tpu_pool_skipped_unhealthy_total"),
+        "runner_states": states,
+    }
+    if missing:
+        verdict["missing_sample"] = [m.decode() for m in missing[:5]]
+    return verdict
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seconds", type=float, default=60.0,
+                    help="wall-clock bound for the whole soak (default 60)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--messages", type=int, default=48)
+    ap.add_argument("--device-pool", type=int, default=2)
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 smoke mode: <=12 messages, deterministic "
+                         "faults only")
+    args = ap.parse_args(argv)
+
+    import os
+
+    if os.environ.get("ARKFLOW_SOAK_KEEP_ENV") != "1":
+        # pin the virtual-CPU platform BEFORE jax loads (run_soak imports it)
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from arkflow_tpu.utils.cleanenv import pin_cpu_env
+
+        pin_cpu_env(os.environ, n_devices=max(2, args.device_pool))
+
+    verdict = run_soak(seconds=args.seconds, seed=args.seed,
+                       messages=args.messages, pool=args.device_pool,
+                       fast=args.fast)
+    print(json.dumps(verdict, indent=2))
+    return 0 if verdict["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
