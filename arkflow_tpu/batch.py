@@ -43,6 +43,14 @@ META_TIMESTAMP = "__meta_timestamp"
 META_INGEST_TIME = "__meta_ingest_time"
 META_EXT_PREFIX = "__meta_ext_"
 
+#: overload-control metadata (runtime/overload.py): an ABSOLUTE wall-clock
+#: deadline in epoch millis stamped by whoever owns the request's latency
+#: budget, and an integer priority band for brownout-surviving traffic.
+#: Both live under the ext prefix so they survive redelivery (unlike
+#: ``__meta_ingest_time``, which every delivery re-stamps).
+META_EXT_DEADLINE_MS = META_EXT_PREFIX + "deadline_ms"
+META_EXT_PRIORITY = META_EXT_PREFIX + "priority"
+
 #: The fixed (non-ext) metadata columns, in canonical order (ref lib.rs:53-63).
 META_COLUMNS = (
     META_SOURCE,
@@ -317,6 +325,61 @@ class MessageBatch:
     def with_ext_metadata_per_row(self, key: str, values: Sequence[str | None]) -> "MessageBatch":
         """Per-row free-form metadata (ref lib.rs ``with_ext_metadata_per_row``)."""
         return self.with_column(META_EXT_PREFIX + key, pa.array(list(values), type=pa.string()))
+
+    # -- overload-control metadata (runtime/overload.py) -------------------
+
+    def with_deadline_ms(self, deadline_unix_ms: float) -> "MessageBatch":
+        """Stamp an ABSOLUTE delivery deadline (epoch millis). Survives
+        redelivery — the remaining budget genuinely shrinks with every
+        retry, unlike a TTL measured from the re-stamped ingest time."""
+        return self.with_ext_metadata({META_EXT_DEADLINE_MS[len(META_EXT_PREFIX):]:
+                                       str(int(deadline_unix_ms))})
+
+    def with_priority(self, priority: int) -> "MessageBatch":
+        """Stamp the batch's admission-priority band (higher = survives
+        brownouts longer; bands >= the controller's ``protect_priority``
+        are never queue-shed)."""
+        return self.with_ext_metadata({META_EXT_PRIORITY[len(META_EXT_PREFIX):]:
+                                       str(int(priority))})
+
+    def deadline_unix_ms(self) -> float | None:
+        """Absolute deadline from ``__meta_ext_deadline_ms``, or None."""
+        raw = self.get_meta(META_EXT_DEADLINE_MS)
+        if raw is None:
+            return None
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            return None
+
+    def remaining_deadline_ms(self, default_ttl_ms: float | None = None,
+                              now_ms: float | None = None) -> float | None:
+        """Remaining latency budget in ms (possibly negative = already
+        stale). The absolute deadline column wins; else ``default_ttl_ms``
+        is measured from ``__meta_ingest_time``; None when the batch
+        carries no deadline at all (admission skips the deadline check)."""
+        if now_ms is None:
+            now_ms = time.time() * 1000.0
+        absolute = self.deadline_unix_ms()
+        if absolute is not None:
+            return absolute - now_ms
+        if default_ttl_ms is not None:
+            ingest = self.get_meta(META_INGEST_TIME)
+            if ingest is not None:
+                return default_ttl_ms - (now_ms - float(ingest))
+            return default_ttl_ms
+        return None
+
+    def priority_band(self, default: int = 0) -> int:
+        """Admission priority from ``__meta_ext_priority`` (int-parsed
+        string column), falling back to the stream's configured default."""
+        raw = self.get_meta(META_EXT_PRIORITY)
+        if raw is None:
+            return default
+        try:
+            return int(float(raw))
+        except (TypeError, ValueError):
+            return default
 
     def metadata_columns(self) -> list[str]:
         return [n for n in self.column_names if is_meta_column(n)]

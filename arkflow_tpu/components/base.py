@@ -163,6 +163,14 @@ class Resource:
 
 
 class Input(abc.ABC):
+    #: cooperative overload backpressure (runtime/overload.py): True means
+    #: the stream's read loop PAUSES this source while the controller is
+    #: shedding, instead of fetching batches it would immediately nack —
+    #: right for pull-based brokers that keep the backlog on their side
+    #: (kafka, redis list, nats). Push servers (http) reject with 429
+    #: instead; the unit-test memory source stays False unless opted in.
+    pause_on_overload = False
+
     @abc.abstractmethod
     async def connect(self) -> None: ...
 

@@ -39,9 +39,24 @@ class PipelineConfig:
     #: quarantine-on-first-failure behavior; >1 lets transient processing
     #: failures heal through broker/nack redelivery.
     max_delivery_attempts: int = 1
+    #: stage-queue depth between input/buffer and the workers; 0 keeps the
+    #: historical ``thread_num * 4`` (ref stream/mod.rs:90-93)
+    queue_size: int = 0
+    #: per-batch latency budget in millis, measured from ingest time unless
+    #: an absolute ``__meta_ext_deadline_ms`` column overrides it; setting
+    #: it turns on deadline-aware admission (see runtime/overload.py)
+    deadline_ms: Optional[float] = None
+    #: default admission-priority band for batches without a
+    #: ``__meta_ext_priority`` column
+    priority: int = 0
+    #: parsed ``pipeline.overload`` controller knobs (OverloadConfig), or
+    #: None when overload control is fully disabled
+    overload: Optional[object] = None
 
     @classmethod
     def from_mapping(cls, m: Mapping[str, Any]) -> "PipelineConfig":
+        from arkflow_tpu.runtime.overload import OverloadConfig
+
         if not isinstance(m, Mapping):
             raise ConfigError("pipeline config must be a mapping")
         threads = m.get("thread_num", 0)
@@ -58,11 +73,34 @@ class PipelineConfig:
         if not isinstance(attempts, int) or attempts < 1:
             raise ConfigError(
                 f"pipeline.max_delivery_attempts must be an int >= 1, got {attempts!r}")
+        qsize = m.get("queue_size", 0)
+        if not isinstance(qsize, int) or isinstance(qsize, bool) or qsize < 0:
+            raise ConfigError(
+                f"pipeline.queue_size must be a non-negative int, got {qsize!r}")
+        deadline = m.get("deadline_ms")
+        if deadline is not None:
+            if isinstance(deadline, bool) or not isinstance(deadline, (int, float)) \
+                    or deadline <= 0:
+                raise ConfigError(
+                    f"pipeline.deadline_ms must be a positive number, got {deadline!r}")
+            deadline = float(deadline)
+        priority = m.get("priority", 0)
+        if not isinstance(priority, int) or isinstance(priority, bool):
+            raise ConfigError(f"pipeline.priority must be an int, got {priority!r}")
+        overload = OverloadConfig.from_config(
+            m.get("overload"), deadline_ms=deadline, priority=priority)
         return cls(thread_num=threads, processors=[dict(p) for p in procs],
-                   process_pool=pool, max_delivery_attempts=attempts)
+                   process_pool=pool, max_delivery_attempts=attempts,
+                   queue_size=qsize, deadline_ms=deadline, priority=priority,
+                   overload=overload)
 
     def effective_threads(self) -> int:
         return self.thread_num if self.thread_num > 0 else (os.cpu_count() or 1)
+
+    def effective_queue_size(self) -> int:
+        """Stage-queue depth: configured ``queue_size`` or the historical
+        ``thread_num * 4`` default."""
+        return self.queue_size if self.queue_size > 0 else self.effective_threads() * 4
 
 
 @dataclass

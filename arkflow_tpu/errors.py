@@ -55,6 +55,17 @@ class Disconnection(ArkError):
         super().__init__(msg)
 
 
+class Overloaded(ArkError):
+    """The engine is shedding load: admission rejected the batch/request
+    before the worker queue (deadline cannot be met, queue window full, or
+    priority band browned out). Carries the controller's drain estimate so
+    transports can tell clients when to retry (HTTP 429 ``Retry-After``)."""
+
+    def __init__(self, msg: str = "overloaded", retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 class StepDeadlineExceeded(ArkError):
     """A device step missed its ``step_deadline``: the runner treats the
     device as hung (UNHEALTHY), abandons the in-flight step, and the stream

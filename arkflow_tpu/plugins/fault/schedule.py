@@ -17,6 +17,11 @@ fires; ``oom`` makes the next step raise a RESOURCE_EXHAUSTED so the bucket
 degradation path runs. Both are armed on the wrapped processor's runner when
 it has one, and fall back to in-wrapper stall/error otherwise.
 
+``burst`` (input family only) multiplies offered load: each firing read is
+amplified ``factor``× (default 4) by requeuing duplicate deliveries behind
+it — with ``every: 1`` the wrapper sustains factor× the inner source's rate,
+which is how the overload-control soak drives admission past saturation.
+
 ``times`` bounds the total number of firings (0 = unlimited; defaults to 1
 for ``at`` triggers, unlimited otherwise). Firing state lives inside the
 spec's own config dict (``_state``), which the engine shares across stream
@@ -42,6 +47,7 @@ class FaultSpec:
     rate: float = 0.0
     times: int = 1  # 0 = unlimited
     duration_s: float = 0.0
+    factor: int = 4  # burst only: offered-load multiplier per firing read
     match: Optional[bytes] = None
     message: str = ""
     #: mutable firing state, shared with the config dict so it survives
@@ -96,12 +102,16 @@ def parse_faults(cfg_list: Any, allowed_kinds: frozenset[str],
             # an unbounded hang would wedge chaos runs with no deadline
             # configured; 30s is "long enough to trip any sane watchdog"
             duration = "30s"
+        factor = raw.get("factor", 4)
+        if kind == "burst" and (not isinstance(factor, int) or factor < 2):
+            raise ConfigError(f"fault {family}: burst 'factor' must be an int >= 2")
         spec = FaultSpec(
             kind=kind,
             at=at,
             every=every,
             rate=rate,
             times=times,
+            factor=factor,
             duration_s=parse_duration(duration) if duration is not None else 0.0,
             match=match.encode() if isinstance(match, str) else match,
             message=str(raw.get("message", f"chaos: injected {kind}")),

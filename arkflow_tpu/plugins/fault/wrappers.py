@@ -17,6 +17,9 @@ reproducible faults on its operation stream:
         - {kind: ack_fail, at: 2}             # that read's ack raises once
         - {kind: ack_dup, at: 5}              # that read's ack fires twice
         - {kind: crash, at: 9}                # non-Ark error: crashes stream
+        - {kind: burst, every: 1, times: 0, factor: 4}   # 4x offered load:
+                                              # every read amplified with 3
+                                              # duplicate deliveries
 
     output:
       type: fault
@@ -54,6 +57,7 @@ from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import (
     Ack,
     Input,
+    NoopAck,
     Output,
     Processor,
     Resource,
@@ -75,7 +79,8 @@ from arkflow_tpu.errors import (
 from arkflow_tpu.plugins.fault.schedule import FaultSchedule, FaultSpec, parse_faults
 
 INPUT_KINDS = frozenset(
-    {"latency", "disconnect", "error", "crash", "ack_fail", "ack_dup", "reconnect_fail"})
+    {"latency", "disconnect", "error", "crash", "ack_fail", "ack_dup",
+     "reconnect_fail", "burst"})
 OUTPUT_KINDS = frozenset({"latency", "error", "crash"})
 PROCESSOR_KINDS = frozenset({"latency", "error", "crash", "hang", "oom"})
 
@@ -90,7 +95,7 @@ _ACK_KINDS = frozenset({"ack_fail", "ack_dup"})
 #: kinds evaluated against the read-op counter; reconnect_fail is excluded —
 #: it runs on its own reconnect counter, and letting read ops see it would
 #: silently consume its firing budget before any reconnect happens
-_READ_KINDS = _PRE_READ_KINDS | _ACK_KINDS
+_READ_KINDS = _PRE_READ_KINDS | _ACK_KINDS | frozenset({"burst"})
 
 
 def _batch_bytes(batch: MessageBatch) -> bytes:
@@ -218,6 +223,13 @@ class FaultInjectingInput(Input):
             except EndOfInput:
                 self._inner_eof = True
                 continue
+            for spec in due:
+                if spec.kind == "burst":
+                    # offered-load multiplier: factor-1 duplicate deliveries
+                    # ride the requeue path behind the real read (their acks
+                    # are NoopAck — the genuine ack settles exactly once)
+                    for _ in range(spec.factor - 1):
+                        self._requeue(batch, NoopAck())
             ack_specs = tuple(s for s in due if s.kind in _ACK_KINDS)
             return self._hand_out(batch, ack, ack_specs)
 

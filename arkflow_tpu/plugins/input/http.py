@@ -21,13 +21,14 @@ Config:
 from __future__ import annotations
 
 import asyncio
+import math
 from typing import Optional
 
 from aiohttp import web
 
 from arkflow_tpu.batch import MessageBatch
 from arkflow_tpu.components import Ack, Input, NoopAck, Resource, register_input
-from arkflow_tpu.errors import ConfigError, EndOfInput
+from arkflow_tpu.errors import ConfigError, EndOfInput, Overloaded
 from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
 from arkflow_tpu.utils.auth import AuthConfig, Authenticator
 from arkflow_tpu.utils.rate_limiter import TokenBucket
@@ -49,6 +50,12 @@ class HttpInput(Input):
         self._queue: Optional[asyncio.Queue] = None
         self._runner: Optional[web.AppRunner] = None
         self._closed = False
+        #: stream's overload controller (runtime/overload.py); a push server
+        #: cannot pause remote clients, so it sheds at the socket with 429
+        self._overload = None
+
+    def attach_overload_controller(self, controller) -> None:
+        self._overload = controller
 
     async def connect(self) -> None:
         self._queue = asyncio.Queue(maxsize=QUEUE_BOUND)
@@ -73,12 +80,38 @@ class HttpInput(Input):
     async def _options(self, _req) -> web.Response:
         return web.Response(status=204, headers=self._cors_headers())
 
+    @staticmethod
+    def _retry_after(seconds: float) -> dict:
+        # Retry-After is delta-seconds, integer, >= 1 (RFC 9110 §10.2.3);
+        # an unsatisfiable deficit (inf) caps at an hour rather than lying
+        if not math.isfinite(seconds):
+            seconds = 3600.0
+        return {"Retry-After": str(max(1, math.ceil(seconds)))}
+
+    def _check_admission(self) -> None:
+        """Raise :class:`Overloaded` when this request must be 429'd.
+        Engine-side overload is checked BEFORE the token bucket so the
+        rejection doesn't also burn one of the client's rate-limit tokens;
+        either way the error carries the exact ``Retry-After`` a
+        well-behaved client should honor instead of hammering blind."""
+        if self._overload is not None and self._overload.should_reject():
+            raise Overloaded("overloaded",
+                             retry_after_s=self._overload.retry_after_s())
+        if self.limiter is not None and not self.limiter.try_acquire():
+            raise Overloaded("rate limited",
+                             retry_after_s=self.limiter.time_until(1.0))
+
     async def _handle(self, req: web.Request) -> web.Response:
         client = req.remote or "?"
         if self.auth is not None and not self.auth.check(req.headers.get("Authorization"), client):
             return web.Response(status=401, headers=self._cors_headers())
-        if self.limiter is not None and not self.limiter.try_acquire():
-            return web.Response(status=429, headers=self._cors_headers())
+        try:
+            self._check_admission()
+        except Overloaded as e:
+            return web.Response(
+                status=429, text=str(e),
+                headers={**self._cors_headers(),
+                         **self._retry_after(e.retry_after_s)})
         body = await req.read()
         try:
             self._queue.put_nowait(body)

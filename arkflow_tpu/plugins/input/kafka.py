@@ -96,6 +96,10 @@ SESSION_TIMEOUT_MS = 10000
 
 
 class KafkaInput(Input):
+    #: cooperative overload backpressure: pausing the fetch loop leaves the
+    #: backlog on the broker (offsets uncommitted, nothing to nack back)
+    pause_on_overload = True
+
     def __init__(self, brokers: str, topics: list[str], group: str,
                  partitions: Optional[list[int]], start: str, batch_size: int, codec=None,
                  client_kwargs: Optional[dict] = None,

@@ -19,10 +19,14 @@ from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
 
 
 class MemoryInput(Input):
-    def __init__(self, messages: list[bytes], codec=None):
+    def __init__(self, messages: list[bytes], codec=None,
+                 pause_on_overload: bool = False):
         self._initial = list(messages)
         self.codec = codec
         self._queue: deque[bytes] = deque()
+        # opt-in (config `pause_on_overload: true`): lets tests exercise the
+        # stream's cooperative-pause path without a broker
+        self.pause_on_overload = pause_on_overload
 
     async def connect(self) -> None:
         self._queue = deque(self._initial)
@@ -54,4 +58,5 @@ def _build(config: dict, resource: Resource) -> MemoryInput:
             import json
 
             encoded.append(json.dumps(m).encode())
-    return MemoryInput(encoded, codec=build_codec(config.get("codec"), resource))
+    return MemoryInput(encoded, codec=build_codec(config.get("codec"), resource),
+                       pause_on_overload=bool(config.get("pause_on_overload", False)))

@@ -105,6 +105,10 @@ class JetStreamAck(Ack):
 class NatsJetStreamInput(Input):
     """Durable pull consumer: fetch batches, ack after downstream write."""
 
+    #: pull consumer: pausing fetches under overload leaves the backlog in
+    #: the JetStream stream (core NATS has no backlog, so NatsInput doesn't)
+    pause_on_overload = True
+
     def __init__(self, url: str, stream: str, durable: str, batch_size: int,
                  deliver_policy: str = "all", filter_subject: Optional[str] = None,
                  codec=None, client_kwargs: Optional[dict] = None):

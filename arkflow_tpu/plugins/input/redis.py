@@ -46,6 +46,10 @@ class RedisInput(Input):
         self.patterns = patterns
         self.keys = keys
         self.codec = codec
+        # list mode is pull-based (LPOP): pausing the fetch loop under
+        # overload leaves the backlog on the server. Pub/sub has no broker
+        # backlog — pausing would only pile frames into the local queue.
+        self.pause_on_overload = mode == "list"
         # client_config is the single source of connection truth (url/
         # password/cluster/urls); the bare params exist for direct construction
         self.client_config = client_config or {"url": url, "password": password}

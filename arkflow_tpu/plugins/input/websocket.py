@@ -24,6 +24,11 @@ from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
 
 
 class WebsocketInput(Input):
+    #: cooperative overload backpressure: pausing read() fills the bounded
+    #: frame queue, the reader task blocks on put, and TCP flow control
+    #: pushes back on the remote server — no frames are dropped locally
+    pause_on_overload = True
+
     def __init__(self, url: str, codec=None):
         self.url = url
         self.codec = codec

@@ -1,3 +1,4 @@
 from arkflow_tpu.runtime.pipeline import Pipeline  # noqa: F401
+from arkflow_tpu.runtime.overload import OverloadConfig, OverloadController  # noqa: F401
 from arkflow_tpu.runtime.stream import Stream, build_stream  # noqa: F401
 from arkflow_tpu.runtime.engine import Engine  # noqa: F401

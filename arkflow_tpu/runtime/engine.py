@@ -73,6 +73,12 @@ class Engine:
             runners = self._stream_runner_reports(s)
             if runners:
                 info["runners"] = runners
+            ctrl = getattr(s, "overload", None)
+            if ctrl is not None:
+                try:
+                    info["overload"] = ctrl.report()
+                except Exception:  # introspection must not break /health
+                    logger.exception("overload report failed for stream %s", s.name)
             out[s.name] = info
         return out
 

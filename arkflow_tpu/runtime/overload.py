@@ -1,0 +1,498 @@
+"""Overload control: deadline-aware admission, AIMD queue windows, shedding.
+
+Once offered load exceeds device throughput, an engine that admits every
+batch turns a traffic burst into unbounded queue wait (BENCH_r05's
+``saturated_queueing_p99_ms`` ≈ 10.7s) and eventual memory pressure. In the
+latency-bound serving regime (Answer Fast / TSP, PAPERS.md) finishing a
+stale request is strictly worse than shedding it up front, so the engine
+protects itself from its own traffic with three cooperating mechanisms, all
+owned by the per-stream :class:`OverloadController`:
+
+1. **Deadline-aware admission** — each batch carries a remaining TTL
+   (``pipeline.deadline_ms`` measured from ``__meta_ingest_time``, or an
+   absolute ``__meta_ext_deadline_ms`` column stamped upstream). A batch
+   whose remaining budget cannot cover the *predicted* queue wait + step
+   time is shed before the worker queue — nacked for redelivery or routed
+   to ``error_output`` tagged ``overloaded``, never silently dropped.
+2. **Adaptive admission window (AIMD)** — the effective worker-queue window
+   shrinks multiplicatively when observed queue wait trends above the
+   deadline budget and re-grows additively on recovery, replacing the fixed
+   ``thread_num * 4`` depth as the only limit. Batches beyond the window are
+   shed (``reason=queue``) instead of queued into the latency cliff.
+3. **Strict-priority bands** — ``pipeline.priority`` (or a per-batch
+   ``__meta_ext_priority`` column) splits traffic into integer bands.
+   Bands at/above ``protect_priority`` are never queue-shed (health probes
+   and premium traffic survive brownouts); under *persistent* overload at
+   the minimum window the admit floor escalates one band at a time
+   (``reason=priority``) and relaxes on recovery.
+
+Cooperative backpressure rides on the controller's state: pull-based inputs
+(kafka/redis/nats — anything marked ``pause_on_overload``) pause consumption
+instead of fetching-then-nacking, and the HTTP input rejects with 429 +
+``Retry-After`` computed from the controller's estimated drain time.
+
+Observability: ``arkflow_overload_state`` (0 admit / 1 throttle / 2 shed),
+``arkflow_overload_window``, ``arkflow_shed_total{reason=deadline|queue|
+priority}``, ``arkflow_overload_paused_seconds_total``; the engine's
+``/health`` embeds :meth:`OverloadController.report` per stream.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Mapping, Optional
+
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.obs import global_registry
+
+#: ``arkflow_overload_state`` gauge values
+STATE_ADMIT = 0  #: window at max, queue wait within budget
+STATE_THROTTLE = 1  #: window shrunk, recovering additively
+STATE_SHED = 2  #: queue wait over budget; admission actively shedding
+
+_STATE_NAMES = {STATE_ADMIT: "admit", STATE_THROTTLE: "throttle", STATE_SHED: "shed"}
+
+SHED_REASONS = ("deadline", "queue", "priority")
+
+
+@dataclass
+class OverloadConfig:
+    """Knobs for the per-stream overload controller (``pipeline.overload``).
+
+    ``enabled`` defaults to True whenever ``pipeline.deadline_ms`` is set —
+    configuring a deadline without admission control would only measure the
+    overload, not prevent it. ``max_window`` is filled by the stream from
+    the effective worker-queue size when left at 0.
+    """
+
+    enabled: bool = False
+    #: per-batch TTL measured from ingest time; None = only absolute
+    #: ``__meta_ext_deadline_ms`` columns are deadline-enforced
+    deadline_ms: Optional[float] = None
+    #: default priority band for batches without a priority column
+    priority: int = 0
+    #: bands >= this are never queue-shed (strict-priority protection)
+    protect_priority: int = 1
+    max_window: int = 0  # 0 -> stream fills with its queue size
+    min_window: int = 1
+    #: fraction of the deadline budget the p50 queue wait may consume before
+    #: the AIMD controller starts shrinking the window
+    headroom: float = 0.5
+    #: absolute queue-wait target (seconds) when no deadline is configured
+    target_wait_s: float = 0.1
+    decrease: float = 0.5  # multiplicative window shrink factor
+    increase: float = 1.0  # additive window re-growth per healthy interval
+    interval_s: float = 0.1  # min spacing between AIMD adjustments
+    #: consecutive over-budget intervals at min_window before the admit
+    #: floor escalates one priority band (brownout); 0 disables escalation
+    escalate_after: int = 3
+
+    @classmethod
+    def from_config(cls, m: Any, *, deadline_ms: Optional[float] = None,
+                    priority: int = 0) -> Optional["OverloadConfig"]:
+        """Parse ``pipeline.overload`` (+ the flat ``deadline_ms``/``priority``
+        keys the issue names). Returns None when overload control is fully
+        disabled — no mapping, no deadline, and no explicit enable."""
+        from arkflow_tpu.utils.duration import parse_duration
+
+        if m is None:
+            m = {}
+        elif isinstance(m, bool):
+            # `overload: false` is an explicit opt-out that beats the
+            # deadline_ms auto-enable (the deadline still tags batches)
+            m = {"enabled": m}
+        elif not isinstance(m, Mapping):
+            raise ConfigError("pipeline.overload must be a mapping or boolean")
+
+        # same validation discipline as config.py: a wrong type raises
+        # ConfigError naming the key, and bools never pass as numbers
+        def _int(key: str, default: int) -> int:
+            v = m.get(key, default)
+            if isinstance(v, bool) or not isinstance(v, int):
+                raise ConfigError(f"overload.{key} must be an int, got {v!r}")
+            return v
+
+        def _num(key: str, default: float) -> float:
+            v = m.get(key, default)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise ConfigError(f"overload.{key} must be a number, got {v!r}")
+            return float(v)
+
+        enabled = bool(m.get("enabled", True)) if (m or deadline_ms is not None) else False
+        cfg = cls(
+            enabled=enabled,
+            deadline_ms=deadline_ms,
+            priority=priority,
+            protect_priority=_int("protect_priority", 1),
+            max_window=_int("max_window", 0),
+            min_window=_int("min_window", 1),
+            headroom=_num("headroom", 0.5),
+            target_wait_s=(parse_duration(m["target_wait"])
+                           if m.get("target_wait") is not None else 0.1),
+            decrease=_num("decrease", 0.5),
+            increase=_num("increase", 1.0),
+            # None-checked, not truthiness: `interval: 0` legitimately means
+            # adjust on every dequeue (and `target_wait: 0` must reach
+            # validate() to be rejected, not silently swapped for 0.1)
+            interval_s=(parse_duration(m["interval"])
+                        if m.get("interval") is not None else 0.1),
+            escalate_after=_int("escalate_after", 3),
+        )
+        cfg.validate()
+        return cfg if (cfg.enabled or m) else None
+
+    def validate(self) -> None:
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ConfigError("pipeline.deadline_ms must be > 0")
+        if self.min_window < 1:
+            raise ConfigError("overload.min_window must be >= 1")
+        if self.max_window < 0:
+            raise ConfigError("overload.max_window must be >= 0")
+        if not (0.0 < self.headroom <= 1.0):
+            raise ConfigError("overload.headroom must be in (0, 1]")
+        if not (0.0 < self.decrease < 1.0):
+            raise ConfigError("overload.decrease must be in (0, 1)")
+        if self.increase <= 0:
+            raise ConfigError("overload.increase must be > 0")
+        if self.target_wait_s <= 0:
+            raise ConfigError("overload.target_wait must be > 0")
+        if self.interval_s < 0:
+            raise ConfigError("overload.interval must be >= 0")
+        if self.escalate_after < 0:
+            raise ConfigError("overload.escalate_after must be >= 0")
+        if self.enabled and self.priority >= self.protect_priority:
+            # with the default band protected, admit() never queue-sheds and
+            # the brownout floor caps below it — the AIMD window silently
+            # becomes a no-op and overload reproduces the latency cliff the
+            # controller exists to prevent; refuse rather than no-op
+            raise ConfigError(
+                f"overload.protect_priority ({self.protect_priority}) must be "
+                f"> pipeline.priority ({self.priority}): protecting the "
+                "default band disables queue shedding entirely")
+
+
+class OverloadController:
+    """Per-stream admission controller: AIMD window + deadline + priority.
+
+    The stream feeds it observations from the hot loop (queue waits at
+    dequeue, pipeline latency after process) and consults :meth:`admit`
+    once per batch *before* the worker queue. asyncio runs the stages on
+    one thread, so plain arithmetic is race-free (same argument as
+    obs/metrics.py).
+    """
+
+    #: samples kept for the queue-wait p50 (small: sorting 64 floats per
+    #: adjustment interval is noise next to a single Arrow slice)
+    SAMPLES = 64
+
+    def __init__(self, cfg: OverloadConfig, name: str = "stream",
+                 workers: int = 1, max_window: Optional[int] = None):
+        self.cfg = cfg
+        self.name = name
+        self.workers = max(1, workers)
+        # resolve the window bounds onto SELF — cfg is caller-owned (e.g.
+        # PipelineConfig.overload, shared across engine restarts) and must
+        # keep reading back exactly what the user wrote
+        resolved = cfg.max_window
+        if resolved <= 0:
+            resolved = max_window if max_window is not None else 0
+        if resolved <= 0:
+            resolved = self.workers * 4
+        self.max_window = resolved
+        self.min_window = min(cfg.min_window, resolved)
+
+        reg = global_registry()
+        labels = {"stream": name}
+        self.m_state = reg.gauge(
+            "arkflow_overload_state",
+            "overload controller state (0 admit, 1 throttle, 2 shed)", labels)
+        self.m_window = reg.gauge(
+            "arkflow_overload_window", "effective admission window (batches)", labels)
+        self.m_shed = {
+            r: reg.counter("arkflow_shed_total", "batches shed before the worker queue",
+                           {**labels, "reason": r})
+            for r in SHED_REASONS
+        }
+        self.m_paused_s = reg.counter(
+            "arkflow_overload_paused_seconds_total",
+            "seconds pull-based inputs spent paused by the controller", labels)
+
+        self.window: float = float(self.max_window)
+        self.queued = 0  # batches currently in the worker queue
+        self.state = STATE_ADMIT
+        self._waits: deque[float] = deque(maxlen=self.SAMPLES)
+        self._wait_p50 = 0.0  # cached: recomputed once per adjustment interval
+        self._step_ewma: Optional[float] = None
+        self._last_adjust = 0.0
+        self._last_activity = 0.0  # monotonic time of the last enqueue/dequeue
+        # (sheds deliberately do NOT count: _idle_recover must fire while
+        # a brownout floor is rejecting every batch at admission)
+        self._over_intervals = 0  # consecutive over-budget adjustments at min window
+        #: admit floor: batches with priority < floor are shed (None = admit all)
+        self.admit_floor: Optional[int] = None
+        self._capacity_waiters: list = []
+        self.m_window.set(self.window)
+        self.m_state.set(self.state)
+
+    # -- observations (hot loop) ------------------------------------------
+
+    def on_enqueue(self) -> None:
+        self.queued += 1
+        self._last_activity = time.monotonic()
+
+    def on_dequeue(self, wait_s: float, now: Optional[float] = None) -> None:
+        """A worker picked a batch up after ``wait_s`` in the queue."""
+        if now is None:
+            now = time.monotonic()
+        self.queued = max(0, self.queued - 1)
+        self._waits.append(wait_s)
+        self._last_activity = time.monotonic()
+        self._maybe_adjust(now)
+        if self.queued < self.window:
+            self._wake_capacity_waiters()
+
+    def observe_step(self, dt_s: float) -> None:
+        """Pipeline latency of one batch (the service-time estimate)."""
+        if self._step_ewma is None:
+            self._step_ewma = dt_s
+        else:
+            self._step_ewma += 0.2 * (dt_s - self._step_ewma)
+
+    # -- estimates ---------------------------------------------------------
+
+    def queue_wait_p50_s(self) -> float:
+        """Cached p50 — recomputed once per adjustment interval
+        (_maybe_adjust), NOT per admitted batch; between adjustments the
+        Little's-law depth model carries the responsiveness."""
+        return self._wait_p50
+
+    def _compute_wait_p50(self) -> float:
+        if not self._waits:
+            return 0.0
+        s = sorted(self._waits)
+        return s[len(s) // 2]
+
+    def step_s(self) -> float:
+        return self._step_ewma or 0.0
+
+    def predicted_wait_s(self) -> float:
+        """Expected queue wait for a batch admitted NOW: the larger of the
+        recent p50 (what batches actually waited) and the Little's-law
+        estimate from current depth (reacts to a building queue before any
+        slow dequeue has been observed)."""
+        model = self.queued * self.step_s() / self.workers
+        return max(self.queue_wait_p50_s(), model)
+
+    def estimated_drain_s(self) -> float:
+        """Time for the current queue to drain at the observed service rate
+        — what a 429's ``Retry-After`` promises a well-behaved client."""
+        step = self.step_s() or self.cfg.target_wait_s
+        return max(0.05, min(60.0, self.queued * step / self.workers))
+
+    def _budget_s(self) -> float:
+        if self.cfg.deadline_ms is not None:
+            return self.cfg.deadline_ms / 1000.0 * self.cfg.headroom
+        return self.cfg.target_wait_s
+
+    # -- AIMD --------------------------------------------------------------
+
+    def _maybe_adjust(self, now: float) -> None:
+        if now - self._last_adjust < self.cfg.interval_s:
+            return
+        self._last_adjust = now
+        wait = self._wait_p50 = self._compute_wait_p50()
+        budget = self._budget_s()
+        if wait > budget:
+            at_min = self.window <= self.min_window
+            self.window = max(float(self.min_window),
+                              self.window * self.cfg.decrease)
+            self.state = STATE_SHED
+            if at_min and self.cfg.escalate_after:
+                # persistent overload the window alone can't absorb:
+                # brown out one priority band at a time (strict bands —
+                # never past protect_priority, which queue-shedding already
+                # exempts and deadline-shedding intentionally does not)
+                self._over_intervals += 1
+                if self._over_intervals >= self.cfg.escalate_after:
+                    self._over_intervals = 0
+                    floor = (self.admit_floor if self.admit_floor is not None
+                             else self.cfg.priority)
+                    self.admit_floor = min(floor + 1, self.cfg.protect_priority)
+        else:
+            self._over_intervals = 0
+            if wait <= budget * 0.5:
+                if self.admit_floor is not None:
+                    # relax the brownout before re-growing the window: the
+                    # shed band gets readmitted at the smallest safe rate
+                    floor = self.admit_floor - 1
+                    self.admit_floor = None if floor <= self.cfg.priority else floor
+                else:
+                    self.window = min(float(self.max_window),
+                                      self.window + self.cfg.increase)
+            self.state = (STATE_ADMIT if self.window >= self.max_window
+                          and self.admit_floor is None else STATE_THROTTLE)
+        self.m_window.set(self.window)
+        self.m_state.set(self.state)
+        if self.queued < self.window:
+            self._wake_capacity_waiters()
+
+    def _idle_recover(self) -> None:
+        """Adjustments are driven by dequeues, so a drained stream would
+        otherwise report SHED forever. When the queue has been empty with no
+        enqueue/dequeue for a few intervals, the burst's wait samples
+        predict nothing about a batch entering an empty queue: drop them
+        and let the state reflect the present. Crucially this also steps a
+        brownout ``admit_floor`` down one band per idle period — admission
+        sheds are NOT activity, so a floor that sheds 100% of traffic at
+        admission (queue permanently empty, no dequeues to drive
+        ``_maybe_adjust``) relaxes here instead of sticking forever; if the
+        readmitted band re-overloads, escalation re-engages. Consulted
+        lazily from admit()/should_pause()/report()."""
+        if self.queued != 0 or self.state != STATE_SHED:
+            return
+        now = time.monotonic()
+        if now - self._last_activity < max(3 * self.cfg.interval_s, 0.5):
+            return
+        self._waits.clear()
+        self._wait_p50 = 0.0
+        self._over_intervals = 0
+        if self.admit_floor is not None:
+            floor = self.admit_floor - 1
+            self.admit_floor = None if floor <= self.cfg.priority else floor
+        # refreshing the idle clock paces successive relax steps: the next
+        # band readmits only after another full idle period
+        self._last_activity = now
+        self.state = (STATE_ADMIT if self.window >= self.max_window
+                      and self.admit_floor is None else STATE_THROTTLE)
+        self.m_state.set(self.state)
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, priority: int, remaining_ms: Optional[float]) -> Optional[str]:
+        """Admission verdict for one batch: None to admit, else the shed
+        reason (already counted in ``arkflow_shed_total``).
+
+        Order matters: a stale batch is shed on deadline even in a
+        protected band (finishing it is strictly worse than dropping —
+        the caller already gave up); the brownout floor and the queue
+        window only apply below ``protect_priority``.
+        """
+        if not self.cfg.enabled:
+            return None
+        self._idle_recover()
+        if remaining_ms is not None:
+            need_ms = (self.predicted_wait_s() + self.step_s()) * 1000.0
+            if remaining_ms <= need_ms:
+                return self._shed("deadline")
+        if self.admit_floor is not None and priority < self.admit_floor:
+            return self._shed("priority")
+        if self.queued >= int(self.window) and priority < self.cfg.protect_priority:
+            return self._shed("queue")
+        return None
+
+    def expire(self) -> str:
+        """Count a batch that went stale WHILE queued (the worker's
+        dequeue-side deadline check). Admission bounds the *predicted* wait;
+        this bounds the actual one — together they guarantee every processed
+        batch still had budget when its step started, which is what makes
+        the soak's delivered-p99 <= 2x deadline bound provable."""
+        return self._shed("deadline")
+
+    def _shed(self, reason: str) -> str:
+        self.m_shed[reason].inc()
+        self.state = STATE_SHED
+        self.m_state.set(self.state)
+        return reason
+
+    # -- cooperative backpressure -----------------------------------------
+
+    def should_pause(self) -> bool:
+        """Pull-based sources consult this before fetching: True while the
+        controller is shedding AND the queue is at/over the window —
+        pausing consumption beats fetch-then-nack (the broker keeps the
+        backlog; nothing churns through the requeue path)."""
+        self._idle_recover()
+        return (self.cfg.enabled and self.state == STATE_SHED
+                and self.queued >= int(self.window))
+
+    def should_reject(self) -> bool:
+        """Push-based servers (HTTP) consult this per request: they cannot
+        pause remote clients, so they reject with 429 + Retry-After."""
+        return self.should_pause()
+
+    def retry_after_s(self) -> float:
+        return self.estimated_drain_s()
+
+    async def wait_capacity(self, timeout: float = 0.25) -> None:
+        """Bounded wait for the queue to drain below the window (pause
+        loop); wakes early the moment a dequeue frees capacity."""
+        import asyncio
+
+        ev = asyncio.Event()
+        self._capacity_waiters.append(ev)
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            try:
+                self._capacity_waiters.remove(ev)
+            except ValueError:
+                pass
+
+    def _wake_capacity_waiters(self) -> None:
+        for ev in self._capacity_waiters:
+            ev.set()
+
+    # -- introspection -----------------------------------------------------
+
+    def report(self) -> dict:
+        """Controller snapshot for the engine's ``/health`` payload."""
+        self._idle_recover()
+        return {
+            "state": _STATE_NAMES.get(self.state, str(self.state)),
+            "window": int(self.window),
+            "max_window": self.max_window,
+            "queued": self.queued,
+            "admit_floor": self.admit_floor,
+            "deadline_ms": self.cfg.deadline_ms,
+            "queue_wait_p50_ms": round(self.queue_wait_p50_s() * 1000.0, 3),
+            "step_ewma_ms": round(self.step_s() * 1000.0, 3),
+            "estimated_drain_s": round(self.estimated_drain_s(), 3),
+            "shed": {r: c.value for r, c in self.m_shed.items()},
+            "paused_s": round(self.m_paused_s.value, 3),
+        }
+
+
+def attach_overload(component: Any, controller: Optional[OverloadController]) -> None:
+    """Hand the controller to an input that can use it (HTTP's 429 path,
+    websocket's control frames), walking fault/decorator wrappers via their
+    ``_inner`` chain so chaos wrapping doesn't hide the real source."""
+    if controller is None:
+        return
+    seen = set()
+    node = component
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        hook = getattr(node, "attach_overload_controller", None)
+        if hook is not None:
+            hook(controller)
+        node = getattr(node, "_inner", None)
+
+
+def input_pauses_on_overload(component: Any) -> bool:
+    """Whether the (possibly wrapper-nested) input opts into cooperative
+    pause — pull-based brokers do; push servers and the unit-test memory
+    source (unless opted in) do not."""
+    seen = set()
+    node = component
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        flag = getattr(node, "pause_on_overload", None)
+        if flag is not None and not callable(flag):
+            if flag:
+                return True
+        node = getattr(node, "_inner", None)
+    return False

@@ -39,6 +39,12 @@ from arkflow_tpu.components.registry import build_component
 from arkflow_tpu.config import StreamConfig
 from arkflow_tpu.errors import ArkError, Disconnection, EndOfInput
 from arkflow_tpu.obs import global_registry
+from arkflow_tpu.runtime.overload import (
+    OverloadConfig,
+    OverloadController,
+    attach_overload,
+    input_pauses_on_overload,
+)
 from arkflow_tpu.runtime.pipeline import Pipeline
 from arkflow_tpu.utils.circuit_breaker import CircuitBreaker, CircuitBreakerConfig
 from arkflow_tpu.utils.retry import RetryConfig, retry_with_backoff
@@ -83,6 +89,8 @@ class Stream:
         error_output_breaker: Optional[CircuitBreakerConfig] = None,
         max_delivery_attempts: int = 1,
         reconnect_retry: Optional[RetryConfig] = None,
+        queue_size: int = 0,
+        overload: Optional[OverloadConfig] = None,
     ):
         self.input = input_
         self.pipeline = pipeline
@@ -96,6 +104,14 @@ class Stream:
         self.error_output_retry = error_output_retry or self.output_retry
         self.max_delivery_attempts = max(1, max_delivery_attempts)
         self.reconnect_retry = reconnect_retry  # None -> default derived at run time
+        #: stage-queue depth; 0 keeps the historical thread_num * 4
+        self.queue_size = queue_size if queue_size > 0 else self.thread_num * 4
+        #: overload controller (deadline admission / AIMD window / priority
+        #: shedding); None = admit everything, the pre-overload behavior
+        self.overload: Optional[OverloadController] = (
+            OverloadController(overload, name=name, workers=self.thread_num,
+                               max_window=self.queue_size)
+            if overload is not None and overload.enabled else None)
 
         reg = global_registry()
         labels = {"stream": name}
@@ -151,6 +167,7 @@ class Stream:
         )
 
         # runtime state
+        self._pause_source = False  # resolved at run() from the input chain
         self._seq_assigned = 0
         self._seq_emitted = 0
         #: delivery attempts per failing batch fingerprint; cleared on success
@@ -173,8 +190,13 @@ class Stream:
             await self.error_output.connect()
         for t in self.temporaries.values():
             await t.connect()
+        # push-based inputs (HTTP) get the controller for their 429 path;
+        # pull-based brokers opt into cooperative pause instead
+        attach_overload(self.input, self.overload)
+        self._pause_source = (self.overload is not None
+                              and input_pauses_on_overload(self.input))
 
-        qsize = self.thread_num * 4  # ref stream/mod.rs:90-93
+        qsize = self.queue_size  # pipeline.queue_size; default ref stream/mod.rs:90-93
         input_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
         output_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
 
@@ -226,6 +248,16 @@ class Stream:
         try:
             while not cancel.is_set():
                 loop = asyncio.get_running_loop()
+                if self._pause_source and self.overload.should_pause():
+                    # cooperative backpressure: a pull-based broker keeps the
+                    # backlog on its side — strictly better than fetching
+                    # batches we would immediately shed and nack back
+                    t_pause = loop.time()
+                    while self.overload.should_pause() and not cancel.is_set():
+                        await self.overload.wait_capacity(0.25)
+                    self.overload.m_paused_s.inc(loop.time() - t_pause)
+                    if cancel.is_set():
+                        break
                 t_read = loop.time()
                 read_f = asyncio.ensure_future(self.input.read())
                 done, _ = await asyncio.wait(
@@ -275,8 +307,10 @@ class Stream:
                 self.m_batches_in.inc()
                 self.m_rows_in.inc(batch.num_rows)
                 if self.buffer is not None:
+                    # admission happens at the worker-queue boundary
+                    # (_do_buffer), after windowing/coalescing
                     await self.buffer.write(item.batch, item.ack)
-                else:
+                elif await self._admit_or_shed(item):
                     await input_q.put(item)
         finally:
             cancel_wait.cancel()
@@ -295,8 +329,9 @@ class Stream:
                     await input_q.put(_DONE)
                 return
             batch, ack = item
-            await input_q.put(_WorkItem(batch, ack,
-                                        asyncio.get_running_loop().time()))
+            work = _WorkItem(batch, ack, asyncio.get_running_loop().time())
+            if await self._admit_or_shed(work):
+                await input_q.put(work)
 
     async def _do_processor(self, input_q: asyncio.Queue, output_q: asyncio.Queue) -> None:
         """Worker: pipeline.process with seq stamping + backpressure (THE hot loop)."""
@@ -319,10 +354,21 @@ class Stream:
             if isinstance(item, _Done):
                 await output_q.put(_DONE)
                 return
+            wait = loop.time() - item.enqueued_at
+            self.m_queue_wait.observe(wait)
+            if self.overload is not None:
+                self.overload.on_dequeue(wait, loop.time())
+                remaining = item.batch.remaining_deadline_ms(
+                    self.overload.cfg.deadline_ms)
+                if remaining is not None and remaining <= 0:
+                    # went stale in the queue: finishing it is strictly worse
+                    # than shedding (the caller already gave up) — and the
+                    # expiry check is what bounds delivered-batch latency
+                    await self._shed_item(item, self.overload.expire())
+                    continue
             seq = self._seq_assigned
             self._seq_assigned += 1
             self.m_pending.set(self._seq_assigned - self._seq_emitted)
-            self.m_queue_wait.observe(loop.time() - item.enqueued_at)
             t0 = loop.time()
             try:
                 results = await self.pipeline.process(item.batch)
@@ -330,7 +376,10 @@ class Stream:
             except Exception as e:  # processor failure -> error path
                 results = []
                 err = e
-            self.m_proc_latency.observe(loop.time() - t0)
+            dt = loop.time() - t0
+            self.m_proc_latency.observe(dt)
+            if self.overload is not None:
+                self.overload.observe_step(dt)
             await output_q.put((seq, item, results, err))
 
     async def _do_output(self, output_q: asyncio.Queue) -> None:
@@ -365,6 +414,58 @@ class Stream:
                 if (self._seq_assigned - self._seq_emitted) <= MAX_PENDING:
                     self._drained.set()  # wake backpressured workers now
                 await self._emit(item, results, err)
+
+    # -- overload admission (runtime/overload.py) --------------------------
+
+    async def _admit_or_shed(self, item: _WorkItem) -> bool:
+        """Admission gate at the worker-queue boundary: True to enqueue,
+        False when the controller shed the batch (already dispatched to
+        error_output / nack — the caller just skips the put)."""
+        ctrl = self.overload
+        if ctrl is None:
+            return True
+        remaining = item.batch.remaining_deadline_ms(ctrl.cfg.deadline_ms)
+        reason = ctrl.admit(item.batch.priority_band(ctrl.cfg.priority), remaining)
+        if reason is None:
+            ctrl.on_enqueue()
+            return True
+        await self._shed_item(item, reason)
+        return False
+
+    async def _shed_item(self, item: _WorkItem, reason: str) -> None:
+        """Dispose of a shed batch without silent loss: route to
+        error_output tagged ``overloaded`` (preferred — terminal, keeps the
+        accounting identity), else nack so the broker redelivers after the
+        brownout, else log-and-ack (counted in ``arkflow_shed_total``)."""
+        if self.error_output is not None:
+            await self._error_route_or_drop(
+                item.batch, {"error": "overloaded", "shed_reason": reason},
+                f"[{self.name}] shed write",
+                "[%s] error_output rejected a shed batch (%s); dropping "
+                "WITH ack", self.name, reason)
+            # terminal disposition: drop the fingerprint's delivery-attempt
+            # count so an identical later payload starts with a fresh budget
+            # (the nack path below keeps it — redelivery continues)
+            self._clear_attempts(item.batch)
+            await self._safe_ack(item.ack)
+            return
+        # an ABSOLUTE deadline that has already passed can only get MORE
+        # expired on redelivery (unlike a TTL, which the re-stamped ingest
+        # time resets), so nacking would spin shed->redeliver->shed forever
+        expired_abs = (item.batch.deadline_unix_ms() is not None
+                       and (item.batch.remaining_deadline_ms() or 0.0) <= 0)
+        if getattr(item.ack, "redeliverable", False) and not expired_abs:
+            await self._safe_nack(item.ack)
+            # in-process brokers requeue instantly; pace the respin so the
+            # read loop doesn't spin hot on shed->redeliver->shed
+            await self.overload.wait_capacity(0.05)
+            return
+        logger.warning("[%s] shed batch (%s) with no error_output and %s; "
+                       "dropping WITH ack", self.name, reason,
+                       "an expired absolute deadline" if expired_abs
+                       else "no redelivery")
+        self._clear_attempts(item.batch)
+        await self._safe_ack(item.ack)
 
     # -- delivery path (hardened) -----------------------------------------
 
@@ -425,24 +526,32 @@ class Stream:
         await retry_with_backoff(attempt, retry_cfg, what=what,
                                  on_retry=self.m_out_retries.inc)
 
-    async def _quarantine(self, item: _WorkItem, reason: str, attempts: int) -> None:
-        """Route a poisoned batch to error_output with attempt-count metadata
-        and ack it. A failing error_output write is retried; if it keeps
-        failing the batch is logged and dropped WITH an ack — the old code
-        dropped the ack on the floor, wedging the stream on eternal
-        redelivery of a batch that can no longer go anywhere."""
-        tagged = item.batch.with_ext_metadata(
-            {"error": reason, "delivery_attempts": str(attempts)})
+    async def _error_route_or_drop(self, batch: MessageBatch, meta: dict,
+                                   what: str, fail_log: str, *fail_args) -> bool:
+        """Shared error_output dispatch for quarantine and overload sheds:
+        tag, write with retry + breaker; on persistent failure count a
+        quarantine drop and log. The caller always acks afterwards — a batch
+        that can no longer go anywhere must not wedge the stream on eternal
+        redelivery."""
+        tagged = batch.with_ext_metadata(meta)
         try:
             await self._write_guarded(self.error_output, self._err_breaker,
-                                      self.error_output_retry, tagged,
-                                      f"[{self.name}] error_output write")
-            self.m_quarantined.inc()
+                                      self.error_output_retry, tagged, what)
+            return True
         except Exception:
             self.m_quarantine_drops.inc()
-            logger.exception(
+            logger.exception(fail_log, *fail_args)
+            return False
+
+    async def _quarantine(self, item: _WorkItem, reason: str, attempts: int) -> None:
+        """Route a poisoned batch to error_output with attempt-count metadata
+        and ack it."""
+        if await self._error_route_or_drop(
+                item.batch, {"error": reason, "delivery_attempts": str(attempts)},
+                f"[{self.name}] error_output write",
                 "[%s] error_output write kept failing; DROPPING batch after %d "
-                "delivery attempt(s) (reason: %s)", self.name, attempts, reason)
+                "delivery attempt(s) (reason: %s)", self.name, attempts, reason):
+            self.m_quarantined.inc()
         self._clear_attempts(item.batch)
         await self._safe_ack(item.ack)
 
@@ -542,4 +651,6 @@ def build_stream(cfg: StreamConfig, name: Optional[str] = None) -> Stream:
         error_output_breaker=cfg.error_output_circuit_breaker,
         max_delivery_attempts=cfg.pipeline.max_delivery_attempts,
         reconnect_retry=cfg.input_reconnect,
+        queue_size=cfg.pipeline.effective_queue_size(),
+        overload=cfg.pipeline.overload,
     )

@@ -8,6 +8,7 @@ non-blocking try_acquire) carry over.
 
 from __future__ import annotations
 
+import math
 import time
 
 from arkflow_tpu.errors import ConfigError
@@ -22,11 +23,26 @@ class TokenBucket:
         self._tokens = float(capacity)
         self._last = time.monotonic()
 
-    def try_acquire(self, n: float = 1.0) -> bool:
-        now = time.monotonic()
+    def _refill(self, now: float) -> None:
         self._tokens = min(self.capacity, self._tokens + (now - self._last) * self.refill_per_sec)
         self._last = now
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        self._refill(time.monotonic())
         if self._tokens >= n:
             self._tokens -= n
             return True
         return False
+
+    def time_until(self, n: float = 1.0) -> float:
+        """Seconds until ``n`` tokens will be available (0.0 = available
+        now). Does NOT consume tokens — the HTTP input's 429 path computes
+        ``Retry-After`` from the deficit so well-behaved clients back off
+        for exactly as long as the bucket needs. ``n`` beyond capacity can
+        never be satisfied: returns ``math.inf``."""
+        if n > self.capacity:
+            return math.inf
+        self._refill(time.monotonic())
+        if self._tokens >= n:
+            return 0.0
+        return (n - self._tokens) / self.refill_per_sec

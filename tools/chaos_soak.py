@@ -1,23 +1,31 @@
-"""Seeded, time-bounded chaos soak for the self-healing device layer.
+"""Seeded, time-bounded chaos soaks for the robustness layers.
 
-Builds a pipeline from the SAME config surface production uses — a
-fault-wrapped redelivering broker input, a memory buffer with bucket-exact
-coalescing, and a ``device_pool`` tpu_inference stage whose steps are
-chaos-injected (``hang`` / ``oom`` via the fault plugin's schedule, plus a
-``disconnect`` on the input) — then runs it to completion under a wall-clock
-bound and emits a JSON verdict:
+Default mode soaks the self-healing device layer: a fault-wrapped
+redelivering broker input, a memory buffer with bucket-exact coalescing, and
+a ``device_pool`` tpu_inference stage whose steps are chaos-injected
+(``hang`` / ``oom`` via the fault plugin's schedule, plus a ``disconnect``
+on the input), run to completion under a wall-clock bound:
 
     python tools/chaos_soak.py --fast            # tier-1 smoke (~seconds)
     python tools/chaos_soak.py --seconds 120 --seed 3 --messages 256
 
-Verdict fields: ``pass`` plus the evidence — delivered/missing/duplicate row
-counts, deadline misses, OOM events, probe/skip counters, and the final
-per-runner health states. PASS means zero message loss AND every runner ended
-HEALTHY/DEGRADED (the at-least-once + self-healing acceptance invariant);
-exit code 1 otherwise. Same seed => same fault schedule => same verdict.
+``--burst`` soaks the overload-control layer instead (runtime/overload.py):
+the ``burst`` input fault multiplies offered load past device throughput
+(default 4x), once with the overload controller ON and once OFF:
 
-Runs on the virtual-CPU JAX platform by default (no TPU needed); set
-ARKFLOW_SOAK_KEEP_ENV=1 to target whatever backend the environment provides.
+    python tools/chaos_soak.py --burst --fast    # tier-1 smoke
+    python tools/chaos_soak.py --burst --factor 4 --messages 96
+
+Burst PASS means the accounting identity holds (every offered batch was
+delivered or counted in ``arkflow_shed_total`` and routed to error_output —
+zero silent loss), delivered-batch p99 end-to-end latency stays <= 2x the
+configured deadline, AND the control run with the controller disabled
+reproduces today's unbounded queue growth (p99 blows past the same bound).
+Same seed => same fault schedule => same verdict; exit code 1 on FAIL.
+
+Runs on the virtual-CPU JAX platform by default (no TPU needed; ``--burst``
+never imports jax at all); set ARKFLOW_SOAK_KEEP_ENV=1 to target whatever
+backend the environment provides.
 """
 
 from __future__ import annotations
@@ -203,6 +211,160 @@ def run_soak(seconds: float = 60.0, seed: int = 7, messages: int = 48,
     return verdict
 
 
+def _burst_config(seed: int, messages: int, factor: int, fast: bool,
+                  controlled: bool, name: str) -> dict:
+    """Overload-soak pipeline: a redelivering broker whose ``burst`` fault
+    amplifies every read ``factor``x, feeding a worker whose per-batch
+    latency fault emulates a device step — offered load is structurally
+    ``factor``x what the worker can absorb. ``controlled=False`` is the
+    same pipeline minus the controller (the unbounded-queue baseline)."""
+    step_ms = 10 if fast else 20
+    payloads = [f"burst row {i:04d}" for i in range(messages)]
+    pipeline = {
+        "thread_num": 1 if fast else 2,
+        # roomy fixed queue: deep enough that, uncontrolled, queue wait
+        # grows far past the deadline (the pre-overload latency cliff);
+        # controlled, the AIMD window is the effective limit instead
+        "queue_size": 512,
+        "processors": [{
+            "type": "fault",
+            "seed": seed,
+            "faults": [
+                {"kind": "latency", "every": 1, "times": 0,
+                 "duration": f"{step_ms}ms"},
+            ],
+        }],
+    }
+    if controlled:
+        pipeline["deadline_ms"] = _burst_deadline_ms(fast)
+        pipeline["overload"] = {"max_window": 64, "interval": "10ms"}
+    return {
+        "name": name,
+        "input": {
+            "type": "fault",
+            "seed": seed,
+            "redeliver_unacked": True,
+            "inner": {"type": "memory", "messages": payloads},
+            "faults": [
+                {"kind": "burst", "every": 1, "times": 0, "factor": factor},
+            ],
+        },
+        "pipeline": pipeline,
+        "output": {"type": "drop"},
+        "error_output": {"type": "drop"},
+    }
+
+
+def _burst_deadline_ms(fast: bool) -> float:
+    return 150.0 if fast else 250.0
+
+
+def run_burst_soak(seconds: float = 60.0, seed: int = 7, messages: int = 48,
+                   factor: int = 4, fast: bool = False) -> dict:
+    """Run the overload soak (controller ON, then OFF) and return the
+    verdict dict. Pure asyncio — never imports jax."""
+    import asyncio
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import build_stream
+
+    ensure_plugins_loaded()
+    if fast:
+        messages = min(messages, 12)
+    deadline_ms = _burst_deadline_ms(fast)
+
+    def run_variant(controlled: bool, name: str) -> dict:
+        cfg = StreamConfig.from_mapping(
+            _burst_config(seed, messages, factor, fast, controlled, name))
+        stream = build_stream(cfg)
+
+        delivered: list[bytes] = []
+        shed: list[bytes] = []
+
+        class _Collect(DropOutput):
+            def __init__(self, sink: list[bytes]):
+                self._sink = sink
+
+            async def write(self, batch: MessageBatch) -> None:
+                self._sink.extend(batch.to_binary())
+
+        stream.output = _Collect(delivered)
+        stream.error_output = _Collect(shed)
+
+        async def bounded_run() -> bool:
+            cancel = asyncio.Event()
+            task = asyncio.create_task(stream.run(cancel))
+            done, _ = await asyncio.wait({task}, timeout=seconds)
+            if done:
+                task.result()
+                return False
+            cancel.set()
+            try:
+                await asyncio.wait_for(task, timeout=15.0)
+            except (asyncio.TimeoutError, Exception):
+                task.cancel()
+            return True
+
+        t0 = time.monotonic()
+        wedged = asyncio.run(bounded_run())
+        elapsed = time.monotonic() - t0
+
+        offered = int(stream.m_batches_in.value)
+        shed_counts = ({r: int(c.value) for r, c in stream.overload.m_shed.items()}
+                       if stream.overload is not None else {})
+        expected = {f"burst row {i:04d}".encode() for i in range(messages)}
+        seen = set(delivered) | set(shed)
+        lost = sorted(expected - seen)
+        p99_e2e_ms = stream.m_e2e_latency.quantile(0.99) * 1000.0
+        p99_wait_ms = stream.m_queue_wait.quantile(0.99) * 1000.0
+        out = {
+            "wedged": wedged,
+            "elapsed_s": round(elapsed, 3),
+            "offered_batches": offered,
+            "delivered_batches": len(delivered),
+            "shed_batches": len(shed),
+            "shed_by_reason": shed_counts,
+            "lost_rows": len(lost),
+            "e2e_p99_ms": round(p99_e2e_ms, 3),
+            "queue_wait_p99_ms": round(p99_wait_ms, 3),
+        }
+        if controlled:
+            # the accounting identity: every offered batch ended somewhere
+            out["identity_ok"] = (
+                offered == len(delivered) + len(shed)
+                and sum(shed_counts.values()) == len(shed))
+            out["p99_bounded"] = p99_e2e_ms <= 2.0 * deadline_ms
+            out["overload_state"] = stream.overload.report()
+        else:
+            # no controller: everything is admitted and queue wait blows
+            # straight past the bound the controlled run must hold
+            out["overload_reproduced"] = p99_e2e_ms > 2.0 * deadline_ms
+        if lost:
+            out["lost_sample"] = [m.decode() for m in lost[:5]]
+        return out
+
+    controlled = run_variant(True, "burst-soak-ctrl")
+    uncontrolled = run_variant(False, "burst-soak-raw")
+    return {
+        "mode": "burst",
+        "pass": bool(not controlled["wedged"]
+                     and controlled["identity_ok"]
+                     and controlled["p99_bounded"]
+                     and controlled["lost_rows"] == 0
+                     and controlled["shed_batches"] > 0
+                     and uncontrolled["overload_reproduced"]),
+        "seed": seed,
+        "messages": messages,
+        "factor": factor,
+        "deadline_ms": deadline_ms,
+        "controlled": controlled,
+        "uncontrolled": uncontrolled,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seconds", type=float, default=60.0,
@@ -210,6 +372,12 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--messages", type=int, default=48)
     ap.add_argument("--device-pool", type=int, default=2)
+    ap.add_argument("--burst", action="store_true",
+                    help="overload-control soak: burst fault drives offered "
+                         "load past throughput; asserts bounded p99 + the "
+                         "zero-silent-loss accounting identity")
+    ap.add_argument("--factor", type=int, default=4,
+                    help="burst mode: offered-load multiplier (default 4)")
     ap.add_argument("--fast", action="store_true",
                     help="tier-1 smoke mode: <=12 messages, deterministic "
                          "faults only")
@@ -217,9 +385,17 @@ def main(argv=None) -> int:
 
     import os
 
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if args.burst:
+        # pure asyncio — no jax, no platform pinning needed
+        verdict = run_burst_soak(seconds=args.seconds, seed=args.seed,
+                                 messages=args.messages, factor=args.factor,
+                                 fast=args.fast)
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["pass"] else 1
+
     if os.environ.get("ARKFLOW_SOAK_KEEP_ENV") != "1":
         # pin the virtual-CPU platform BEFORE jax loads (run_soak imports it)
-        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         from arkflow_tpu.utils.cleanenv import pin_cpu_env
 
         pin_cpu_env(os.environ, n_devices=max(2, args.device_pool))
