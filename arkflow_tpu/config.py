@@ -155,6 +155,7 @@ class StreamConfig:
             if req not in m:
                 raise ConfigError(f"stream config missing required section {req!r}")
         pipeline = PipelineConfig.from_mapping(m.get("pipeline", {}))
+        _validate_token_coalesce(m.get("buffer"), pipeline.processors)
         temps = [TemporaryConfig.from_mapping(t) for t in m.get("temporary", [])]
         input_cfg = dict(m["input"])
         reconnect = input_cfg.pop("reconnect", None)
@@ -181,6 +182,48 @@ class StreamConfig:
             error_output_circuit_breaker=err_breaker,
             input_reconnect=RetryConfig.from_config(reconnect) if reconnect else None,
         )
+
+
+def _validate_token_coalesce(buffer_cfg: Any, processors: list[dict]) -> None:
+    """Cross-component sanity for the packed fast path: a buffer carving
+    token-budget emissions only makes sense feeding a packing-enabled
+    ``tpu_inference`` processor (token-sized emissions fill a compiled
+    (rows, seq) shape only AFTER pack_tokens; an unpacked runner would pad
+    their oversized row counts straight back). Caught at parse time with a
+    clear message — the component builders can't see across sections."""
+    packing_vals = []
+    for p in processors:
+        # chaos streams wrap the real processor: look through `fault.inner`
+        # so the cross-check still sees the tpu_inference config
+        while (isinstance(p, Mapping) and p.get("type") == "fault"
+               and isinstance(p.get("inner"), Mapping)):
+            p = p["inner"]
+        if not isinstance(p, Mapping) or p.get("type") != "tpu_inference":
+            continue
+        packing = p.get("packing", False)
+        if not isinstance(packing, bool):
+            raise ConfigError(
+                f"tpu_inference.packing must be a bool, got {packing!r}")
+        packing_vals.append(packing)
+    if not isinstance(buffer_cfg, Mapping):
+        return
+    coalesce = buffer_cfg.get("coalesce")
+    if not isinstance(coalesce, Mapping):
+        return
+    token_budget = coalesce.get("token_budget")
+    if token_budget is None:
+        return
+    if isinstance(token_budget, bool) or not isinstance(token_budget, int) \
+            or token_budget < 1:
+        raise ConfigError(
+            f"buffer.coalesce.token_budget must be a positive int, "
+            f"got {token_budget!r}")
+    if packing_vals and not any(packing_vals):
+        raise ConfigError(
+            "buffer.coalesce.token_budget requires 'packing: true' on the "
+            "stream's tpu_inference processor (token-budget emissions only "
+            "fill the compiled (rows, seq) shape after pack_tokens packing; "
+            "set packing: true or drop token_budget)")
 
 
 def _restart_config(m: Any) -> Optional[dict]:

@@ -29,6 +29,19 @@ Config:
                                        # grid (global bucket = per-chip bucket
                                        # x dp), so emissions stay bucket-exact
                                        # on the sharded executable too
+      # token-budget mode (packed serving): carve emissions by TOTAL TOKEN
+      # COUNT instead of row count, sized to fill the packed runner's top
+      # (rows, seq) shape after pack_tokens (BucketPolicy.token_budget):
+      token_budget: 32704              # tokens per emission (requires a
+                                       # packing-enabled tpu_inference
+                                       # processor downstream; also x dp)
+      token_field: __value__           # payload column the estimates read
+      token_bytes: 4.0                 # bytes-per-token divisor for subword
+                                       # (HF/BPE) tokenizers; default: exact
+                                       # word/punct counting matching the
+                                       # hash tokenizer
+      max_row_tokens: 32               # clamp per-row estimates to the
+                                       # serving truncation width
 """
 
 from __future__ import annotations
@@ -46,7 +59,11 @@ from arkflow_tpu.utils.duration import parse_duration
 class MemoryBuffer(Buffer):
     def __init__(self, capacity: int, timeout_s: Optional[float] = None,
                  coalesce_buckets: Optional[list[int]] = None,
-                 coalesce_deadline_s: Optional[float] = None):
+                 coalesce_deadline_s: Optional[float] = None,
+                 token_budget: Optional[int] = None,
+                 token_field: Optional[str] = None,
+                 token_bytes: Optional[float] = None,
+                 max_row_tokens: Optional[int] = None):
         if capacity <= 0:
             raise ConfigError("buffer.capacity must be positive")
         self.capacity = capacity
@@ -54,7 +71,10 @@ class MemoryBuffer(Buffer):
         self._coalescer: Optional[MicroBatchCoalescer] = None
         self._deadline_s = None
         if coalesce_buckets:
-            self._coalescer = MicroBatchCoalescer(coalesce_buckets)
+            self._coalescer = MicroBatchCoalescer(
+                coalesce_buckets, token_budget=token_budget,
+                token_field=token_field, token_bytes=token_bytes,
+                max_row_tokens=max_row_tokens)
             # device OOM degradation: when a runner proves the device can't
             # hold a bucket, the announced cap shrinks this coalescer's grid
             # so we stop merging emissions that would just OOM again
@@ -72,6 +92,19 @@ class MemoryBuffer(Buffer):
                     f"buffer's backpressure bound "
                     f"{capacity * self.BACKPRESSURE_FACTOR} rows "
                     f"(raise capacity or shrink batch_buckets)")
+            if token_budget is not None and max_row_tokens is not None:
+                # same attainability check for the token budget: write()
+                # blocks at capacity*4 held rows, so held tokens can never
+                # exceed capacity*4*max_row_tokens — a budget above that
+                # would silently degrade every emission to a deadline flush
+                bound = capacity * self.BACKPRESSURE_FACTOR * max_row_tokens
+                if token_budget > bound:
+                    raise ConfigError(
+                        f"coalesce token_budget {token_budget} exceeds the "
+                        f"buffer's attainable bound {bound} tokens "
+                        f"(capacity x {self.BACKPRESSURE_FACTOR} rows x "
+                        f"max_row_tokens; raise capacity or shrink the "
+                        f"budget)")
         self._held: list[tuple[MessageBatch, Ack]] = []
         self._held_rows = 0
         self._first_write_at: Optional[float] = None
@@ -187,18 +220,44 @@ def _build(config: dict, resource: Resource) -> MemoryBuffer:
     buckets = coalesce.get("batch_buckets")
     if coalesce and not buckets:
         raise ConfigError("buffer.coalesce requires 'batch_buckets'")
+    token_budget = coalesce.get("token_budget")
+    if token_budget is not None:
+        if isinstance(token_budget, bool) or not isinstance(token_budget, int) \
+                or token_budget < 1:
+            raise ConfigError(
+                f"buffer.coalesce token_budget must be a positive int, "
+                f"got {token_budget!r}")
     if buckets:
         # dp-sharded serving: the runner scales its compiled grid by dp
         # (tpu/bucketing.py BucketPolicy.dp_scaled), so the coalescer must
-        # target the same dp-scaled global buckets to stay bucket-exact
+        # target the same dp-scaled global buckets — and the same dp-scaled
+        # token budget — to stay bucket-exact
         dp = int(coalesce.get("dp", 1))
         if dp < 1:
             raise ConfigError(f"buffer.coalesce dp must be >= 1, got {dp}")
         buckets = [int(b) * dp for b in buckets]
+        if token_budget is not None:
+            token_budget = token_budget * dp
+    token_bytes = coalesce.get("token_bytes")
+    if token_bytes is not None:
+        token_bytes = float(token_bytes)
+        if token_bytes <= 0:
+            raise ConfigError(
+                f"buffer.coalesce token_bytes must be positive, got {token_bytes}")
+    max_row_tokens = coalesce.get("max_row_tokens")
+    if max_row_tokens is not None:
+        max_row_tokens = int(max_row_tokens)
+        if max_row_tokens < 1:
+            raise ConfigError(
+                f"buffer.coalesce max_row_tokens must be >= 1, got {max_row_tokens}")
     deadline = coalesce.get("deadline")
     return MemoryBuffer(
         capacity=int(capacity),
         timeout_s=parse_duration(timeout) if timeout is not None else None,
         coalesce_buckets=buckets or None,
         coalesce_deadline_s=parse_duration(deadline) if deadline is not None else None,
+        token_budget=token_budget,
+        token_field=coalesce.get("token_field"),
+        token_bytes=token_bytes,
+        max_row_tokens=max_row_tokens,
     )

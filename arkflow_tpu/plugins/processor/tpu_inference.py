@@ -40,7 +40,14 @@ Config:
                                    # (int8 = dynamic W8A8, 2x MXU roofline)
     packing: true                  # token packing (tpu/packing.py): bin-pack
                                    # short examples into dense model rows so
-                                   # flops/row tracks real token count
+                                   # flops/row tracks real token count; the
+                                   # batch packs ONCE and is carved into
+                                   # row windows that fill the compiled grid
+    example_scale: 4               # packed only: the example-dim bucket grid
+                                   # extends this far past the row grid
+                                   # (default 4 with packing; a full row
+                                   # bucket of short texts holds several
+                                   # examples per row)
     step_deadline: 2s              # self-healing: per-step watchdog — a step
                                    # exceeding it is abandoned, the runner
                                    # goes UNHEALTHY (recovery probes re-admit
@@ -175,42 +182,48 @@ class TpuInferenceProcessor(Processor):
         return [self._attach(batch, outputs)]
 
     async def _infer_packed(self, batch: MessageBatch) -> dict[str, np.ndarray]:
-        """Token-packed inference (tpu/packing.py): tokenize, first-fit-pack
-        examples into dense model rows, serve, gather per-example outputs
-        back into row order. Chunked by EXAMPLE count before packing so both
-        the packed-row and example dims fit the bucket grid."""
-        from arkflow_tpu.tpu.packing import pack_tokens
+        """Token-packed inference (tpu/packing.py): tokenize off the payload
+        buffer view, first-fit-pack ALL examples into dense rows of the
+        batch's seq bucket, then carve the packed layout into row windows
+        that fill the compiled (rows, seq) grid (``carve_row_windows``) —
+        pack-once-carve-after means a token-budget emission fills the
+        largest bucket exactly, with only the final window as a tail on a
+        smaller bucket. Windows serve concurrently (the runner's in-flight
+        semaphore pipelines them) and per-example outputs scatter back into
+        original row order. No per-row Python anywhere on this path."""
+        from arkflow_tpu.tpu.packing import carve_row_windows, pack_tokens
 
-        def tokenize_and_pack() -> list[dict[str, np.ndarray]]:
-            # host-side Python/numpy loops: off the event loop, like the
-            # runner's own _prep, so a big batch never stalls other streams
+        def tokenize_and_carve() -> list[tuple[dict[str, np.ndarray], np.ndarray]]:
+            # host-side numpy work: off the event loop, like the runner's
+            # own _prep, so a big batch never stalls other streams
             ids, mask = self._encode_texts(batch, self.max_seq)
             lengths = mask.sum(axis=1).astype(np.int64)
-            mb = self.runner.buckets.max_batch()
-            chunks = []
-            for i in range(0, len(ids), mb):
-                sub_len = lengths[i:i + mb]
-                sb = self.runner.buckets.seq_bucket(int(sub_len.max()) if len(sub_len) else 1)
-                pk = pack_tokens(ids[i:i + mb], sub_len, sb)
-                chunks.append({
-                    "input_ids": pk.input_ids,
-                    "segment_ids": pk.segment_ids,
-                    "position_ids": pk.position_ids,
-                    "example_row": pk.example_row,
-                    "example_pos": pk.example_pos,
-                })
-            return chunks
+            sb = self.runner.buckets.seq_bucket(
+                int(lengths.max()) if len(lengths) else 1)
+            pk = pack_tokens(ids, lengths, sb)
+            return carve_row_windows(pk, self.runner.buckets.max_batch(),
+                                     self.runner.buckets.max_examples(),
+                                     self.runner.buckets.batch_buckets)
 
-        def timed_tokenize_and_pack() -> list[dict[str, np.ndarray]]:
+        def timed_tokenize_and_carve():
             with self.m_extract.time():
-                return tokenize_and_pack()
+                return tokenize_and_carve()
 
         loop = asyncio.get_running_loop()
-        chunks = await loop.run_in_executor(None, timed_tokenize_and_pack)
-        outs = await asyncio.gather(*[self.runner.infer(c) for c in chunks])
-        if len(outs) == 1:
-            return outs[0]
-        return {k: np.concatenate([o[k] for o in outs]) for k in outs[0]}
+        windows = await loop.run_in_executor(None, timed_tokenize_and_carve)
+        outs = await asyncio.gather(
+            *[self.runner.infer(inputs) for inputs, _ in windows])
+        # scatter each window's [E_w, ...] outputs back into original row
+        # order (window examples are row-sorted, not input-ordered)
+        n = batch.num_rows
+        merged: dict[str, np.ndarray] = {}
+        for key in outs[0]:
+            first = np.asarray(outs[0][key])
+            out = np.empty((n, *first.shape[1:]), first.dtype)
+            for (_, idx), chunk in zip(windows, outs):
+                out[idx] = np.asarray(chunk[key])
+            merged[key] = out
+        return merged
 
 
 @register_processor("tpu_inference")
@@ -223,14 +236,24 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
     if not model:
         raise ConfigError("tpu_inference requires 'model'")
     max_seq = int(config.get("max_seq", 128))
-    buckets = BucketPolicy.from_config(config, max_seq=max_seq,
-                                       max_batch=int(config.get("max_batch", 256)))
+    packing_raw = config.get("packing", False)
+    if not isinstance(packing_raw, bool):
+        raise ConfigError(
+            f"tpu_inference.packing must be a bool, got {packing_raw!r}")
+    # packed serving: the EXAMPLE-dim grid defaults to 4x the row grid — a
+    # full row bucket of short texts carries ~seq/len(example) examples per
+    # row, so the example dim must extend past max_batch or token-budget
+    # emissions would be capped by example count instead of tokens
+    buckets = BucketPolicy.from_config(
+        config, max_seq=max_seq,
+        max_batch=int(config.get("max_batch", 256)),
+        default_example_scale=4 if packing_raw else 1)
     mesh_cfg = config.get("mesh") or {}
     mesh_spec = None
     if mesh_cfg:
         mesh_spec = MeshSpec(dp=int(mesh_cfg.get("dp", 1)), tp=int(mesh_cfg.get("tp", 1)),
                              sp=int(mesh_cfg.get("sp", 1)))
-    packing = bool(config.get("packing", False))
+    packing = packing_raw
     pool_size = int(config.get("device_pool", 0) or 0)
     if pool_size and mesh_cfg:
         raise ConfigError(

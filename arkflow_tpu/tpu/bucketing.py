@@ -39,10 +39,16 @@ def pow2_buckets(lo: int, hi: int) -> list[int]:
 class BucketPolicy:
     batch_buckets: tuple[int, ...] = tuple(pow2_buckets(8, 256))
     seq_buckets: tuple[int, ...] = tuple(pow2_buckets(32, 512))
+    #: packed serving only: how far past the row grid the EXAMPLE-dim bucket
+    #: grid extends (a packed row holds several examples, so a full row
+    #: bucket of short texts carries ~seq/len(example) times more examples
+    #: than rows). 1 keeps the example grid identical to the row grid.
+    example_scale: int = 1
 
     @classmethod
     def from_config(cls, config: dict, *, max_batch: Optional[int] = None,
-                    max_seq: Optional[int] = None) -> "BucketPolicy":
+                    max_seq: Optional[int] = None,
+                    default_example_scale: int = 1) -> "BucketPolicy":
         bb = config.get("batch_buckets")
         sb = config.get("seq_buckets")
         if bb is None:
@@ -53,7 +59,11 @@ class BucketPolicy:
         sb = tuple(sorted(int(x) for x in sb))
         if not bb or not sb or bb[0] <= 0 or sb[0] <= 0:
             raise ConfigError("bucket lists must be non-empty positive ints")
-        return cls(bb, sb)
+        es = config.get("example_scale", default_example_scale)
+        if not isinstance(es, int) or isinstance(es, bool) or es < 1:
+            raise ConfigError(
+                f"example_scale must be an int >= 1, got {es!r}")
+        return cls(bb, sb, es)
 
     @staticmethod
     def _pick(n: int, buckets: Sequence[int]) -> int:
@@ -71,6 +81,44 @@ class BucketPolicy:
     def max_batch(self) -> int:
         return self.batch_buckets[-1]
 
+    # -- packed serving: example-dim grid + token-budget grid ---------------
+
+    def example_buckets(self) -> tuple[int, ...]:
+        """Bucket grid for the packed path's EXAMPLE dim: the row grid,
+        pow2-extended up to ``max_batch * example_scale`` (and at least the
+        top seq bucket, so one worst-case row of minimum-length examples
+        always has a servable example bucket). Derived from the row grid on
+        purpose: ``capped``/``dp_scaled`` rescale it automatically."""
+        out = list(self.batch_buckets)
+        top = self.batch_buckets[-1]
+        want = max(top * self.example_scale, self.seq_buckets[-1]) \
+            if self.example_scale > 1 else top
+        while top < want:
+            top *= 2
+            out.append(top)
+        return tuple(out)
+
+    def example_bucket(self, n: int) -> int:
+        return self._pick(n, self.example_buckets())
+
+    def max_examples(self) -> int:
+        return self.example_buckets()[-1]
+
+    def token_buckets(self, seq: int) -> tuple[int, ...]:
+        """Token-budget grid for packed serving at row width ``seq``: each
+        batch bucket's row capacity in tokens (rows x seq). Composes with
+        ``dp_scaled`` (batch buckets already carry the x dp) and ``capped``
+        (OOM-dropped row buckets vanish from the token grid too)."""
+        if seq < 1:
+            raise ConfigError(f"token_buckets seq must be >= 1, got {seq}")
+        return tuple(b * seq for b in self.batch_buckets)
+
+    def token_budget(self, seq: int) -> int:
+        """Tokens that fill the LARGEST compiled (rows, seq) shape — the
+        natural emission target for a token-budget coalescer feeding
+        ``pack_tokens``."""
+        return self.token_buckets(seq)[-1]
+
     def capped(self, below: int) -> Optional["BucketPolicy"]:
         """OOM degradation: the grid with only batch buckets strictly below
         ``below`` (the bucket the device just failed to hold). ``None`` when
@@ -79,7 +127,7 @@ class BucketPolicy:
         smaller = tuple(b for b in self.batch_buckets if b < below)
         if not smaller:
             return None
-        return BucketPolicy(smaller, self.seq_buckets)
+        return BucketPolicy(smaller, self.seq_buckets, self.example_scale)
 
     def dp_scaled(self, dp: int) -> "BucketPolicy":
         """The policy for dp-sharded dispatch: every batch bucket times
@@ -94,7 +142,7 @@ class BucketPolicy:
         if dp == 1:
             return self
         return BucketPolicy(tuple(b * dp for b in self.batch_buckets),
-                            self.seq_buckets)
+                            self.seq_buckets, self.example_scale)
 
 
 class BucketCapBus:
@@ -166,6 +214,17 @@ class MicroBatchCoalescer:
     full bucket; ``pop_flush`` carves the remainder bucket-exact on
     deadline/close.
 
+    Token-budget mode (``token_budget``): pending work is bucketed by TOTAL
+    TOKEN COUNT instead of row count — per-row token estimates come from the
+    payload column's Arrow offsets (``extract.payload_token_estimates``: one
+    vectorized pass, no per-row Python), and emissions carve the row prefix
+    whose token sum fills ``token_budget``. The budget is sized to fill a
+    compiled ``(rows, seq)`` shape after ``pack_tokens`` packing
+    (``BucketPolicy.token_budget(seq)``), so the packed row count lands
+    bucket-exact where row-count carving would leave the packer starved or
+    overflowing. Splits still happen on ROW boundaries (rows are atomic),
+    with the same ``split_ack`` share semantics as row mode.
+
     At-least-once is preserved: every emission carries a composite ack over
     the source acks (or their split shares), so a quarantined merged batch
     acks exactly the source batches whose rows it contained, and a nacked
@@ -184,25 +243,50 @@ class MicroBatchCoalescer:
     #: matters with thousands of concurrently failing source batches
     MAX_SUSPECTS = 1024
 
-    def __init__(self, batch_buckets: Sequence[int]):
+    def __init__(self, batch_buckets: Sequence[int], *,
+                 token_budget: Optional[int] = None,
+                 token_field: Optional[str] = None,
+                 token_bytes: Optional[float] = None,
+                 max_row_tokens: Optional[int] = None):
         buckets = tuple(sorted(int(b) for b in batch_buckets))
         if not buckets or buckets[0] <= 0:
             raise ConfigError("coalesce batch_buckets must be non-empty positive ints")
+        if token_budget is not None and token_budget < 1:
+            raise ConfigError(
+                f"coalesce token_budget must be a positive int, got {token_budget}")
+        if token_bytes is not None and token_bytes <= 0:
+            raise ConfigError(
+                f"coalesce token_bytes must be positive, got {token_bytes}")
+        if max_row_tokens is not None and max_row_tokens < 1:
+            raise ConfigError(
+                f"coalesce max_row_tokens must be >= 1, got {max_row_tokens}")
         self.buckets = buckets
         self.target = buckets[-1]
-        self._held: deque[tuple["MessageBatch", "Ack"]] = deque()
+        #: token-budget mode: emissions carve this many estimated tokens
+        #: instead of ``target`` rows (None = row mode)
+        self.token_budget = int(token_budget) if token_budget is not None else None
+        self._token_field = token_field
+        self._token_bytes = token_bytes
+        self._max_row_tokens = max_row_tokens
+        self._held: deque[tuple["MessageBatch", "Ack", Optional[np.ndarray]]] = deque()
         #: suspect (previously-nacked) batches, emitted alone and first
-        self._solo: deque[tuple["MessageBatch", "Ack"]] = deque()
+        self._solo: deque[tuple["MessageBatch", "Ack", Optional[np.ndarray]]] = deque()
         #: fingerprint -> row count of each currently-suspect source batch
         self._suspects: dict[bytes, int] = {}
         #: cheap prefilter so healthy adds/acks skip hashing: row counts of
         #: current suspects (hash only on a row-count match)
         self._suspect_rows: set[int] = set()
         self._rows = 0
+        self._tokens = 0
 
     @property
     def rows(self) -> int:
         return self._rows
+
+    @property
+    def tokens(self) -> int:
+        """Estimated tokens held (token-budget mode; 0 in row mode)."""
+        return self._tokens
 
     @property
     def pending(self) -> int:
@@ -214,14 +298,43 @@ class MicroBatchCoalescer:
         drop buckets above ``max_bucket`` so future emissions stay within
         what the device can actually hold. If even the smallest bucket is
         above the cap, the cap itself becomes the only bucket. Already-held
-        rows simply drain at the new, smaller target."""
+        rows simply drain at the new, smaller target. Token-budget mode
+        shrinks the token budget by the same ratio: the budget was sized to
+        fill the old top (rows, seq) shape, and the device just proved it
+        cannot hold that many rows."""
         fitting = tuple(b for b in self.buckets if b <= max_bucket)
         if not fitting:
             fitting = (max(1, int(max_bucket)),)
         if fitting == self.buckets:
             return
+        if self.token_budget is not None:
+            self.token_budget = max(
+                1, int(self.token_budget * fitting[-1] / self.target))
         self.buckets = fitting
         self.target = fitting[-1]
+
+    # -- token estimation (token-budget mode) -------------------------------
+
+    def _row_tokens(self, batch: "MessageBatch") -> np.ndarray:
+        """Per-row token estimates off the payload column's Arrow offsets
+        (zero per-row Python; see ``extract.payload_token_estimates``).
+        Batches without a usable payload column estimate conservatively —
+        each row counts as ``max_row_tokens`` (or 1) — so malformed traffic
+        still flows instead of wedging the budget accounting."""
+        from arkflow_tpu.errors import ArkError
+        from arkflow_tpu.tpu.extract import payload_token_estimates
+
+        from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD
+
+        field = self._token_field or DEFAULT_BINARY_VALUE_FIELD
+        try:
+            col = batch.column(field)
+            return payload_token_estimates(
+                col, token_bytes=self._token_bytes,
+                max_tokens=self._max_row_tokens)
+        except ArkError:
+            return np.full(batch.num_rows, self._max_row_tokens or 1,
+                           dtype=np.int64)
 
     # -- suspect tracking (hashing only on failure paths, plus on adds/acks
     # -- that pass the row-count prefilter while failures are outstanding —
@@ -254,12 +367,15 @@ class MicroBatchCoalescer:
 
     def add(self, batch: "MessageBatch", ack: "Ack") -> None:
         ack = self._observed(batch, ack)
+        lens = self._row_tokens(batch) if self.token_budget is not None else None
         if (batch.num_rows in self._suspect_rows
                 and self._fingerprint(batch) in self._suspects):
-            self._solo.append((batch, ack))
+            self._solo.append((batch, ack, lens))
         else:
-            self._held.append((batch, ack))
+            self._held.append((batch, ack, lens))
         self._rows += batch.num_rows
+        if lens is not None:
+            self._tokens += int(lens.sum())
 
     def _carve(self, rows: int) -> tuple["MessageBatch", "Ack"]:
         """Take exactly ``rows`` held rows as one merged emission, splitting
@@ -271,7 +387,7 @@ class MicroBatchCoalescer:
         acks: list["Ack"] = []
         need = rows
         while need > 0:
-            batch, ack = self._held.popleft()
+            batch, ack, _ = self._held.popleft()
             if batch.num_rows <= need:
                 parts.append(batch)
                 acks.append(ack)
@@ -280,18 +396,85 @@ class MicroBatchCoalescer:
                 head_ack, tail_ack = split_ack(ack, 2)
                 parts.append(batch.slice(0, need))
                 acks.append(head_ack)
-                self._held.appendleft((batch.slice(need), tail_ack))
+                self._held.appendleft((batch.slice(need), tail_ack, None))
                 need = 0
         self._rows -= rows
         return MessageBatch.concat(parts), VecAck(acks)
 
+    def _carve_tokens(self, budget: int) -> tuple["MessageBatch", "Ack"]:
+        """Take the longest held row prefix whose estimated token sum fits
+        ``budget``, splitting the boundary batch at a ROW edge (rows are
+        atomic; the boundary source ack is shared via ``split_ack``). A
+        single row whose estimate alone exceeds the budget emits solo —
+        downstream packing/truncation owns over-long rows."""
+        from arkflow_tpu.batch import MessageBatch
+        from arkflow_tpu.components.base import VecAck, split_ack
+
+        parts: list["MessageBatch"] = []
+        acks: list["Ack"] = []
+        took_rows = 0
+        took_tokens = 0
+        need = budget
+        while need > 0 and self._held:
+            batch, ack, lens = self._held[0]
+            total = int(lens.sum())
+            if total <= need:
+                self._held.popleft()
+                parts.append(batch)
+                acks.append(ack)
+                took_rows += batch.num_rows
+                took_tokens += total
+                need -= total
+                continue
+            # boundary batch: rows [0, k) fit the remaining budget
+            cs = np.cumsum(lens)
+            k = int(np.searchsorted(cs, need, side="right"))
+            if k == 0:
+                if parts:
+                    break  # next row alone would overflow; emit under-budget
+                k = 1  # a single over-budget row still has to flow
+            if k >= batch.num_rows:
+                # the whole batch fits after all (a single over-budget row):
+                # take it intact — splitting would strand an empty tail and
+                # its ack share in the queue
+                self._held.popleft()
+                parts.append(batch)
+                acks.append(ack)
+                took_rows += batch.num_rows
+                took_tokens += total
+                break
+            self._held.popleft()
+            head_ack, tail_ack = split_ack(ack, 2)
+            parts.append(batch.slice(0, k))
+            acks.append(head_ack)
+            self._held.appendleft((batch.slice(k), tail_ack, lens[k:]))
+            took_rows += k
+            took_tokens += int(cs[k - 1])
+            break
+        self._rows -= took_rows
+        self._tokens -= took_tokens
+        return MessageBatch.concat(parts), VecAck(acks)
+
+    def _pop_solo(self) -> Optional[tuple["MessageBatch", "Ack"]]:
+        if not self._solo:
+            return None
+        batch, ack, lens = self._solo.popleft()
+        self._rows -= batch.num_rows
+        if lens is not None:
+            self._tokens -= int(lens.sum())
+        return batch, ack
+
     def pop_exact(self) -> Optional[tuple["MessageBatch", "Ack"]]:
         """Next emission: a suspect batch alone (stable fingerprint for the
-        stream's attempt budget), else exactly ``target`` carved rows."""
-        if self._solo:
-            batch, ack = self._solo.popleft()
-            self._rows -= batch.num_rows
-            return batch, ack
+        stream's attempt budget), else exactly ``target`` carved rows (row
+        mode) / a ``token_budget``-filling row prefix (token mode)."""
+        emission = self._pop_solo()
+        if emission is not None:
+            return emission
+        if self.token_budget is not None:
+            if self._tokens < self.token_budget:
+                return None
+            return self._carve_tokens(self.token_budget)
         if self._rows < self.target:
             return None
         return self._carve(self.target)
@@ -301,7 +484,10 @@ class MicroBatchCoalescer:
         bucket that the held rows fill exactly (so a 40-row flush against
         buckets [8,16,32] emits 32 then 8, zero padding), and only the
         sub-minimum remainder emits unpadded-to-bucket as one merged batch.
-        Suspects drain through ``pop_exact`` first."""
+        Token mode: full-budget emissions first, then the whole remainder as
+        one merged batch — the packer right-sizes its row count to a smaller
+        bucket, so sub-budget flushes stay dense. Suspects drain through
+        ``pop_exact`` first."""
         from arkflow_tpu.batch import MessageBatch
         from arkflow_tpu.components.base import VecAck
 
@@ -310,12 +496,19 @@ class MicroBatchCoalescer:
             return emission
         if not self._held:
             return None
+        if self.token_budget is not None:
+            self._tokens = 0
+            self._rows -= sum(b.num_rows for b, _, _ in self._held)
+            parts = [b for b, _, _ in self._held]
+            acks = VecAck([a for _, a, _ in self._held])
+            self._held.clear()
+            return MessageBatch.concat(parts), acks
         held_rows = self._rows
         fitting = [b for b in self.buckets if b <= held_rows]
         if fitting:
             return self._carve(fitting[-1])
-        parts = [b for b, _ in self._held]
-        acks = VecAck([a for _, a in self._held])
+        parts = [b for b, _, _ in self._held]
+        acks = VecAck([a for _, a, _ in self._held])
         self._held.clear()
         self._rows = 0
         return MessageBatch.concat(parts), acks

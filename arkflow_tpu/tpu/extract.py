@@ -26,6 +26,64 @@ from arkflow_tpu.errors import ProcessError
 _GATHER_MAX_MEAN_LEN = 128
 
 
+#: byte-class lookup tables over the raw payload buffer, mirroring the hash
+#: tokenizer's ``[a-z0-9]+|[^\sa-z0-9]`` split after ``.lower()``: WORD bytes
+#: extend a token, SINGLE bytes are one token each, the rest is whitespace
+_TOK_WORD = np.zeros(256, np.bool_)
+for _r in (range(ord("a"), ord("z") + 1), range(ord("A"), ord("Z") + 1),
+           range(ord("0"), ord("9") + 1)):
+    _TOK_WORD[list(_r)] = True
+_TOK_SPACE = np.zeros(256, np.bool_)
+_TOK_SPACE[[ord(c) for c in " \t\n\r\x0b\x0c"]] = True
+_TOK_SINGLE = ~(_TOK_WORD | _TOK_SPACE)
+
+
+def payload_token_estimates(col: pa.Array, *, token_bytes: Optional[float] = None,
+                            max_tokens: Optional[int] = None) -> np.ndarray:
+    """Per-row token-count estimates for a binary/string payload column —
+    the token-budget coalescer's sizing signal (one vectorized pass over the
+    Arrow buffers, zero per-row Python).
+
+    Default mode mirrors the hash tokenizer exactly: tokens = alnum runs +
+    standalone punctuation bytes, counted with byte-class lookup tables and
+    a cumsum over run starts, plus 2 specials ([CLS]/[SEP]). ``token_bytes``
+    switches to a bytes-per-token divisor (``ceil(len/token_bytes) + 2``) —
+    the right estimate for subword (HF/BPE) tokenizers, where splits don't
+    follow whitespace. ``max_tokens`` clamps rows to the serving truncation
+    width so one huge payload can't starve an emission's budget.
+    """
+    values, offsets = binary_column_view(col)
+    n = len(col)
+    if n == 0:
+        return np.zeros(0, np.int64)
+    starts = offsets[:-1]
+    lens = (offsets[1:] - starts).astype(np.int64)
+    if col.null_count:
+        # nulls estimate as empty payloads (their byte range may be garbage)
+        lens = np.where(col.is_null().to_numpy(zero_copy_only=False), 0, lens)
+    if token_bytes is not None:
+        est = np.ceil(lens / float(token_bytes)).astype(np.int64) + 2
+    else:
+        lo = int(starts[0])
+        hi = int(offsets[-1])
+        window = values[lo:hi]
+        word = _TOK_WORD[window]
+        # a word-run start: WORD byte not preceded by a WORD byte; row starts
+        # always begin a run (the previous byte belongs to another row)
+        run_start = word.copy()
+        run_start[1:] &= ~word[:-1]
+        within = starts - lo
+        run_start[within[within < len(window)]] = word[within[within < len(window)]]
+        counts = run_start.astype(np.int64) + _TOK_SINGLE[window]
+        cs = np.concatenate(([0], np.cumsum(counts)))
+        ends = np.minimum(starts - lo + lens, len(window))
+        est = cs[ends] - cs[np.minimum(within, len(window))] + 2
+    est = np.maximum(est, 2)  # empty text still tokenizes to [CLS][SEP]
+    if max_tokens is not None:
+        est = np.minimum(est, int(max_tokens))
+    return est
+
+
 def _binary_matrix(col: pa.Array, n: int, size: int) -> np.ndarray:
     """Binary column -> ``[n, size]`` uint8, zero-padded/truncated per row.
 

@@ -116,3 +116,78 @@ def pack_tokens(ids: np.ndarray, lengths: np.ndarray, seq: int) -> PackedTokens:
         ex_row[i] = b
         ex_pos[i] = st
     return PackedTokens(out_ids, seg, pos, ex_row, ex_pos)
+
+
+def carve_row_windows(
+    pk: PackedTokens, max_rows: int, max_examples: int,
+    row_buckets: "tuple[int, ...] | None" = None,
+) -> list[tuple[dict, np.ndarray]]:
+    """Slice a packed layout into independent row windows that fit the
+    compiled grid: at most ``max_rows`` packed rows and ``max_examples``
+    examples per window.
+
+    Rows are independent after packing (attention is block-diagonal within a
+    row and every example's tokens live in exactly one row), so a window is
+    a pure row slice plus the examples whose [CLS] sits in it — packing once
+    and carving after is what lets a token-budget emission fill the largest
+    compiled ``(rows, seq)`` shape exactly. With ``row_buckets`` the window
+    sizes CASCADE down the compiled grid (a 1139-row layout against
+    [...,512,1024] carves 1024 + 64 + 32 + ...): every window lands
+    bucket-exact, so the only bucket-padding left is the sub-minimum
+    residue — the per-dispatch waste stays at the packer's fill ratio
+    instead of whatever the emission size happened to round up to. Returns
+    ``(inputs, example_idx)`` pairs: ``inputs`` feeds the packed apply
+    directly (``example_row`` re-based to the window), ``example_idx``
+    scatters the window's outputs back into original example order. All
+    index work is numpy (one argsort + two searchsorteds per window); no
+    per-row or per-example Python.
+    """
+    if max_rows < 1 or max_examples < 1:
+        raise ValueError(
+            f"carve_row_windows: max_rows/max_examples must be >= 1, "
+            f"got ({max_rows}, {max_examples})")
+    total_rows = pk.num_rows
+    if total_rows == 0:
+        return []
+    buckets = sorted(b for b in (row_buckets or ()) if b <= max_rows)
+    order = np.argsort(pk.example_row, kind="stable")
+    row_sorted = pk.example_row[order]
+    windows: list[tuple[dict, np.ndarray]] = []
+    lo = 0
+    b0 = 0
+    while lo < total_rows:
+        remaining = total_rows - lo
+        step = min(max_rows, remaining)
+        if buckets:
+            fitting = [b for b in buckets if b <= step]
+            # bucket-exact cascade; the sub-minimum residue emits as-is
+            # (the runner rounds it up to the smallest compiled bucket)
+            if fitting and remaining > fitting[-1]:
+                step = fitting[-1]
+        hi = lo + step
+        b1 = int(np.searchsorted(row_sorted, hi, side="left"))
+        if b1 - b0 > max_examples:
+            # the (b0 + max_examples)-th example's row doesn't fully fit;
+            # end the window before it (a row's examples are inseparable)
+            hi = int(row_sorted[b0 + max_examples])
+            b1 = int(np.searchsorted(row_sorted, hi, side="left"))
+            if hi <= lo:
+                # one row alone holds > max_examples examples (possible only
+                # when the policy's example grid was overridden below seq/2):
+                # emit it solo and let the runner's bucket check surface it
+                hi = lo + 1
+                b1 = int(np.searchsorted(row_sorted, hi, side="left"))
+        idx = order[b0:b1]
+        windows.append((
+            {
+                "input_ids": pk.input_ids[lo:hi],
+                "segment_ids": pk.segment_ids[lo:hi],
+                "position_ids": pk.position_ids[lo:hi],
+                "example_row": (pk.example_row[idx] - lo).astype(np.int32),
+                "example_pos": pk.example_pos[idx],
+            },
+            idx,
+        ))
+        lo = hi
+        b0 = b1
+    return windows

@@ -348,6 +348,16 @@ class ModelRunner:
             "arkflow_tpu_exec_rows_total",
             "bucket rows dispatched to the device, padding included (the "
             "honest FLOPs denominator; rows_total counts true examples)", labels)
+        self.m_tokens = reg.counter(
+            "arkflow_tpu_tokens_total",
+            "true (non-padding) tokens dispatched by packed runners — the "
+            "numerator of effective tokens/sec", labels)
+        self.m_token_capacity = reg.counter(
+            "arkflow_tpu_token_capacity_total",
+            "token slots dispatched by packed runners (bucket rows x padded "
+            "seq): 1 - tokens_total/capacity is the capacity-weighted padding "
+            "waste — the honest aggregate; the per-step waste histogram "
+            "over-weights small tail windows", labels)
         self.m_inflight = reg.gauge(
             "arkflow_tpu_steps_inflight", "device steps dispatched, not yet complete", labels)
         self.m_busy_s = reg.counter(
@@ -600,18 +610,22 @@ class ModelRunner:
     def _pad_inputs_packed(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
         """Pad a packed layout (tpu/packing.py): [P, S] row arrays pad P to a
         batch bucket (dead rows: segment 0), [E] example-index arrays pad E
-        to its own batch bucket (they point at row 0/pos 0, sliced off by the
-        true-count return). Fill metric reports TOKEN fill — the quantity
-        packing exists to maximize."""
+        to its own EXAMPLE bucket (they point at row 0/pos 0, sliced off by
+        the true-count return; the example grid extends ``example_scale``
+        past the row grid because a full row bucket of short texts carries
+        several examples per row). Fill metric reports TOKEN fill — the
+        quantity packing exists to maximize."""
         p = inputs["input_ids"].shape[0]
         e = inputs["example_row"].shape[0]
         mb = self.buckets.max_batch()
-        if p > mb or e > mb:
+        me = self.buckets.max_examples()
+        if p > mb or e > me:
             raise ConfigError(
-                f"packed batch ({p} rows / {e} examples) exceeds the largest "
-                f"bucket {mb}; pack at most max_batch examples per call")
+                f"packed batch ({p} rows / {e} examples) exceeds the grid "
+                f"(max {mb} rows / {me} examples); carve row windows that "
+                "fit before dispatch (tpu/packing.py carve_row_windows)")
         pb = self.buckets.batch_bucket(p)
-        eb = self.buckets.batch_bucket(e)
+        eb = self.buckets.example_bucket(e)
         out = {}
         for name, (dtype, trailing) in self.spec.items():
             arr = inputs.get(name)
@@ -632,6 +646,8 @@ class ModelRunner:
             self.m_fill.observe(fill)
             self.m_waste.observe(1.0 - fill)
             self.m_exec_rows.inc(pb)
+            self.m_tokens.inc(true_tokens)
+            self.m_token_capacity.inc(pb * sb)
         return out, e
 
     def _pad_inputs(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
@@ -1152,16 +1168,18 @@ class ModelRunner:
 
         Packed mode warms every reachable (row-bucket, example-bucket) pair:
         the row dim P lands in a smaller-or-equal bucket than the example dim
-        E (each packed row holds >= 1 example), so the upper-triangular grid
-        |B|(|B|+1)/2 x |S| covers all shapes packed traffic can produce —
-        full chunks (eb = max) and tail chunks alike. The persistent compile
-        cache makes this a one-time cost per host.
+        E (each packed row holds >= 1 example), with E drawn from the
+        extended example grid (``BucketPolicy.example_buckets``) — so the
+        upper-triangular grid covers all shapes packed traffic can produce:
+        full token-budget chunks (eb up to max_examples) and tail chunks
+        alike. The persistent compile cache makes this a one-time cost per
+        host.
         """
         count = 0
         has_seq = any("seq" in t for _, t in self.spec.values())
         seqs = seq_lens or (list(self.buckets.seq_buckets) if has_seq else [None])
         if self.packed:
-            pairs = [(pb, eb) for eb in self.buckets.batch_buckets
+            pairs = [(pb, eb) for eb in self.buckets.example_buckets()
                      for pb in self.buckets.batch_buckets if pb <= eb]
         else:
             pairs = [(bb, bb) for bb in self.buckets.batch_buckets]

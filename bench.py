@@ -8,12 +8,19 @@ I/O excluded so the number is rows/sec/chip. Prints ONE JSON line.
 Env knobs: BENCH_SECONDS (default 15), BENCH_BATCH (1024), BENCH_SEQ (32),
 BENCH_TINY=1 for a CPU-sized smoke run, BENCH_MODE=sql for the CPU reference
 anchor (BASELINE.json config 1: generate -> json_to_arrow -> sql filter),
-BENCH_PACKING=1 for token-packed execution (tpu/packing.py: several examples
-per model row, effective rows/s tracks real token count), BENCH_RAGGED=1 for
-a mixed short/long payload distribution (the realistic packing workload),
-BENCH_MODE=multichip for the data-parallel scaling phase (1 chip vs
-BENCH_MC_DEVICES chips on a forced host mesh; BENCH_MC_STYLE=dp|pool picks
-dp-sharded dispatch vs replicated device pool; emits scaling_efficiency).
+BENCH_PACKING (default 1: token-packed execution is the measured default —
+several examples per model row, effective rows/s tracks real token count;
+0 reverts to padded serving), BENCH_DTYPE (default bfloat16; int8 = W8A8),
+BENCH_COALESCE (default follows BENCH_PACKING: token-budget coalescing in
+the buffer carves emissions that fill the top compiled (rows, seq) shape
+after packing), BENCH_RAGGED=1 for a mixed short/long payload distribution
+(the realistic packing workload), BENCH_MODE=multichip for the data-parallel
+scaling phase (1 chip vs BENCH_MC_DEVICES chips on a forced host mesh;
+BENCH_MC_STYLE=dp|pool picks dp-sharded dispatch vs replicated device pool;
+emits scaling_efficiency). The packed default phase asserts argmax parity
+against the float32 unpacked reference before its number becomes the
+headline (BENCH_SKIP_PARITY=1 skips; a parity failure falls back to the
+unpacked float32 phase so the driver always gets an honest number).
 """
 
 from __future__ import annotations
@@ -38,8 +45,49 @@ def _backend() -> str:
 
 def _bench_dtype(tiny: bool) -> str:
     """The serving dtype every phase runs AND every artifact is tagged with
-    — single source so the tags can never disagree with what was served."""
-    return "float32" if tiny else os.environ.get("BENCH_DTYPE", "bfloat16")
+    — single source so the tags can never disagree with what was served.
+    bf16 is the default on EVERY backend now (the measured fast path is
+    packed + low-precision); BENCH_DTYPE=float32 reverts, =int8 serves W8A8."""
+    return os.environ.get("BENCH_DTYPE", "bfloat16")
+
+
+def _full_pow2_grid(batch: int) -> list[int]:
+    """The packed processor's row-bucket grid: pow2 from 8 up to ``batch``
+    (the runner's own grid helper, so bench and runner can never disagree
+    on grid semantics)."""
+    from arkflow_tpu.tpu.bucketing import pow2_buckets
+
+    return pow2_buckets(8, batch)
+
+
+def _bench_token_budget(batch: int, seq: int) -> int:
+    """Tokens per coalesced emission: fills the top compiled (batch, seq)
+    shape minus a 2-row margin for first-fit fragmentation. Single source
+    for the stream config AND the BENCH_RESULT knob record, so the recorded
+    budget can never diverge from what was served."""
+    return batch * seq - 2 * seq
+
+
+def _latency_dtype(tiny: bool) -> str:
+    """Serving dtype for the bounded-load LATENCY phase: the bench dtype on
+    accelerators, but float32 in tiny/CPU mode — XLA emulates bf16 on CPU
+    (~9x worse committed p99 measured), and an emulated dtype is not what
+    anyone deploys there, so it would only corrupt the <50ms target."""
+    return "float32" if tiny else _bench_dtype(tiny)
+
+
+def _bench_packing() -> bool:
+    """Token packing is the measured default (ROADMAP item 3: the speed
+    levers belong ON the measured path); BENCH_PACKING=0 reverts to padded
+    serving."""
+    return os.environ.get("BENCH_PACKING", "1") == "1"
+
+
+def _bench_coalesce() -> bool:
+    """Token-budget coalescing defaults on exactly when packing is on (its
+    emissions are sized for the packer); BENCH_COALESCE forces either way."""
+    default = "1" if _bench_packing() else "0"
+    return os.environ.get("BENCH_COALESCE", default) == "1"
 
 
 # latency phase offered load: batch_size rows every interval. The artifact
@@ -72,17 +120,21 @@ def build_sql_config(batch: int) -> dict:
     }
 
 
+#: the CPU-sized smoke model every tiny phase (and the parity gate) serves
+TINY_MODEL_CONFIG = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+                     "ffn": 64, "max_positions": 64, "num_labels": 2}
+
+
 def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
     model_config = (
-        {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4, "ffn": 64,
-         "max_positions": 64, "num_labels": 2}
+        dict(TINY_MODEL_CONFIG)
         if tiny
         # bf16 softmax halves scores bandwidth: ~11% of the step at b1024
         # (labels argmax-identical; BENCH_SOFTMAX_DTYPE=float32 reverts)
         else {"softmax_dtype": os.environ.get("BENCH_SOFTMAX_DTYPE", "bfloat16")}
     )
     payload = "stream processing on tpu: sensor reading nominal, no anomaly detected"
-    packing = os.environ.get("BENCH_PACKING", "0") == "1"
+    packing = _bench_packing()
     ragged = os.environ.get("BENCH_RAGGED", "0") == "1"
     if ragged:
         # realistic length mix (mostly short, a long tail) — the workload
@@ -92,6 +144,25 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
                             word * 1, word * 2, word * 8, word * 1]}
     else:
         src = {"payload": payload}
+    if packing and _bench_coalesce():
+        # token-budget coalescing: emissions carry the tokens that fill the
+        # TOP compiled (rows, seq) shape after packing (minus a 2-row margin
+        # for first-fit fragmentation), so the packed row count lands
+        # bucket-exact instead of wherever the source batch size fell. The
+        # deadline must cover the budget's fill time at device speed (short
+        # payloads need several source batches per emission) or every
+        # emission is a flush-sized fragment; 250ms only delays the FIRST
+        # batches after an idle gap — at saturation the budget fills first.
+        buffer = {"type": "memory", "capacity": batch, "timeout": "5ms",
+                  "coalesce": {"batch_buckets": [batch], "deadline": "250ms",
+                               "token_budget": _bench_token_budget(batch, seq),
+                               "max_row_tokens": seq}}
+    elif _bench_coalesce():
+        # row mode: merged emissions land exactly on the compiled bucket
+        buffer = {"type": "memory", "capacity": batch, "timeout": "5ms",
+                  "coalesce": {"batch_buckets": [batch], "deadline": "5ms"}}
+    else:
+        buffer = {"type": "memory", "capacity": batch, "timeout": "5ms"}
     return {
         # per-phase stream name: metrics are labeled by stream, so the packed
         # phase must NOT share the padded phase's rows counter / e2e
@@ -104,13 +175,7 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
             "interval": 0,
             "batch_size": batch,
         },
-        # BENCH_COALESCE=1: bucket-exact coalescing in the buffer — merged
-        # emissions land exactly on the compiled batch bucket, so the device
-        # never runs padding rows (watch padding_waste_frac in the detail)
-        "buffer": ({"type": "memory", "capacity": batch, "timeout": "5ms",
-                    "coalesce": {"batch_buckets": [batch], "deadline": "5ms"}}
-                   if os.environ.get("BENCH_COALESCE", "0") == "1"
-                   else {"type": "memory", "capacity": batch, "timeout": "5ms"}),
+        "buffer": buffer,
         "pipeline": {
             # workers must cover the device queue depth or the semaphore
             # can't fill: each in-flight step is held by one processor call
@@ -121,14 +186,15 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
                     "model": "bert_classifier",
                     "model_config": model_config,
                     "max_seq": seq,
-                    # packing shrinks the row dim to ~E*avg_len/seq, so a
-                    # single full-size bucket would pad the win away; a short
-                    # pow2 grid (down to batch//8: covers packing factors up
-                    # to ~8x, e.g. short payloads at BENCH_SEQ 128) lets
-                    # packed rows land near their natural size while keeping
-                    # the tunnel warmup bounded (10 bucket pairs, cached)
-                    "batch_buckets": (sorted({max(8, batch // 8), max(8, batch // 4),
-                                              max(8, batch // 2), batch})
+                    # packing shrinks the row dim to ~E*avg_len/seq and the
+                    # cascade carve (tpu/packing.py carve_row_windows) emits
+                    # bucket-exact windows down the grid, so the grid must
+                    # reach SMALL buckets or every emission's sub-bucket
+                    # residue pads up to the grid floor (a 48-row residue on
+                    # a 128-floor grid is fill 0.37 — measured 20% capacity
+                    # waste). Full pow2 grid: the warmup pair count grows,
+                    # but the persistent compile cache makes it one-time
+                    "batch_buckets": (_full_pow2_grid(batch)
                                       if packing else [batch]),
                     "seq_buckets": [seq],
                     "outputs": ["label", "score"],
@@ -153,12 +219,7 @@ def build_latency_config(seq: int, tiny: bool) -> dict:
     """Latency mode: bounded input rate + small buckets + buffer-timeout
     micro-batching, so p50/p99 measure end-to-end latency rather than
     queueing under saturation (VERDICT r1 weak-point 3; target p99<50ms)."""
-    model_config = (
-        {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4, "ffn": 64,
-         "max_positions": 64, "num_labels": 2}
-        if tiny
-        else {}
-    )
+    model_config = dict(TINY_MODEL_CONFIG) if tiny else {}
     payload = "stream processing on tpu: sensor reading nominal, no anomaly detected"
     return {
         "name": "bench-lat",
@@ -184,9 +245,9 @@ def build_latency_config(seq: int, tiny: bool) -> dict:
                     "seq_buckets": [seq],
                     "outputs": ["label", "score"],
                     "warmup": True,
-                    # same precision as the headline phase, so the reported
-                    # p99 describes the dtype the artifact is tagged with
-                    "serving_dtype": _bench_dtype(tiny),
+                    # headline precision on accelerators; float32 in tiny
+                    # mode where CPU-emulated bf16 would 9x the p99
+                    "serving_dtype": _latency_dtype(tiny),
                 }
             ],
         },
@@ -361,6 +422,9 @@ def main() -> None:
                 "vs_baseline": 0.0,
                 "detail": {"rows": res["rows"], "elapsed_s": round(res["elapsed_s"], 2),
                            "batch": batch, "backend": _backend(),
+                           # knob record (uniform across phases): the SQL
+                           # anchor has no model, so both are inert here
+                           "packing": False, "serving_dtype": None,
                            # no device infeed in the SQL anchor: both report 0
                            **_infeed_detail(infeed0, _infeed_host_metrics())},
             }
@@ -396,6 +460,26 @@ def main() -> None:
     # (and its executable in the persistent cache) before latency is
     # attempted. Output order is fixed regardless: latency line first,
     # headline LAST for last-JSON-line parsers.
+    # parity gate FIRST (before any measured phase, so a fallback's dtype
+    # flip relabels every phase consistently): the packed low-precision
+    # default only becomes the headline after proving argmax parity against
+    # unpacked float32. A mismatch (or any packed failure below) falls back
+    # to the unpacked float32 phase, so the driver always gets an honest
+    # number.
+    parity_detail: dict = {}
+    if _bench_packing() and os.environ.get("BENCH_SKIP_PARITY", "0") != "1":
+        try:
+            parity_detail = _packed_parity_check(tiny, seq)
+            print(f"bench: packed {_bench_dtype(tiny)} argmax parity OK "
+                  f"({parity_detail['parity_rows']} rows)",
+                  file=sys.stderr, flush=True)
+        except Exception as e:
+            print(f"bench: {e}; falling back to unpacked float32",
+                  file=sys.stderr, flush=True)
+            os.environ["BENCH_PACKING"] = "0"
+            os.environ["BENCH_DTYPE"] = "float32"
+            parity_detail = {"parity": "FAILED (unpacked float32 fallback)"}
+
     run_latency = os.environ.get("BENCH_SKIP_LATENCY", "0") != "1"
     lat = None
     if run_latency and tiny:
@@ -404,25 +488,47 @@ def main() -> None:
 
     # saturated throughput — the headline metric.
     # duty cycle is this phase's DELTA (the latency phase idles on purpose)
-    busy0, stall0 = _busy_stall_from_registry()
-    exec0, exrows0 = _exec_and_example_rows()
-    infeed0 = _infeed_host_metrics()
-    res = asyncio.run(run_bench(seconds, batch, seq, tiny))
-    busy1, stall1 = _busy_stall_from_registry()
-    exec1, exrows1 = _exec_and_example_rows()
-    infeed1 = _infeed_host_metrics()
-    infeed_detail = _infeed_detail(infeed0, infeed1)
-    # examples/s -> device-rows/s via the phase's exec/example ratio (both
-    # deltas span the same phase, so the ratio is window-independent)
-    exec_ratio = (exec1 - exec0) / (exrows1 - exrows0) if exrows1 > exrows0 else 1.0
-    exec_rate = res["rows_per_sec"] * exec_ratio
+    def _headline_phase() -> tuple:
+        busy0, stall0 = _busy_stall_from_registry()
+        exec0, exrows0 = _exec_and_example_rows()
+        infeed0 = _infeed_host_metrics()
+        tok0 = _tokens_total()
+        res = asyncio.run(run_bench(seconds, batch, seq, tiny))
+        busy1, stall1 = _busy_stall_from_registry()
+        exec1, exrows1 = _exec_and_example_rows()
+        detail = dict(_infeed_detail(infeed0, _infeed_host_metrics()))
+        # examples/s -> device-rows/s via the phase's exec/example ratio
+        # (both deltas span the same phase: the ratio is window-independent)
+        exec_ratio = ((exec1 - exec0) / (exrows1 - exrows0)
+                      if exrows1 > exrows0 else 1.0)
+        if _bench_packing() and res["elapsed_s"] > 0:
+            # effective token throughput: true (non-padding) tokens the
+            # packed phase pushed through the device per second
+            detail["tokens_per_sec"] = round(
+                (_tokens_total() - tok0) / res["elapsed_s"], 1)
+        return (res, busy1 - busy0, stall1 - stall0, detail,
+                res["rows_per_sec"] * exec_ratio)
+
+    try:
+        res, d_busy, d_stall, infeed_detail, exec_rate = _headline_phase()
+    except Exception as e:
+        if not _bench_packing():
+            raise
+        print(f"bench: packed default phase failed ({e}); falling back to "
+              "unpacked float32", file=sys.stderr, flush=True)
+        os.environ["BENCH_PACKING"] = "0"
+        os.environ["BENCH_DTYPE"] = "float32"
+        parity_detail = dict(parity_detail,
+                             packed_phase="FAILED (unpacked fallback)")
+        res, d_busy, d_stall, infeed_detail, exec_rate = _headline_phase()
+    infeed_detail.update(parity_detail)
 
     if run_latency and not tiny:
         # TPU: bank the headline BEFORE attempting latency — its bucket
         # compiles can outlive an external kill, and the last printed JSON
         # line must survive as the headline either way (it is re-printed,
         # with latency detail, after a successful latency phase)
-        _print_headline(res, tiny, batch, seq, busy1 - busy0, stall1 - stall0,
+        _print_headline(res, tiny, batch, seq, d_busy, d_stall,
                         dict(infeed_detail), exec_rate)
         lat_seconds = float(os.environ.get("BENCH_LAT_SECONDS", "10"))
         lat = asyncio.run(run_bench(lat_seconds, 8, seq, tiny, mode="latency"))
@@ -442,7 +548,7 @@ def main() -> None:
         lat_tagged = dict(
             lat_detail,
             backend=_backend(),
-            serving_dtype=_bench_dtype(tiny),
+            serving_dtype=_latency_dtype(tiny),
             seq=seq,
             offered_rows_per_sec=LAT_OFFERED_ROWS_PER_SEC,
         )
@@ -462,6 +568,10 @@ def main() -> None:
                         "achieved_rows_per_sec": round(lat["rows_per_sec"], 1),
                         "buffer_timeout_ms": 10,
                         "seq": seq,
+                        # knob record: the bounded-load phase is always
+                        # unpacked (tiny batches); see _latency_dtype
+                        "packing": False,
+                        "serving_dtype": _latency_dtype(tiny),
                     },
                 }
             ),
@@ -475,46 +585,59 @@ def main() -> None:
                 json.dump(lat_tagged, f)
         except OSError:
             pass
-    _print_headline(res, tiny, batch, seq, busy1 - busy0, stall1 - stall0,
+    if lat is not None and _bench_packing():
+        # the latency numbers come from the bounded-load UNPACKED phase;
+        # tag them so the packed headline artifact self-describes
+        lat_detail = dict(lat_detail, latency_phase="unpacked")
+    _print_headline(res, tiny, batch, seq, d_busy, d_stall,
                     {**infeed_detail, **lat_detail}, exec_rate)
 
-    # Opportunistic packed phase (chip runs only): the padded headline above
-    # is banked (printed + BENCH_RESULT.json); if token packing does better
-    # on the same workload it re-emits as the final JSON line (the driver
-    # parses the last line), self-described with packing:true. Any failure
-    # leaves the padded number standing. Even the bench's constant payload
-    # (~14 tokens vs the 32 bucket) wastes >half the MXU on padding, so this
-    # is the first-order lever toward the 100k north star.
-    if ((not tiny or os.environ.get("BENCH_FORCE_PACKED_PHASE") == "1")
-            and os.environ.get("BENCH_PACKING", "0") != "1"
-            and os.environ.get("BENCH_SKIP_PACKED", "0") != "1"):
-        try:
-            os.environ["BENCH_PACKING"] = "1"
-            busy2, stall2 = _busy_stall_from_registry()
-            exec2, exrows2 = _exec_and_example_rows()
-            infeed2 = _infeed_host_metrics()
-            res_p = asyncio.run(run_bench(seconds, batch, seq, tiny))
-            busy3, stall3 = _busy_stall_from_registry()
-            exec3, exrows3 = _exec_and_example_rows()
-            infeed_p = _infeed_detail(infeed2, _infeed_host_metrics())
-            ratio_p = ((exec3 - exec2) / (exrows3 - exrows2)
-                       if exrows3 > exrows2 else 1.0)
-            print(f"bench: packed phase: {res_p['rows_per_sec']:.0f} rows/s "
-                  f"vs padded {res['rows_per_sec']:.0f}", file=sys.stderr, flush=True)
-            if res_p["rows_per_sec"] > res["rows_per_sec"]:
-                # the latency numbers were measured by the earlier UNPACKED
-                # bounded-load phase; tag them so the packed headline
-                # artifact self-describes instead of implying otherwise
-                _print_headline(res_p, tiny, batch, seq, busy3 - busy2,
-                                stall3 - stall2,
-                                dict(lat_detail, latency_phase="unpacked",
-                                     **infeed_p),
-                                res_p["rows_per_sec"] * ratio_p)
-        except Exception as e:  # never lose the banked padded headline
-            print(f"bench: packed phase failed ({e}); padded headline stands",
-                  file=sys.stderr, flush=True)
-        finally:
-            os.environ.pop("BENCH_PACKING", None)
+
+def _packed_parity_check(tiny: bool, seq: int) -> dict:
+    """Argmax-parity gate for the packed low-precision default: the packed
+    processor at the bench dtype must produce the SAME labels as the
+    float32 unpacked reference on a ragged text mix (plus empty- and
+    single-row edges) before its throughput becomes the headline. Returns
+    the detail tags on success; raises AssertionError on any mismatch."""
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
+
+    ensure_plugins_loaded()
+    dtype = _bench_dtype(tiny)
+    word = "sensor reading nominal "
+    texts = [(word * k).encode() for k in (1, 2, 1, 3, 1, 2, 8, 1)] * 8 + [b"", b"x"]
+    base = {"type": "tpu_inference", "model": "bert_classifier",
+            "model_config": dict(TINY_MODEL_CONFIG) if tiny else {},
+            "max_seq": seq, "batch_buckets": [8, 16], "seq_buckets": [seq],
+            "outputs": ["label"]}
+    packed = build_component(
+        "processor", dict(base, packing=True, serving_dtype=dtype), Resource())
+    ref = build_component(
+        "processor", dict(base, serving_dtype="float32"), Resource())
+
+    def labels(proc, payloads):
+        out = asyncio.run(proc.process(MessageBatch.new_binary(payloads)))[0]
+        return out.column("label").to_pylist()
+
+    got = labels(packed, texts) + labels(packed, [b"solo probe"])
+    want = labels(ref, texts) + labels(ref, [b"solo probe"])
+    if got != want:
+        mism = sum(1 for a, b in zip(got, want) if a != b)
+        raise AssertionError(
+            f"packed {dtype} argmax parity failed: {mism}/{len(want)} labels "
+            "differ from the unpacked float32 reference")
+    return {"parity": "argmax_vs_unpacked_float32", "parity_rows": len(want)}
+
+
+def _tokens_total() -> float:
+    """True (non-padding) tokens dispatched by packed runners so far."""
+    from arkflow_tpu.obs import global_registry
+
+    total = 0.0
+    for m in global_registry().collect():
+        if getattr(m, "name", "") == "arkflow_tpu_tokens_total":
+            total += m.value
+    return total
 
 
 def _print_headline(res: dict, tiny: bool, batch: int, seq: int,
@@ -557,7 +680,7 @@ def _print_headline(res: dict, tiny: bool, batch: int, seq: int,
                 "serving_dtype": _bench_dtype(tiny),
                 "softmax_dtype": ("float32" if tiny
                                   else os.environ.get("BENCH_SOFTMAX_DTYPE", "bfloat16")),
-                **_packing_detail(),
+                **_packing_detail(batch, seq),
                 **_flops_detail(res["rows_per_sec"], exec_rate, seq, tiny),
                 **lat_detail,
             },
@@ -565,12 +688,17 @@ def _print_headline(res: dict, tiny: bool, batch: int, seq: int,
     )
 
 
-def _packing_detail() -> dict:
-    """Packed-execution context: on, plus the realized token-fill of packed
+def _packing_detail(batch: int, seq: int) -> dict:
+    """Packed-execution context: the knobs the phase ran with (packing,
+    coalescing mode + token budget) plus the realized token-fill of packed
     rows (effective rows/s = the headline value; fill shows how much bucket
-    padding the packer eliminated)."""
-    out = {"packing": os.environ.get("BENCH_PACKING", "0") == "1",
-           "ragged_payloads": os.environ.get("BENCH_RAGGED", "0") == "1"}
+    padding the packer eliminated) — recorded in every BENCH_RESULT so
+    plateau diagnosis never requires a rerun."""
+    out = {"packing": _bench_packing(),
+           "ragged_payloads": os.environ.get("BENCH_RAGGED", "0") == "1",
+           "coalesce": _bench_coalesce()}
+    if out["packing"] and out["coalesce"]:
+        out["coalesce_token_budget"] = _bench_token_budget(batch, seq)
     if out["packing"]:
         from arkflow_tpu.obs import global_registry
 
@@ -748,6 +876,10 @@ def _run_multichip_bench() -> None:
             "donate_active": donate_on,
             "backend": _backend(),
             "host_cores": os.cpu_count(),
+            # knob record: the scaling phase serves unpacked float32 (it
+            # measures dispatch mechanics, not precision/packing wins)
+            "packing": False,
+            "serving_dtype": "float32",
         },
     })
 
@@ -791,7 +923,9 @@ def _run_generate_bench(tiny: bool) -> None:
     total_tokens = rows * max_new
     detail = {"rows": rows, "max_new_tokens": max_new,
               "elapsed_s": round(elapsed, 2), "warmup_s": round(warm_s, 2),
-              "serving": "continuous", "slots": 8, "backend": _backend()}
+              "serving": "continuous", "slots": 8, "backend": _backend(),
+              # knob record: generation serves unpacked at default precision
+              "packing": False, "serving_dtype": "float32"}
     server = getattr(proc, "_server", None)
     if server is not None and server.m_spec_drafted.value > 0:
         detail["speculative_tokens"] = server.speculative_tokens
@@ -866,14 +1000,16 @@ def _flops_detail(rows_per_sec: float, exec_rate: float, seq: int,
     return out
 
 
-def _infeed_host_metrics() -> tuple[float, float, float, float]:
-    """(prep_s_sum, prep_steps, extract_s_sum, waste_sum) totals across all
-    runners/processors this process ran. prep covers the runner's pad/stage
-    stage, extract the processor's Arrow->tensor + tokenize stage; waste_sum
-    is the per-step padding fraction summed over prep_steps dispatches."""
+def _infeed_host_metrics() -> tuple[float, float, float, float, float, float]:
+    """(prep_s_sum, prep_steps, extract_s_sum, waste_sum, tokens, capacity)
+    totals across all runners/processors this process ran. prep covers the
+    runner's pad/stage stage, extract the processor's Arrow->tensor +
+    tokenize stage; waste_sum is the per-step padding fraction summed over
+    prep_steps dispatches; tokens/capacity are the packed runners' true-token
+    and dispatched-token-slot counters."""
     from arkflow_tpu.obs import global_registry
 
-    prep_s = prep_n = extract_s = waste = 0.0
+    prep_s = prep_n = extract_s = waste = tokens = capacity = 0.0
     for m in global_registry().collect():
         name = getattr(m, "name", "")
         if name == "arkflow_tpu_infeed_prep_seconds":
@@ -883,22 +1019,33 @@ def _infeed_host_metrics() -> tuple[float, float, float, float]:
             extract_s += m.sum
         elif name == "arkflow_padding_waste_frac":
             waste += m.sum
-    return prep_s, prep_n, extract_s, waste
+        elif name == "arkflow_tpu_tokens_total":
+            tokens += m.value
+        elif name == "arkflow_tpu_token_capacity_total":
+            capacity += m.value
+    return prep_s, prep_n, extract_s, waste, tokens, capacity
 
 
 def _infeed_detail(before: tuple, after: tuple) -> dict:
     """Phase-delta infeed numbers for the JSON detail: mean host prep ms per
-    dispatched step (pad/stage + extract/tokenize) and mean padding fraction
-    of the dispatched buckets."""
+    dispatched step (pad/stage + extract/tokenize) and the phase's padding
+    waste. Packed phases report CAPACITY-WEIGHTED waste (1 - true tokens /
+    dispatched token slots): the per-step mean over-weights small tail
+    windows, which carry a sliver of the device time but the same histogram
+    weight as a full bucket."""
     d_prep_s = after[0] - before[0]
     d_steps = after[1] - before[1]
     d_extract_s = after[2] - before[2]
     d_waste = after[3] - before[3]
+    d_tokens = after[4] - before[4]
+    d_capacity = after[5] - before[5]
     if d_steps <= 0:
         return {"infeed_prep_ms": 0.0, "padding_waste_frac": 0.0}
+    waste = (1.0 - d_tokens / d_capacity) if d_capacity > 0 \
+        else d_waste / d_steps
     return {
         "infeed_prep_ms": round((d_prep_s + d_extract_s) / d_steps * 1000.0, 3),
-        "padding_waste_frac": round(d_waste / d_steps, 4),
+        "padding_waste_frac": round(waste, 4),
     }
 
 
