@@ -50,6 +50,12 @@ META_EXT_PREFIX = "__meta_ext_"
 #: ``__meta_ingest_time``, which every delivery re-stamps).
 META_EXT_DEADLINE_MS = META_EXT_PREFIX + "deadline_ms"
 META_EXT_PRIORITY = META_EXT_PREFIX + "priority"
+#: multi-tenant isolation (runtime/overload.py): the tenant id a batch is
+#: accounted against — weighted-fair admission shares, per-tenant quotas and
+#: tenant-labeled shed/latency metrics all key on it. Stamped input-side
+#: (HTTP header / auth subject, Kafka header, or static per-input config);
+#: an ext column so it survives redelivery like deadline/priority.
+META_EXT_TENANT = META_EXT_PREFIX + "tenant"
 
 #: The fixed (non-ext) metadata columns, in canonical order (ref lib.rs:53-63).
 META_COLUMNS = (
@@ -341,6 +347,22 @@ class MessageBatch:
         are never queue-shed)."""
         return self.with_ext_metadata({META_EXT_PRIORITY[len(META_EXT_PREFIX):]:
                                        str(int(priority))})
+
+    def with_tenant(self, tenant: str) -> "MessageBatch":
+        """Stamp the tenant id this batch is accounted against (weighted-fair
+        admission shares + per-tenant quotas, runtime/overload.py). Inputs
+        stamp it from wherever the deployment keeps identity — an HTTP
+        header, the auth subject, a Kafka header, or static config."""
+        return self.with_ext_metadata({META_EXT_TENANT[len(META_EXT_PREFIX):]:
+                                       str(tenant)})
+
+    def tenant(self, default: str | None = None) -> str | None:
+        """Tenant id from ``__meta_ext_tenant``, or ``default`` when the
+        batch is untagged (single-tenant streams never pay for the column)."""
+        raw = self.get_meta(META_EXT_TENANT)
+        if raw is None:
+            return default
+        return str(raw)
 
     def deadline_unix_ms(self) -> float | None:
         """Absolute deadline from ``__meta_ext_deadline_ms``, or None."""

@@ -156,6 +156,7 @@ class StreamConfig:
                 raise ConfigError(f"stream config missing required section {req!r}")
         pipeline = PipelineConfig.from_mapping(m.get("pipeline", {}))
         _validate_token_coalesce(m.get("buffer"), pipeline.processors)
+        _validate_response_cache(pipeline.processors)
         temps = [TemporaryConfig.from_mapping(t) for t in m.get("temporary", [])]
         input_cfg = dict(m["input"])
         reconnect = input_cfg.pop("reconnect", None)
@@ -224,6 +225,25 @@ def _validate_token_coalesce(buffer_cfg: Any, processors: list[dict]) -> None:
             "stream's tpu_inference processor (token-budget emissions only "
             "fill the compiled (rows, seq) shape after pack_tokens packing; "
             "set packing: true or drop token_budget)")
+
+
+def _validate_response_cache(processors: list[dict]) -> None:
+    """Parse-time validation of ``tpu_inference.response_cache`` knobs, so a
+    bad cache config fails at ``--validate`` instead of at stream build —
+    looking through ``fault.inner`` chaos wrappers like the coalesce check.
+    The actual construction happens in the processor builder
+    (runtime/respcache.py ``build_response_cache``); this shares its parse
+    rules without instantiating a cache (or its metric series) per pass."""
+    from arkflow_tpu.runtime.respcache import parse_response_cache_config
+
+    for p in processors:
+        while (isinstance(p, Mapping) and p.get("type") == "fault"
+               and isinstance(p.get("inner"), Mapping)):
+            p = p["inner"]
+        if not isinstance(p, Mapping) or p.get("type") != "tpu_inference":
+            continue
+        if p.get("response_cache") is not None:
+            parse_response_cache_config(p["response_cache"])
 
 
 def _restart_config(m: Any) -> Optional[dict]:

@@ -178,6 +178,10 @@ class KafkaRecord:
     timestamp_ms: int
     key: Optional[bytes]
     value: Optional[bytes]
+    #: record headers (v2 batches); None when the record carried none —
+    #: consumers read routing identity from them (e.g. the kafka input's
+    #: ``tenant_header`` multi-tenancy extraction)
+    headers: Optional[dict[bytes, bytes]] = None
 
 
 def encode_record_batch(records: list[tuple[Optional[bytes], Optional[bytes]]],
@@ -367,13 +371,17 @@ def decode_record_set(data: bytes) -> tuple[list[KafkaRecord], Optional[int]]:
             vlen = rr.varint()
             value = bytes(rr._take(vlen)) if vlen >= 0 else None
             hn = rr.varint()
+            headers: Optional[dict[bytes, bytes]] = None
             for _ in range(hn):
                 hk = rr.varint()
-                rr._take(hk)
+                hkey = bytes(rr._take(hk))
                 hv = rr.varint()
-                if hv >= 0:
-                    rr._take(hv)
-            out.append(KafkaRecord(base_offset + off_delta, first_ts + ts_delta, key, value))
+                hval = bytes(rr._take(hv)) if hv >= 0 else b""
+                if headers is None:
+                    headers = {}
+                headers[hkey] = hval
+            out.append(KafkaRecord(base_offset + off_delta, first_ts + ts_delta,
+                                   key, value, headers))
         r.pos = end
     return out, next_offset
 

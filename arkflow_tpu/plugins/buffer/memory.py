@@ -47,9 +47,10 @@ Config:
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 from typing import Optional
 
-from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.batch import META_EXT_TENANT, MessageBatch
 from arkflow_tpu.components import Ack, Buffer, Resource, VecAck, register_buffer
 from arkflow_tpu.errors import ConfigError
 from arkflow_tpu.tpu.bucketing import MicroBatchCoalescer, bucket_cap_bus
@@ -70,15 +71,27 @@ class MemoryBuffer(Buffer):
         self.timeout_s = timeout_s
         self._coalescer: Optional[MicroBatchCoalescer] = None
         self._deadline_s = None
+        #: tenant isolation: one coalescer per tenant id, so rows of
+        #: different tenants NEVER merge into one emission — a merged
+        #: emission has ONE fair-share/quota identity and one cache
+        #: fingerprint, and both break on mixed-tenant rows. Key ``None``
+        #: is the UNTAGGED lane (``self._coalescer``, kept as an attribute
+        #: for the cap-bus/introspection paths that predate tenancy) —
+        #: untagged and tagged batches differ in schema (the tenant column
+        #: itself), so they can never share a lane: concat would raise.
+        self._tenant_coalescers: dict[Optional[str], MicroBatchCoalescer] = {}
+        #: round-robin cursor over lanes so one tenant's full bucket can't
+        #: starve another lane's deadline flush
+        self._lane_rr: deque[Optional[str]] = deque()
+        self._coalesce_kwargs: Optional[dict] = None
         if coalesce_buckets:
-            self._coalescer = MicroBatchCoalescer(
-                coalesce_buckets, token_budget=token_budget,
+            self._coalesce_kwargs = dict(
+                batch_buckets=coalesce_buckets, token_budget=token_budget,
                 token_field=token_field, token_bytes=token_bytes,
                 max_row_tokens=max_row_tokens)
-            # device OOM degradation: when a runner proves the device can't
-            # hold a bucket, the announced cap shrinks this coalescer's grid
-            # so we stop merging emissions that would just OOM again
-            bucket_cap_bus().register(self._coalescer)
+            self._coalescer = self._new_coalescer()
+            self._tenant_coalescers[None] = self._coalescer
+            self._lane_rr.append(None)
             self._deadline_s = (coalesce_deadline_s if coalesce_deadline_s is not None
                                 else timeout_s)
             if self._deadline_s is None:
@@ -105,7 +118,13 @@ class MemoryBuffer(Buffer):
                         f"(capacity x {self.BACKPRESSURE_FACTOR} rows x "
                         f"max_row_tokens; raise capacity or shrink the "
                         f"budget)")
+        #: the stream's tenant policy (attach_overload hook): supplies the
+        #: SAME reserved set (configured tenants keep their own lane, never
+        #: the overflow) and cap the admission controller caps labels with
+        self._tenant_policy = None
         self._held: list[tuple[MessageBatch, Ack]] = []
+        #: plain-path emissions already carved by tenant, awaiting read()
+        self._ready: deque[tuple[MessageBatch, Ack]] = deque()
         self._held_rows = 0
         self._first_write_at: Optional[float] = None
         self._cond = asyncio.Condition()
@@ -114,6 +133,57 @@ class MemoryBuffer(Buffer):
     #: write() blocks once held rows exceed this multiple of capacity, restoring
     #: the backpressure the bounded queues provide on the non-buffered path.
     BACKPRESSURE_FACTOR = 4
+
+    def _new_coalescer(self) -> MicroBatchCoalescer:
+        c = MicroBatchCoalescer(**self._coalesce_kwargs)
+        # device OOM degradation: when a runner proves the device can't
+        # hold a bucket, the announced cap shrinks this coalescer's grid
+        # so we stop merging emissions that would just OOM again (register
+        # replays the current cap onto late-created tenant lanes)
+        bucket_cap_bus().register(c)
+        return c
+
+    @staticmethod
+    def _tenant_key(batch: MessageBatch) -> Optional[str]:
+        """Grouping key: ``None`` for batches WITHOUT a tenant column —
+        they can never share a lane/group with tagged batches (different
+        schemas; concat requires identical ones). A present-but-empty
+        tenant value normalizes like the controller's label capping."""
+        if not batch.has_column(META_EXT_TENANT):
+            return None
+        from arkflow_tpu.runtime.overload import DEFAULT_TENANT
+
+        return batch.tenant("") or DEFAULT_TENANT
+
+    def attach_overload_controller(self, controller) -> None:
+        """Stream hook (runtime/overload.attach_overload): adopt the
+        controller's tenant policy so lane capping reserves configured
+        tenants and honors ``max_tracked`` exactly like admission labels —
+        a premium tenant's rows must never merge into the overflow lane."""
+        self._tenant_policy = controller.cfg.tenants
+
+    def _lane(self, batch: MessageBatch) -> MicroBatchCoalescer:
+        from arkflow_tpu.runtime.overload import MAX_TENANT_LABELS, cap_tenant_label
+
+        key = self._tenant_key(batch)
+        if key is not None:
+            # bound the lane count with the shared capping rule (same
+            # reserved set + cap as the admission controller when a policy
+            # is attached): the long tail of (possibly attacker-chosen)
+            # tenant ids shares ONE dedicated TAGGED overflow lane — never
+            # the untagged lane, whose schema (no tenant column) wouldn't
+            # concat with theirs
+            policy = self._tenant_policy
+            key = cap_tenant_label(
+                key, self._tenant_coalescers,
+                reserved=(policy.weights if policy is not None else ()),
+                cap=(policy.max_tracked if policy is not None
+                     else MAX_TENANT_LABELS))
+        lane = self._tenant_coalescers.get(key)
+        if lane is None:
+            lane = self._tenant_coalescers[key] = self._new_coalescer()
+            self._lane_rr.append(key)
+        return lane
 
     async def write(self, batch: MessageBatch, ack: Ack) -> None:
         async with self._cond:
@@ -125,7 +195,7 @@ class MemoryBuffer(Buffer):
             if self._first_write_at is None:
                 self._first_write_at = asyncio.get_running_loop().time()
             if self._coalescer is not None:
-                self._coalescer.add(batch, ack)
+                self._lane(batch).add(batch, ack)
             else:
                 self._held.append((batch, ack))
             self._held_rows += batch.num_rows
@@ -133,25 +203,68 @@ class MemoryBuffer(Buffer):
             self._cond.notify_all()
 
     def _emit_locked(self) -> tuple[MessageBatch, Ack]:
-        batches = [b for b, _ in self._held]
-        acks = VecAck([a for _, a in self._held])
+        """Plain-path flush: one merged emission per TENANT (arrival order
+        within a tenant preserved; mixed-tenant rows never share an
+        emission). The first group returns now, the rest park in ``_ready``
+        for the next read() calls — their rows STAY in ``_held_rows`` until
+        actually consumed, so parked groups can't slip past the capacity
+        backpressure bound."""
+        groups: dict[str, list[tuple[MessageBatch, Ack]]] = {}
+        order: list[str] = []
+        for b, a in self._held:
+            key = self._tenant_key(b)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append((b, a))
         self._held = []
-        self._held_rows = 0
         self._first_write_at = None
+        for key in order:
+            pairs = groups[key]
+            self._ready.append((MessageBatch.concat([b for b, _ in pairs]),
+                                VecAck([a for _, a in pairs])))
+        return self._pop_ready_locked()
+
+    def _pop_ready_locked(self) -> tuple[MessageBatch, Ack]:
+        emission = self._ready.popleft()
+        self._held_rows -= emission[0].num_rows
         self._cond.notify_all()  # wake writers blocked on backpressure
-        return MessageBatch.concat(batches), acks
+        return emission
 
     def _emit_coalesced_locked(self, *, flush: bool) -> Optional[tuple[MessageBatch, Ack]]:
         """Bucket-exact emission; ``flush`` (deadline/close) also carves the
-        sub-target tail against the smaller buckets, then the remainder."""
-        if flush:
-            emission = self._coalescer.pop_flush()
+        sub-target tail against the smaller buckets, then the remainder.
+        One deadline expiry services EVERY backlogged lane — the flush pass
+        drains one emission per lane into ``_ready`` before the shared
+        deadline restarts, else with K tenant lanes the last one's tail
+        would wait K x deadline (each single-lane flush used to restart the
+        clock for everyone). Exact (full-bucket) pops visit lanes
+        round-robin so one tenant's steady full buckets can't starve
+        another's."""
+        if flush and not self._ready:
+            for _ in range(len(self._lane_rr)):
+                key = self._lane_rr[0]
+                self._lane_rr.rotate(-1)
+                emission = self._tenant_coalescers[key].pop_flush()
+                if emission is not None:
+                    self._ready.append(emission)
+        if self._ready:
+            emission = self._ready.popleft()
         else:
-            emission = self._coalescer.pop_exact()
-        if emission is None:
-            return None
+            emission = None
+            for _ in range(len(self._lane_rr)):
+                key = self._lane_rr[0]
+                self._lane_rr.rotate(-1)
+                emission = self._tenant_coalescers[key].pop_exact()
+                if emission is not None:
+                    break
+            if emission is None:
+                return None
+        # rows leave the backpressure accounting only when an emission is
+        # actually handed to the reader — parked _ready emissions still
+        # count, so a multi-lane flush can't slip past the capacity bound
         self._held_rows -= emission[0].num_rows
-        if self._coalescer.pending == 0:
+        if self.pending_entries == 0 and not self._ready:
             self._first_write_at = None
         else:
             # the held tail's deadline budget restarts, else a long-ago first
@@ -160,11 +273,19 @@ class MemoryBuffer(Buffer):
         self._cond.notify_all()  # wake writers blocked on backpressure
         return emission
 
+    @property
+    def pending_entries(self) -> int:
+        """Held entries across every tenant lane (coalescer mode)."""
+        return sum(c.pending for c in self._tenant_coalescers.values())
+
     async def read(self) -> Optional[tuple[MessageBatch, Ack]]:
         if self._coalescer is not None:
             return await self._read_coalesced()
         while True:
             async with self._cond:
+                if self._ready:
+                    # tenant groups carved by an earlier flush drain first
+                    return self._pop_ready_locked()
                 if self._held_rows >= self.capacity:
                     return self._emit_locked()
                 if self._closed:

@@ -16,6 +16,15 @@ Config:
     auth: {type: basic, username: u, password: "${HTTP_PW}"}
     rate_limit: {capacity: 100, per_second: 50}
     cors: true
+    tenant_header: X-Tenant-Id  # multi-tenancy: the request header whose
+                                # value lands in __meta_ext_tenant (default
+                                # X-Arkflow-Tenant); when the header is
+                                # absent and auth is enabled, the auth
+                                # subject (basic-auth username) is the
+                                # fallback identity. `tenant_header: false`
+                                # disables extraction entirely. Per-tenant
+                                # quota rejections answer 429 with a
+                                # Retry-After from the tenant's own bucket.
 """
 
 from __future__ import annotations
@@ -36,10 +45,14 @@ from arkflow_tpu.utils.rate_limiter import TokenBucket
 QUEUE_BOUND = 1000  # ref http.rs flume bound
 
 
+DEFAULT_TENANT_HEADER = "X-Arkflow-Tenant"
+
+
 class HttpInput(Input):
     def __init__(self, host: str, port: int, path: str, codec=None,
                  auth: Optional[Authenticator] = None,
-                 limiter: Optional[TokenBucket] = None, cors: bool = False):
+                 limiter: Optional[TokenBucket] = None, cors: bool = False,
+                 tenant_header: Optional[str] = DEFAULT_TENANT_HEADER):
         self.host = host
         self.port = port
         self.path = path
@@ -47,6 +60,9 @@ class HttpInput(Input):
         self.auth = auth
         self.limiter = limiter
         self.cors = cors
+        #: header whose value becomes ``__meta_ext_tenant`` (None = off);
+        #: absent header falls back to the auth subject when auth is on
+        self.tenant_header = tenant_header
         self._queue: Optional[asyncio.Queue] = None
         self._runner: Optional[web.AppRunner] = None
         self._closed = False
@@ -88,15 +104,44 @@ class HttpInput(Input):
             seconds = 3600.0
         return {"Retry-After": str(max(1, math.ceil(seconds)))}
 
-    def _check_admission(self) -> None:
+    def _tenant_of(self, req: web.Request) -> Optional[str]:
+        """Tenant identity for this request: the configured header first,
+        the auth subject (basic-auth username) as the authenticated
+        fallback, else None (single-tenant accounting).
+        ``tenant_header: false`` (-> None) disables BOTH — the documented
+        full opt-out must not leave the auth fallback minting tenant
+        state behind the operator's back."""
+        if self.tenant_header is None:
+            return None
+        t = req.headers.get(self.tenant_header)
+        if t:
+            return t
+        if self.auth is not None:
+            return self.auth.subject()
+        return None
+
+    def _check_admission(self, tenant: Optional[str] = None) -> None:
         """Raise :class:`Overloaded` when this request must be 429'd.
-        Engine-side overload is checked BEFORE the token bucket so the
-        rejection doesn't also burn one of the client's rate-limit tokens;
-        either way the error carries the exact ``Retry-After`` a
-        well-behaved client should honor instead of hammering blind."""
-        if self._overload is not None and self._overload.should_reject():
-            raise Overloaded("overloaded",
-                             retry_after_s=self._overload.retry_after_s())
+        Engine-side overload is checked BEFORE the buckets so the rejection
+        doesn't also burn the client's rate-limit tokens; the per-tenant
+        quota (when the stream's controller meters tenants) answers with
+        the TENANT's own ``Retry-After`` — a well-behaved client backs off
+        for exactly as long as its bucket needs, and nobody else's traffic
+        is implicated. Quota availability is checked without consuming: the
+        batch consumes at stream admission, so the socket check and the
+        admission charge never double-bill. The socket meters ONE row per
+        request (the body isn't decoded yet; a codec may expand it to many
+        rows) — the full row/token cost is charged at admission, so
+        quota-metered HTTP streams should configure ``error_output``:
+        an admission-level quota shed of an already-200'd request then
+        stays routed instead of log-dropped (HTTP acks can't redeliver)."""
+        if self._overload is not None:
+            if self._overload.should_reject():
+                raise Overloaded("overloaded",
+                                 retry_after_s=self._overload.retry_after_s())
+            wait = self._overload.quota_retry_after_s(tenant)
+            if wait > 0:
+                raise Overloaded("tenant quota exceeded", retry_after_s=wait)
         if self.limiter is not None and not self.limiter.try_acquire():
             raise Overloaded("rate limited",
                              retry_after_s=self.limiter.time_until(1.0))
@@ -105,8 +150,9 @@ class HttpInput(Input):
         client = req.remote or "?"
         if self.auth is not None and not self.auth.check(req.headers.get("Authorization"), client):
             return web.Response(status=401, headers=self._cors_headers())
+        tenant = self._tenant_of(req)
         try:
-            self._check_admission()
+            self._check_admission(tenant)
         except Overloaded as e:
             return web.Response(
                 status=429, text=str(e),
@@ -114,7 +160,7 @@ class HttpInput(Input):
                          **self._retry_after(e.retry_after_s)})
         body = await req.read()
         try:
-            self._queue.put_nowait(body)
+            self._queue.put_nowait((body, tenant))
         except asyncio.QueueFull:
             return web.Response(status=503, text="queue full", headers=self._cors_headers())
         return web.Response(status=200, text="ok", headers=self._cors_headers())
@@ -122,11 +168,15 @@ class HttpInput(Input):
     async def read(self) -> tuple[MessageBatch, Ack]:
         if self._closed:
             raise EndOfInput()
-        payload = await self._queue.get()
-        if payload is None:
+        item = await self._queue.get()
+        if item is None:
             raise EndOfInput()
+        payload, tenant = item
         batch = decode_payloads([payload], self.codec)
-        return batch.with_source("http").with_ingest_time(), NoopAck()
+        batch = batch.with_source("http").with_ingest_time()
+        if tenant is not None:
+            batch = batch.with_tenant(tenant)
+        return batch, NoopAck()
 
     async def close(self) -> None:
         self._closed = True
@@ -150,6 +200,13 @@ def _build(config: dict, resource: Resource) -> HttpInput:
     rl = config.get("rate_limit")
     if rl:
         limiter = TokenBucket(int(rl.get("capacity", 100)), float(rl.get("per_second", 100)))
+    tenant_header = config.get("tenant_header", DEFAULT_TENANT_HEADER)
+    if tenant_header is False or tenant_header is None:
+        tenant_header = None  # explicit opt-out of tenant extraction
+    elif not isinstance(tenant_header, str) or not tenant_header:
+        raise ConfigError(
+            f"http input tenant_header must be a header name or false, "
+            f"got {tenant_header!r}")
     return HttpInput(
         host=str(config.get("host", "0.0.0.0")),
         port=int(port),
@@ -158,4 +215,5 @@ def _build(config: dict, resource: Resource) -> HttpInput:
         auth=Authenticator(auth_cfg) if auth_cfg.kind != "none" else None,
         limiter=limiter,
         cors=bool(config.get("cors", False)),
+        tenant_header=tenant_header,
     )

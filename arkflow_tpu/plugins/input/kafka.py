@@ -29,6 +29,11 @@ Config:
     batch_size: 500           # max records per read
     assignor: cooperative-sticky,range   # preference order; 'range' forces eager
     codec: json               # optional; raw __value__ otherwise
+    tenant: team-a            # multi-tenancy: static tenant id stamped into
+                              # __meta_ext_tenant for every batch, or
+    tenant_header: x-tenant   # read it from each fetch's record headers
+                              # (first record of the batch decides — one
+                              # partition fetch is one admission unit)
 """
 
 from __future__ import annotations
@@ -103,7 +108,9 @@ class KafkaInput(Input):
     def __init__(self, brokers: str, topics: list[str], group: str,
                  partitions: Optional[list[int]], start: str, batch_size: int, codec=None,
                  client_kwargs: Optional[dict] = None,
-                 assignors: tuple[str, ...] = ("cooperative-sticky", "range")):
+                 assignors: tuple[str, ...] = ("cooperative-sticky", "range"),
+                 tenant: Optional[str] = None,
+                 tenant_header: Optional[str] = None):
         if start not in ("earliest", "latest"):
             raise ConfigError("kafka input 'start' must be earliest|latest")
         for a in assignors:
@@ -126,6 +133,10 @@ class KafkaInput(Input):
         self.start = start
         self.batch_size = batch_size
         self.codec = codec
+        #: static tenant id for every batch (__meta_ext_tenant), and/or the
+        #: record-header name carrying a per-message tenant (header wins)
+        self.tenant = tenant
+        self.tenant_header = tenant_header.encode() if tenant_header else None
         self.client_kwargs = client_kwargs or {}
         self._client: Optional[KafkaClient] = None
         #: next offset to fetch per (topic, partition)
@@ -339,6 +350,18 @@ class KafkaInput(Input):
             .with_ext_metadata({"topic": topic})
             .with_ingest_time()
         )
+        tenant = self.tenant
+        if self.tenant_header is not None:
+            hdrs = records[0].headers or {}
+            raw = hdrs.get(self.tenant_header)
+            if raw:
+                try:
+                    tenant = raw.decode("utf-8")
+                except UnicodeDecodeError:
+                    logger.warning("kafka tenant header %r not utf-8; using %r",
+                                   self.tenant_header, tenant)
+        if tenant is not None:
+            out = out.with_tenant(tenant)
         if per_row is not None and base.num_rows == len(records):
             out = out.with_column("__meta_offset", pa.array([r.offset for r in records], pa.int64()))
             out = out.with_column("__meta_key", pa.array([r.key for r in records], pa.binary()))
@@ -392,4 +415,7 @@ def _build(config: dict, resource: Resource) -> KafkaInput:
             a.strip()
             for a in str(config.get("assignor", "cooperative-sticky,range")).split(",")
             if a.strip()),
+        tenant=(str(config["tenant"]) if config.get("tenant") else None),
+        tenant_header=(str(config["tenant_header"])
+                       if config.get("tenant_header") else None),
     )

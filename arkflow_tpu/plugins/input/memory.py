@@ -20,13 +20,16 @@ from arkflow_tpu.plugins.codec.helper import build_codec, decode_payloads
 
 class MemoryInput(Input):
     def __init__(self, messages: list[bytes], codec=None,
-                 pause_on_overload: bool = False):
+                 pause_on_overload: bool = False,
+                 tenant: str | None = None):
         self._initial = list(messages)
         self.codec = codec
         self._queue: deque[bytes] = deque()
         # opt-in (config `pause_on_overload: true`): lets tests exercise the
         # stream's cooperative-pause path without a broker
         self.pause_on_overload = pause_on_overload
+        #: static per-stream tenant id (multi-tenancy: __meta_ext_tenant)
+        self.tenant = tenant
 
     async def connect(self) -> None:
         self._queue = deque(self._initial)
@@ -36,7 +39,10 @@ class MemoryInput(Input):
             raise EndOfInput()
         payload = self._queue.popleft()
         batch = decode_payloads([payload], self.codec)
-        return batch.with_source("memory"), NoopAck()
+        batch = batch.with_source("memory")
+        if self.tenant is not None:
+            batch = batch.with_tenant(self.tenant)
+        return batch, NoopAck()
 
     def push(self, payload: bytes) -> None:
         """Test hook: enqueue a message after construction."""
@@ -59,4 +65,6 @@ def _build(config: dict, resource: Resource) -> MemoryInput:
 
             encoded.append(json.dumps(m).encode())
     return MemoryInput(encoded, codec=build_codec(config.get("codec"), resource),
-                       pause_on_overload=bool(config.get("pause_on_overload", False)))
+                       pause_on_overload=bool(config.get("pause_on_overload", False)),
+                       tenant=(str(config["tenant"]) if config.get("tenant")
+                               else None))

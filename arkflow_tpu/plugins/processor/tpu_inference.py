@@ -48,6 +48,13 @@ Config:
                                    # (default 4 with packing; a full row
                                    # bucket of short texts holds several
                                    # examples per row)
+    response_cache:                # exact-match dedup cache in front of the
+      capacity: 1024               # device (runtime/respcache.py): keyed on
+      ttl: 30s                     # batch_fingerprint, LRU + TTL bounded,
+                                   # N concurrent duplicate deliveries
+                                   # collapse onto ONE device step and hits
+                                   # return bitwise-identical responses —
+                                   # retry storms stop costing TPU dispatches
     step_deadline: 2s              # self-healing: per-step watchdog — a step
                                    # exceeding it is abandoned, the runner
                                    # goes UNHEALTHY (recovery probes re-admit
@@ -81,7 +88,7 @@ if TYPE_CHECKING:  # jax-importing modules load lazily in the builder
 class TpuInferenceProcessor(Processor):
     def __init__(self, runner: ModelRunner, *, text_field: str, tensor_field: Optional[str],
                  tokenizer, max_seq: int, outputs: Optional[list[str]], warmup: bool = False,
-                 packing: bool = False):
+                 packing: bool = False, response_cache=None):
         self.runner = runner
         self.text_field = text_field
         self.tensor_field = tensor_field
@@ -90,6 +97,9 @@ class TpuInferenceProcessor(Processor):
         self.outputs = outputs
         self._warmed = not warmup
         self.packing = packing
+        #: exact-match dedup cache (runtime/respcache.py); None = every
+        #: batch pays a device step, the pre-cache behavior
+        self.cache = response_cache
         from arkflow_tpu.obs import global_registry
 
         # extraction/tokenization is the other half of host infeed prep
@@ -98,6 +108,13 @@ class TpuInferenceProcessor(Processor):
             "arkflow_tpu_extract_seconds",
             "host-side Arrow->tensor extraction + tokenization per batch",
             {"model": runner.family.name})
+
+    def attach_overload_controller(self, controller) -> None:
+        """Stream hook (runtime/overload.attach_overload): hand the tenant
+        policy to the response cache so its tenant-hit labels cap with the
+        same reserved set / bound as the admission controller."""
+        if self.cache is not None:
+            self.cache.set_tenant_policy(controller.cfg.tenants)
 
     # -- input extraction --------------------------------------------------
 
@@ -173,13 +190,26 @@ class TpuInferenceProcessor(Processor):
             return []
         if not self._warmed:  # direct use without a stream (tests, tools)
             await self.connect()
-        if self.packing:
-            outputs = await self._infer_packed(batch)
+        if self.cache is not None:
+            from arkflow_tpu.batch import batch_fingerprint
+
+            # the shared stable identity: redeliveries and byte-identical
+            # retries hash equal (ingest time / ext metadata excluded), so
+            # a duplicate storm costs one fingerprint hash, zero dispatches
+            key = batch_fingerprint(batch)
+            outputs = await self.cache.get_or_compute(
+                key, lambda: self._infer(batch), tenant=batch.tenant())
         else:
-            with self.m_extract.time():
-                inputs = self._extract(batch)
-            outputs = await self.runner.infer(inputs)
+            outputs = await self._infer(batch)
         return [self._attach(batch, outputs)]
+
+    async def _infer(self, batch: MessageBatch) -> dict[str, np.ndarray]:
+        """One un-cached inference: extract -> device step(s)."""
+        if self.packing:
+            return await self._infer_packed(batch)
+        with self.m_extract.time():
+            inputs = self._extract(batch)
+        return await self.runner.infer(inputs)
 
     async def _infer_packed(self, batch: MessageBatch) -> dict[str, np.ndarray]:
         """Token-packed inference (tpu/packing.py): tokenize off the payload
@@ -289,6 +319,8 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
             model, config.get("model_config"), mesh_spec=mesh_spec, **common)
     vocab = getattr(runner.cfg, "vocab_size", 30522)
     tokenizer = build_tokenizer(config.get("tokenizer"), vocab_size=vocab)
+    from arkflow_tpu.runtime.respcache import build_response_cache
+
     return TpuInferenceProcessor(
         runner,
         text_field=config.get("text_field", DEFAULT_BINARY_VALUE_FIELD),
@@ -298,4 +330,6 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         outputs=config.get("outputs"),
         warmup=bool(config.get("warmup", False)),
         packing=packing,
+        response_cache=build_response_cache(
+            config.get("response_cache"), name=str(model)),
     )

@@ -79,6 +79,25 @@ class Engine:
                     info["overload"] = ctrl.report()
                 except Exception:  # introspection must not break /health
                     logger.exception("overload report failed for stream %s", s.name)
+            caches = []
+            for proc in getattr(s.pipeline, "processors", None) or []:
+                # walk fault/decorator wrappers via their _inner chain (the
+                # attach_overload convention) so a chaos-wrapped inference
+                # stage still reports its cache
+                node, seen = proc, set()
+                while node is not None and id(node) not in seen:
+                    seen.add(id(node))
+                    report = getattr(getattr(node, "cache", None), "report", None)
+                    if report is not None:
+                        try:
+                            caches.append(report())
+                        except Exception:
+                            logger.exception("cache report failed for stream %s",
+                                             s.name)
+                        break
+                    node = getattr(node, "_inner", None)
+            if caches:
+                info["response_caches"] = caches
             out[s.name] = info
         return out
 

@@ -40,6 +40,7 @@ from arkflow_tpu.config import StreamConfig
 from arkflow_tpu.errors import ArkError, Disconnection, EndOfInput
 from arkflow_tpu.obs import global_registry
 from arkflow_tpu.runtime.overload import (
+    FairQueue,
     OverloadConfig,
     OverloadController,
     attach_overload,
@@ -63,6 +64,10 @@ class _WorkItem:
     batch: MessageBatch
     ack: Ack
     enqueued_at: float = 0.0  # loop-clock time it entered the worker queue
+    #: capped tenant label (set at admission when tenant accounting is on);
+    #: None routes FairQueue items to the control lane, so admission MUST
+    #: stamp it before putting — the default only applies pre-admission
+    tenant: Optional[str] = None
 
 
 class _Done:
@@ -191,13 +196,24 @@ class Stream:
         for t in self.temporaries.values():
             await t.connect()
         # push-based inputs (HTTP) get the controller for their 429 path;
-        # pull-based brokers opt into cooperative pause instead
+        # pull-based brokers opt into cooperative pause instead. The buffer
+        # and processors get it too: tenant-lane capping and cache
+        # tenant-hit labels must reserve/cap EXACTLY like admission labels
         attach_overload(self.input, self.overload)
+        attach_overload(self.buffer, self.overload)
+        for proc in getattr(self.pipeline, "processors", None) or []:
+            attach_overload(proc, self.overload)
         self._pause_source = (self.overload is not None
                               and input_pauses_on_overload(self.input))
 
         qsize = self.queue_size  # pipeline.queue_size; default ref stream/mod.rs:90-93
-        input_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
+        if self.overload is not None and self.overload.cfg.tenants is not None:
+            # multi-tenant serving: the worker queue itself schedules by
+            # weighted deficit round robin, so one tenant's admitted backlog
+            # cannot sit in front of everyone else's dequeues
+            input_q = FairQueue(self.overload, qsize)
+        else:
+            input_q = asyncio.Queue(maxsize=qsize)
         output_q: asyncio.Queue = asyncio.Queue(maxsize=qsize)
 
         tasks = [asyncio.create_task(self._do_input(input_q, cancel), name=f"{self.name}-input")]
@@ -357,14 +373,14 @@ class Stream:
             wait = loop.time() - item.enqueued_at
             self.m_queue_wait.observe(wait)
             if self.overload is not None:
-                self.overload.on_dequeue(wait, loop.time())
+                self.overload.on_dequeue(wait, loop.time(), tenant=item.tenant)
                 remaining = item.batch.remaining_deadline_ms(
                     self.overload.cfg.deadline_ms)
                 if remaining is not None and remaining <= 0:
                     # went stale in the queue: finishing it is strictly worse
                     # than shedding (the caller already gave up) — and the
                     # expiry check is what bounds delivered-batch latency
-                    await self._shed_item(item, self.overload.expire())
+                    await self._shed_item(item, self.overload.expire(item.tenant))
                     continue
             seq = self._seq_assigned
             self._seq_assigned += 1
@@ -425,12 +441,39 @@ class Stream:
         if ctrl is None:
             return True
         remaining = item.batch.remaining_deadline_ms(ctrl.cfg.deadline_ms)
-        reason = ctrl.admit(item.batch.priority_band(ctrl.cfg.priority), remaining)
+        tokens = 0.0
+        if ctrl.cfg.tenants is not None:
+            # capped label computed ONCE here; every later touch (fair
+            # queue lane, dequeue accounting, expiry, latency) reuses it
+            item.tenant = ctrl.tenant_label(item.batch.tenant())
+            if ctrl.meters_tokens():
+                tokens = self._estimate_tokens(item.batch, ctrl.cfg.tenants)
+        reason = ctrl.admit(item.batch.priority_band(ctrl.cfg.priority), remaining,
+                            tenant=item.tenant, rows=float(item.batch.num_rows),
+                            tokens=tokens)
         if reason is None:
-            ctrl.on_enqueue()
+            ctrl.on_enqueue(item.tenant)
             return True
         await self._shed_item(item, reason)
         return False
+
+    @staticmethod
+    def _estimate_tokens(batch: MessageBatch, policy) -> float:
+        """Estimated token cost for tokens/s quota metering — the PR-6
+        vectorized payload estimator (one pass over the Arrow offsets),
+        reading the policy's ``token_field``/``token_bytes`` (which must
+        match the serving stage's payload column). Batches without a usable
+        payload column meter one token per row, so malformed traffic still
+        counts against SOMETHING instead of riding free."""
+        from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD
+        from arkflow_tpu.tpu.extract import payload_token_estimates
+
+        try:
+            col = batch.column(policy.token_field or DEFAULT_BINARY_VALUE_FIELD)
+            return float(payload_token_estimates(
+                col, token_bytes=policy.token_bytes).sum())
+        except Exception:
+            return float(batch.num_rows)
 
     async def _shed_item(self, item: _WorkItem, reason: str) -> None:
         """Dispose of a shed batch without silent loss: route to
@@ -609,7 +652,12 @@ class Stream:
         self._clear_attempts(item.batch)
         ingest = item.batch.get_meta("__meta_ingest_time")
         if ingest is not None:
-            self.m_e2e_latency.observe(max(0.0, time.time() - ingest / 1000.0))
+            e2e = max(0.0, time.time() - ingest / 1000.0)
+            self.m_e2e_latency.observe(e2e)
+            if self.overload is not None and item.tenant is not None:
+                # tenant-labeled delivered latency: what the noisy-tenant
+                # soak's per-tenant p99 SLO assertion reads
+                self.overload.observe_tenant_latency(item.tenant, e2e)
         await self._safe_ack(item.ack)
 
 

@@ -92,6 +92,15 @@ class Authenticator:
         if entry[0] >= LOCKOUT_THRESHOLD and not entry[2]:
             entry[2] = now + LOCKOUT_SECONDS
 
+    def subject(self) -> Optional[str]:
+        """The authenticated principal's identity, used as the tenant-id
+        fallback when no tenant header is sent (runtime/overload.py multi-
+        tenancy). Basic auth has a real subject (the username); bearer auth
+        is a shared capability token with no identity — None."""
+        if self.config.kind == "basic":
+            return self.config.username
+        return None
+
     def check(self, authorization: Optional[str], client: str = "?") -> bool:
         """Validate an Authorization header; tracks lockout per client."""
         if self.config.kind == "none":

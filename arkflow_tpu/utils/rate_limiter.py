@@ -1,38 +1,65 @@
-"""Token-bucket rate limiter for the HTTP input.
+"""Token-bucket rate limiter for the HTTP input and per-tenant quotas.
 
 Mirrors the reference's lock-free CAS bucket (ref:
-crates/arkflow-plugin/src/rate_limiter.rs:24-120) — asyncio is single-threaded
-so plain arithmetic replaces the atomics; semantics (capacity, refill rate,
-non-blocking try_acquire) carry over.
+crates/arkflow-plugin/src/rate_limiter.rs:24-120). The original port relied
+on asyncio single-threadedness, but per-tenant quota buckets
+(runtime/overload.py) are now shared across worker threads (procpool
+pipelines, runner executor threads, the HTTP handler), so refill/acquire
+run under a lock — the Python analog of the reference's CAS loop. Time is
+``time.monotonic()`` throughout: a wall clock stepping backward (NTP slew,
+VM migration) would otherwise mint negative elapsed time and silently
+freeze refill.
 """
 
 from __future__ import annotations
 
 import math
+import threading
 import time
 
 from arkflow_tpu.errors import ConfigError
 
 
 class TokenBucket:
-    def __init__(self, capacity: int, refill_per_sec: float):
+    def __init__(self, capacity: int | float, refill_per_sec: float):
         if capacity <= 0 or refill_per_sec <= 0:
             raise ConfigError("rate limiter needs positive capacity and refill rate")
         self.capacity = float(capacity)
         self.refill_per_sec = float(refill_per_sec)
         self._tokens = float(capacity)
         self._last = time.monotonic()
+        # concurrent try_acquire/time_until callers (tenant buckets shared
+        # across worker threads): refill+test+consume must be one atomic
+        # step or two racing acquirers both spend the same tokens
+        self._lock = threading.Lock()
 
     def _refill(self, now: float) -> None:
-        self._tokens = min(self.capacity, self._tokens + (now - self._last) * self.refill_per_sec)
+        # monotonic never steps backward, but guard the subtraction anyway:
+        # a bucket constructed on one thread and first used on another may
+        # observe interleaved _last updates during lock-free reads in tests
+        elapsed = max(0.0, now - self._last)
+        self._tokens = min(self.capacity, self._tokens + elapsed * self.refill_per_sec)
         self._last = now
 
     def try_acquire(self, n: float = 1.0) -> bool:
-        self._refill(time.monotonic())
-        if self._tokens >= n:
+        with self._lock:
+            self._refill(time.monotonic())
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def drain(self, n: float = 1.0) -> None:
+        """Consume ``n`` tokens unconditionally — the balance may go
+        NEGATIVE (debt). For admission paths that gate on a
+        capacity-clamped availability check but must charge the REAL cost
+        of an oversized unit: the debt throttles every subsequent
+        acquisition until the refill pays it off, so a batch 10x the burst
+        allowance still averages out to the contracted rate instead of
+        riding the clamp 10x over quota."""
+        with self._lock:
+            self._refill(time.monotonic())
             self._tokens -= n
-            return True
-        return False
 
     def time_until(self, n: float = 1.0) -> float:
         """Seconds until ``n`` tokens will be available (0.0 = available
@@ -42,7 +69,8 @@ class TokenBucket:
         never be satisfied: returns ``math.inf``."""
         if n > self.capacity:
             return math.inf
-        self._refill(time.monotonic())
-        if self._tokens >= n:
-            return 0.0
-        return (n - self._tokens) / self.refill_per_sec
+        with self._lock:
+            self._refill(time.monotonic())
+            if self._tokens >= n:
+                return 0.0
+            return (n - self._tokens) / self.refill_per_sec

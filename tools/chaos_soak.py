@@ -23,6 +23,20 @@ configured deadline, AND the control run with the controller disabled
 reproduces today's unbounded queue growth (p99 blows past the same bound).
 Same seed => same fault schedule => same verdict; exit code 1 on FAIL.
 
+``--noisy-tenant`` soaks the multi-tenant fairness layer: three tenants
+share one stream, the noisy one offering 10x its configured rows/s quota
+while the weighted-fair scheduler and per-tenant quotas protect the rest:
+
+    python tools/chaos_soak.py --noisy-tenant --fast     # tier-1 smoke
+    python tools/chaos_soak.py --noisy-tenant --seed 3
+
+Noisy-tenant PASS means: every quiet tenant's DELIVERED p99 stays within
+the deadline SLO, the noisy tenant's sheds are fully accounted
+(``arkflow_shed_total{reason=quota}`` > 0 and offered == delivered + shed —
+zero silent loss), and a duplicate-delivery burst against a response-cached
+``tpu_inference`` stage shows cache hits > 0 with bitwise-identical
+responses and exactly ONE device step for N concurrent duplicates.
+
 Runs on the virtual-CPU JAX platform by default (no TPU needed; ``--burst``
 never imports jax at all); set ARKFLOW_SOAK_KEEP_ENV=1 to target whatever
 backend the environment provides.
@@ -365,6 +379,256 @@ def run_burst_soak(seconds: float = 60.0, seed: int = 7, messages: int = 48,
     }
 
 
+QUIET_TENANTS = ("alpha", "beta")
+NOISY_TENANT = "noisy"
+
+
+def _noisy_config(seed: int, deadline_ms: float, step_ms: int, quota: int,
+                  name: str) -> dict:
+    """Multi-tenant overload pipeline: a per-batch latency fault emulates
+    the device step; the overload controller meters the noisy tenant's
+    rows/s quota and divides the admission window by weight. The input is
+    swapped for the seeded tenant source after build (like the collectors)."""
+    return {
+        "name": name,
+        "input": {"type": "memory", "messages": ["placeholder"]},
+        "pipeline": {
+            "thread_num": 2,
+            "queue_size": 64,
+            "deadline_ms": deadline_ms,
+            "processors": [{
+                "type": "fault",
+                "seed": seed,
+                "faults": [
+                    {"kind": "latency", "every": 1, "times": 0,
+                     "duration": f"{step_ms}ms"},
+                ],
+            }],
+            "overload": {
+                "max_window": 16,
+                "interval": "10ms",
+                "tenants": {
+                    "burst": "1s",
+                    "per_tenant": {
+                        # the noisy tenant's CONTRACT: quota rows/s with a
+                        # 1s burst allowance; quiet tenants are unmetered
+                        # but their weight dominates the admission window
+                        NOISY_TENANT: {"weight": 1, "rows_per_sec": quota},
+                        QUIET_TENANTS[0]: {"weight": 4},
+                        QUIET_TENANTS[1]: {"weight": 4},
+                    },
+                },
+            },
+        },
+        "output": {"type": "drop"},
+        "error_output": {"type": "drop"},
+    }
+
+
+def run_noisy_tenant_soak(seconds: float = 60.0, seed: int = 7,
+                          fast: bool = False) -> dict:
+    """Run the multi-tenant fairness soak + the duplicate-burst cache phase
+    and return the verdict dict. The fairness phase is pure asyncio; the
+    cache phase builds a tiny ``tpu_inference`` stage (the caller owns jax
+    platform env setup, like ``run_soak``)."""
+    import asyncio
+    import random
+    from collections import deque
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import (
+        Ack,
+        Input,
+        NoopAck,
+        ensure_plugins_loaded,
+    )
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.errors import EndOfInput
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import build_stream
+
+    ensure_plugins_loaded()
+    deadline_ms = 250.0
+    step_ms = 3 if fast else 5
+    quota = 16 if fast else 32          # noisy rows/s contract
+    quiet_each = 16 if fast else 48     # per quiet tenant
+    noisy_total = quota * 10            # the 10x-over-quota retry storm
+    name = f"noisy-soak-{seed}"
+
+    class _TenantSource(Input):
+        """Seeded interleave of per-tenant single-row batches, tenant
+        stamped input-side (static per-stream config analog)."""
+
+        def __init__(self, schedule):
+            self._items = deque(schedule)
+
+        async def connect(self) -> None:
+            return None
+
+        async def read(self) -> tuple[MessageBatch, Ack]:
+            if not self._items:
+                raise EndOfInput()
+            tenant, payload = self._items.popleft()
+            batch = MessageBatch.new_binary([payload]).with_source(
+                "tenant-soak").with_tenant(tenant)
+            return batch, NoopAck()
+
+    rng = random.Random(seed)
+    schedule = [(NOISY_TENANT, f"{NOISY_TENANT} {i:05d}".encode())
+                for i in range(noisy_total)]
+    for t in QUIET_TENANTS:
+        schedule += [(t, f"{t} {i:05d}".encode()) for i in range(quiet_each)]
+    rng.shuffle(schedule)
+
+    cfg = StreamConfig.from_mapping(
+        _noisy_config(seed, deadline_ms, step_ms, quota, name))
+    stream = build_stream(cfg)
+    stream.input = _TenantSource(schedule)
+
+    delivered: list[tuple[str, bytes]] = []
+    shed: list[tuple[str, bytes]] = []
+
+    class _Collect(DropOutput):
+        def __init__(self, sink):
+            self._sink = sink
+
+        async def write(self, batch: MessageBatch) -> None:
+            tenant = batch.tenant("?")
+            self._sink.extend((tenant, p) for p in batch.to_binary())
+
+    stream.output = _Collect(delivered)
+    stream.error_output = _Collect(shed)
+
+    async def bounded_run() -> bool:
+        cancel = asyncio.Event()
+        task = asyncio.create_task(stream.run(cancel))
+        done, _ = await asyncio.wait({task}, timeout=seconds)
+        if done:
+            task.result()
+            return False
+        cancel.set()
+        try:
+            await asyncio.wait_for(task, timeout=15.0)
+        except (asyncio.TimeoutError, Exception):
+            task.cancel()
+        return True
+
+    t0 = time.monotonic()
+    wedged = asyncio.run(bounded_run())
+    elapsed = time.monotonic() - t0
+
+    ctrl = stream.overload
+    offered = int(stream.m_batches_in.value)
+    shed_by_reason = {r: int(c.value) for r, c in ctrl.m_shed.items()}
+    expected = {p for _, p in schedule}
+    seen = {p for _, p in delivered} | {p for _, p in shed}
+    lost = sorted(expected - seen)
+
+    tenant_p99_ms = {}
+    quiet_ok = True
+    for t in QUIET_TENANTS:
+        ts = ctrl.tenants.get(t)
+        p99 = ts.m_e2e.quantile(0.99) * 1000.0 if ts is not None else float("nan")
+        tenant_p99_ms[t] = round(p99, 3)
+        delivered_t = sum(1 for tn, _ in delivered if tn == t)
+        # the SLO is on DELIVERED batches; a quiet tenant must both deliver
+        # and deliver fast — zero deliveries would vacuously "pass"
+        quiet_ok = quiet_ok and delivered_t > 0 and p99 <= deadline_ms
+    noisy = ctrl.tenants.get(NOISY_TENANT)
+    noisy_sheds = ({r: int(c.value) for r, c in noisy.m_shed.items()}
+                   if noisy is not None else {})
+
+    fairness = {
+        "wedged": wedged,
+        "elapsed_s": round(elapsed, 3),
+        "offered_batches": offered,
+        "delivered_batches": len(delivered),
+        "shed_batches": len(shed),
+        "shed_by_reason": shed_by_reason,
+        "noisy_shed_by_reason": noisy_sheds,
+        "lost_rows": len(lost),
+        "quiet_tenant_p99_ms": tenant_p99_ms,
+        "deadline_ms": deadline_ms,
+        # the accounting identity: every offered batch ended somewhere, and
+        # every shed is reason-counted — zero silent loss
+        "identity_ok": (offered == len(delivered) + len(shed)
+                        and sum(shed_by_reason.values()) == len(shed)),
+        "quota_sheds": shed_by_reason.get("quota", 0),
+        "quiet_p99_ok": quiet_ok,
+    }
+    if lost:
+        fairness["lost_sample"] = [p.decode() for p in lost[:5]]
+
+    cache = asyncio.run(_duplicate_burst_cache_phase(fast))
+
+    return {
+        "mode": "noisy-tenant",
+        "pass": bool(not wedged
+                     and fairness["identity_ok"]
+                     and fairness["lost_rows"] == 0
+                     and fairness["quota_sheds"] > 0
+                     and fairness["quiet_p99_ok"]
+                     and cache["pass"]),
+        "seed": seed,
+        "fairness": fairness,
+        "cache": cache,
+    }
+
+
+async def _duplicate_burst_cache_phase(fast: bool) -> dict:
+    """Duplicate-delivery burst against a response-cached tpu_inference
+    stage: N concurrent identical batches must collapse onto ONE device
+    step and every response must be bitwise-identical."""
+    import asyncio
+
+    duplicates = 4 if fast else 12
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Resource, ensure_plugins_loaded
+    from arkflow_tpu.components.registry import build_component
+
+    ensure_plugins_loaded()
+    tiny_model = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+                  "ffn": 64, "max_positions": 64, "num_labels": 2}
+    proc = build_component("processor", {
+        "type": "tpu_inference",
+        "model": "bert_classifier",
+        "model_config": tiny_model,
+        "max_seq": 16,
+        "batch_buckets": [2, 4],
+        "seq_buckets": [16],
+        "warmup": True,
+        "response_cache": {"capacity": 64, "ttl": "60s"},
+    }, Resource())
+
+    # prime with a DIFFERENT payload so compiles/warmup steps are excluded
+    # from the duplicate-burst step count
+    await proc.process(MessageBatch.new_binary([b"prime row"]))
+    base_steps = proc.runner.m_infer.count
+
+    dup = MessageBatch.new_binary([b"dup row 0", b"dup row 1"]).with_tenant(
+        NOISY_TENANT)
+    results = await asyncio.gather(
+        *[proc.process(dup) for _ in range(duplicates)])
+    late = await proc.process(dup)  # post-in-flight: a pure cache hit
+    steps = proc.runner.m_infer.count - base_steps
+
+    first = results[0][0]
+    identical = all(r[0] == first for r in results) and late[0] == first
+    cache = proc.cache
+    out = {
+        "duplicates_offered": duplicates + 1,
+        "device_steps_for_duplicates": int(steps),
+        "hits": int(cache.m_hits.value),
+        "collapsed": int(cache.m_collapsed.value),
+        "misses": int(cache.m_misses.value),
+        "bitwise_identical": bool(identical),
+    }
+    out["pass"] = bool(steps == 1 and identical
+                       and out["hits"] + out["collapsed"] >= duplicates)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seconds", type=float, default=60.0,
@@ -376,6 +640,11 @@ def main(argv=None) -> int:
                     help="overload-control soak: burst fault drives offered "
                          "load past throughput; asserts bounded p99 + the "
                          "zero-silent-loss accounting identity")
+    ap.add_argument("--noisy-tenant", action="store_true",
+                    help="multi-tenant fairness soak: one tenant offers 10x "
+                         "its quota; asserts quiet-tenant p99 within SLO, "
+                         "quota sheds fully accounted, and duplicate-burst "
+                         "cache hits with no extra device steps")
     ap.add_argument("--factor", type=int, default=4,
                     help="burst mode: offered-load multiplier (default 4)")
     ap.add_argument("--fast", action="store_true",
@@ -391,6 +660,18 @@ def main(argv=None) -> int:
         verdict = run_burst_soak(seconds=args.seconds, seed=args.seed,
                                  messages=args.messages, factor=args.factor,
                                  fast=args.fast)
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["pass"] else 1
+
+    if args.noisy_tenant:
+        if os.environ.get("ARKFLOW_SOAK_KEEP_ENV") != "1":
+            # the cache phase builds a tiny device stage: pin virtual CPU
+            # BEFORE jax loads, like the default self-healing soak
+            from arkflow_tpu.utils.cleanenv import pin_cpu_env
+
+            pin_cpu_env(os.environ, n_devices=2)
+        verdict = run_noisy_tenant_soak(seconds=args.seconds, seed=args.seed,
+                                        fast=args.fast)
         print(json.dumps(verdict, indent=2))
         return 0 if verdict["pass"] else 1
 
