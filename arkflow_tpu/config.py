@@ -157,6 +157,7 @@ class StreamConfig:
         pipeline = PipelineConfig.from_mapping(m.get("pipeline", {}))
         _validate_token_coalesce(m.get("buffer"), pipeline.processors)
         _validate_response_cache(pipeline.processors)
+        _validate_generate_mesh(pipeline.processors)
         temps = [TemporaryConfig.from_mapping(t) for t in m.get("temporary", [])]
         input_cfg = dict(m["input"])
         reconnect = input_cfg.pop("reconnect", None)
@@ -244,6 +245,64 @@ def _validate_response_cache(processors: list[dict]) -> None:
             continue
         if p.get("response_cache") is not None:
             parse_response_cache_config(p["response_cache"])
+
+
+#: decoder_lm's DecoderConfig default — mirrored here (not imported) so mesh
+#: validation at parse time never drags jax into `--validate`
+_DECODER_LM_DEFAULT_KV_HEADS = 4
+
+
+def _validate_generate_mesh(processors: list[dict]) -> None:
+    """Parse-time checks for multi-chip ``tpu_generate`` serving, looking
+    through ``fault.inner`` chaos wrappers like the other cross-checks:
+
+    - mesh axis values must be positive ints;
+    - ``serving: continuous`` shards TENSOR-PARALLEL only — the lockstep
+      slot grid does not batch-split, so ``dp``/``sp`` > 1 fail here with a
+      clear message instead of a shape error at stream build;
+    - ``tp`` must divide the model's KV head count (the page pools shard
+      over KV heads on the tp axis).
+    """
+    for p in processors:
+        while (isinstance(p, Mapping) and p.get("type") == "fault"
+               and isinstance(p.get("inner"), Mapping)):
+            p = p["inner"]
+        if not isinstance(p, Mapping) or p.get("type") != "tpu_generate":
+            continue
+        mesh = p.get("mesh")
+        if mesh is None:
+            continue
+        if not isinstance(mesh, Mapping):
+            raise ConfigError(
+                f"tpu_generate.mesh must be a mapping, got {mesh!r}")
+        axes: dict[str, int] = {}
+        for k in ("dp", "tp", "sp"):
+            v = mesh.get(k, 1)
+            if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+                raise ConfigError(
+                    f"tpu_generate.mesh.{k} must be a positive int, got {v!r}")
+            axes[k] = v
+        if str(p.get("serving", "batch")) != "continuous":
+            continue
+        for axis in ("dp", "sp"):
+            if axes[axis] > 1:
+                raise ConfigError(
+                    f"tpu_generate: serving: continuous + mesh {axis} > 1 is "
+                    "unsupported — the lockstep slot grid does not "
+                    "batch-split; shard tp (mesh: {tp: N}) or use serving: "
+                    "batch / tpu_inference for dp")
+        tp = axes["tp"]
+        if tp > 1:
+            mc = p.get("model_config")
+            kv_heads = (mc.get("kv_heads") if isinstance(mc, Mapping) else None)
+            if kv_heads is None and p.get("model", "decoder_lm") == "decoder_lm":
+                kv_heads = _DECODER_LM_DEFAULT_KV_HEADS
+            if (isinstance(kv_heads, int) and not isinstance(kv_heads, bool)
+                    and kv_heads % tp != 0):
+                raise ConfigError(
+                    f"tpu_generate: mesh tp={tp} must divide the model's "
+                    f"kv_heads={kv_heads} (KV pages shard over heads on the "
+                    "tp axis)")
 
 
 def _restart_config(m: Any) -> Optional[dict]:

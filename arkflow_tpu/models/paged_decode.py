@@ -34,13 +34,28 @@ def init_page_pool(cfg: DecoderConfig, num_pages: int, page_size: int):
     return jnp.zeros(shape, jnp.bfloat16), jnp.zeros(shape, jnp.bfloat16)
 
 
+def _constrain(x, sharding):
+    """Pin a per-layer pool slice to its tensor-parallel sharding (KV heads
+    over ``tp``). Under GSPMD the layer scan would otherwise be free to
+    all-gather the pools at every step — hundreds of MB of HBM churn; the
+    constraint keeps scatter/gather partitioned. ``None`` (single-device
+    serving) is a no-op so the unsharded path traces identically."""
+    if sharding is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
 def paged_prefill(params: dict, cfg: DecoderConfig, input_ids, lengths,
-                  page_table, k_pages, v_pages, return_logits: bool = False):
+                  page_table, k_pages, v_pages, return_logits: bool = False,
+                  kv_sharding=None):
     """Prefill prompts and scatter their K/V into pages.
 
     input_ids: [B, T] right-padded; lengths: [B]; page_table: [B, P].
     Returns (next_ids [B], k_pages, v_pages) — pools updated for all
     positions < lengths (padding scatters to scratch page 0).
+
+    ``kv_sharding``: optional per-layer-pool ``NamedSharding`` (KV heads over
+    ``tp``) for tensor-parallel serving; see ``_constrain``.
     """
     b, t = input_ids.shape
     page = k_pages.shape[2]
@@ -72,8 +87,10 @@ def paged_prefill(params: dict, cfg: DecoderConfig, input_ids, lengths,
         v = cm.dense(lp["wv"], y).reshape(b, t, cfg.kv_heads, dh)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        kp = kp.at[page_idx, offset].set(k.astype(jnp.bfloat16))
-        vp = vp.at[page_idx, offset].set(v.astype(jnp.bfloat16))
+        kp = _constrain(kp.at[page_idx, offset].set(k.astype(jnp.bfloat16)),
+                        kv_sharding)
+        vp = _constrain(vp.at[page_idx, offset].set(v.astype(jnp.bfloat16)),
+                        kv_sharding)
         kk = jnp.repeat(k, group, axis=2)
         vv = jnp.repeat(v, group, axis=2)
         attn = cm.attention(q, kk, vv, mask).reshape(b, t, cfg.heads * dh)
@@ -95,7 +112,7 @@ def paged_prefill(params: dict, cfg: DecoderConfig, input_ids, lengths,
 
 def paged_prefill_chunk(params: dict, cfg: DecoderConfig, input_ids, chunk_off,
                         chunk_len, page_table, k_pages, v_pages,
-                        return_all: bool = False):
+                        return_all: bool = False, kv_sharding=None):
     """Prefill ONE CHUNK of a prompt at absolute offset ``chunk_off``.
 
     Chunked prefill keeps continuous serving responsive: a long prompt no
@@ -155,8 +172,10 @@ def paged_prefill_chunk(params: dict, cfg: DecoderConfig, input_ids, chunk_off,
         v = cm.dense(lp["wv"], y).reshape(b, t, cfg.kv_heads, dh)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        kp = kp.at[page_idx, offset].set(k.astype(jnp.bfloat16))
-        vp = vp.at[page_idx, offset].set(v.astype(jnp.bfloat16))
+        kp = _constrain(kp.at[page_idx, offset].set(k.astype(jnp.bfloat16)),
+                        kv_sharding)
+        vp = _constrain(vp.at[page_idx, offset].set(v.astype(jnp.bfloat16)),
+                        kv_sharding)
         # earlier chunks' keys come back through the page gather (this
         # chunk's own keys were just scattered, so they are included too)
         kk = kp[page_table].reshape(b, ctx, cfg.kv_heads, dh).astype(x.dtype)
@@ -182,7 +201,7 @@ def paged_prefill_chunk(params: dict, cfg: DecoderConfig, input_ids, chunk_off,
 
 def paged_decode_step(params: dict, cfg: DecoderConfig, token_ids, lengths,
                       active, page_table, k_pages, v_pages,
-                      return_logits: bool = False):
+                      return_logits: bool = False, kv_sharding=None):
     """One decode step over all serving slots.
 
     token_ids: [S] current token per slot; lengths: [S] tokens already in
@@ -222,8 +241,12 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, token_ids, lengths,
         v = cm.dense(lp["wv"], y).reshape(s, 1, cfg.kv_heads, dh)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        kp = kp.at[write_page, write_off].set(k[:, 0].astype(jnp.bfloat16))
-        vp = vp.at[write_page, write_off].set(v[:, 0].astype(jnp.bfloat16))
+        kp = _constrain(
+            kp.at[write_page, write_off].set(k[:, 0].astype(jnp.bfloat16)),
+            kv_sharding)
+        vp = _constrain(
+            vp.at[write_page, write_off].set(v[:, 0].astype(jnp.bfloat16)),
+            kv_sharding)
         # gather each slot's context from the pool: [S, P, page, kh, dh]
         kk = kp[page_table].reshape(s, ctx, cfg.kv_heads, dh).astype(x.dtype)
         vv = vp[page_table].reshape(s, ctx, cfg.kv_heads, dh).astype(x.dtype)

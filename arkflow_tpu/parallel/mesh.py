@@ -80,6 +80,45 @@ def dp_size(mesh: Mesh) -> int:
     return int(dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1))
 
 
+def tp_size(mesh: Mesh) -> int:
+    """Size of the tensor-parallel axis (1 when the mesh has no ``tp``)."""
+    return int(dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated placement on the mesh (scalars, page tables, token
+    ids — everything the paged serving path keeps static-shaped and global)."""
+    return NamedSharding(mesh, P())
+
+
+def kv_pool_shardings(mesh: Mesh) -> tuple[NamedSharding, NamedSharding]:
+    """Shardings for the paged KV pools under tensor-parallel serving.
+
+    Returns ``(full_pool, per_layer)``: the full pool is
+    ``[layers, num_pages, page, kv_heads, dh]`` (the jitted steps' in/out
+    sharding), the per-layer slice inside the layer scan is
+    ``[num_pages, page, kv_heads, dh]`` (applied as a sharding constraint so
+    GSPMD keeps the pools partitioned instead of all-gathering hundreds of
+    MB per step). KV heads split over ``tp``; the page dims stay replicated,
+    so page-table gathers/scatters remain static-shaped and local."""
+    if tp_size(mesh) > 1:
+        return (NamedSharding(mesh, P(None, None, None, "tp", None)),
+                NamedSharding(mesh, P(None, None, "tp", None)))
+    return replicated(mesh), replicated(mesh)
+
+
+def validate_tp_heads(tp: int, kv_heads: int, who: str = "serving") -> None:
+    """Tensor-parallel serving shards attention state over KV heads, so the
+    tp degree must divide ``kv_heads`` (GQA keeps ``heads % kv_heads == 0``,
+    so query heads divide automatically)."""
+    if tp > 1 and kv_heads % tp != 0:
+        from arkflow_tpu.errors import ConfigError
+
+        raise ConfigError(
+            f"{who}: mesh tp={tp} must divide the model's kv_heads={kv_heads} "
+            "(the KV cache shards over heads on the tp axis)")
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for model INPUTS/OUTPUTS under serving: leading (batch) dim
     split over ``dp``, everything else replicated. On a mesh without a dp

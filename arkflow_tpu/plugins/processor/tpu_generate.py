@@ -24,6 +24,11 @@ Config:
     output_field: generated
     batch_buckets: [8, 16]
     serving: continuous      # batch | continuous (paged KV + lockstep slots)
+    mesh: {tp: 4}            # multi-chip serving. batch mode shards dp/tp/sp;
+                             # continuous mode shards TENSOR-PARALLEL only:
+                             # KV pages split over KV heads on the tp axis
+                             # (tp must divide the model's kv_heads; dp/sp
+                             # don't compose with the lockstep slot grid)
     prefill_chunk: 128       # continuous mode: admit long prompts in chunks
                              # interleaved with decode steps (0 = one-shot)
     speculative_tokens: 3    # continuous+greedy: self-drafted (n-gram
@@ -33,6 +38,12 @@ Config:
                              # finished prompts donate full KV pages, later
                              # requests with the same token prefix alias
                              # them and prefill only the rest (0 = off)
+    step_deadline: 2s        # continuous mode: per-step watchdog from the
+                             # shared serving core (tpu/serving_core.py) — a
+                             # hung step marks the server UNHEALTHY and the
+                             # batch nacks for redelivery
+    step_deadline_first: 60s # budget for first-compile steps (default 10x)
+    health: {probe_backoff: 500ms, probe_backoff_cap: 30s, dead_after: 8}
 """
 
 from __future__ import annotations
@@ -59,25 +70,35 @@ class TpuGenerateProcessor(Processor):
                  serving: str = "batch", slots: int = 8, page_size: int = 16,
                  temperature: float = 0.0, top_k: int = 0,
                  mesh_config: Optional[dict] = None, prefill_chunk: int = 0,
-                 speculative_tokens: int = 0, prefix_cache_pages: int = 0):
+                 speculative_tokens: int = 0, prefix_cache_pages: int = 0,
+                 step_deadline_s: Optional[float] = None,
+                 step_deadline_first_s: Optional[float] = None,
+                 health_config=None):
         import jax
 
         from arkflow_tpu.models import get_model
         from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
 
         enable_persistent_cache()  # the whole-generation jit is the costliest compile
-        if serving == "continuous" and mesh_config:
-            raise ConfigError(
-                "tpu_generate: continuous serving + mesh sharding is not "
-                "composed yet (use batch mode for tensor-parallel decode)")
         if mesh_config:
             allowed = {"dp", "tp", "sp"}
             unknown = set(mesh_config) - allowed
             if unknown:
                 raise ConfigError(
                     f"tpu_generate mesh keys {sorted(unknown)} not supported "
-                    f"here (batch generation shards over {sorted(allowed)}; "
+                    f"here (generation shards over {sorted(allowed)}; "
                     f"ep/pp apply to training/forward paths)")
+        if serving == "continuous" and mesh_config:
+            # continuous serving is tensor-parallel only: the lockstep slot
+            # grid does not batch-split, so dp/sp must stay 1 (parse-time
+            # config.py validation gives the same answer at --validate)
+            for axis in ("dp", "sp"):
+                if int(mesh_config.get(axis, 1)) > 1:
+                    raise ConfigError(
+                        f"tpu_generate: serving: continuous + mesh {axis} > 1 "
+                        "is unsupported — the lockstep slot grid does not "
+                        "batch-split; shard tp (mesh: {tp: N}) or use "
+                        "serving: batch / tpu_inference for dp")
         self.family = get_model(model)
         if "generate" not in self.family.extras:
             raise ConfigError(f"model {model!r} does not support incremental decoding")
@@ -137,7 +158,11 @@ class TpuGenerateProcessor(Processor):
 
         # continuous mode: paged-KV lockstep server (vLLM-style); requests
         # from every stream worker share the slot grid, so long generations
-        # never hold short ones hostage (per-row completion, not per-batch)
+        # never hold short ones hostage (per-row completion, not per-batch).
+        # Under a mesh the server runs tensor-parallel (KV pages over tp);
+        # it also sits on the shared serving core, so the engine's /health
+        # and the fault plugin reach it through ``self.runner`` exactly like
+        # a tpu_inference ModelRunner.
         self.serving = serving
         self._server = None
         if serving == "continuous":
@@ -151,7 +176,16 @@ class TpuGenerateProcessor(Processor):
                 prefill_chunk=prefill_chunk,
                 speculative_tokens=speculative_tokens,
                 prefix_cache_pages=prefix_cache_pages,
+                mesh=self.mesh,
+                step_deadline_s=step_deadline_s,
+                step_deadline_first_s=step_deadline_first_s,
+                health_config=health_config,
+                name=model,
             )
+            #: the engine's /health introspection and the fault plugin's
+            #: step-fault arming both look for ``.runner`` — the generation
+            #: server IS this processor's device runner
+            self.runner = self._server
 
         reg = global_registry()
         self.m_tokens = reg.counter("arkflow_generated_tokens_total", "tokens generated",
@@ -239,12 +273,15 @@ class TpuGenerateProcessor(Processor):
 
 @register_processor("tpu_generate")
 def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
+    from arkflow_tpu.tpu.serving_core import parse_core_config
+
     model = config.get("model", "decoder_lm")
     max_input = int(config.get("max_input", 256))
     buckets = BucketPolicy.from_config(config, max_batch=int(config.get("max_batch", 16)),
                                        max_seq=max_input)
     runner_cfg = config.get("model_config")
     vocab = (runner_cfg or {}).get("vocab_size", 2048)
+    core_cfg = parse_core_config(config)
     return TpuGenerateProcessor(
         model,
         runner_cfg,
@@ -265,6 +302,9 @@ def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
         prefill_chunk=int(config.get("prefill_chunk", 0)),
         speculative_tokens=int(config.get("speculative_tokens", 0)),
         prefix_cache_pages=int(config.get("prefix_cache_pages", 0)),
+        step_deadline_s=core_cfg["step_deadline_s"],
+        step_deadline_first_s=core_cfg["step_deadline_first_s"],
+        health_config=core_cfg["health_config"],
     )
 
 

@@ -290,11 +290,8 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
             "tpu_inference: 'device_pool' and 'mesh' are mutually exclusive "
             "(a pool member is a single-device runner; pick sharded dispatch "
             "OR replicated serving)")
-    from arkflow_tpu.tpu.health import HealthConfig
-    from arkflow_tpu.utils.duration import parse_duration
+    from arkflow_tpu.tpu.serving_core import parse_core_config
 
-    step_deadline = config.get("step_deadline")
-    step_deadline_first = config.get("step_deadline_first")
     common = dict(
         buckets=buckets,
         checkpoint=config.get("checkpoint"),
@@ -303,11 +300,9 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         max_in_flight=(int(config["max_in_flight"])
                        if config.get("max_in_flight") is not None else None),
         packed=packing,
-        step_deadline_s=(parse_duration(step_deadline)
-                         if step_deadline is not None else None),
-        step_deadline_first_s=(parse_duration(step_deadline_first)
-                               if step_deadline_first is not None else None),
-        health_config=HealthConfig.from_config(config.get("health")),
+        # shared self-healing knobs (step_deadline / step_deadline_first /
+        # health) — parsed by the serving core both device paths sit on
+        **parse_core_config(config),
     )
     if pool_size > 1:
         from arkflow_tpu.tpu.pool import ModelRunnerPool

@@ -42,12 +42,12 @@ from typing import Optional
 
 import numpy as np
 
-from arkflow_tpu.errors import ConfigError, RunnerDead, StepDeadlineExceeded
+from arkflow_tpu.errors import ConfigError, RunnerDead
 from arkflow_tpu.tpu.health import DEAD, DEGRADED, HEALTHY, UNHEALTHY
 from arkflow_tpu.obs import global_registry
 from arkflow_tpu.tpu.bucketing import BucketPolicy
 from arkflow_tpu.tpu.runner import (ModelRunner, convert_for_serving,
-                                    init_host_params, is_oom_error)
+                                    init_host_params)
 
 logger = logging.getLogger("arkflow.tpu")
 
@@ -244,15 +244,11 @@ class ModelRunnerPool:
         return min(waits) if waits else 0.05
 
     def _note_member_failure(self, i: int, e: Exception) -> None:
-        """Health bookkeeping for a member step that raised. Deadline misses
-        and OOMs self-mark inside the runner (which also releases a probe
-        claim); anything else — a raw XLA fault, a generic probe failure —
-        must mark HERE, unconditionally: ``mark_unhealthy`` both stops
-        dispatch feeding the chip and clears the probing flag, so a FAILED
-        probe re-arms its backoff instead of fencing the member forever."""
-        if isinstance(e, (StepDeadlineExceeded, RunnerDead)) or is_oom_error(e):
-            return
-        self.members[i].health.mark_unhealthy(f"step failed: {e}")
+        """Health bookkeeping for a member step that raised: shared policy on
+        the member's serving core (deadline misses and OOMs self-mark inside
+        the step; anything else marks UNHEALTHY here) — the same surface any
+        dispatcher sitting on ``ServingRunnerCore`` members uses."""
+        self.members[i].core.note_external_failure(e)
 
     def infer_sync(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
         while True:

@@ -29,7 +29,6 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
-from collections import deque
 from functools import partial
 from typing import Any, Optional
 
@@ -48,44 +47,18 @@ from arkflow_tpu.parallel.mesh import (
     shard_params,
 )
 from arkflow_tpu.tpu.bucketing import BucketPolicy, bucket_cap_bus, pad_batch_dim, pad_seq_dim
-from arkflow_tpu.tpu.health import HealthConfig, RunnerHealth
-from arkflow_tpu.tpu.health import DEAD as HEALTH_DEAD
-from arkflow_tpu.tpu.health import UNHEALTHY as HEALTH_UNHEALTHY
+from arkflow_tpu.tpu.health import HealthConfig
+# the self-healing substrate (health gates, deadline watchdog, chaos hooks)
+# lives in the shared serving core now; these re-exports keep the historical
+# import surface (tests, fault plugin) stable
+from arkflow_tpu.tpu.serving_core import (  # noqa: F401  (re-exported)
+    FIRST_COMPILE_DEADLINE_SCALE,
+    InjectedOom,
+    ServingRunnerCore,
+    is_oom_error,
+)
 
 logger = logging.getLogger("arkflow.tpu")
-
-#: an unseen (batch, seq) shape compiles before it executes; the watchdog
-#: scales the step deadline by this factor unless ``step_deadline_first``
-#: pins an absolute budget for first-compile steps
-FIRST_COMPILE_DEADLINE_SCALE = 10.0
-
-
-class InjectedOom(RuntimeError):
-    """Chaos-injected device OOM (``inject_step_fault('oom')``): carries the
-    RESOURCE_EXHAUSTED signature so it walks the real degradation path."""
-
-    def __init__(self, msg: str = "RESOURCE_EXHAUSTED: chaos: injected device OOM"):
-        super().__init__(msg)
-
-
-#: substrings identifying an XLA allocation failure across backends/versions
-_OOM_SIGNATURES = ("resource_exhausted", "resource exhausted", "out of memory", "oom")
-
-
-def is_oom_error(e: BaseException) -> bool:
-    """Device allocation failure? Matched on the message because jaxlib's
-    ``XlaRuntimeError`` carries the gRPC status only as text (and the chaos
-    layer fabricates the same signature). Word-boundary match: a bare
-    substring test would classify any message containing e.g. "boom" as an
-    OOM and route it into the degradation path."""
-    if isinstance(e, InjectedOom):
-        return True
-    if isinstance(e, MemoryError):
-        return True
-    import re
-
-    msg = str(e).lower()
-    return any(re.search(rf"\b{re.escape(sig)}\b", msg) for sig in _OOM_SIGNATURES)
 
 
 def _env_int(name: str, default: int, minimum: Optional[int] = None) -> int:
@@ -421,39 +394,23 @@ class ModelRunner:
             self._staging = _StagingPool(max_per_key=self.max_in_flight + 1)
 
         # -- self-healing device layer (step deadlines / OOM degradation /
-        # -- health state machine) ------------------------------------------
-        if step_deadline_s is not None and step_deadline_s <= 0:
-            raise ConfigError(f"step_deadline must be positive, got {step_deadline_s}")
-        if step_deadline_first_s is not None and step_deadline_first_s <= 0:
-            raise ConfigError(
-                f"step_deadline_first must be positive, got {step_deadline_first_s}")
-        self.step_deadline_s = step_deadline_s
-        #: first-compile steps trace + compile before executing; they get
-        #: their own (much larger) budget so a cold bucket isn't misread as a
-        #: hung device
-        self.step_deadline_first_s = (
-            step_deadline_first_s
-            if step_deadline_first_s is not None
-            else (step_deadline_s * FIRST_COMPILE_DEADLINE_SCALE
-                  if step_deadline_s is not None else None))
+        # -- health state machine) — shared serving core ---------------------
         self.device_label = device_label
         health_name = f"{model}" + (f"[dev {device_label}]" if device_label else "")
-        self.health = RunnerHealth(
-            health_config,
-            gauge=reg.gauge(
-                "arkflow_tpu_runner_health",
-                "runner health state (0 healthy, 1 degraded, 2 unhealthy, 3 dead)",
-                labels),
-            name=health_name)
-        self.m_deadline_miss = reg.counter(
-            "arkflow_tpu_step_deadline_misses",
-            "device steps abandoned after exceeding step_deadline", labels)
+        self.core = ServingRunnerCore(
+            name=health_name,
+            labels=labels,
+            step_deadline_s=step_deadline_s,
+            step_deadline_first_s=step_deadline_first_s,
+            health_config=health_config,
+            rebuild_fn=self._rebuild_after_incident,
+        )
+        self.health = self.core.health
+        self.m_deadline_miss = self.core.m_deadline_miss
+        self.m_rebuilds = self.core.m_rebuilds
         self.m_oom = reg.counter(
             "arkflow_tpu_oom_total",
             "device RESOURCE_EXHAUSTED / OOM failures observed in steps", labels)
-        self.m_rebuilds = reg.counter(
-            "arkflow_tpu_runner_rebuilds_total",
-            "jitted-step rebuilds after a deadline miss", labels)
         #: largest batch bucket this runner will still dispatch; shrinks
         #: permanently when the device OOMs on a bucket
         self.m_bucket_cap = reg.gauge(
@@ -461,18 +418,6 @@ class ModelRunner:
             "largest batch bucket currently served (shrinks after device OOM)",
             labels)
         self.m_bucket_cap.set(self.buckets.max_batch())
-        #: armed chaos faults consumed by the next device steps (fault plugin)
-        self._chaos: deque = deque()
-        #: set on a deadline miss: the jitted step is rebuilt before the next
-        #: dispatch (stale executables on a wedged device are not trusted)
-        self._needs_rebuild = False
-        #: recycled single-thread watchdog executors for deadlined steps —
-        #: NEVER the shared default executor: an abandoned (hung) step would
-        #: wedge a thread that _prep and every other runner also need. A
-        #: miss discards the executor with its wedged thread; the no-miss
-        #: path reuses them, so steady state costs one submit per step.
-        self._watchdog_free: list = []
-        self._watchdog_lock = threading.Lock()
 
     @staticmethod
     def _resolve_auto_flags(cfg, devices, mesh_spec, packed: bool = False):
@@ -741,29 +686,30 @@ class ModelRunner:
         return False
 
     # -- self-healing: chaos hook / watchdog / OOM degradation --------------
+    # (the health state machine, deadline watchdog, and chaos queue live in
+    # the shared ServingRunnerCore; the runner keeps the OOM degradation
+    # policy, which is bucket-grid-specific)
 
     def inject_step_fault(self, kind: str, duration_s: float = 0.0) -> None:
-        """Arm a one-shot fault consumed by the NEXT device step: ``hang``
-        wedges the step for ``duration_s`` of dead time (as a stuck device
-        sync would) so the deadline watchdog fires; ``oom`` raises a
-        fabricated RESOURCE_EXHAUSTED so the degradation path runs. Driven by
-        the fault plugin's processor wrapper (kinds ``hang`` / ``oom``)."""
-        if kind not in ("hang", "oom"):
-            raise ConfigError(f"unknown step fault kind {kind!r} (hang/oom)")
-        self._chaos.append((kind, float(duration_s)))
+        """Arm a one-shot fault consumed by the NEXT device step (fault
+        plugin's processor wrapper; kinds ``hang`` / ``oom``)."""
+        self.core.inject_step_fault(kind, duration_s)
 
-    def _apply_chaos(self) -> None:
-        """Executor-thread side of ``inject_step_fault``."""
-        try:
-            kind, duration_s = self._chaos.popleft()
-        except IndexError:
-            return
-        if kind == "hang":
-            import time
+    @property
+    def step_deadline_s(self) -> Optional[float]:
+        return self.core.step_deadline_s
 
-            time.sleep(duration_s if duration_s > 0 else 30.0)
-        else:
-            raise InjectedOom()
+    @step_deadline_s.setter
+    def step_deadline_s(self, v: Optional[float]) -> None:
+        self.core.step_deadline_s = v
+
+    @property
+    def step_deadline_first_s(self) -> Optional[float]:
+        return self.core.step_deadline_first_s
+
+    @step_deadline_first_s.setter
+    def step_deadline_first_s(self, v: Optional[float]) -> None:
+        self.core.step_deadline_first_s = v
 
     def _step_blocking(self, padded: dict[str, Any]):
         """The full blocking device step (chaos hook -> dispatch -> fetch).
@@ -771,36 +717,8 @@ class ModelRunner:
         sub-ms hop, cold shapes compile for seconds-to-minutes on remote
         backends — never on the event loop — and the deadline watchdog can
         abandon the thread if the device wedges."""
-        self._apply_chaos()
+        self.core.apply_chaos()
         return jax.device_get(self._dispatch(padded))
-
-    def _deadline_for(self, first_compile: bool) -> Optional[float]:
-        """Per-step watchdog budget; first-compile shapes get the scaled-up
-        budget so a cold bucket isn't misread as a hung device."""
-        if self.step_deadline_s is None:
-            return None
-        return self.step_deadline_first_s if first_compile else self.step_deadline_s
-
-    def _deadline_miss_error(self, fut, staged, deadline: float) -> StepDeadlineExceeded:
-        """Bookkeeping for an abandoned step: count the miss, mark the runner
-        UNHEALTHY (recovery probes re-admit it), schedule a jit rebuild, and
-        wire the zombie future so its staging buffers recycle — and its
-        eventual exception is retrieved — whenever the wedged step ends."""
-        self.m_deadline_miss.inc()
-        self._needs_rebuild = True
-        self.health.mark_unhealthy(f"step exceeded its {deadline:.3g}s deadline")
-
-        def _reap(f) -> None:
-            try:
-                f.exception()
-            except Exception:
-                pass
-            self._release_staging(staged)
-
-        fut.add_done_callback(_reap)
-        return StepDeadlineExceeded(
-            f"device step exceeded its {deadline:.3g}s deadline "
-            "(runner marked unhealthy; batch nacked for redelivery)")
 
     def _note_oom(self, bucket_rows: int) -> bool:
         """Device OOM on a ``bucket_rows`` bucket: permanently cap the batch
@@ -826,58 +744,24 @@ class ModelRunner:
             "splitting the batch and retrying", self.family.name, bucket_rows, cap)
         return True
 
-    def _rebuild_if_needed(self) -> None:
-        """Rebuild the jitted step after a deadline miss: executables cached
-        across a device hang are not trusted, so the next (probe) step
-        recompiles from scratch. Shares the flash lock with the other
-        cfg-flip/rebuild paths so concurrent probes rebuild once."""
-        if not self._needs_rebuild:
-            return
+    def _rebuild_after_incident(self) -> None:
+        """Core rebuild hook (runs inside the heal gate after a deadline
+        miss): executables cached across a device hang are not trusted, so
+        the next (probe) step recompiles from scratch. Shares the flash lock
+        with the other cfg-flip/rebuild paths."""
         with self._flash_lock:
-            if not self._needs_rebuild:
-                return
-            self._needs_rebuild = False
             self._seen_shapes.clear()
             self._build_jitted()
-        self.m_rebuilds.inc()
         logger.warning("[%s] rebuilt jitted step after a deadline miss",
                        self.family.name)
 
-    def _heal_gate_sync(self) -> None:
-        """Admission control for the runner's own callers (pool dispatch has
-        its own health-aware pick): DEAD fails fast; UNHEALTHY waits out the
-        probe backoff, claims the probe, and rebuilds if needed — the step
-        that follows IS the recovery probe."""
-        import time
-
-        h = self.health
-        while True:
-            if h.state == HEALTH_DEAD:
-                raise RunnerDead(f"runner {h.name} is DEAD; not serving")
-            if h.join_or_begin_probe():
-                break
-            time.sleep(min(max(h.seconds_until_probe(), 0.01), 0.5))
-        self._rebuild_if_needed()
-
-    async def _heal_gate(self) -> None:
-        """Async twin of ``_heal_gate_sync`` (never blocks the event loop)."""
-        h = self.health
-        while True:
-            if h.state == HEALTH_DEAD:
-                raise RunnerDead(f"runner {h.name} is DEAD; not serving")
-            if h.join_or_begin_probe():
-                break
-            await asyncio.sleep(min(max(h.seconds_until_probe(), 0.01), 0.5))
-        self._rebuild_if_needed()
-
     def health_report(self) -> dict:
         """JSON-able health snapshot for the engine's ``/health`` endpoint."""
-        rep = self.health.report()
+        rep = self.core.health_report()
         rep["model"] = self.family.name
         if self.device_label is not None:
             rep["device"] = self.device_label
         rep["bucket_cap"] = self.buckets.max_batch()
-        rep["deadline_misses"] = int(self.m_deadline_miss.value)
         return rep
 
     # -- execution ---------------------------------------------------------
@@ -904,17 +788,19 @@ class ModelRunner:
             ]
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
 
-        self._heal_gate_sync()
+        self.core.heal_gate_sync()
         padded, n = self._prep(inputs)
         first = self._note_shape(padded)
         bucket_rows = next(iter(padded.values())).shape[0]
-        deadline = self._deadline_for(first)
+        deadline = self.core.deadline_for(first)
         t0 = time.perf_counter()
         try:
             if deadline is None:
                 out = self._step_blocking(padded)
             else:
-                out = self._run_deadlined_sync(padded, deadline)
+                out = self.core.run_deadlined_sync(
+                    partial(self._step_blocking, padded), deadline,
+                    on_zombie=partial(self._release_staging, padded))
         except StepDeadlineExceeded:
             raise  # the zombie step still owns the staging buffers
         except Exception as e:
@@ -935,43 +821,6 @@ class ModelRunner:
             self.m_rows.inc(n)
         self.health.mark_success()
         return {k: np.asarray(v)[:n] for k, v in out.items()}
-
-    def _borrow_watchdog(self):
-        """A single-thread executor for one deadlined step: reused across
-        steps in the no-miss steady state, discarded (with its wedged
-        thread) on a miss. Concurrent steps each borrow their own, so the
-        watchdog never serializes in-flight work."""
-        import concurrent.futures
-
-        with self._watchdog_lock:
-            if self._watchdog_free:
-                return self._watchdog_free.pop()
-        return concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="arkflow-step-watchdog")
-
-    def _return_watchdog(self, ex) -> None:
-        with self._watchdog_lock:
-            self._watchdog_free.append(ex)
-
-    def _run_deadlined_sync(self, padded: dict[str, Any], deadline: float):
-        """Run the blocking step on a dedicated watchdog thread so a hang can
-        be abandoned (the thread itself cannot be killed — its executor is
-        dropped and the thread left to finish or leak; the shared default
-        executor is never at risk)."""
-        import concurrent.futures
-
-        ex = self._borrow_watchdog()
-        fut = ex.submit(self._step_blocking, padded)
-        try:
-            out = fut.result(timeout=deadline)
-        except concurrent.futures.TimeoutError:
-            ex.shutdown(wait=False)  # abandon: the wedged thread goes with it
-            raise self._deadline_miss_error(fut, padded, deadline) from None
-        except Exception:
-            self._return_watchdog(ex)  # step ended: its thread is idle again
-            raise
-        self._return_watchdog(ex)
-        return out
 
     def _prep(self, inputs: dict[str, np.ndarray]) -> tuple[dict[str, Any], int]:
         """Host-side stage: pad to buckets + validate masks (CPU only)."""
@@ -1086,11 +935,11 @@ class ModelRunner:
                 for i in range(0, n_total, mb)
             ])
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
-        await self._heal_gate()
+        await self.core.heal_gate()
         padded, n = await loop.run_in_executor(None, self._prep, inputs)
         first = self._note_shape(padded)
         bucket_rows = next(iter(padded.values())).shape[0]
-        deadline = self._deadline_for(first)
+        deadline = self.core.deadline_for(first)
         staged = padded  # host staging buffers, recycled once the step ends
 
         self._ensure_sems()
@@ -1104,22 +953,13 @@ class ModelRunner:
                         out = await loop.run_in_executor(
                             None, self._step_blocking, padded)
                     else:
-                        # the watchdog: wait for the step, not forever, and
-                        # run it on a borrowed DEDICATED thread — abandoning
-                        # a hung step on the shared default executor would
-                        # wedge a thread _prep and every other runner need.
-                        # On a miss the thread cannot be interrupted: its
-                        # executor is dropped with it and the miss handler
-                        # reaps the step's eventual result.
-                        ex = self._borrow_watchdog()
-                        cfut = ex.submit(self._step_blocking, padded)
-                        fut = asyncio.wrap_future(cfut, loop=loop)
-                        done, _ = await asyncio.wait({fut}, timeout=deadline)
-                        if not done:
-                            ex.shutdown(wait=False)
-                            raise self._deadline_miss_error(cfut, staged, deadline)
-                        self._return_watchdog(ex)  # step ended; thread idle
-                        out = fut.result()
+                        # the shared core's watchdog: wait for the step, not
+                        # forever, on a borrowed dedicated thread; on a miss
+                        # the zombie's eventual end recycles the staging
+                        # buffers (on_zombie)
+                        out = await self.core.run_deadlined(
+                            partial(self._step_blocking, padded), deadline,
+                            on_zombie=partial(self._release_staging, staged))
                 finally:
                     # an abandoned step counts as complete for duty-cycle
                     # accounting: the device is no longer doing useful work

@@ -12,12 +12,28 @@ Split of responsibilities (TPU-first):
   compiled once per (slot-count, page-table-width) + per prompt bucket.
 - host (this module): page allocation, slot bookkeeping, EOS/max-token
   tracking, admission — cheap numpy/python between steps.
+
+Multi-chip (``mesh``): the server runs tensor-parallel over a Mesh's ``tp``
+axis. The page pools shard over KV heads (``P(None, None, None, "tp",
+None)``), params carry their tensor-parallel PartitionSpecs, and every jitted
+step is built with explicit NamedSharding in/out shardings — page tables,
+token ids, and lengths stay static-shaped and replicated, so the layer scan
+lowers to GSPMD collectives with zero dynamic shapes. The host-side
+scheduler is untouched: it only ever sees replicated scalars.
+
+Self-healing: the server sits on the shared ``ServingRunnerCore``
+(tpu/serving_core.py) — the same health state machine, step-deadline
+watchdog, and chaos hooks the ``tpu_inference`` runner uses. A generate step
+that blows its deadline marks the server UNHEALTHY, fails every in-flight
+request (their batches NACK for redelivery), and the next step waits out the
+probe backoff, rebuilds the jitted steps, and reinitializes the pools.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
@@ -26,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.errors import ConfigError, StepDeadlineExceeded
 from arkflow_tpu.models.decoder import DecoderConfig
 from arkflow_tpu.models.paged_decode import (
     init_page_pool,
@@ -34,6 +50,7 @@ from arkflow_tpu.models.paged_decode import (
     paged_prefill,
 )
 from arkflow_tpu.obs import global_registry
+from arkflow_tpu.tpu.serving_core import ServingRunnerCore
 
 logger = logging.getLogger("arkflow.serving")
 
@@ -55,7 +72,10 @@ class GenerationServer:
                  prompt_buckets: Optional[list[int]] = None,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                  prefill_chunk: int = 0, speculative_tokens: int = 0,
-                 prefix_cache_pages: int = 0):
+                 prefix_cache_pages: int = 0, mesh=None,
+                 step_deadline_s: Optional[float] = None,
+                 step_deadline_first_s: Optional[float] = None,
+                 health_config=None, name: str = "decoder_lm"):
         from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
 
         enable_persistent_cache()
@@ -78,7 +98,30 @@ class GenerationServer:
         # (generate() rejects prompts longer than max_seq up front)
         self.prompt_buckets = sorted(
             {b for b in (prompt_buckets or [32, 128]) if b <= max_seq} | {max_seq})
-        self.k_pages, self.v_pages = init_page_pool(cfg, self.num_pages, page_size)
+
+        # tensor-parallel serving: the page pools shard over KV heads on the
+        # mesh's tp axis; everything the host scheduler touches (page tables,
+        # token ids, lengths, active masks) stays replicated, so admission /
+        # page accounting is identical whether one chip serves or eight
+        self.mesh = mesh
+        self._kv_io_sharding = None     # full pool  [L, pages, page, kv, dh]
+        self._kv_layer_sharding = None  # scan slice [pages, page, kv, dh]
+        self._repl_sharding = None
+        if mesh is not None:
+            from arkflow_tpu.parallel.mesh import (dp_size, kv_pool_shardings,
+                                                   replicated, tp_size,
+                                                   validate_tp_heads)
+
+            if dp_size(mesh) > 1:
+                raise ConfigError(
+                    "continuous serving shards tensor-parallel only — the "
+                    "lockstep slot grid does not batch-split over dp (use "
+                    "serving: batch or tpu_inference for dp)")
+            validate_tp_heads(tp_size(mesh), cfg.kv_heads,
+                              who="continuous serving")
+            self._kv_io_sharding, self._kv_layer_sharding = kv_pool_shardings(mesh)
+            self._repl_sharding = replicated(mesh)
+        self.k_pages, self.v_pages = self._init_pools()
 
         # chunked prefill: prompts longer than this admit in fixed-size
         # chunks interleaved with decode steps, so one long prompt never
@@ -138,40 +181,23 @@ class GenerationServer:
                 "speculative_tokens requires greedy decoding (temperature 0); "
                 "sampled acceptance is not implemented")
 
-        from arkflow_tpu.models.decoder import select_token
+        #: first-seen jitted-step keys — a cold (kind, shape) compiles before
+        #: it executes, so the deadline watchdog grants it the first-compile
+        #: budget (cleared on rebuild, like the runner's seen-shape set)
+        self._seen_steps: set[tuple] = set()
+        self._build_jitted()
 
-        def _pick(logits, key):
-            return select_token(logits, key, self.temperature, self.top_k)
-
-        # donate the KV pools: they are pure in->out state, so XLA updates
-        # them in place instead of copying hundreds of MB per decode step
-        def _decode(tok, lens, act, table, kp, vp, key):
-            logits, kp, vp = paged_decode_step(
-                self.params, cfg, tok, lens, act, table, kp, vp,
-                return_logits=True)
-            return _pick(logits, key), kp, vp
-
-        def _prefill(ids, lens, table, kp, vp, key):
-            logits, kp, vp = paged_prefill(
-                self.params, cfg, ids, lens, table, kp, vp, return_logits=True)
-            return _pick(logits, key), kp, vp
-
-        def _chunk(ids, off, clen, table, kp, vp):
-            from arkflow_tpu.models.paged_decode import paged_prefill_chunk
-
-            return paged_prefill_chunk(self.params, cfg, ids, off, clen,
-                                       table, kp, vp)
-
-        def _verify(ids, off, clen, table, kp, vp):
-            from arkflow_tpu.models.paged_decode import paged_prefill_chunk
-
-            return paged_prefill_chunk(self.params, cfg, ids, off, clen,
-                                       table, kp, vp, return_all=True)
-
-        self._decode = jax.jit(_decode, donate_argnums=(4, 5))
-        self._prefill = jax.jit(_prefill, donate_argnums=(3, 4))
-        self._chunk = jax.jit(_chunk, donate_argnums=(4, 5))
-        self._verify = jax.jit(_verify, donate_argnums=(4, 5))
+        # the shared serving-runner core: health state machine, step-deadline
+        # watchdog, chaos hooks — the generate path inherits the PR-4/5
+        # hardening instead of reimplementing it
+        self.core = ServingRunnerCore(
+            name=f"{name}[generate]",
+            labels={"model": name, "path": "generate"},
+            step_deadline_s=step_deadline_s,
+            step_deadline_first_s=step_deadline_first_s,
+            health_config=health_config,
+            rebuild_fn=self._rebuild_after_incident,
+        )
 
         reg = global_registry()
         self.m_steps = reg.counter("arkflow_gen_decode_steps_total", "lockstep decode steps")
@@ -189,6 +215,180 @@ class GenerationServer:
             "arkflow_gen_prefix_cache_hits_total", "admissions that reused cached prefix pages")
         self.m_prefix_pages = reg.counter(
             "arkflow_gen_prefix_pages_shared_total", "pages aliased from the prefix cache")
+        # observability satellites: the generation server used to be nearly
+        # dark — these four answer "is the server keeping up" from /metrics
+        self.m_slots_busy = reg.gauge(
+            "arkflow_gen_slots_busy", "decode slots occupied (admitting + decoding)")
+        self.m_pool_occupancy = reg.gauge(
+            "arkflow_gen_page_pool_occupancy",
+            "fraction of KV pages in use (scratch page excluded)")
+        self.m_prefix_evictions = reg.counter(
+            "arkflow_gen_prefix_cache_evictions_total",
+            "prefix-cache entries evicted (LRU capacity or page pressure)")
+        self.m_tps = reg.gauge(
+            "arkflow_gen_tokens_per_sec",
+            "windowed generation throughput (tokens/s over the serve loop)")
+        #: tokens emitted by THIS server (m_tokens is registry-global)
+        self._tokens_emitted = 0
+        self._rate_window: Optional[tuple[float, int]] = None
+
+    # -- device plumbing (jit build / sharding / reset) --------------------
+
+    def _init_pools(self):
+        """Fresh KV page pools, placed with their tensor-parallel sharding
+        under a mesh (KV heads over ``tp``; replicated otherwise)."""
+        kp, vp = init_page_pool(self.cfg, self.num_pages, self.page_size)
+        if self._kv_io_sharding is not None:
+            kp = jax.device_put(kp, self._kv_io_sharding)
+            vp = jax.device_put(vp, self._kv_io_sharding)
+        return kp, vp
+
+    def _build_jitted(self) -> None:
+        """(Re)build the four jitted steps. Under a mesh every step carries
+        explicit in/out shardings: the KV pools split over KV heads on
+        ``tp``, everything else (token ids, lengths, page tables, keys) is
+        replicated — page-table gathers stay static-shaped, so the layer
+        scan lowers to plain GSPMD collectives with no dynamic shapes."""
+        from arkflow_tpu.models.decoder import select_token
+        from arkflow_tpu.models.paged_decode import paged_prefill_chunk
+
+        cfg = self.cfg
+        kv_layer = self._kv_layer_sharding
+
+        def _pick(logits, key):
+            return select_token(logits, key, self.temperature, self.top_k)
+
+        # donate the KV pools: they are pure in->out state, so XLA updates
+        # them in place instead of copying hundreds of MB per decode step
+        def _decode(tok, lens, act, table, kp, vp, key):
+            logits, kp, vp = paged_decode_step(
+                self.params, cfg, tok, lens, act, table, kp, vp,
+                return_logits=True, kv_sharding=kv_layer)
+            return _pick(logits, key), kp, vp
+
+        def _prefill(ids, lens, table, kp, vp, key):
+            logits, kp, vp = paged_prefill(
+                self.params, cfg, ids, lens, table, kp, vp, return_logits=True,
+                kv_sharding=kv_layer)
+            return _pick(logits, key), kp, vp
+
+        def _chunk(ids, off, clen, table, kp, vp):
+            return paged_prefill_chunk(self.params, cfg, ids, off, clen,
+                                       table, kp, vp, kv_sharding=kv_layer)
+
+        def _verify(ids, off, clen, table, kp, vp):
+            return paged_prefill_chunk(self.params, cfg, ids, off, clen,
+                                       table, kp, vp, return_all=True,
+                                       kv_sharding=kv_layer)
+
+        if self.mesh is None:
+            self._decode = jax.jit(_decode, donate_argnums=(4, 5))
+            self._prefill = jax.jit(_prefill, donate_argnums=(3, 4))
+            self._chunk = jax.jit(_chunk, donate_argnums=(4, 5))
+            self._verify = jax.jit(_verify, donate_argnums=(4, 5))
+            return
+        r, kv = self._repl_sharding, self._kv_io_sharding
+        self._decode = jax.jit(_decode, donate_argnums=(4, 5),
+                               in_shardings=(r, r, r, r, kv, kv, r),
+                               out_shardings=(r, kv, kv))
+        self._prefill = jax.jit(_prefill, donate_argnums=(3, 4),
+                                in_shardings=(r, r, r, kv, kv, r),
+                                out_shardings=(r, kv, kv))
+        self._chunk = jax.jit(_chunk, donate_argnums=(4, 5),
+                              in_shardings=(r, r, r, r, kv, kv),
+                              out_shardings=(r, kv, kv))
+        self._verify = jax.jit(_verify, donate_argnums=(4, 5),
+                               in_shardings=(r, r, r, r, kv, kv),
+                               out_shardings=(r, kv, kv))
+
+    def _rebuild_after_incident(self) -> None:
+        """Core rebuild hook (runs inside the heal gate, before the recovery
+        probe): executables cached across a hung step are not trusted —
+        recompile everything from scratch under the first-compile budget."""
+        self._seen_steps.clear()
+        self._build_jitted()
+        logger.warning("generation server rebuilt its jitted steps after a "
+                       "deadline miss")
+
+    def _reset_device_state(self) -> None:
+        """Fresh pools + host page accounting after a crashed/abandoned step:
+        a zombie step still owns the donated pool buffers, and the prefix
+        cache's KV content died with them. Every future admission starts
+        from a clean pool (leaked refs would wedge admission forever)."""
+        self._prefix_cache.clear()
+        self._cache_pages.clear()
+        self._prefix_lengths.clear()
+        self._page_refs.clear()
+        self._free_pages = list(range(1, self.num_pages))
+        self.k_pages, self.v_pages = self._init_pools()
+
+    # -- self-healing surface (fault plugin / engine /health) ---------------
+
+    def inject_step_fault(self, kind: str, duration_s: float = 0.0) -> None:
+        """Arm a one-shot ``hang``/``oom`` on the next device step (the fault
+        plugin's processor wrapper drives this, same as for ModelRunner)."""
+        self.core.inject_step_fault(kind, duration_s)
+
+    def health_report(self) -> dict:
+        """JSON-able snapshot for the engine's ``/health``: health state +
+        the serving detail that says whether the server is keeping up."""
+        rep = self.core.health_report()
+        rep["serving"] = "continuous"
+        rep["slots"] = self.slots
+        rep["slots_busy"] = sum(1 for r in self._slot_req if r is not None)
+        total = self.num_pages - 1
+        rep["page_pool_occupancy"] = (
+            round((total - len(self._free_pages)) / total, 4) if total else 0.0)
+        rep["prefix_cache"] = {
+            "entries": len(self._prefix_cache),
+            "pages": self._cache_held,
+            "capacity_pages": self.prefix_cache_pages,
+        }
+        rep["tokens_per_sec"] = round(float(self.m_tps.value), 1)
+        if self.mesh is not None:
+            from arkflow_tpu.parallel.mesh import tp_size
+
+            rep["mesh"] = {"tp": tp_size(self.mesh)}
+        return rep
+
+    # -- gated device step --------------------------------------------------
+
+    def _note_step(self, key: tuple) -> bool:
+        """True when this (kind, shape) jitted step has not run yet — it will
+        compile, so the watchdog grants the first-compile budget."""
+        if key in self._seen_steps:
+            return False
+        self._seen_steps.add(key)
+        return True
+
+    async def _run_device_step(self, key: tuple, fn):
+        """One health-gated jitted call: the same admission gate pool
+        dispatch uses, a first-compile-aware deadline watchdog, and the
+        chaos hook. A deadline miss marks the server UNHEALTHY, schedules a
+        rebuild, and raises — the serve loop fails every in-flight request,
+        so their batches nack for redelivery; the next step waits out the
+        probe backoff and runs as the recovery probe."""
+        core = self.core
+        await core.heal_gate()
+        deadline = core.deadline_for(self._note_step(key))
+
+        def blocking():
+            core.apply_chaos()
+            return jax.block_until_ready(fn())
+
+        try:
+            if deadline is None:
+                out = await asyncio.get_running_loop().run_in_executor(
+                    None, blocking)
+            else:
+                out = await core.run_deadlined(blocking, deadline)
+        except StepDeadlineExceeded:
+            raise  # the core already marked UNHEALTHY + scheduled rebuild
+        except Exception as e:
+            core.health.mark_unhealthy(f"generate step failed: {e}")
+            raise
+        core.health.mark_success()
+        return out
 
     # -- public API --------------------------------------------------------
 
@@ -247,6 +447,7 @@ class GenerationServer:
     def _evict_one(self) -> bool:
         if not self._prefix_cache:
             return False
+        self.m_prefix_evictions.inc()
         key, pages = self._prefix_cache.popitem(last=False)  # LRU
         self._prefix_lengths[len(key)] -= 1
         if self._prefix_lengths[len(key)] == 0:
@@ -372,13 +573,19 @@ class GenerationServer:
         # single-row table padded to the slot width
         table = np.zeros((1, self.pages_per_slot), np.int32)
         table[0, :len(pages)] = pages
-        loop = asyncio.get_running_loop()
         self._key, sub = jax.random.split(self._key)
-        # off-loop: first call per bucket compiles (seconds on TPU)
-        nxt, self.k_pages, self.v_pages = await loop.run_in_executor(
-            None, lambda: jax.block_until_ready(self._prefill(
+        # off-loop + gated: first call per bucket compiles (seconds on TPU)
+        # pools bound EAGERLY: a deadline-abandoned zombie step waking after
+        # a pool reset must consume the pools it already owned, never the
+        # fresh ones. The jitted fn resolves LAZILY at call time: the heal
+        # gate's rebuild runs before the probe step executes, and the probe
+        # must use the rebuilt executable, not the distrusted cached one.
+        # (Same for the other three step kinds below.)
+        nxt, self.k_pages, self.v_pages = await self._run_device_step(
+            ("prefill", bucket),
+            lambda kp=self.k_pages, vp=self.v_pages: self._prefill(
                 jnp.asarray(ids), jnp.asarray([n], jnp.int32), jnp.asarray(table),
-                self.k_pages, self.v_pages, sub)))
+                kp, vp, sub))
         self._lengths[slot] = n
         self._cur_tokens[slot] = int(nxt[0])
         self._handle_token(slot, int(nxt[0]))
@@ -393,6 +600,7 @@ class GenerationServer:
             return
         req.tokens.append(token)
         self.m_tokens.inc()
+        self._tokens_emitted += 1
         if len(req.tokens) >= req.max_new_tokens:
             self._finish(slot)
 
@@ -429,12 +637,12 @@ class GenerationServer:
         ids[0, :len(chunk)] = chunk
         table = np.zeros((1, self.pages_per_slot), np.int32)
         table[0, :len(self._slot_pages[slot])] = self._slot_pages[slot]
-        loop = asyncio.get_running_loop()
-        logits, self.k_pages, self.v_pages = await loop.run_in_executor(
-            None, lambda: jax.block_until_ready(self._chunk(
+        logits, self.k_pages, self.v_pages = await self._run_device_step(
+            ("chunk", c),
+            lambda kp=self.k_pages, vp=self.v_pages: self._chunk(
                 jnp.asarray(ids), jnp.asarray([off], jnp.int32),
                 jnp.asarray([len(chunk)], jnp.int32), jnp.asarray(table),
-                self.k_pages, self.v_pages)))
+                kp, vp))
         new_off = off + len(chunk)
         if new_off < n:
             self._prefill_pos[slot] = new_off
@@ -484,6 +692,23 @@ class GenerationServer:
             self._finish(longest)
             act[longest] = False
 
+    def _update_gauges(self, busy: int) -> None:
+        self.m_active.set(busy)
+        self.m_slots_busy.set(busy)
+        self.m_waiting.set(len(self._pending))
+        total = self.num_pages - 1
+        if total:
+            self.m_pool_occupancy.set((total - len(self._free_pages)) / total)
+        # windowed tokens/sec: cheap enough to refresh every loop pass
+        now = time.monotonic()
+        if self._rate_window is None:
+            self._rate_window = (now, self._tokens_emitted)
+            return
+        t0, tok0 = self._rate_window
+        if now - t0 >= 0.25:
+            self.m_tps.set((self._tokens_emitted - tok0) / (now - t0))
+            self._rate_window = (now, self._tokens_emitted)
+
     async def _serve_loop(self) -> None:
         try:
             while not self._closed:
@@ -492,8 +717,7 @@ class GenerationServer:
                               if s in self._prefill_pos and self._slot_req[s]]
                 active = [s for s in range(self.slots)
                           if self._slot_req[s] and s not in self._prefill_pos]
-                self.m_active.set(len(active) + len(prefilling))
-                self.m_waiting.set(len(self._pending))
+                self._update_gauges(len(active) + len(prefilling))
                 if not active and not prefilling:
                     if not self._pending:
                         return  # drained; next generate() restarts the loop
@@ -516,6 +740,10 @@ class GenerationServer:
         except Exception as e:  # fail all in-flight requests, don't hang them
             logger.exception("generation serve loop failed")
             self._fail_all(e)
+            # a crashed/abandoned step leaves the pools untrustworthy (a
+            # deadline-missed zombie still owns the donated buffers): start
+            # the next admission from fresh pools and a clean page ledger
+            self._reset_device_state()
 
     def _fail_all(self, err: Exception) -> None:
         self._prefill_pos.clear()
@@ -557,16 +785,16 @@ class GenerationServer:
         act[active] = True
         for s in active:
             self._reserve_or_truncate(s, act)
-        loop = asyncio.get_running_loop()
         cur = jnp.asarray(self._cur_tokens)
         lens = jnp.asarray(self._lengths)
         act_dev = jnp.asarray(act)
         table = self._table_array()
         self._key, sub = jax.random.split(self._key)
-        # off-loop: one device-step of wall time (plus the first-call compile)
-        nxt, self.k_pages, self.v_pages = await loop.run_in_executor(
-            None, lambda: jax.block_until_ready(self._decode(
-                cur, lens, act_dev, table, self.k_pages, self.v_pages, sub)))
+        # off-loop + gated: one device-step of wall time (plus first compile)
+        nxt, self.k_pages, self.v_pages = await self._run_device_step(
+            ("decode",),
+            lambda kp=self.k_pages, vp=self.v_pages: self._decode(
+                cur, lens, act_dev, table, kp, vp, sub))
         self.m_steps.inc()
         nxt_host = np.asarray(nxt)
         for s in range(self.slots):
@@ -623,12 +851,12 @@ class GenerationServer:
             ids[s, 0] = self._cur_tokens[s]
             if c > 1:
                 ids[s, 1:c] = self._draft(req, c - 1)
-        loop = asyncio.get_running_loop()
         table = self._table_array()
-        logits, self.k_pages, self.v_pages = await loop.run_in_executor(
-            None, lambda: jax.block_until_ready(self._verify(
+        logits, self.k_pages, self.v_pages = await self._run_device_step(
+            ("verify", k),
+            lambda kp=self.k_pages, vp=self.v_pages: self._verify(
                 jnp.asarray(ids), jnp.asarray(self._lengths),
-                jnp.asarray(clen), table, self.k_pages, self.v_pages)))
+                jnp.asarray(clen), table, kp, vp))
         self.m_steps.inc()
         lg = np.asarray(logits)
         for s in range(self.slots):

@@ -379,6 +379,9 @@ def main() -> None:
         _run_multichip_bench()
         return
     if mode == "generate":
+        if os.environ.get("ARKFLOW_GEN_TP_CHILD") == "1":
+            _generate_tp_child()
+            return
         if tiny or (axon_hook_present() and os.environ.get("JAX_PLATFORMS") != "cpu"
                     and not _tpu_reachable()):
             if os.environ.get("JAX_PLATFORMS") != "cpu":
@@ -884,12 +887,111 @@ def _run_multichip_bench() -> None:
     })
 
 
-def _run_generate_bench(tiny: bool) -> None:
-    """BENCH_MODE=generate: continuous-batching generation throughput
-    (tokens/sec) through the tpu_generate processor's paged-KV server."""
+def _run_generate_tp_phase() -> None:
+    """Generate-mode TP phase: 1-chip vs tp=N continuous decode on a FORCED
+    HOST mesh (always virtual CPU — it validates the sharded serving
+    mechanics hermetically; real-chip numbers come from the main phase on
+    real silicon). Emits ``generate_tp_scaling_efficiency`` with
+    tokens/sec for both sides and the mesh knobs in the detail, so the
+    multichip story reads as a dp/pool/tp comparison. ``BENCH_GEN_TP=0``
+    skips; ``BENCH_GEN_TP_DEVICES`` sizes the mesh (default 2)."""
+    import subprocess
+    import sys
+
+    from arkflow_tpu.utils.cleanenv import cpu_child_env
+
+    n = int(os.environ.get("BENCH_GEN_TP_DEVICES", "2"))
+    env = cpu_child_env(n_devices=n)
+    env["ARKFLOW_GEN_TP_CHILD"] = "1"
+    env["ARKFLOW_BENCH_CHILD"] = "1"
+    env["BENCH_MODE"] = "generate"
+    try:
+        res = subprocess.run([sys.executable, __file__], env=env,
+                             capture_output=True, timeout=1200)
+    except subprocess.TimeoutExpired:
+        print("bench: generate TP phase timed out (main phase unaffected)",
+              file=sys.stderr)
+        return
+    _relay_child(res)
+    if res.returncode != 0:
+        print("bench: generate TP phase failed (main phase unaffected)",
+              file=sys.stderr)
+
+
+def _generate_tp_child() -> None:
+    """In-child measurement for the TP phase: same tiny decoder served
+    continuous, once single-chip and once tensor-parallel over all N forced
+    host devices (KV pages sharded over KV heads)."""
     from arkflow_tpu.batch import MessageBatch
     from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
 
+    import jax
+
+    ensure_plugins_loaded()
+    n = len(jax.devices())
+    rows = int(os.environ.get("BENCH_GEN_TP_ROWS", "16"))
+    max_new = int(os.environ.get("BENCH_GEN_TP_TOKENS", "16"))
+    model_config = {"vocab_size": 512, "dim": 64, "layers": 2, "heads": 4,
+                    "kv_heads": 2, "ffn": 96, "max_seq": 256}
+    base = {"type": "tpu_generate", "model": "decoder_lm",
+            "model_config": model_config, "serving": "continuous",
+            "slots": 8, "page_size": 16, "max_input": 64,
+            "max_new_tokens": max_new, "eos_id": -1,
+            "batch_buckets": [8], "seq_buckets": [64]}
+
+    def tps(cfg_map) -> float:
+        proc = build_component("processor", cfg_map, Resource())
+        batch = MessageBatch.new_binary(
+            [f"sensor event {i} nominal reading".encode() for i in range(rows)])
+
+        async def go() -> float:
+            await proc.process(MessageBatch.new_binary([b"warmup prompt"]))
+            t0 = time.perf_counter()
+            await proc.process(batch)
+            return time.perf_counter() - t0
+
+        elapsed = asyncio.run(go())
+        return rows * max_new / elapsed if elapsed > 0 else 0.0
+
+    tps1 = tps(base)
+    tpsn = tps({**base, "mesh": {"tp": n}})
+    eff = tpsn / (n * tps1) if tps1 > 0 else 0.0
+    _emit({
+        "metric": "generate_tp_scaling_efficiency",
+        "value": round(eff, 4),
+        "unit": "ratio",
+        # floor 0.5 = half-linear, same convention as the multichip phase
+        "vs_baseline": round(eff / 0.5, 4),
+        "detail": {
+            "n_devices": n,
+            "mesh": {"tp": n},
+            "tokens_per_sec_1chip": round(tps1, 1),
+            "tokens_per_sec_tp": round(tpsn, 1),
+            "rows": rows,
+            "max_new_tokens": max_new,
+            "serving": "continuous",
+            "slots": 8,
+            "backend": _backend(),
+            "host_cores": os.cpu_count(),
+            # knob record (PR-6 convention): the phase serves unpacked f32
+            "packing": False,
+            "serving_dtype": "float32",
+            "caveat": "virtual host devices share physical cores; real-slice "
+                      "efficiency reads higher",
+        },
+    })
+
+
+def _run_generate_bench(tiny: bool) -> None:
+    """BENCH_MODE=generate: continuous-batching generation throughput
+    (tokens/sec) through the tpu_generate processor's paged-KV server.
+    A TP phase (1-chip vs tp=N on a forced host mesh) runs first unless
+    BENCH_GEN_TP=0, so the headline metric stays tokens/sec."""
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
+
+    if os.environ.get("BENCH_GEN_TP", "1") != "0":
+        _run_generate_tp_phase()
     ensure_plugins_loaded()
     model_config = (
         {"vocab_size": 512, "dim": 64, "layers": 2, "heads": 4, "kv_heads": 2,

@@ -362,3 +362,65 @@ def test_profile_step_host_mesh_smoke():
     # phase 1 drives member 0 directly (no pool dispatch); phase 2 routes
     # steps * n = 8 batches through the dispatcher
     assert sum(out["dispatch_per_chip"]) == 8
+
+
+# -- tensor-parallel continuous generation: parse-time validation -----------
+
+
+def test_generate_mesh_parse_time_validation():
+    """config.py validates tpu_generate mesh knobs at parse time — through
+    fault.inner chaos wrappers — so --validate catches them before build."""
+    from arkflow_tpu.config import StreamConfig
+
+    def stream(proc):
+        return {
+            "name": "gen-mesh",
+            "input": {"type": "memory", "messages": ["x"]},
+            "pipeline": {"processors": [proc]},
+            "output": {"type": "drop"},
+        }
+
+    gen = {"type": "tpu_generate", "model": "decoder_lm",
+           "serving": "continuous"}
+    # dp > 1 with continuous serving: clear error, even chaos-wrapped
+    with pytest.raises(ConfigError, match="batch-split"):
+        StreamConfig.from_mapping(stream(
+            {"type": "fault", "inner": {**gen, "mesh": {"dp": 2}}}))
+    with pytest.raises(ConfigError, match="batch-split"):
+        StreamConfig.from_mapping(stream({**gen, "mesh": {"sp": 2}}))
+    # tp must divide kv_heads (decoder_lm default kv_heads=4)
+    with pytest.raises(ConfigError, match="kv_heads"):
+        StreamConfig.from_mapping(stream({**gen, "mesh": {"tp": 3}}))
+    with pytest.raises(ConfigError, match="kv_heads"):
+        StreamConfig.from_mapping(stream(
+            {**gen, "model_config": {"kv_heads": 2}, "mesh": {"tp": 4}}))
+    # malformed axis values fail with the knob name
+    with pytest.raises(ConfigError, match="mesh.tp"):
+        StreamConfig.from_mapping(stream({**gen, "mesh": {"tp": "two"}}))
+    # valid tensor-parallel spec parses (batch mode ignores the continuous
+    # constraints entirely)
+    StreamConfig.from_mapping(stream({**gen, "mesh": {"tp": 2}}))
+    StreamConfig.from_mapping(stream(
+        {**gen, "serving": "batch", "mesh": {"dp": 2, "tp": 2}}))
+
+
+def test_profile_decode_host_mesh_smoke():
+    """CI smoke for ``tools/profile_decode.py --devices 2``: profiles the
+    paged decode step at tp=1 vs tp=2 and emits sane TP-bubble stats."""
+    from arkflow_tpu.utils.cleanenv import cpu_child_env
+
+    env = cpu_child_env(n_devices=2)
+    env["PROF_STEPS"] = "4"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "profile_decode.py"),
+         "--devices", "2"],
+        env=env, capture_output=True, timeout=420, cwd=repo)
+    assert res.returncode == 0, res.stderr.decode(errors="replace")[-2000:]
+    line = res.stdout.decode().strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["devices"] == 2
+    assert out["decode_step_ms_1chip"] > 0 and out["decode_step_ms_tp"] > 0
+    assert 0.0 < out["tp_scaling_efficiency"] < 2.0
+    assert 0.0 <= out["collective_share_est"] <= 1.0
+    assert len(out["per_chip_duty_cycle_est"]) == 2

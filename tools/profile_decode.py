@@ -1,0 +1,159 @@
+"""Profile the paged decode step under tensor parallelism: where does the
+TP bubble come from?
+
+``tools/profile_step.py`` decomposes the CLASSIFIER step (dp / device-pool
+scaling); this tool does the same for the continuous-batching DECODE step,
+which is what ``tpu_generate`` ``serving: continuous`` + ``mesh: {tp: N}``
+runs in steady state. It builds the real ``GenerationServer`` jitted decode
+twice — single-chip and tp=N — on identical pool/slot shapes, times warm
+steps, and reports:
+
+- ``decode_step_ms_1chip`` / ``decode_step_ms_tp``: warm median step time
+- ``tp_speedup``: t1 / tN (ideal = N — TP splits ONE step's work)
+- ``tp_scaling_efficiency``: t1 / (N * tN)  (1.0 = perfect TP scaling)
+- ``collective_share_est``: max(0, (tN - t1/N) / tN) — the fraction of the
+  sharded step NOT explained by partitioned compute; on a real slice this is
+  ICI collective time (psum for wo/w_down, lm_head gather), on a virtual
+  host mesh it also absorbs shared-core contention (honest caveat below)
+- ``per_chip_duty_cycle_est``: (t1/N) / tN per chip — GSPMD runs all chips
+  in lockstep, so the estimate is uniform
+
+so a TP bubble diagnosis never needs a bench rerun.
+
+    python tools/profile_decode.py --devices 4
+    PROF_SLOTS=16 PROF_CTX=256 PROF_STEPS=32 python tools/profile_decode.py --devices 8
+
+NOTE: virtual host devices share physical cores — efficiency on a laptop is
+bounded by cores/N; on a real N-chip slice the same number reads as true TP
+scaling. ``PROF_TINY=0`` profiles the llama3-8b shape (real-TPU use only).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _cli_devices() -> int:
+    if "--devices" in sys.argv:
+        return int(sys.argv[sys.argv.index("--devices") + 1])
+    return int(os.environ.get("PROF_DEVICES", "2"))
+
+
+def _median_ms(fn, reps: int) -> float:
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def _child(n: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from arkflow_tpu.models import get_model
+    from arkflow_tpu.parallel.mesh import MeshSpec, create_mesh, shard_params
+    from arkflow_tpu.tpu.serving import GenerationServer
+
+    tiny = os.environ.get("PROF_TINY", "1") == "1"
+    slots = int(os.environ.get("PROF_SLOTS", "8"))
+    ctx = int(os.environ.get("PROF_CTX", "64"))  # context tokens per slot
+    page_size = int(os.environ.get("PROF_PAGE", "16"))
+    steps = int(os.environ.get("PROF_STEPS", "16"))
+
+    fam = get_model("decoder_lm")
+    cfg = fam.make_config(**(
+        {"vocab_size": 512, "dim": 64, "layers": 2, "heads": 4, "kv_heads": 2,
+         "ffn": 96, "max_seq": max(ctx + page_size, 128)} if tiny else {}))
+    params = fam.init(jax.random.PRNGKey(0), cfg)
+    print(f"# devices={len(jax.devices())} n={n} slots={slots} ctx={ctx} "
+          f"tiny={tiny}", file=sys.stderr, flush=True)
+
+    def build(mesh):
+        p = params
+        if mesh is not None:
+            axes = {name: name for name in mesh.axis_names}
+            p = shard_params(params, fam.param_specs(cfg, axes), mesh)
+        return GenerationServer(p, cfg, slots=slots, page_size=page_size,
+                                max_seq=ctx + page_size, mesh=mesh)
+
+    def measure(srv) -> float:
+        # synthetic steady state: every slot active at ctx tokens, pages
+        # dense — exactly the shape the serve loop dispatches
+        pages_per = -(-ctx // page_size)
+        table = np.zeros((slots, srv.pages_per_slot), np.int32)
+        for s in range(slots):
+            table[s, :pages_per] = np.arange(
+                1 + s * pages_per, 1 + (s + 1) * pages_per)
+        tok = jnp.zeros((slots,), jnp.int32)
+        lens = jnp.full((slots,), ctx, jnp.int32)
+        act = jnp.ones((slots,), bool)
+        tbl = jnp.asarray(table)
+        key = jax.random.PRNGKey(1)
+        kp, vp = srv.k_pages, srv.v_pages
+
+        def step():
+            nonlocal kp, vp
+            nxt, kp, vp = srv._decode(tok, lens, act, tbl, kp, vp, key)
+            jax.block_until_ready(nxt)
+
+        step()  # compile
+        return _median_ms(step, steps)
+
+    t1 = measure(build(None))
+    mesh = create_mesh(MeshSpec(tp=n), devices=jax.devices()[:n])
+    tn = measure(build(mesh))
+
+    ideal = t1 / n
+    duty = round(min(1.0, ideal / tn), 4) if tn > 0 else 0.0
+    print(json.dumps({
+        "devices": n,
+        "slots": slots,
+        "context_tokens": ctx,
+        "steps_measured": steps,
+        "decode_step_ms_1chip": round(t1, 3),
+        "decode_step_ms_tp": round(tn, 3),
+        "tp_speedup": round(t1 / tn, 4) if tn > 0 else 0.0,
+        "tp_scaling_efficiency": round(t1 / (n * tn), 4) if tn > 0 else 0.0,
+        "collective_share_est": round(max(0.0, (tn - ideal) / tn), 4)
+        if tn > 0 else 0.0,
+        "per_chip_duty_cycle_est": [duty] * n,
+        "host_cores": os.cpu_count(),
+        "caveat": "virtual host devices share physical cores; on a real "
+                  "slice collective_share_est is ICI time",
+    }), flush=True)
+
+
+def main() -> None:
+    n = _cli_devices()
+    if n < 2:
+        print("profile_decode: --devices N (N >= 2) required", file=sys.stderr)
+        sys.exit(2)
+    if os.environ.get("_ARKFLOW_PROFDEC_CHILD") == "1":
+        _child(n)
+        return
+    # the axon sitecustomize hijacks in-process jax init, and the forced
+    # host device count only takes effect pre-import — always re-exec into
+    # a clean N-device CPU child (same recipe as profile_step host-mesh)
+    import subprocess
+
+    from arkflow_tpu.utils.cleanenv import cpu_child_env
+
+    env = cpu_child_env(n_devices=n)
+    env["_ARKFLOW_PROFDEC_CHILD"] = "1"
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--devices", str(n)],
+        env=env, timeout=900)
+    sys.exit(res.returncode)
+
+
+if __name__ == "__main__":
+    main()
