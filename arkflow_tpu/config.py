@@ -158,6 +158,7 @@ class StreamConfig:
         _validate_token_coalesce(m.get("buffer"), pipeline.processors)
         _validate_response_cache(pipeline.processors)
         _validate_generate_mesh(pipeline.processors)
+        _validate_swap(pipeline.processors)
         temps = [TemporaryConfig.from_mapping(t) for t in m.get("temporary", [])]
         input_cfg = dict(m["input"])
         reconnect = input_cfg.pop("reconnect", None)
@@ -245,6 +246,25 @@ def _validate_response_cache(processors: list[dict]) -> None:
             continue
         if p.get("response_cache") is not None:
             parse_response_cache_config(p["response_cache"])
+
+
+def _validate_swap(processors: list[dict]) -> None:
+    """Parse-time validation of the ``swap:`` hot-swap block on
+    ``tpu_inference``/``tpu_generate`` (tpu/swap.py owns the parse rules; it
+    imports no jax), looking through ``fault.inner`` chaos wrappers like the
+    other cross-checks — a bad canary/drain knob fails at ``--validate``
+    instead of at the first POST /admin/swap."""
+    from arkflow_tpu.tpu.swap import parse_swap_config
+
+    for p in processors:
+        while (isinstance(p, Mapping) and p.get("type") == "fault"
+               and isinstance(p.get("inner"), Mapping)):
+            p = p["inner"]
+        if not isinstance(p, Mapping):
+            continue
+        ptype = p.get("type")
+        if ptype in ("tpu_inference", "tpu_generate") and p.get("swap") is not None:
+            parse_swap_config(p["swap"], who=str(ptype))
 
 
 #: decoder_lm's DecoderConfig default — mirrored here (not imported) so mesh

@@ -77,5 +77,13 @@ class RunnerDead(ArkError):
     probes and was marked DEAD; batches can no longer be served by it."""
 
 
+class SwapError(ArkError):
+    """A live model hot-swap (``tpu/swap.py``) was rejected or rolled back:
+    the candidate checkpoint failed to restore, the canary found the new
+    weights disagreeing with the live model, a post-flip probe failed, or a
+    swap was already in progress. The PRIOR params are serving throughout —
+    a SwapError never implies an interruption of traffic."""
+
+
 class UnsupportedSql(ArkError):
     """Raised by the Arrow-native SQL planner when a query needs the fallback engine."""

@@ -40,6 +40,10 @@ reproducible faults on its operation stream:
           - {kind: oom, at: 5}                # next device step raises
                                               # RESOURCE_EXHAUSTED (bucket
                                               # degradation coverage)
+          - {kind: swap_corrupt, at: 6}       # next hot-swap restores a
+                                              # mangled tree (canary rollback)
+          - {kind: swap_crash, at: 8}         # next hot-swap crashes mid-roll
+                                              # (partial-flip rollback)
 
 Crash faults raise a plain RuntimeError (not ArkError) so they escape the
 stream's contained error paths and exercise the engine restart policy; their
@@ -82,12 +86,18 @@ INPUT_KINDS = frozenset(
     {"latency", "disconnect", "error", "crash", "ack_fail", "ack_dup",
      "reconnect_fail", "burst"})
 OUTPUT_KINDS = frozenset({"latency", "error", "crash"})
-PROCESSOR_KINDS = frozenset({"latency", "error", "crash", "hang", "oom"})
+PROCESSOR_KINDS = frozenset(
+    {"latency", "error", "crash", "hang", "oom", "swap_corrupt", "swap_crash"})
 
 #: device-step faults: armed on the wrapped processor's runner (the fault
 #: fires INSIDE the next device step, exercising the real watchdog / OOM
 #: degradation machinery) — or emulated in-wrapper when there is no runner
 _STEP_KINDS = frozenset({"hang", "oom"})
+#: hot-swap faults: armed on the wrapped processor's swapper (tpu/swap.py)
+#: and consumed by its NEXT swap — ``swap_corrupt`` mangles the restored
+#: tree (canary rollback path), ``swap_crash`` raises mid-roll after the
+#: first unit flipped (partial-flip rollback path)
+_SWAP_KINDS = frozenset({"swap_corrupt", "swap_crash"})
 
 #: faults applied before the inner read (they replace the read, losing no data)
 _PRE_READ_KINDS = frozenset({"latency", "disconnect", "error", "crash"})
@@ -298,6 +308,13 @@ class FaultInjectingProcessor(Processor):
         ``/health`` introspection."""
         return getattr(self._inner, "runner", None)
 
+    @property
+    def swapper(self):
+        """The inner processor's hot-swap manager (None for non-swappable
+        inners): the engine's /admin/swap and /health walk through chaos
+        wrapping the same way they reach the runner."""
+        return getattr(self._inner, "swapper", None)
+
     async def process(self, batch: MessageBatch) -> list[MessageBatch]:
         self._calls += 1
         payload = _batch_bytes(batch) if self._needs_payload else None
@@ -306,6 +323,8 @@ class FaultInjectingProcessor(Processor):
                 await asyncio.sleep(spec.duration_s)
             elif spec.kind in _STEP_KINDS:
                 await self._apply_step_fault(spec)
+            elif spec.kind in _SWAP_KINDS:
+                self._arm_swap_fault(spec)
             elif spec.kind == "error":
                 raise ProcessError(spec.message)
             elif spec.kind == "crash":
@@ -330,6 +349,18 @@ class FaultInjectingProcessor(Processor):
             await asyncio.sleep(spec.duration_s if spec.duration_s > 0 else 30.0)
         else:
             raise ProcessError(f"RESOURCE_EXHAUSTED: {spec.message}")
+
+    def _arm_swap_fault(self, spec: FaultSpec) -> None:
+        """Arm a ``swap_corrupt``/``swap_crash`` on the inner processor's
+        hot-swap manager so the fault fires inside its NEXT swap. No
+        emulation fallback: a swap fault against a non-swappable inner is a
+        misconfigured chaos schedule and fails loudly."""
+        inject = getattr(self.swapper, "inject_swap_fault", None)
+        if inject is None:
+            raise ProcessError(
+                f"chaos: {spec.kind} requires a hot-swappable inner "
+                "processor (tpu_inference / tpu_generate)")
+        inject(spec.kind)
 
     async def close(self) -> None:
         if self._inner is not None:

@@ -44,6 +44,10 @@ Config:
                              # batch nacks for redelivery
     step_deadline_first: 60s # budget for first-compile steps (default 10x)
     health: {probe_backoff: 500ms, probe_backoff_cap: 30s, dead_after: 8}
+    checkpoint: /path/to/orbax   # optional: restore params at build
+    swap:                    # live hot-swap knobs (tpu/swap.py): continuous
+      canary: {rows: 4}      # mode drains the slot grid, flips, rebuilds
+      drain_timeout: 30s     # jits, and resets KV pools + prefix cache
 """
 
 from __future__ import annotations
@@ -73,7 +77,7 @@ class TpuGenerateProcessor(Processor):
                  speculative_tokens: int = 0, prefix_cache_pages: int = 0,
                  step_deadline_s: Optional[float] = None,
                  step_deadline_first_s: Optional[float] = None,
-                 health_config=None):
+                 health_config=None, checkpoint: Optional[str] = None):
         import jax
 
         from arkflow_tpu.models import get_model
@@ -111,20 +115,18 @@ class TpuGenerateProcessor(Processor):
         self.output_field = output_field
         self.buckets = buckets
 
-        try:
-            cpu = jax.devices("cpu")[0]
-        except RuntimeError:
-            cpu = None
-        if cpu is not None:
-            with jax.default_device(cpu):
-                params = self.family.init(jax.random.PRNGKey(seed), self.cfg)
-        else:
-            params = self.family.init(jax.random.PRNGKey(seed), self.cfg)
+        # host init (+ optional checkpoint restore) on CPU, one transfer to
+        # the execution devices — shared with the batch runner, and the same
+        # restore path the hot-swap manager replays for candidate weights
+        from arkflow_tpu.tpu.runner import init_host_params
+
+        params = init_host_params(self.family, self.cfg, seed, checkpoint)
         # tensor-parallel serving: shard params over a Mesh so decode runs
         # multi-chip via GSPMD (the KV cache shards over heads implicitly)
         self.mesh = None
+        self._pspecs = None
         if mesh_config:
-            from arkflow_tpu.parallel.mesh import MeshSpec, create_mesh, shard_params
+            from arkflow_tpu.parallel.mesh import MeshSpec, create_mesh
 
             try:
                 spec = MeshSpec(dp=int(mesh_config.get("dp", 1)),
@@ -136,11 +138,9 @@ class TpuGenerateProcessor(Processor):
             except (TypeError, ValueError) as e:
                 raise ConfigError(f"tpu_generate mesh config invalid: {e}") from e
             axes = {name: name for name in self.mesh.axis_names}
-            pspecs = (self.family.param_specs(self.cfg, axes)
-                      if self.family.param_specs else None)
-            self.params = shard_params(params, pspecs, self.mesh)
-        else:
-            self.params = jax.device_put(params, jax.devices()[0])
+            self._pspecs = (self.family.param_specs(self.cfg, axes)
+                            if self.family.param_specs else None)
+        self.params = self._place_params(params)
 
         ex = self.family.extras
         # whole-generation jit: one device dispatch per batch (prefill +
@@ -190,6 +190,21 @@ class TpuGenerateProcessor(Processor):
         reg = global_registry()
         self.m_tokens = reg.counter("arkflow_generated_tokens_total", "tokens generated",
                                     {"model": model})
+        #: live hot-swap manager (tpu/swap.py), attached by the builder; the
+        #: engine's POST /admin/swap and the fault plugin reach it here
+        self.swapper = None
+
+    def _place_params(self, host_params):
+        """Place a host param tree exactly like construction placed the
+        original (sharded under a mesh, one-hop device_put otherwise) — the
+        hot-swap manager places candidate trees through this."""
+        import jax
+
+        if self.mesh is not None:
+            from arkflow_tpu.parallel.mesh import shard_params
+
+            return shard_params(host_params, self._pspecs, self.mesh)
+        return jax.device_put(host_params, jax.devices()[0])
 
     # -- generation --------------------------------------------------------
 
@@ -282,7 +297,7 @@ def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
     runner_cfg = config.get("model_config")
     vocab = (runner_cfg or {}).get("vocab_size", 2048)
     core_cfg = parse_core_config(config)
-    return TpuGenerateProcessor(
+    proc = TpuGenerateProcessor(
         model,
         runner_cfg,
         text_field=config.get("text_field", DEFAULT_BINARY_VALUE_FIELD),
@@ -305,7 +320,15 @@ def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
         step_deadline_s=core_cfg["step_deadline_s"],
         step_deadline_first_s=core_cfg["step_deadline_first_s"],
         health_config=core_cfg["health_config"],
+        checkpoint=config.get("checkpoint"),
     )
+    from arkflow_tpu.tpu.swap import build_generate_swapper, parse_swap_config
+
+    proc.swapper = build_generate_swapper(
+        proc, model=str(model), seed=int(config.get("seed", 0)),
+        swap_cfg=parse_swap_config(config.get("swap"), who="tpu_generate"),
+        checkpoint=config.get("checkpoint"))
+    return proc
 
 
 def _serving_mode(config: dict) -> str:

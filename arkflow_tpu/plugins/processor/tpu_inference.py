@@ -65,6 +65,11 @@ Config:
       probe_backoff: 500ms         # first probe delay; doubles per incident
       probe_backoff_cap: 30s
       dead_after: 8                # consecutive incidents -> DEAD (0: never)
+    swap:                          # live hot-swap knobs (tpu/swap.py; the
+      canary:                      # manager itself is always on — POST
+        rows: 4                    # /admin/swap works without this block):
+        min_agreement: 1.0         # golden-batch rows + required argmax
+      drain_timeout: 30s           # agreement; drain budget is generate-only
 """
 
 from __future__ import annotations
@@ -88,8 +93,11 @@ if TYPE_CHECKING:  # jax-importing modules load lazily in the builder
 class TpuInferenceProcessor(Processor):
     def __init__(self, runner: ModelRunner, *, text_field: str, tensor_field: Optional[str],
                  tokenizer, max_seq: int, outputs: Optional[list[str]], warmup: bool = False,
-                 packing: bool = False, response_cache=None):
+                 packing: bool = False, response_cache=None, swapper=None):
         self.runner = runner
+        #: live hot-swap manager (tpu/swap.py): the engine's POST /admin/swap
+        #: and the fault plugin's swap_corrupt/swap_crash arming reach it here
+        self.swapper = swapper
         self.text_field = text_field
         self.tensor_field = tensor_field
         self.tokenizer = tokenizer
@@ -316,6 +324,19 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
     tokenizer = build_tokenizer(config.get("tokenizer"), vocab_size=vocab)
     from arkflow_tpu.runtime.respcache import build_response_cache
 
+    cache = build_response_cache(config.get("response_cache"), name=str(model))
+    from arkflow_tpu.tpu.swap import build_batch_swapper, parse_swap_config
+
+    swapper = build_batch_swapper(
+        runner, model=str(model),
+        serving_dtype=config.get("serving_dtype"),
+        seed=int(config.get("seed", 0)),
+        swap_cfg=parse_swap_config(config.get("swap"), who="tpu_inference"),
+        checkpoint=config.get("checkpoint"))
+    if cache is not None:
+        # swap-aware cache: a committed swap epoch-flushes so a post-swap
+        # duplicate can never be answered with pre-swap bytes
+        swapper.add_commit_hook(cache.bump_epoch)
     return TpuInferenceProcessor(
         runner,
         text_field=config.get("text_field", DEFAULT_BINARY_VALUE_FIELD),
@@ -325,6 +346,6 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         outputs=config.get("outputs"),
         warmup=bool(config.get("warmup", False)),
         packing=packing,
-        response_cache=build_response_cache(
-            config.get("response_cache"), name=str(model)),
+        response_cache=cache,
+        swapper=swapper,
     )

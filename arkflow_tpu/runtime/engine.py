@@ -64,6 +64,23 @@ class Engine:
             reports.extend(rep if isinstance(rep, list) else [rep])
         return reports
 
+    @staticmethod
+    def _stream_swappers(stream: Stream) -> list:
+        """Hot-swap managers of every swappable processor of a stream
+        (tpu/swap.py), walking ``_inner`` chains so chaos wrapping doesn't
+        hide them — the surface POST /admin/swap and /health drive."""
+        swappers = []
+        for proc in getattr(stream.pipeline, "processors", None) or []:
+            node, seen = proc, set()
+            while node is not None and id(node) not in seen:
+                seen.add(id(node))
+                sw = getattr(node, "swapper", None)
+                if sw is not None and hasattr(sw, "swap"):
+                    swappers.append(sw)
+                    break
+                node = getattr(node, "_inner", None)
+        return swappers
+
     def stream_health(self) -> dict:
         """Restart accounting + per-runner device health, per stream."""
         out: dict[str, dict] = {}
@@ -98,6 +115,14 @@ class Engine:
                     node = getattr(node, "_inner", None)
             if caches:
                 info["response_caches"] = caches
+            swaps = []
+            for sw in self._stream_swappers(s):
+                try:
+                    swaps.append(sw.report())
+                except Exception:  # introspection must not break /health
+                    logger.exception("swap report failed for stream %s", s.name)
+            if swaps:
+                info["swap"] = swaps
             out[s.name] = info
         return out
 
@@ -183,10 +208,62 @@ class Engine:
             return web.Response(text=json.dumps({"trace_dir": out_dir, "seconds": seconds}),
                                 content_type="application/json")
 
+        async def admin_swap(req):
+            """POST /admin/swap {"checkpoint": "/path", "stream": "name"?} —
+            rolling model hot-swap (tpu/swap.py) on every swappable
+            processor of the targeted stream(s), sequentially (the rolling
+            discipline extends across streams). Each swap canary-verifies
+            the candidate and rolls back on any failure with the old
+            version serving throughout; the response carries the per-stream
+            verdicts. 200 = every swap committed, 409 = no swap ran /
+            some rolled back (old versions still serving)."""
+            from arkflow_tpu.errors import SwapError
+
+            try:
+                body = await req.json()
+            except Exception:
+                return web.Response(
+                    status=400, text='{"error":"body must be JSON"}',
+                    content_type="application/json")
+            ckpt = body.get("checkpoint") if isinstance(body, dict) else None
+            if not ckpt or not isinstance(ckpt, str):
+                return web.Response(
+                    status=400,
+                    text='{"error":"a \'checkpoint\' path is required"}',
+                    content_type="application/json")
+            target = body.get("stream")
+            results: dict[str, list] = {}
+            ok_all, found = True, False
+            for s in self.streams:
+                if target is not None and s.name != target:
+                    continue
+                for sw in self._stream_swappers(s):
+                    found = True
+                    try:
+                        rep = {"ok": True, **(await sw.swap(ckpt))}
+                    except SwapError as e:
+                        ok_all, rep = False, {"ok": False, "error": str(e)}
+                    except Exception as e:  # an unexpected bug must still answer
+                        ok_all = False
+                        rep = {"ok": False,
+                               "error": f"{type(e).__name__}: {e}"}
+                    results.setdefault(s.name, []).append(rep)
+            if not found:
+                return web.Response(
+                    status=404,
+                    text=json.dumps({"error": "no hot-swappable processors"
+                                     + (f" in stream {target!r}" if target else "")}),
+                    content_type="application/json")
+            return web.Response(
+                status=200 if ok_all else 409,
+                text=json.dumps({"ok": ok_all, "results": results}),
+                content_type="application/json")
+
         app.router.add_get(hc.path, health)
         app.router.add_get("/readiness", readiness)
         app.router.add_get("/liveness", liveness)
         app.router.add_get("/metrics", metrics)
+        app.router.add_post("/admin/swap", admin_swap)
         if hc.profiling_dir:
             app.router.add_post("/debug/profile", profile)
         runner = web.AppRunner(app, access_log=None)

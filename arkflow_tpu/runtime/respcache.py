@@ -47,6 +47,11 @@ class ResponseCache:
                 f"response_cache.ttl must be > 0, got {ttl_s}")
         self.capacity = capacity
         self.ttl_s = ttl_s
+        #: model-version epoch folded into every key (``get_or_compute``):
+        #: ``batch_fingerprint`` identifies the REQUEST, not the weights that
+        #: answered it — after a hot-swap a byte-identical duplicate must
+        #: miss, or the cache would serve bitwise pre-swap responses forever
+        self._epoch = 0
         #: key -> (expires_at_monotonic | None, value); insertion order = LRU
         self._entries: "OrderedDict[bytes, tuple[Optional[float], Any]]" = OrderedDict()
         self._inflight: dict[bytes, asyncio.Future] = {}
@@ -78,6 +83,25 @@ class ResponseCache:
         #: share the counters above — /health must still report each
         #: cache's own traffic, not the pooled totals
         self.n_hits = self.n_misses = self.n_collapsed = self.n_evictions = 0
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def bump_epoch(self) -> None:
+        """A model swap committed: every cached response was computed by the
+        OLD weights. The epoch in the key makes them unreachable (a post-swap
+        duplicate misses and recomputes); the flush reclaims their memory
+        now instead of waiting for LRU churn. In-flight computes keyed under
+        the old epoch complete harmlessly — they store under a key no new
+        lookup can form."""
+        self._epoch += 1
+        flushed = len(self._entries)
+        if flushed:
+            self._entries.clear()
+            self.m_evictions.inc(flushed)
+            self.n_evictions += flushed
+            self.m_size.set(0)
 
     def set_tenant_policy(self, policy) -> None:
         """Adopt the stream's tenant policy (stream hook via the serving
@@ -142,6 +166,9 @@ class ResponseCache:
         an identical in-flight compute, or a fresh compute (stored on
         success). Exceptions from ``compute`` reach every collapsed caller
         and leave the cache untouched."""
+        # the model-version epoch is part of the identity: the same request
+        # against different weights is a different cache entry
+        key = self._epoch.to_bytes(8, "big") + key
         hit = self.lookup(key)
         if hit is not None:
             self.m_hits.inc()
@@ -183,6 +210,7 @@ class ResponseCache:
             "entries": len(self._entries),
             "capacity": self.capacity,
             "ttl_s": self.ttl_s,
+            "epoch": self._epoch,
             "hits": self.n_hits,
             "misses": self.n_misses,
             "collapsed": self.n_collapsed,

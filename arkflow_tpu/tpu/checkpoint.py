@@ -4,28 +4,120 @@ The reference has no state checkpointing (SURVEY.md section 5 — delivery
 relies on broker acks); model parameters are new state this engine owns, so
 they get first-class checkpointing: ``save``/``restore`` wrap orbax's
 StandardCheckpointer and the ``tpu_inference``/``tpu_generate`` processors
-accept a ``checkpoint:`` path at build.
+accept a ``checkpoint:`` path at build. The same paths feed the live
+hot-swap manager (``tpu/swap.py``), so their failure modes must be clean:
+
+- ``save`` is **crash-atomic**: orbax writes into a hidden temp sibling
+  directory which is renamed into place only once fully written and synced.
+  A reader (a later ``restore``, a hot-swap on another process) therefore
+  sees the old checkpoint, the new checkpoint, or — in the narrow replace
+  window — no checkpoint at all (a loud, detectable state), but **never a
+  half-written tree** it would restore garbage from.
+- ``restore`` maps orbax's raw tree-structure mismatch tracebacks to a
+  ``ConfigError`` that names the offending leaves (what the model expects
+  vs what the checkpoint holds), so a wrong-architecture checkpoint fails
+  with an actionable message instead of a stack of orbax internals.
 """
 
 from __future__ import annotations
 
+import os
+import shutil
 from pathlib import Path
 
 from arkflow_tpu.errors import ConfigError
 
 
+def _tmp_sibling(p: Path, tag: str) -> Path:
+    """Hidden sibling on the SAME filesystem (os.rename must not cross
+    devices); pid-suffixed so concurrent savers to DIFFERENT paths under
+    one parent never collide. (Concurrent savers to the SAME path are
+    unsupported — last rename wins.)"""
+    return p.parent / f".{p.name}.{tag}-{os.getpid()}"
+
+
+def _clean_stale_siblings(p: Path) -> None:
+    """Remove temp/old siblings left by CRASHED earlier saves of this path,
+    from any pid — a crashed process never cleans its own, so without the
+    glob full-size checkpoint copies would leak on disk forever."""
+    for stale in p.parent.glob(f".{p.name}.tmp-*"):
+        shutil.rmtree(stale, ignore_errors=True)
+    for stale in p.parent.glob(f".{p.name}.old-*"):
+        shutil.rmtree(stale, ignore_errors=True)
+
+
 def save(path: str, params) -> None:
+    """Write ``params`` to ``path`` atomically (temp sibling + rename).
+
+    Replacing an existing checkpoint renames the old tree aside before the
+    new one lands, then deletes it — a crash anywhere in the sequence leaves
+    either a complete old tree, a complete new tree, or a missing path
+    (which ``restore`` rejects loudly), never a partial one.
+    """
     import orbax.checkpoint as ocp
 
+    p = Path(path).absolute()
+    p.parent.mkdir(parents=True, exist_ok=True)
+    _clean_stale_siblings(p)  # crashed saves (any pid) never half-read
+    tmp = _tmp_sibling(p, "tmp")
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(Path(path).absolute(), params)
+    ckptr.save(tmp, params)
     ckptr.wait_until_finished()
+    if p.exists():
+        old = _tmp_sibling(p, "old")
+        if old.exists():
+            shutil.rmtree(old)
+        os.rename(p, old)
+        os.rename(tmp, p)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.rename(tmp, p)
+
+
+def _mismatch_hint(ckptr, p: Path, like_params) -> str:
+    """Best-effort diff of the checkpoint's tree structure against the
+    model's: names the first offending leaves. Returns "" when the saved
+    metadata itself is unreadable (corrupt checkpoint)."""
+    try:
+        import jax.tree_util as jtu
+
+        saved = {jtu.keystr(k)
+                 for k, _ in jtu.tree_flatten_with_path(ckptr.metadata(p))[0]}
+        want = {jtu.keystr(k)
+                for k, _ in jtu.tree_flatten_with_path(like_params)[0]}
+        missing = sorted(want - saved)
+        extra = sorted(saved - want)
+        parts = []
+        if missing:
+            parts.append(f"model expects leaves the checkpoint lacks: "
+                         f"{missing[:3]}{'...' if len(missing) > 3 else ''}")
+        if extra:
+            parts.append(f"checkpoint holds leaves the model lacks: "
+                         f"{extra[:3]}{'...' if len(extra) > 3 else ''}")
+        return "; ".join(parts)
+    except Exception:
+        return ""
 
 
 def restore(path: str, like_params):
+    """Restore ``path`` into the structure/dtypes of ``like_params``.
+
+    Raises ``ConfigError`` (never a raw orbax traceback) when the path is
+    missing, the tree structure does not match the model's, or the
+    checkpoint bytes are unreadable (truncated / mangled files).
+    """
     import orbax.checkpoint as ocp
 
     p = Path(path).absolute()
     if not p.exists():
         raise ConfigError(f"checkpoint path {p} does not exist")
-    return ocp.StandardCheckpointer().restore(p, like_params)
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        return ckptr.restore(p, like_params)
+    except ConfigError:
+        raise
+    except Exception as e:
+        hint = _mismatch_hint(ckptr, p, like_params)
+        raise ConfigError(
+            f"failed to restore checkpoint {p}: "
+            f"{hint if hint else f'{type(e).__name__}: {e}'}") from e

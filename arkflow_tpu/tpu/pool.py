@@ -191,6 +191,13 @@ class ModelRunnerPool:
         """Per-member health snapshots for the engine's ``/health``."""
         return [m.health_report() for m in self.members]
 
+    def swap_units(self) -> list[tuple[str, "ModelRunner"]]:
+        """Independently-flippable serving surfaces for a rolling hot-swap
+        (tpu/swap.py): each member flips and probes ALONE, in pool order, so
+        the dispatcher keeps serving on the other N-1 members throughout —
+        the pool's replication is exactly what makes the roll zero-downtime."""
+        return [(f"member {i}", m) for i, m in enumerate(self.members)]
+
     # -- dispatch ----------------------------------------------------------
 
     def _pick(self, exclude: set[int]) -> Optional[int]:

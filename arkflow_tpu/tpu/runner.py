@@ -248,6 +248,10 @@ class ModelRunner:
         self.mesh = None
         self._device = None
         self._input_sharding = None
+        #: PartitionSpecs the params were placed with (None off-mesh) — kept
+        #: so a hot-swap (tpu/swap.py) can place a candidate tree EXACTLY
+        #: like the original, including the int8 spec rewrite
+        self._pspecs = None
         axes: dict[str, str] = {}
         if mesh_spec is not None and mesh_spec.num_devices > 1:
             self.mesh = create_mesh(mesh_spec, devices=devices)
@@ -260,6 +264,7 @@ class ModelRunner:
                 from arkflow_tpu.models.quantize import quantize_param_specs
 
                 pspecs = quantize_param_specs(pspecs)
+            self._pspecs = pspecs
             params = shard_params(params, pspecs, self.mesh)
             # dp-sharded dispatch: the batch dim splits over the dp axis, so
             # every GLOBAL bucket scales by dp — per-chip shards stay exactly
@@ -754,6 +759,33 @@ class ModelRunner:
             self._build_jitted()
         logger.warning("[%s] rebuilt jitted step after a deadline miss",
                        self.family.name)
+
+    # -- live hot-swap surface (tpu/swap.py) --------------------------------
+
+    def place_params(self, host_params):
+        """Place a (converted) host param tree exactly like ``__init__``
+        placed the original: sharded with the same PartitionSpecs under a
+        mesh, a one-hop transfer to the runner's device otherwise. Blocking
+        (device transfer) — swap runs it on an executor thread, never the
+        serving loop."""
+        if self.mesh is not None:
+            return shard_params(host_params, self._pspecs, self.mesh)
+        return jax.device_put(host_params, self._device)
+
+    def adopt_params(self, placed):
+        """Atomically flip serving onto ``placed``; returns the prior tree
+        (the rollback token). Params ride the jitted step as an ARGUMENT
+        (never a traced constant), so the flip is one attribute assignment:
+        in-flight steps finish on the tree they already read, the next
+        dispatch serves the new weights, and — same structure/dtypes/
+        shardings — no executable recompiles."""
+        old, self.params = self.params, placed
+        return old
+
+    def swap_units(self) -> list[tuple[str, "ModelRunner"]]:
+        """A single runner is one flippable unit (the pool overrides this
+        with its per-member rolling order)."""
+        return [("runner", self)]
 
     def health_report(self) -> dict:
         """JSON-able health snapshot for the engine's ``/health`` endpoint."""

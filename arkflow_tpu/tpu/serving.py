@@ -163,6 +163,10 @@ class GenerationServer:
         self._pending: deque[_Request] = deque()
         self._loop_task: Optional[asyncio.Task] = None
         self._closed = False
+        #: hot-swap drain flag (``swap_params``): admission pauses, the slot
+        #: grid runs dry, then params flip + jits rebuild + pools reset —
+        #: queued requests wait through the flip instead of failing
+        self._draining = False
 
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -322,6 +326,42 @@ class GenerationServer:
         self._free_pages = list(range(1, self.num_pages))
         self.k_pages, self.v_pages = self._init_pools()
 
+    # -- live hot-swap surface (tpu/swap.py) --------------------------------
+
+    async def swap_params(self, placed, drain_timeout_s: float = 30.0):
+        """Adopt a new (pre-placed) param tree with zero dropped requests.
+
+        Unlike the batch runner — whose params ride the jitted step as an
+        argument — the four generation jits close over ``self.params`` as
+        traced constants, so a flip must rebuild them. The sequence: pause
+        admission, let the lockstep slot grid run dry (queued requests WAIT,
+        they are never failed), flip params, rebuild the jits (the cleared
+        ``_seen_steps`` grants the next step the first-compile budget), and
+        reset the page pools + prefix cache — cached KV against new weights
+        is a silent correctness bug. Returns the prior tree (the rollback
+        token); raises ``SwapError`` (old params untouched, still serving)
+        when the grid does not drain within ``drain_timeout_s``.
+        """
+        from arkflow_tpu.errors import SwapError
+
+        self._draining = True
+        try:
+            deadline = time.monotonic() + drain_timeout_s
+            while any(r is not None for r in self._slot_req):
+                if time.monotonic() >= deadline:
+                    raise SwapError(
+                        f"slot grid did not drain within {drain_timeout_s:.3g}s "
+                        f"({sum(1 for r in self._slot_req if r is not None)} "
+                        "slots still busy); old params still serving")
+                await asyncio.sleep(0.01)
+            old, self.params = self.params, placed
+            self._seen_steps.clear()
+            self._build_jitted()
+            self._reset_device_state()
+            return old
+        finally:
+            self._draining = False
+
     # -- self-healing surface (fault plugin / engine /health) ---------------
 
     def inject_step_fault(self, kind: str, duration_s: float = 0.0) -> None:
@@ -334,6 +374,7 @@ class GenerationServer:
         the serving detail that says whether the server is keeping up."""
         rep = self.core.health_report()
         rep["serving"] = "continuous"
+        rep["draining"] = self._draining
         rep["slots"] = self.slots
         rep["slots_busy"] = sum(1 for r in self._slot_req if r is not None)
         total = self.num_pages - 1
@@ -765,6 +806,8 @@ class GenerationServer:
                 req.future.set_exception(err)
 
     async def _admit_pending(self) -> bool:
+        if self._draining:  # hot-swap in progress: let the slot grid run dry
+            return False
         admitted = False
         for slot in range(self.slots):
             if self._slot_req[slot] is not None or not self._pending:

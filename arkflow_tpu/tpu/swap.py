@@ -1,0 +1,595 @@
+"""Zero-downtime model lifecycle: rolling hot-swap with canary + rollback.
+
+ROADMAP item 4 names "rolling model hot-swap so a weight update never stops
+serving" as a required capability of the serving tier; until this module the
+only way to change weights was a full process restart — every in-flight
+batch dropped, every executable recompiled from cold. Flex-TPU (PAPERS.md)
+makes the same argument at the hardware layer: reconfigure at runtime
+instead of tearing down. ``ModelSwapManager`` gives the serving tier that
+property for model weights:
+
+1. **Restore off the serving path.** The candidate checkpoint is restored
+   and dtype-converted on an executor thread against a freshly-initialized
+   host tree — the live params are never touched, and a corrupt/mismatched
+   checkpoint fails here (``ConfigError`` from ``tpu/checkpoint.py``) with
+   the old version serving throughout.
+2. **Canary-verify.** A deterministic golden batch runs through the model
+   family's forward with the LIVE params and with the candidate; the swap
+   proceeds only when their argmax signatures agree to ``min_agreement``
+   (default 1.0 — right for same-prediction weight refreshes; lower it for
+   genuinely behavior-changing updates, or set ``rows: 0`` to skip).
+3. **Flip atomically, one serving unit at a time.** ``ModelRunner`` params
+   ride the jitted step as an argument, so a flip is one attribute
+   assignment — no recompiles, in-flight steps finish on the weights they
+   already read. ``ModelRunnerPool`` members flip one at a time, so the
+   pool keeps serving on N-1 members while each flips and probes. The
+   continuous ``GenerationServer`` flips only after its slot grid drains,
+   then rebuilds its jits and resets page pools + prefix cache (cached KV
+   against new weights is a silent correctness bug — so are response-cache
+   hits, which the commit hooks epoch-flush).
+4. **Probe, then commit — or roll back.** After each flip one real
+   health-gated step runs through the unit (the PR-4 serving core: deadline
+   watchdog, probe/backoff on failure). Any probe failure, canary
+   disagreement, restore error, or chaos-injected crash rolls every flipped
+   unit back to the prior params and raises ``SwapError`` — the old version
+   served continuously and keeps serving.
+
+Chaos: ``inject_swap_fault("swap_corrupt")`` mangles the next swap's
+restored tree (the canary/rollback path a truncated checkpoint would take);
+``"swap_crash"`` raises mid-roll after the first unit flipped (the
+rollback-under-partial-flip path a crashed operator process would leave).
+Both are armed by the fault plugin's processor wrapper, like hang/oom.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from arkflow_tpu.errors import ConfigError, SwapError
+from arkflow_tpu.obs import global_registry
+
+logger = logging.getLogger("arkflow.tpu.swap")
+
+#: chaos fault kinds the fault plugin may arm on a swapper
+SWAP_FAULT_KINDS = ("swap_corrupt", "swap_crash")
+
+
+@dataclass(frozen=True)
+class SwapConfig:
+    """Knobs for the ``swap:`` block on ``tpu_inference``/``tpu_generate``."""
+
+    #: golden-batch rows for the canary (0 disables canary verification)
+    canary_rows: int = 4
+    #: fraction of golden argmax positions that must agree between the live
+    #: model and the candidate (1.0 = exact)
+    min_agreement: float = 1.0
+    #: rng seed for the golden batch (deterministic across both runs)
+    canary_seed: int = 0x5117
+    #: continuous generation only: budget for the slot grid to run dry
+    drain_timeout_s: float = 30.0
+
+
+def parse_swap_config(cfg: Any, who: str = "processor") -> SwapConfig:
+    """Pure parse of a ``swap:`` block (config.py runs this at --validate
+    without building a swapper or importing jax)."""
+    if cfg is None:
+        return SwapConfig()
+    if not isinstance(cfg, Mapping):
+        raise ConfigError(f"{who}.swap must be a mapping, got {cfg!r}")
+    unknown = set(cfg) - {"canary", "drain_timeout"}
+    if unknown:
+        raise ConfigError(
+            f"{who}.swap: unknown keys {sorted(unknown)} "
+            "(allowed: canary, drain_timeout)")
+    out: dict[str, Any] = {}
+    canary = cfg.get("canary")
+    if canary is not None:
+        if not isinstance(canary, Mapping):
+            raise ConfigError(f"{who}.swap.canary must be a mapping, got {canary!r}")
+        bad = set(canary) - {"rows", "min_agreement", "seed"}
+        if bad:
+            raise ConfigError(
+                f"{who}.swap.canary: unknown keys {sorted(bad)} "
+                "(allowed: rows, min_agreement, seed)")
+        rows = canary.get("rows", SwapConfig.canary_rows)
+        if isinstance(rows, bool) or not isinstance(rows, int) or rows < 0:
+            raise ConfigError(
+                f"{who}.swap.canary.rows must be an int >= 0, got {rows!r}")
+        out["canary_rows"] = rows
+        agree = canary.get("min_agreement", SwapConfig.min_agreement)
+        if isinstance(agree, bool) or not isinstance(agree, (int, float)) \
+                or not (0.0 <= float(agree) <= 1.0):
+            raise ConfigError(
+                f"{who}.swap.canary.min_agreement must be in [0, 1], got {agree!r}")
+        out["min_agreement"] = float(agree)
+        seed = canary.get("seed", SwapConfig.canary_seed)
+        if isinstance(seed, bool) or not isinstance(seed, int):
+            raise ConfigError(
+                f"{who}.swap.canary.seed must be an int, got {seed!r}")
+        out["canary_seed"] = seed
+    drain = cfg.get("drain_timeout")
+    if drain is not None:
+        from arkflow_tpu.utils.duration import parse_duration
+
+        drain_s = parse_duration(drain)
+        if drain_s <= 0:
+            raise ConfigError(
+                f"{who}.swap.drain_timeout must be positive, got {drain!r}")
+        out["drain_timeout_s"] = drain_s
+    return SwapConfig(**out)
+
+
+# -- golden batch / canary signature ----------------------------------------
+
+
+def golden_inputs(spec: Mapping[str, tuple], cfg, rows: int, seed: int,
+                  seq: int = 16) -> dict[str, np.ndarray]:
+    """Deterministic spec-shaped inputs for the canary: token ids drawn
+    below the model's vocab, masks a contiguous prefix of ones (the flash
+    kernels' contract), float features standard-normal. Same (spec, cfg,
+    rows, seed) => bitwise-same batch, so live and candidate score the
+    exact same inputs."""
+    rng = np.random.default_rng(seed)
+    vocab = int(getattr(cfg, "vocab_size", 256) or 256)
+    out: dict[str, np.ndarray] = {}
+    for name, (dtype, trailing) in spec.items():
+        dims = tuple(seq if d == "seq" else int(d) for d in trailing)
+        shape = (rows, *dims)
+        if name == "attention_mask":
+            out[name] = np.ones(shape, dtype)
+        elif np.issubdtype(np.dtype(dtype), np.integer):
+            out[name] = rng.integers(1, max(vocab, 2), size=shape).astype(dtype)
+        else:
+            out[name] = rng.standard_normal(shape).astype(dtype)
+    return out
+
+
+def argmax_signature(outputs: Mapping[str, Any]) -> np.ndarray:
+    """Discrete decision signature of a forward pass: the argmax over the
+    class/vocab axis of the logits (robust to benign float drift between
+    hosts/devices in a way raw logits are not)."""
+    cand = outputs.get("logits")
+    if cand is None:
+        for v in outputs.values():
+            arr = np.asarray(v)
+            if arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating):
+                cand = v
+                break
+    if cand is None:  # no float output: compare the first output verbatim
+        return np.asarray(next(iter(outputs.values())))
+    return np.asarray(np.argmax(np.asarray(cand, np.float32), axis=-1))
+
+
+# -- swap units (one per independently-flippable serving surface) ------------
+
+
+class BatchRunnerUnit:
+    """One ``ModelRunner`` (standalone, or a pool member): place/flip are the
+    runner's own swap surface; the probe is one real health-gated step."""
+
+    def __init__(self, runner, label: str):
+        self.runner = runner
+        self.label = label
+
+    def live(self):
+        return self.runner.params
+
+    def place(self, host_params):
+        return self.runner.place_params(host_params)
+
+    async def adopt(self, placed):
+        return self.runner.adopt_params(placed)
+
+    def _probe_inputs(self) -> dict[str, np.ndarray]:
+        r = self.runner
+        seq = min(r.buckets.seq_buckets) if r.buckets.seq_buckets else 16
+        rows = min(2, r.buckets.batch_buckets[0]) if r.buckets.batch_buckets else 1
+        if not r.packed:
+            return golden_inputs(r.spec, r.cfg, rows, seed=0xB0B, seq=seq)
+        # packed runners consume the packed layout; build a tiny valid one
+        from arkflow_tpu.tpu.packing import pack_tokens
+
+        rng = np.random.default_rng(0xB0B)
+        vocab = int(getattr(r.cfg, "vocab_size", 256) or 256)
+        ids = rng.integers(1, max(vocab, 2), size=(rows, seq)).astype(np.int32)
+        pk = pack_tokens(ids, np.full(rows, seq, np.int64), seq)
+        return {"input_ids": pk.input_ids, "segment_ids": pk.segment_ids,
+                "position_ids": pk.position_ids, "example_row": pk.example_row,
+                "example_pos": pk.example_pos}
+
+    async def probe(self) -> None:
+        """One real step through the runner's own gate (heal gate, deadline
+        watchdog). The swap manager is a dispatcher here: a failed probe
+        applies the shared ``note_external_failure`` policy (deadline
+        misses/OOMs self-mark inside the step), so the rolled-back unit
+        enters the SAME probe/backoff schedule pool dispatch honors."""
+        try:
+            await self.runner.infer(self._probe_inputs())
+        except Exception as e:
+            self.runner.core.note_external_failure(e)
+            raise
+
+
+class BatchGenerateUnit:
+    """``tpu_generate`` in batch mode: the processor holds the params and
+    its whole-generation jit takes them as an argument — flip is one
+    assignment, like the batch runner."""
+
+    label = "generate[batch]"
+
+    def __init__(self, proc):
+        self.proc = proc
+
+    def live(self):
+        return self.proc.params
+
+    def place(self, host_params):
+        return self.proc._place_params(host_params)
+
+    async def adopt(self, placed):
+        old, self.proc.params = self.proc.params, placed
+        return old
+
+    def _probe_blocking(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        p = self.proc
+        seq = min(8, p.buckets.seq_bucket(8))
+        ids = np.ones((p.buckets.batch_bucket(1), seq), np.int32)
+        lengths = np.ones(ids.shape[0], np.int32)
+        # fixed key: the probe must not race the serving path's rng state
+        out = p._generate(p.params, input_ids=jnp.asarray(ids),
+                          lengths=jnp.asarray(lengths, jnp.int32),
+                          n_real=jnp.asarray(1, jnp.int32),
+                          rng_key=jax.random.PRNGKey(0))
+        jax.block_until_ready(out)
+
+    async def probe(self) -> None:
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._probe_blocking)
+
+
+class GenerationServerUnit:
+    """Continuous generation: the server drains its slot grid, flips,
+    rebuilds jits, and resets pools/prefix cache inside ``swap_params``;
+    the probe is one real (health-gated) generation."""
+
+    label = "generate[continuous]"
+
+    def __init__(self, server, place_fn: Callable[[Any], Any],
+                 drain_timeout_s: float, owner=None):
+        self.server = server
+        self._place_fn = place_fn
+        self._drain_timeout_s = drain_timeout_s
+        #: the TpuGenerateProcessor holding a ``params`` alias of the
+        #: server's tree: kept in sync on every flip, or the boot-time tree
+        #: would stay pinned in device memory for the process lifetime (a
+        #: third full weight copy on every later swap) and introspection
+        #: would read version-0 weights forever
+        self._owner = owner
+
+    def live(self):
+        return self.server.params
+
+    def place(self, host_params):
+        return self._place_fn(host_params)
+
+    async def adopt(self, placed):
+        old = await self.server.swap_params(placed, self._drain_timeout_s)
+        if self._owner is not None:
+            self._owner.params = placed
+        return old
+
+    async def probe(self) -> None:
+        vocab = int(getattr(self.server.cfg, "vocab_size", 256) or 256)
+        await self.server.generate([t % max(vocab, 2) for t in (3, 5, 7)],
+                                   max_new_tokens=2)
+
+
+# -- the manager -------------------------------------------------------------
+
+
+class ModelSwapManager:
+    """Orchestrates one rolling hot-swap at a time over a list of units.
+
+    ``prepare(path)`` is the blocking restore+convert (runs on an executor
+    thread, off the serving path); ``canary(params)`` is the blocking golden
+    forward returning an :func:`argmax_signature`-style array. ``commit
+    hooks`` run after a successful swap — the response cache's epoch bump
+    registers here so post-swap duplicates can never return pre-swap bytes.
+    """
+
+    def __init__(self, *, name: str, config: Optional[SwapConfig] = None,
+                 prepare: Callable[[str], Any],
+                 canary: Callable[[Any], np.ndarray],
+                 units: Sequence[Any],
+                 checkpoint: Optional[str] = None):
+        if not units:
+            raise ConfigError("ModelSwapManager needs at least one swap unit")
+        self.name = name
+        self.cfg = config or SwapConfig()
+        self._prepare = prepare
+        self._canary = canary
+        self.units = list(units)
+        #: monotonically-increasing model-version epoch; 0 = the params the
+        #: process booted with (possibly from ``checkpoint:`` config)
+        self.version = 0
+        self.checkpoint = checkpoint
+        self._lock = asyncio.Lock()
+        self._state = "idle"
+        self._last_error: Optional[str] = None
+        self._chaos: deque[str] = deque()
+        self._commit_hooks: list[Callable[[], None]] = []
+
+        reg = global_registry()
+        labels = {"model": name}
+        self.m_version = reg.gauge(
+            "arkflow_model_version",
+            "model-version epoch (increments on each committed hot-swap)",
+            labels)
+        self.m_version.set(0)
+        self.m_started = reg.counter(
+            "arkflow_swap_started_total", "hot-swap attempts started", labels)
+        self.m_completed = reg.counter(
+            "arkflow_swap_completed_total", "hot-swaps committed", labels)
+        self.m_rolled_back = reg.counter(
+            "arkflow_swap_rolled_back_total",
+            "hot-swaps rolled back (canary/restore/probe failure) with the "
+            "prior version serving throughout", labels)
+        #: per-instance counts for report() (the registry dedupes series on
+        #: (name, labels): two streams serving the same model share counters)
+        self.n_started = self.n_completed = self.n_rolled_back = 0
+
+    # -- chaos / hooks ------------------------------------------------------
+
+    def inject_swap_fault(self, kind: str) -> None:
+        """Arm a one-shot fault consumed by the NEXT swap (fault plugin):
+        ``swap_corrupt`` mangles the restored tree so the canary rejects it;
+        ``swap_crash`` raises mid-roll after the first unit flipped so the
+        partial-flip rollback path runs."""
+        if kind not in SWAP_FAULT_KINDS:
+            raise ConfigError(
+                f"unknown swap fault kind {kind!r} ({'/'.join(SWAP_FAULT_KINDS)})")
+        self._chaos.append(kind)
+
+    def _consume_chaos(self, kind: str) -> bool:
+        if self._chaos and self._chaos[0] == kind:
+            self._chaos.popleft()
+            return True
+        return False
+
+    def add_commit_hook(self, hook: Callable[[], None]) -> None:
+        """Run whenever the WEIGHTS SERVING TRAFFIC may have changed: after
+        every committed swap, and after a rollback in which any unit had
+        already flipped (a flipped member may have answered live requests
+        with the candidate weights — those responses must not survive in
+        any cache). Swap-aware caches flush here."""
+        self._commit_hooks.append(hook)
+
+    def _run_flush_hooks(self) -> None:
+        for hook in self._commit_hooks:
+            try:
+                hook()
+            except Exception:  # a cache flush must not undo/compound a swap
+                logger.exception("[%s] swap flush hook failed", self.name)
+
+    # -- introspection ------------------------------------------------------
+
+    def report(self) -> dict:
+        """JSON-able snapshot for the engine's ``/health``."""
+        rep = {
+            "version": self.version,
+            "checkpoint": self.checkpoint,
+            "state": self._state,
+            "units": len(self.units),
+            "started": self.n_started,
+            "completed": self.n_completed,
+            "rolled_back": self.n_rolled_back,
+        }
+        if self._last_error:
+            rep["last_error"] = self._last_error
+        return rep
+
+    # -- the swap -----------------------------------------------------------
+
+    @staticmethod
+    def _mangle(host_params):
+        """swap_corrupt: the restored-garbage a truncated/mangled checkpoint
+        would produce — every float leaf perturbed hard enough that no
+        argmax survives, deterministically."""
+        import jax
+
+        def garble(leaf):
+            if hasattr(leaf, "dtype") and np.issubdtype(
+                    np.asarray(leaf).dtype, np.floating):
+                return np.asarray(leaf) * -1000.0 + 3.7
+            return leaf
+
+        return jax.tree_util.tree_map(garble, host_params)
+
+    def _prepare_checked(self, checkpoint: str):
+        host = self._prepare(checkpoint)
+        if self._consume_chaos("swap_corrupt"):
+            logger.warning("[%s] chaos: mangling restored checkpoint tree",
+                           self.name)
+            host = self._mangle(host)
+        return host
+
+    def _fail(self, stage: str, err: Exception) -> SwapError:
+        self.m_rolled_back.inc()
+        self.n_rolled_back += 1
+        msg = f"swap rolled back at {stage}: {err}"
+        self._last_error = msg
+        logger.warning("[%s] %s (version %d still serving)",
+                       self.name, msg, self.version)
+        return SwapError(f"[{self.name}] {msg}; version {self.version} "
+                         "still serving")
+
+    async def swap(self, checkpoint: str) -> dict:
+        """Run one rolling hot-swap to ``checkpoint``. Returns the committed
+        report; raises ``SwapError`` on rejection/rollback (the prior params
+        served continuously either way)."""
+        if self._lock.locked():
+            raise SwapError(f"[{self.name}] a swap is already in progress")
+        async with self._lock:
+            loop = asyncio.get_running_loop()
+            self.m_started.inc()
+            self.n_started += 1
+            self._state = "restoring"
+            try:
+                # 1. restore + convert the candidate OFF the serving path
+                try:
+                    host = await loop.run_in_executor(
+                        None, self._prepare_checked, checkpoint)
+                except Exception as e:
+                    raise self._fail("restore", e) from e
+
+                # 2. canary: the candidate must agree with the live model on
+                # the golden batch before any serving unit flips
+                self._state = "canary"
+                placed0 = None
+                if self.cfg.canary_rows > 0:
+                    try:
+                        placed0 = await loop.run_in_executor(
+                            None, self.units[0].place, host)
+                        live_sig, cand_sig = await loop.run_in_executor(
+                            None, self._canary_pair, placed0)
+                    except Exception as e:
+                        raise self._fail("canary", e) from e
+                    agreement = (float(np.mean(live_sig == cand_sig))
+                                 if live_sig.size else 1.0)
+                    if agreement < self.cfg.min_agreement:
+                        raise self._fail("canary", SwapError(
+                            f"golden-batch agreement {agreement:.3f} < "
+                            f"min_agreement {self.cfg.min_agreement:.3f}"))
+
+                # 3. rolling flip: one unit at a time, probe after each —
+                # the pool keeps serving on the not-yet-flipped members
+                self._state = "rolling"
+                flipped: list[tuple[Any, Any]] = []
+                try:
+                    for i, unit in enumerate(self.units):
+                        placed = (placed0 if i == 0 and placed0 is not None
+                                  else await loop.run_in_executor(
+                                      None, unit.place, host))
+                        old = await unit.adopt(placed)
+                        flipped.append((unit, old))
+                        if self._consume_chaos("swap_crash"):
+                            raise SwapError(
+                                "chaos: injected crash mid-swap "
+                                f"({len(flipped)}/{len(self.units)} units flipped)")
+                        await unit.probe()
+                except Exception as e:
+                    await self._rollback(flipped)
+                    if flipped:
+                        # live traffic may have been answered by the
+                        # candidate weights while a unit was flipped — those
+                        # responses must not survive the rollback in any
+                        # cache, so the flush hooks run here too
+                        self._run_flush_hooks()
+                    raise self._fail("rolling flip", e) from e
+
+                # 4. commit
+                self.version += 1
+                self.checkpoint = checkpoint
+                self.m_version.set(self.version)
+                self.m_completed.inc()
+                self.n_completed += 1
+                self._last_error = None
+                self._run_flush_hooks()
+                logger.info("[%s] hot-swap committed: version %d <- %s",
+                            self.name, self.version, checkpoint)
+                self._state = "idle"
+                return self.report()
+            finally:
+                self._state = "idle"
+
+    def _canary_pair(self, placed_candidate) -> tuple[np.ndarray, np.ndarray]:
+        """Blocking golden forwards (executor thread): live first, then the
+        candidate, on identical inputs."""
+        live = self._canary(self.units[0].live())
+        cand = self._canary(placed_candidate)
+        return np.asarray(live), np.asarray(cand)
+
+    async def _rollback(self, flipped: list[tuple[Any, Any]]) -> None:
+        """Re-adopt the prior params on every flipped unit, newest first.
+        The old trees are the exact device/sharded arrays that were serving
+        before, so re-adoption can't fail on placement; a unit whose
+        re-adopt still raises is left to the PR-4 probe/backoff schedule
+        (marked unhealthy by its own failing step, re-admitted by probes)."""
+        for unit, old in reversed(flipped):
+            try:
+                await unit.adopt(old)
+            except Exception:
+                logger.exception(
+                    "[%s] rollback re-adopt failed on %s; unit left to its "
+                    "probe/backoff schedule", self.name,
+                    getattr(unit, "label", "unit"))
+
+
+# -- builders ---------------------------------------------------------------
+
+
+def build_batch_swapper(runner, *, model: str, serving_dtype: Optional[str],
+                        seed: int, swap_cfg: Optional[SwapConfig],
+                        checkpoint: Optional[str] = None) -> ModelSwapManager:
+    """Swapper over a ``ModelRunner`` or ``ModelRunnerPool`` (one unit per
+    pool member — the rolling flip IS the N-1 availability story)."""
+    from arkflow_tpu.tpu.runner import convert_for_serving, init_host_params
+
+    family, cfg = runner.family, runner.cfg
+    units = [BatchRunnerUnit(member, label)
+             for label, member in runner.swap_units()]
+    swap_cfg = swap_cfg or SwapConfig()
+
+    def prepare(path: str):
+        # one restore + ONE dtype convert for the whole pool (the full-tree
+        # walk is the expensive part), exactly like pool construction
+        return convert_for_serving(
+            init_host_params(family, cfg, seed, checkpoint=path),
+            serving_dtype, family.name)
+
+    def canary(params) -> np.ndarray:
+        golden = golden_inputs(
+            family.input_spec(cfg), cfg, swap_cfg.canary_rows,
+            seed=swap_cfg.canary_seed)
+        return argmax_signature(family.apply(params, cfg, **golden))
+
+    return ModelSwapManager(name=model, config=swap_cfg, prepare=prepare,
+                            canary=canary, units=units, checkpoint=checkpoint)
+
+
+def build_generate_swapper(proc, *, model: str, seed: int,
+                           swap_cfg: Optional[SwapConfig],
+                           checkpoint: Optional[str] = None) -> ModelSwapManager:
+    """Swapper over a ``TpuGenerateProcessor`` (batch mode flips the
+    processor's own params; continuous mode drains and flips the server)."""
+    from arkflow_tpu.tpu.runner import init_host_params
+
+    family, cfg = proc.family, proc.cfg
+    swap_cfg = swap_cfg or SwapConfig()
+    if proc._server is not None:
+        units: list[Any] = [GenerationServerUnit(
+            proc._server, proc._place_params, swap_cfg.drain_timeout_s,
+            owner=proc)]
+    else:
+        units = [BatchGenerateUnit(proc)]
+
+    def prepare(path: str):
+        return init_host_params(family, cfg, seed, checkpoint=path)
+
+    def canary(params) -> np.ndarray:
+        golden = golden_inputs(
+            family.input_spec(cfg), cfg, swap_cfg.canary_rows,
+            seed=swap_cfg.canary_seed)
+        return argmax_signature(family.apply(params, cfg, **golden))
+
+    return ModelSwapManager(name=model, config=swap_cfg, prepare=prepare,
+                            canary=canary, units=units, checkpoint=checkpoint)

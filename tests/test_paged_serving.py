@@ -127,13 +127,16 @@ def test_generation_server_matches_reference_and_reuses_pages():
     async def go():
         server = GenerationServer(params, cfg, slots=2, page_size=4, max_seq=32)
         free0 = len(server._free_pages)
+        # the arkflow_gen_* series are registry-global and unlabeled: other
+        # tests' servers share them, so token accounting asserts the DELTA
+        tok0 = server.m_tokens.value
         # 5 overlapping requests through 2 slots: admission + slot reuse
         outs = await asyncio.gather(*[
             server.generate(p, max_new_tokens=6) for p in prompts])
         await server.close()
         assert outs == refs
         assert len(server._free_pages) == free0  # every page returned
-        assert server.m_tokens.value == sum(len(r) for r in refs)
+        assert server.m_tokens.value - tok0 == sum(len(r) for r in refs)
 
     asyncio.run(go())
 

@@ -37,6 +37,22 @@ zero silent loss), and a duplicate-delivery burst against a response-cached
 ``tpu_inference`` stage shows cache hits > 0 with bitwise-identical
 responses and exactly ONE device step for N concurrent duplicates.
 
+``--swap`` soaks the zero-downtime model lifecycle (tpu/swap.py): under
+sustained offered load, a rolling hot-swap runs across a ``device_pool: 2``
+``tpu_inference`` stage AND a continuous ``tpu_generate`` server, with a
+chaos-armed ``swap_corrupt`` checkpoint proving rollback first:
+
+    python tools/chaos_soak.py --swap --fast     # tier-1 smoke
+    python tools/chaos_soak.py --swap --seconds 120 --seed 3
+
+Swap PASS means: the corrupt candidate was rejected/rolled back with the
+old version serving throughout (version gauge unchanged, traffic
+uninterrupted), the good swap then committed (version bumped, response
+cache epoch-flushed), every offered row was delivered exactly where
+expected with ZERO failed or lost requests (offered == delivered + shed,
+and shed == 0 here), and delivered p99 stayed within the deadline SLO
+across both swaps.
+
 Runs on the virtual-CPU JAX platform by default (no TPU needed; ``--burst``
 never imports jax at all); set ARKFLOW_SOAK_KEEP_ENV=1 to target whatever
 backend the environment provides.
@@ -457,7 +473,12 @@ def run_noisy_tenant_soak(seconds: float = 60.0, seed: int = 7,
 
     class _TenantSource(Input):
         """Seeded interleave of per-tenant single-row batches, tenant
-        stamped input-side (static per-stream config analog)."""
+        stamped input-side (static per-stream config analog). Reads are
+        PACED: a 10x-over-quota offer is a sustained RATE, and on a warm
+        host an unpaced deque would dump the whole schedule into admission
+        in one burst — every noisy row sheds as fair-share ``queue`` before
+        the rows/s TokenBucket can ever trip, and the ``quota`` assertion
+        turns timing-flaky (it only passed on cold/slow runs)."""
 
         def __init__(self, schedule):
             self._items = deque(schedule)
@@ -468,6 +489,7 @@ def run_noisy_tenant_soak(seconds: float = 60.0, seed: int = 7,
         async def read(self) -> tuple[MessageBatch, Ack]:
             if not self._items:
                 raise EndOfInput()
+            await asyncio.sleep(0.001)
             tenant, payload = self._items.popleft()
             batch = MessageBatch.new_binary([payload]).with_source(
                 "tenant-soak").with_tenant(tenant)
@@ -484,6 +506,10 @@ def run_noisy_tenant_soak(seconds: float = 60.0, seed: int = 7,
         _noisy_config(seed, deadline_ms, step_ms, quota, name))
     stream = build_stream(cfg)
     stream.input = _TenantSource(schedule)
+    # metric series are registry-global (keyed on name+labels): a second
+    # in-process run would otherwise read the first run's counts as its own
+    offered0 = int(stream.m_batches_in.value)
+    shed0 = {r: int(c.value) for r, c in stream.overload.m_shed.items()}
 
     delivered: list[tuple[str, bytes]] = []
     shed: list[tuple[str, bytes]] = []
@@ -518,8 +544,9 @@ def run_noisy_tenant_soak(seconds: float = 60.0, seed: int = 7,
     elapsed = time.monotonic() - t0
 
     ctrl = stream.overload
-    offered = int(stream.m_batches_in.value)
-    shed_by_reason = {r: int(c.value) for r, c in ctrl.m_shed.items()}
+    offered = int(stream.m_batches_in.value) - offered0
+    shed_by_reason = {r: int(c.value) - shed0.get(r, 0)
+                      for r, c in ctrl.m_shed.items()}
     expected = {p for _, p in schedule}
     seen = {p for _, p in delivered} | {p for _, p in shed}
     lost = sorted(expected - seen)
@@ -629,6 +656,328 @@ async def _duplicate_burst_cache_phase(fast: bool) -> dict:
     return out
 
 
+def _swap_pool_config(seed: int, messages: int) -> dict:
+    """Swap-soak pipeline A: sustained paced load through a fault-wrapped
+    redelivering broker into a ``device_pool: 2`` inference stage with a
+    response cache. The processor fault schedule arms ``swap_corrupt`` on
+    the SECOND processor call, so the first swap the driver triggers
+    consumes a mangled candidate and must roll back under live traffic."""
+    payloads = [f"swap row {i:04d}" for i in range(messages)]
+    tiny_model = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+                  "ffn": 64, "max_positions": 64, "num_labels": 2}
+    return {
+        "name": "swap-soak-pool",
+        "input": {
+            "type": "fault",
+            "seed": seed,
+            "redeliver_unacked": True,
+            "inner": {"type": "memory", "messages": payloads},
+            "faults": [
+                # pace reads so offered load SUSTAINS across both swaps
+                {"kind": "latency", "every": 1, "times": 0, "duration": "4ms"},
+            ],
+        },
+        "buffer": {
+            "type": "memory",
+            "capacity": 64,
+            "timeout": "20ms",
+            "coalesce": {"batch_buckets": [2, 4], "deadline": "10ms"},
+        },
+        "pipeline": {
+            "thread_num": 2,
+            "max_delivery_attempts": 4,
+            "processors": [{
+                "type": "fault",
+                "seed": seed,
+                "faults": [
+                    {"kind": "swap_corrupt", "at": 2},
+                ],
+                "inner": {
+                    "type": "tpu_inference",
+                    "model": "bert_classifier",
+                    "model_config": tiny_model,
+                    "max_seq": 16,
+                    "batch_buckets": [2, 4],
+                    "seq_buckets": [16],
+                    "device_pool": 2,
+                    "warmup": True,
+                    "step_deadline": "5s",
+                    "step_deadline_first": "120s",
+                    "response_cache": {"capacity": 64, "ttl": "60s"},
+                    "swap": {"canary": {"rows": 4, "min_agreement": 1.0}},
+                },
+            }],
+        },
+        "output": {"type": "drop"},
+        "error_output": {"type": "drop"},
+    }
+
+
+def _swap_generate_config(seed: int, messages: int) -> dict:
+    """Swap-soak pipeline B: continuous ``tpu_generate`` serving — the swap
+    must wait for the slot grid to drain, flip, rebuild the jits, and reset
+    the page pools + prefix cache, with every queued request completing."""
+    payloads = [f"gen prompt {i:04d} lorem ipsum" for i in range(messages)]
+    tiny_model = {"vocab_size": 128, "dim": 16, "layers": 1, "heads": 2,
+                  "kv_heads": 2, "ffn": 32, "max_seq": 64}
+    return {
+        "name": "swap-soak-generate",
+        "input": {
+            "type": "fault",
+            "seed": seed,
+            "redeliver_unacked": True,
+            "inner": {"type": "memory", "messages": payloads},
+            "faults": [
+                {"kind": "latency", "every": 1, "times": 0, "duration": "4ms"},
+            ],
+        },
+        "pipeline": {
+            "thread_num": 2,
+            "max_delivery_attempts": 4,
+            "processors": [{
+                "type": "tpu_generate",
+                "model": "decoder_lm",
+                "model_config": tiny_model,
+                "max_input": 16,
+                "max_new_tokens": 4,
+                "batch_buckets": [2],
+                "seq_buckets": [16],
+                "serving": "continuous",
+                "slots": 2,
+                "page_size": 4,
+                "prefix_cache_pages": 8,
+                "swap": {"canary": {"rows": 4}, "drain_timeout": "30s"},
+            }],
+        },
+        "output": {"type": "drop"},
+        "error_output": {"type": "drop"},
+    }
+
+
+def run_swap_soak(seconds: float = 120.0, seed: int = 7, messages: int = 64,
+                  fast: bool = False) -> dict:
+    """Run the model-lifecycle soak and return the verdict dict: a corrupt
+    candidate rolled back + a good rolling swap committed across a device
+    pool (phase A) and a continuous generation server (phase B), both under
+    sustained offered load with zero failed/lost requests and bounded
+    delivered p99. The caller owns jax platform env setup (see main)."""
+    import asyncio
+    import tempfile
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.errors import SwapError
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import build_stream
+    from arkflow_tpu.tpu import checkpoint
+
+    ensure_plugins_loaded()
+    if fast:
+        messages = min(messages, 24)
+    # generous on a 2-core CPU host: pool steps are ~ms but the soak shares
+    # the host with coalescing/redelivery bookkeeping and the swap itself
+    pool_slo_ms = 2000.0
+    gen_slo_ms = 20000.0  # the drain+rebuild window queues requests briefly
+    ckpt_dir = tempfile.mkdtemp(prefix="arkflow-swap-soak-")
+
+    class _Collect(DropOutput):
+        def __init__(self, sink: list):
+            self._sink = sink
+
+        async def write(self, batch: MessageBatch) -> None:
+            self._sink.extend(batch.to_binary())
+
+    def phase_pool() -> dict:
+        cfg = StreamConfig.from_mapping(_swap_pool_config(seed, messages))
+        stream = build_stream(cfg)
+        delivered: list = []
+        failed: list = []
+        stream.output = _Collect(delivered)
+        stream.error_output = _Collect(failed)
+        proc = stream.pipeline.processors[0]  # the fault wrapper
+        inner = getattr(proc, "_inner", proc)  # the tpu_inference stage
+        swapper = proc.swapper
+        pool = proc.runner
+        import os
+
+        ck = os.path.join(ckpt_dir, "pool")
+        checkpoint.save(ck, pool.members[0].params)
+
+        events: dict = {"corrupt_rolled_back": False, "good_committed": False}
+
+        async def driver() -> None:
+            # wait for live traffic AND the chaos schedule to arm the
+            # corrupt fault (it fires on the second processor call)
+            deadline = time.monotonic() + seconds
+            while (len(delivered) < 4 or not swapper._chaos) \
+                    and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            try:
+                await swapper.swap(ck)
+            except SwapError:
+                events["corrupt_rolled_back"] = True
+            events["version_after_corrupt"] = swapper.version
+            try:
+                await swapper.swap(ck)
+                events["good_committed"] = True
+            except SwapError as e:
+                events["good_error"] = str(e)
+
+        async def bounded() -> bool:
+            cancel = asyncio.Event()
+            task = asyncio.create_task(stream.run(cancel))
+            drv = asyncio.create_task(driver())
+            done, _ = await asyncio.wait({task}, timeout=seconds)
+            wedged = not done
+            if done:
+                task.result()
+            else:
+                cancel.set()
+                try:
+                    await asyncio.wait_for(task, timeout=15.0)
+                except (asyncio.TimeoutError, Exception):
+                    task.cancel()
+            try:
+                await asyncio.wait_for(drv, timeout=10.0)
+            except (asyncio.TimeoutError, Exception):
+                drv.cancel()
+            return wedged
+
+        t0 = time.monotonic()
+        wedged = asyncio.run(bounded())
+        elapsed = time.monotonic() - t0
+        expected = {f"swap row {i:04d}".encode() for i in range(messages)}
+        lost = sorted(expected - set(delivered))
+        p99_ms = stream.m_e2e_latency.quantile(0.99) * 1000.0
+        rep = swapper.report()
+        cache = inner.cache
+        out = {
+            "wedged": wedged,
+            "elapsed_s": round(elapsed, 3),
+            "offered_rows": messages,
+            "delivered_rows": len(delivered),
+            "failed_rows": len(failed),
+            "lost_rows": len(lost),
+            "e2e_p99_ms": round(p99_ms, 3),
+            "slo_ms": pool_slo_ms,
+            "corrupt_rolled_back": events["corrupt_rolled_back"],
+            "version_after_corrupt": events.get("version_after_corrupt"),
+            "good_committed": events["good_committed"],
+            "swap": rep,
+            "cache_epoch": cache.epoch if cache is not None else None,
+            "runner_states": [m.health.state for m in pool.members],
+        }
+        if events.get("good_error"):
+            out["good_error"] = events["good_error"]
+        if lost:
+            out["lost_sample"] = [x.decode() for x in lost[:5]]
+        out["pass"] = bool(
+            not wedged
+            and out["corrupt_rolled_back"]
+            and out["version_after_corrupt"] == 0
+            and out["good_committed"]
+            and rep["version"] == 1 and rep["rolled_back"] == 1
+            and out["cache_epoch"] == 1  # flushed on commit, NOT on rollback
+            and out["lost_rows"] == 0 and out["failed_rows"] == 0
+            and p99_ms <= pool_slo_ms)
+        return out
+
+    def phase_generate() -> dict:
+        cfg = StreamConfig.from_mapping(_swap_generate_config(seed, messages))
+        stream = build_stream(cfg)
+        delivered: list = []
+        failed: list = []
+        stream.output = _Collect(delivered)
+        stream.error_output = _Collect(failed)
+        proc = stream.pipeline.processors[0]
+        swapper = proc.swapper
+        import os
+
+        ck = os.path.join(ckpt_dir, "generate")
+        checkpoint.save(ck, proc.params)
+
+        events: dict = {"good_committed": False}
+
+        async def driver() -> None:
+            deadline = time.monotonic() + seconds
+            while len(delivered) < 4 and time.monotonic() < deadline:
+                await asyncio.sleep(0.02)
+            try:
+                await swapper.swap(ck)
+                events["good_committed"] = True
+            except SwapError as e:
+                events["good_error"] = str(e)
+
+        async def bounded() -> bool:
+            cancel = asyncio.Event()
+            task = asyncio.create_task(stream.run(cancel))
+            drv = asyncio.create_task(driver())
+            done, _ = await asyncio.wait({task}, timeout=seconds)
+            wedged = not done
+            if done:
+                task.result()
+            else:
+                cancel.set()
+                try:
+                    await asyncio.wait_for(task, timeout=15.0)
+                except (asyncio.TimeoutError, Exception):
+                    task.cancel()
+            try:
+                await asyncio.wait_for(drv, timeout=10.0)
+            except (asyncio.TimeoutError, Exception):
+                drv.cancel()
+            return wedged
+
+        t0 = time.monotonic()
+        wedged = asyncio.run(bounded())
+        elapsed = time.monotonic() - t0
+        # delivered batches carry the original payload column; row count is
+        # the loss check (the generated column rides along as extra data)
+        expected = {f"gen prompt {i:04d} lorem ipsum".encode()
+                    for i in range(messages)}
+        lost = sorted(expected - set(delivered))
+        p99_ms = stream.m_e2e_latency.quantile(0.99) * 1000.0
+        rep = swapper.report()
+        srv = proc._server
+        out = {
+            "wedged": wedged,
+            "elapsed_s": round(elapsed, 3),
+            "offered_rows": messages,
+            "delivered_rows": len(delivered),
+            "failed_rows": len(failed),
+            "lost_rows": len(lost),
+            "e2e_p99_ms": round(p99_ms, 3),
+            "slo_ms": gen_slo_ms,
+            "good_committed": events["good_committed"],
+            "swap": rep,
+            "prefix_cache_entries_after": len(srv._prefix_cache),
+            "server_state": srv.core.health.state,
+        }
+        if events.get("good_error"):
+            out["good_error"] = events["good_error"]
+        if lost:
+            out["lost_sample"] = [x.decode() for x in lost[:5]]
+        out["pass"] = bool(
+            not wedged
+            and out["good_committed"]
+            and rep["version"] == 1
+            and out["lost_rows"] == 0 and out["failed_rows"] == 0
+            and p99_ms <= gen_slo_ms)
+        return out
+
+    pool_phase = phase_pool()
+    gen_phase = phase_generate()
+    return {
+        "mode": "swap",
+        "pass": bool(pool_phase["pass"] and gen_phase["pass"]),
+        "seed": seed,
+        "messages": messages,
+        "pool": pool_phase,
+        "generate": gen_phase,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seconds", type=float, default=60.0,
@@ -645,6 +994,11 @@ def main(argv=None) -> int:
                          "its quota; asserts quiet-tenant p99 within SLO, "
                          "quota sheds fully accounted, and duplicate-burst "
                          "cache hits with no extra device steps")
+    ap.add_argument("--swap", action="store_true",
+                    help="model-lifecycle soak: a corrupt checkpoint rolls "
+                         "back and a good rolling hot-swap commits across a "
+                         "device pool and a continuous generate server under "
+                         "sustained load — zero failed/lost, bounded p99")
     ap.add_argument("--factor", type=int, default=4,
                     help="burst mode: offered-load multiplier (default 4)")
     ap.add_argument("--fast", action="store_true",
@@ -672,6 +1026,16 @@ def main(argv=None) -> int:
             pin_cpu_env(os.environ, n_devices=2)
         verdict = run_noisy_tenant_soak(seconds=args.seconds, seed=args.seed,
                                         fast=args.fast)
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["pass"] else 1
+
+    if args.swap:
+        if os.environ.get("ARKFLOW_SOAK_KEEP_ENV") != "1":
+            from arkflow_tpu.utils.cleanenv import pin_cpu_env
+
+            pin_cpu_env(os.environ, n_devices=2)
+        verdict = run_swap_soak(seconds=args.seconds, seed=args.seed,
+                                messages=args.messages, fast=args.fast)
         print(json.dumps(verdict, indent=2))
         return 0 if verdict["pass"] else 1
 
