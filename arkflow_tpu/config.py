@@ -159,6 +159,7 @@ class StreamConfig:
         _validate_response_cache(pipeline.processors)
         _validate_generate_mesh(pipeline.processors)
         _validate_swap(pipeline.processors)
+        _validate_remote_tpu(pipeline.processors)
         temps = [TemporaryConfig.from_mapping(t) for t in m.get("temporary", [])]
         input_cfg = dict(m["input"])
         reconnect = input_cfg.pop("reconnect", None)
@@ -265,6 +266,22 @@ def _validate_swap(processors: list[dict]) -> None:
         ptype = p.get("type")
         if ptype in ("tpu_inference", "tpu_generate") and p.get("swap") is not None:
             parse_swap_config(p["swap"], who=str(ptype))
+
+
+def _validate_remote_tpu(processors: list[dict]) -> None:
+    """Parse-time validation of the ``remote_tpu`` cluster-dispatch stage
+    (runtime/cluster.py owns the parse rules; it imports no jax), looking
+    through ``fault.inner`` chaos wrappers like the other cross-checks — a
+    bad worker URL or routing knob fails at ``--validate`` instead of at
+    stream connect."""
+    from arkflow_tpu.runtime.cluster import parse_remote_tpu_config
+
+    for p in processors:
+        while (isinstance(p, Mapping) and p.get("type") == "fault"
+               and isinstance(p.get("inner"), Mapping)):
+            p = p["inner"]
+        if isinstance(p, Mapping) and p.get("type") == "remote_tpu":
+            parse_remote_tpu_config(p)
 
 
 #: decoder_lm's DecoderConfig default — mirrored here (not imported) so mesh

@@ -54,6 +54,16 @@ def ipc_to_batches(data: bytes) -> list[pa.RecordBatch]:
         return list(r)
 
 
+#: Default cap on a single wire frame. The u32 length header could name
+#: anything up to 4 GiB and ``readexactly`` would dutifully buffer it all —
+#: one malformed (or malicious) frame must not be able to balloon a worker
+#: or client to gigabytes. The default keeps the historical 1 GiB bound
+#: (large-row-group scans that worked keep working); tighten it per
+#: endpoint via ``max_frame`` on FlightWorker/FlightClient, the remote
+#: inputs' ``max_frame`` config key, or ``--max-frame`` on the CLI.
+DEFAULT_MAX_FRAME = 1 << 30
+
+
 async def _send_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
     writer.write(struct.pack(">I", len(payload)) + payload)
     await writer.drain()
@@ -77,13 +87,21 @@ async def _end_stream(writer: asyncio.StreamWriter) -> None:
 
 
 async def _read_frame(reader: asyncio.StreamReader,
-                      limit: int = 1 << 30) -> Optional[bytes]:
+                      limit: int = DEFAULT_MAX_FRAME) -> Optional[bytes]:
+    """One length-prefixed frame, or None for the zero-length end marker.
+
+    The length header is untrusted input: a frame above ``limit`` raises a
+    loud ``ConnectError`` *before* any payload byte is buffered, on both the
+    client and worker sides (both read through here)."""
     hdr = await reader.readexactly(4)
     (n,) = struct.unpack(">I", hdr)
     if n == 0:
         return None
     if n > limit:
-        raise ReadError(f"flight frame of {n} bytes exceeds limit")
+        raise ConnectError(
+            f"flight frame of {n} bytes exceeds the configured max_frame "
+            f"cap of {limit} bytes (raise max_frame / --max-frame if this "
+            "payload is legitimate)")
     return await reader.readexactly(n)
 
 
@@ -105,11 +123,14 @@ class FlightWorker:
     """The remote executor: scans files / runs SQL next to the data."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 50051,
-                 allow_paths: Optional[list[str]] = None):
+                 allow_paths: Optional[list[str]] = None,
+                 max_frame: int = DEFAULT_MAX_FRAME):
         self.host = host
         self.port = port
         #: optional allowlist of path prefixes workers may scan
         self.allow_paths = allow_paths
+        #: cap on a single inbound frame (the u32 header is untrusted)
+        self.max_frame = int(max_frame)
         self._server: Optional[asyncio.base_events.Server] = None
 
     async def start(self) -> None:
@@ -144,7 +165,7 @@ class FlightWorker:
 
     async def _serve(self, reader, writer) -> None:
         try:
-            raw = await _read_frame(reader)
+            raw = await _read_frame(reader, self.max_frame)
             req = json.loads(raw.decode())
             action = req.get("action")
             if action == "scan":
@@ -301,9 +322,13 @@ def _merge_null_types(batches: list[pa.RecordBatch],
 class FlightClient:
     """Client for a FlightWorker: remote scans stream back as batches."""
 
-    def __init__(self, url: str, timeout: float = 30.0):
+    def __init__(self, url: str, timeout: float = 30.0,
+                 max_frame: int = DEFAULT_MAX_FRAME):
         self.host, self.port = parse_remote_url(url)
         self.timeout = timeout
+        #: cap on a single inbound frame (a worker gone bad must not make
+        #: the client buffer gigabytes off one length header)
+        self.max_frame = int(max_frame)
 
     async def _open(self, request: dict):
         try:
@@ -314,7 +339,8 @@ class FlightClient:
                 f"flight worker {self.host}:{self.port} unreachable: {e}") from e
         try:
             await _send_frame(writer, json.dumps(request).encode())
-            status_raw = await asyncio.wait_for(_read_frame(reader), self.timeout)
+            status_raw = await asyncio.wait_for(
+                _read_frame(reader, self.max_frame), self.timeout)
             if status_raw is None:
                 raise ReadError("flight worker closed the stream before a status")
             status = json.loads(status_raw.decode())
@@ -328,7 +354,8 @@ class FlightClient:
     async def _stream(self, reader, writer) -> AsyncIterator[pa.RecordBatch]:
         try:
             while True:
-                frame = await asyncio.wait_for(_read_frame(reader), self.timeout)
+                frame = await asyncio.wait_for(
+                    _read_frame(reader, self.max_frame), self.timeout)
                 if frame is None:
                     return
                 tag, payload = frame[:1], frame[1:]

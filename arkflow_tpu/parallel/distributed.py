@@ -26,19 +26,52 @@ def init_distributed(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None) -> bool:
     """Initialize jax.distributed from args or ARKFLOW_* env; returns True if
-    multi-process mode was activated (False = single host, no-op)."""
+    multi-process mode was activated (False = single host, no-op).
+
+    Failures are wrapped in :class:`ConfigError` naming the effective
+    ``ARKFLOW_COORDINATOR`` / ``ARKFLOW_NUM_PROCESSES`` /
+    ``ARKFLOW_PROCESS_ID`` values — a raw RuntimeError out of
+    ``jax.distributed.initialize`` (bad address, duplicate process id, a
+    coordinator that never came up) tells an operator nothing about which
+    knob on which host was wrong."""
+    from arkflow_tpu.errors import ConfigError
+
     coordinator = coordinator or os.environ.get("ARKFLOW_COORDINATOR")
     if not coordinator:
         return False
+    raw_np = (num_processes if num_processes is not None
+              else os.environ.get("ARKFLOW_NUM_PROCESSES", "1"))
+    raw_pid = (process_id if process_id is not None
+               else os.environ.get("ARKFLOW_PROCESS_ID", "0"))
+    where = (f"ARKFLOW_COORDINATOR={coordinator!r} "
+             f"ARKFLOW_NUM_PROCESSES={raw_np!r} ARKFLOW_PROCESS_ID={raw_pid!r}")
+    try:
+        num_processes = int(raw_np)
+        process_id = int(raw_pid)
+    except (TypeError, ValueError) as e:
+        raise ConfigError(
+            f"distributed bootstrap: ARKFLOW_NUM_PROCESSES / "
+            f"ARKFLOW_PROCESS_ID must be integers ({where}): {e}") from e
+    if num_processes < 1:
+        raise ConfigError(
+            f"distributed bootstrap: num_processes must be >= 1 ({where})")
+    if not 0 <= process_id < num_processes:
+        # caught BEFORE jax.distributed.initialize: the coordinator would
+        # otherwise hang waiting for a process id that can never arrive
+        raise ConfigError(
+            f"distributed bootstrap: process_id must be in "
+            f"[0, num_processes) ({where})")
     import jax  # deferred: single-host pipelines shouldn't touch jax here
-    num_processes = int(num_processes or os.environ.get("ARKFLOW_NUM_PROCESSES", "1"))
-    process_id = int(process_id if process_id is not None
-                     else os.environ.get("ARKFLOW_PROCESS_ID", "0"))
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception as e:
+        raise ConfigError(
+            f"distributed bootstrap failed ({where}): {e}") from e
     logger.info(
         "distributed runtime up: process %d/%d, %d global / %d local devices",
         process_id, num_processes, jax.device_count(), jax.local_device_count(),

@@ -191,7 +191,8 @@ def _scan(path: Path, fmt: str, batch_rows: int) -> Iterator[pa.RecordBatch]:
 class FileInput(Input):
     def __init__(self, paths: list, fmt: Optional[str], query: Optional[str],
                  batch_rows: int, remote_url: Optional[str] = None,
-                 fs_config: Optional[dict] = None):
+                 fs_config: Optional[dict] = None,
+                 max_frame: Optional[int] = None):
         #: mixed list of local paths and object-store URIs
         self.paths = paths
         self.fmt = fmt
@@ -201,6 +202,9 @@ class FileInput(Input):
         #: arkflow://host:port — scan executes on a remote flight worker
         #: (the reference's Ballista remote-context slot, input/file.rs:396)
         self.remote_url = remote_url
+        #: optional wire-frame cap for the remote scan client (bytes);
+        #: None keeps the flight default
+        self.max_frame = max_frame
         if remote_url is not None:
             from arkflow_tpu.connect.flight import parse_remote_url
 
@@ -210,9 +214,10 @@ class FileInput(Input):
 
     async def connect(self) -> None:
         if self.remote_url is not None:
-            from arkflow_tpu.connect.flight import FlightClient
+            from arkflow_tpu.connect.flight import DEFAULT_MAX_FRAME, FlightClient
 
-            client = FlightClient(self.remote_url)
+            client = FlightClient(self.remote_url,
+                                  max_frame=self.max_frame or DEFAULT_MAX_FRAME)
             self._remote_gen = self._remote_scan_all(client)
             return
         for p in self.paths:
@@ -282,4 +287,6 @@ def _build(config: dict, resource: Resource) -> FileInput:
         batch_rows=int(config.get("batch_rows", DEFAULT_RECORD_BATCH_ROWS)),
         remote_url=config.get("remote_url"),
         fs_config=config.get("fs"),
+        max_frame=(int(config["max_frame"])
+                   if config.get("max_frame") is not None else None),
     )

@@ -154,7 +154,8 @@ class RemoteSqliteInput(Input):
     """sqlite query executed on a remote flight worker (the reference's
     Ballista remote-context slot for DB scans, ref input/sql.rs:313-315)."""
 
-    def __init__(self, remote_url: str, path: str, query: str, batch_rows: int):
+    def __init__(self, remote_url: str, path: str, query: str, batch_rows: int,
+                 max_frame: Optional[int] = None):
         from arkflow_tpu.connect.flight import parse_remote_url
 
         parse_remote_url(remote_url)  # fail fast at build
@@ -162,13 +163,17 @@ class RemoteSqliteInput(Input):
         self.path = path
         self.query = query
         self.batch_rows = batch_rows
+        #: optional wire-frame cap (bytes); None keeps the flight default
+        self.max_frame = max_frame
         self._gen = None
 
     async def connect(self) -> None:
-        from arkflow_tpu.connect.flight import FlightClient
+        from arkflow_tpu.connect.flight import DEFAULT_MAX_FRAME, FlightClient
 
-        self._gen = FlightClient(self.remote_url).sqlite(
-            self.path, self.query, batch_rows=self.batch_rows)
+        self._gen = FlightClient(
+            self.remote_url,
+            max_frame=self.max_frame or DEFAULT_MAX_FRAME,
+        ).sqlite(self.path, self.query, batch_rows=self.batch_rows)
 
     async def read(self) -> tuple[MessageBatch, Ack]:
         if self._gen is None:
@@ -197,7 +202,9 @@ def _build(config: dict, resource: Resource) -> Input:
             raise ConfigError("remote sql input requires 'path' and 'query'")
         return RemoteSqliteInput(
             str(config["remote_url"]), str(config["path"]), str(config["query"]),
-            int(config.get("batch_rows", DEFAULT_RECORD_BATCH_ROWS)))
+            int(config.get("batch_rows", DEFAULT_RECORD_BATCH_ROWS)),
+            max_frame=(int(config["max_frame"])
+                       if config.get("max_frame") is not None else None))
     if driver in _GATED_DRIVERS:
         raise ConfigError(
             f"sql input driver {driver!r} requires a client library not present in "

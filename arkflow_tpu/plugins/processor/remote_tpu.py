@@ -1,0 +1,29 @@
+"""``remote_tpu`` processor: dispatch emissions to a device-tier worker
+fleet over the flight plane (the disaggregated-serving ingest stage).
+
+The implementation lives in ``runtime/cluster.py`` next to the worker
+server and hash ring it pairs with; this module only registers the builder
+so ``ensure_plugins_loaded`` sees it.
+
+Config:
+
+    type: remote_tpu
+    workers: ["arkflow://host-a:50052", "arkflow://host-b:50052"]
+    route_key: fingerprint      # fingerprint | prefix (prompt-prefix affinity)
+    prefix_bytes: 64            # prefix mode: bytes of payload hashed
+    text_field: __value__       # prefix mode: payload column
+    virtual_nodes: 64           # hash-ring vnodes per worker
+    heartbeat: 2s               # register/heartbeat probe interval
+    request_timeout: 60s        # per-dispatch wire timeout
+    connect_timeout: 5s
+    drain_timeout: 30s          # per-worker drain budget in rolling swaps
+    max_frame: 1073741824       # wire frame cap in bytes (default 1 GiB)
+    response_cache: {capacity: 1024, ttl: 30s}   # optional ingest-side dedup
+"""
+
+from __future__ import annotations
+
+from arkflow_tpu.components import register_processor
+from arkflow_tpu.runtime.cluster import build_remote_tpu
+
+register_processor("remote_tpu")(build_remote_tpu)

@@ -71,6 +71,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="run a remote-execution flight worker instead of an engine "
              "(the distributed scan/SQL tier; see connect/flight.py)")
     parser.add_argument(
+        "--cluster-worker", action="store_true",
+        help="run a device-tier serving worker instead of an engine: hosts "
+             "the processor chain of --config behind the cluster 'infer' "
+             "action (the disaggregated serving tier; see runtime/cluster.py)")
+    parser.add_argument(
         "--host", default="127.0.0.1",
         help="worker bind host (default loopback; binding wider exposes "
              "file reads — pair with --allow-path)")
@@ -78,10 +83,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument(
         "--allow-path", action="append", default=None,
         help="restrict worker scans to these path prefixes (repeatable)")
+    parser.add_argument(
+        "--worker-id", default=None,
+        help="cluster worker: stable identity reported to the ingest tier "
+             "(default hostname-pid)")
+    parser.add_argument(
+        "--max-frame", type=int, default=None,
+        help="cap in bytes on a single wire frame (both worker kinds; "
+             "default 1 GiB — an oversized length header fails loudly "
+             "instead of buffering gigabytes)")
     args = parser.parse_args(argv)
 
+    if args.worker and args.cluster_worker:
+        parser.error("--worker and --cluster-worker are mutually exclusive")
+    if args.max_frame is not None and args.max_frame < 1024:
+        # same floor the yaml `worker.max_frame` key enforces — a cap below
+        # the smallest request frame would refuse every call
+        parser.error("--max-frame must be >= 1024 bytes")
     if args.worker:
-        from arkflow_tpu.connect.flight import FlightWorker
+        from arkflow_tpu.connect.flight import DEFAULT_MAX_FRAME, FlightWorker
 
         init_logging(LoggingConfig())
         if args.host not in ("127.0.0.1", "localhost") and not args.allow_path:
@@ -89,14 +109,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   "--allow-path (it would serve arbitrary readable files)",
                   file=sys.stderr)
             return 2
-        worker = FlightWorker(args.host, args.port, allow_paths=args.allow_path)
+        worker = FlightWorker(args.host, args.port, allow_paths=args.allow_path,
+                              max_frame=args.max_frame or DEFAULT_MAX_FRAME)
         try:
             asyncio.run(worker.serve_forever())
         except KeyboardInterrupt:
             pass
         return 0
+    if args.cluster_worker:
+        import yaml
+
+        from arkflow_tpu.runtime.cluster import run_worker
+
+        if not args.config:
+            parser.error("--cluster-worker requires --config (the worker's "
+                         "processor chain)")
+        try:
+            from pathlib import Path
+
+            raw = yaml.safe_load(Path(args.config).read_text()) or {}
+            logging_cfg = LoggingConfig.from_mapping(raw.get("logging", {}) or {}) \
+                if isinstance(raw, dict) else LoggingConfig()
+            init_logging(logging_cfg)
+            asyncio.run(run_worker(raw, host=args.host, port=args.port,
+                                   worker_id=args.worker_id,
+                                   max_frame=args.max_frame))
+        except KeyboardInterrupt:
+            pass
+        except (OSError, yaml.YAMLError, ConfigError) as e:
+            # missing/unreadable/malformed config gets the same clean exit-2
+            # path the engine mode provides, not a raw traceback
+            print(f"config error: {e}", file=sys.stderr)
+            return 2
+        return 0
     if not args.config:
-        parser.error("--config is required (or use --worker)")
+        parser.error("--config is required (or use --worker / --cluster-worker)")
 
     try:
         cfg = EngineConfig.from_file(args.config)

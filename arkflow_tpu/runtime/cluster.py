@@ -1,0 +1,1155 @@
+"""Disaggregated serving cluster: ingest tier ⇄ device tier over the flight plane.
+
+Everything the engine scaled so far (packed bf16, fair queues, tp continuous
+batching, hot-swap) lives inside one process. This module is the
+millions-of-users step: it splits serving into an **ingest tier** — the
+ordinary stream runtime doing parse/SQL/coalesce/admission/response-cache —
+and a **device tier** of worker processes each hosting a
+``ServingRunnerCore``-backed processor chain (``tpu_inference`` runner pools
+or ``tpu_generate`` generation servers). Batches travel between the tiers as
+Arrow IPC over the framed wire protocol ``connect/flight.py`` already speaks
+(the reference's Ballista analog), so prefill→decode page streaming later is
+an extension of this plane, not a rewrite.
+
+Wire protocol (extends the flight framing; ``arkflow://host:port``):
+
+- ``register``  — handshake: the ingest side learns ``worker_id``, protocol
+  version and the hosted processor types.
+- ``heartbeat`` — liveness + load report: the worker's advertised AIMD
+  admission window and drain estimate (the PR-5 overload signals, computed
+  by a per-worker ``OverloadController``), in-flight depth, device health
+  reports and response-cache stats. The ingest side re-exports them as
+  per-worker autoscaling gauges.
+- ``drain``     — ``{"drain": true|false}``: a draining worker refuses new
+  ``infer`` requests (they re-route to the hash ring's next worker) while
+  in-flight steps finish — the building block of rolling fleet swaps and
+  graceful scale-in.
+- ``swap``      — ``{"checkpoint": path}``: run the worker's own PR-10
+  ``ModelSwapManager`` (canary + per-unit probe + rollback) on its hosted
+  processors.
+- ``infer``     — the request JSON frame is followed by ONE raw frame of
+  Arrow IPC (the batch, metadata columns included); the worker replies a
+  status frame, then tagged data frames (processed batches), then the
+  zero-length end frame. A processing error after streaming began uses the
+  0x01 error tag, exactly like remote scans.
+
+Routing (``remote_tpu`` dispatch stage): consistent hashing on
+``batch_fingerprint`` (or the prompt prefix) over a virtual-node ring, so a
+redelivered or byte-identical duplicate batch lands on the SAME worker and
+its response/prefix caches keep hitting after scale-out. The hash owner is
+skipped only when it is dead, draining, or has no advertised window headroom
+— then the dispatch spills to the next live worker on the ring (affinity
+trades for throughput only under saturation). A worker death mid-dispatch
+retries on the ring's successors; if every worker fails the error surfaces
+to the stream, whose existing nack path redelivers — at-least-once is
+preserved end to end.
+
+Run a device worker with::
+
+    python -m arkflow_tpu --cluster-worker --config worker.yaml --port 50052
+
+and point an ingest stream's pipeline at the fleet::
+
+    processors:
+      - type: remote_tpu
+        workers: ["arkflow://host-a:50052", "arkflow://host-b:50052"]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import os
+import socket
+from typing import Any, Mapping, Optional, Sequence
+
+from arkflow_tpu.batch import MessageBatch, batch_fingerprint
+from arkflow_tpu.components.base import Resource
+from arkflow_tpu.components.registry import build_component, ensure_plugins_loaded
+from arkflow_tpu.connect.flight import (
+    DEFAULT_MAX_FRAME,
+    ERROR_TAG,
+    _end_stream,
+    _read_frame,
+    _send_data,
+    _send_frame,
+    _send_stream_error,
+    batch_to_ipc,
+    ipc_to_batches,
+    parse_remote_url,
+)
+from arkflow_tpu.errors import (
+    ConfigError,
+    ConnectError,
+    ProcessError,
+    SwapError,
+)
+from arkflow_tpu.obs import global_registry
+
+logger = logging.getLogger("arkflow.cluster")
+
+#: wire-protocol version carried in register responses; the ingest side
+#: refuses a worker speaking a newer major protocol than it understands
+PROTO_VERSION = 1
+
+ROUTE_KEYS = ("fingerprint", "prefix")
+
+
+# ---------------------------------------------------------------------------
+# consistent hashing
+# ---------------------------------------------------------------------------
+
+
+def _ring_hash(data: bytes) -> int:
+    """Stable 64-bit ring position (blake2b — NOT Python's randomized hash;
+    affinity must survive process restarts on both tiers)."""
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+class HashRing:
+    """Consistent hash ring with virtual nodes.
+
+    ``candidates(key)`` returns every distinct node in ring order starting
+    at the key's position — index 0 is the affinity owner, the rest are the
+    failover/spill order. Adding or removing one node only remaps the keys
+    that hashed to it (the property that keeps response/prefix caches warm
+    through scale-out)."""
+
+    def __init__(self, nodes: Sequence[str] = (), virtual_nodes: int = 64):
+        if virtual_nodes < 1:
+            raise ConfigError(
+                f"virtual_nodes must be >= 1, got {virtual_nodes}")
+        self.virtual_nodes = virtual_nodes
+        self._points: list[tuple[int, str]] = []  # sorted (position, node)
+        for n in nodes:
+            self.add(n)
+
+    def __len__(self) -> int:
+        return len({n for _, n in self._points})
+
+    def add(self, node: str) -> None:
+        import bisect
+
+        for i in range(self.virtual_nodes):
+            pt = (_ring_hash(f"{node}#{i}".encode()), node)
+            idx = bisect.bisect_left(self._points, pt)
+            if idx < len(self._points) and self._points[idx] == pt:
+                continue  # idempotent
+            self._points.insert(idx, pt)
+
+    def remove(self, node: str) -> None:
+        self._points = [p for p in self._points if p[1] != node]
+
+    def candidates(self, key: bytes) -> list[str]:
+        """All distinct nodes in ring order from the key's hash point."""
+        if not self._points:
+            return []
+        import bisect
+
+        # U+FFFF sorts after any node name: start strictly past every
+        # point at this exact hash position
+        start = bisect.bisect_right(self._points, (_ring_hash(key), "\uffff"))
+        out: list[str] = []
+        seen: set[str] = set()
+        n = len(self._points)
+        for i in range(n):
+            node = self._points[(start + i) % n][1]
+            if node not in seen:
+                seen.add(node)
+                out.append(node)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# shared introspection helpers (mirror engine.py's _inner-chain walks)
+# ---------------------------------------------------------------------------
+
+
+def _walk_inner(proc: Any, attr: str) -> Optional[Any]:
+    """First ``attr`` found on a processor or its ``_inner`` wrapper chain."""
+    node, seen = proc, set()
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        val = getattr(node, attr, None)
+        if val is not None:
+            return val
+        node = getattr(node, "_inner", None)
+    return None
+
+
+def _runner_reports(processors: Sequence[Any]) -> list[dict]:
+    reports: list[dict] = []
+    for proc in processors:
+        runner = _walk_inner(proc, "runner")
+        report = getattr(runner, "health_report", None)
+        if report is None:
+            continue
+        try:
+            rep = report()
+        except Exception:  # a sick runner must not break heartbeats
+            logger.exception("worker health_report failed")
+            continue
+        reports.extend(rep if isinstance(rep, list) else [rep])
+    return reports
+
+
+def _cache_reports(processors: Sequence[Any]) -> list[dict]:
+    out = []
+    for proc in processors:
+        cache = _walk_inner(proc, "cache")
+        report = getattr(cache, "report", None)
+        if report is not None:
+            try:
+                out.append(report())
+            except Exception:
+                logger.exception("worker cache report failed")
+    return out
+
+
+def _swappers(processors: Sequence[Any]) -> list:
+    out = []
+    for proc in processors:
+        sw = _walk_inner(proc, "swapper")
+        if sw is not None and hasattr(sw, "swap"):
+            out.append(sw)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device tier: the cluster worker server
+# ---------------------------------------------------------------------------
+
+
+class ClusterWorkerServer:
+    """A device-tier worker: hosts a processor chain behind the flight-framed
+    ``infer`` action, with register/heartbeat/drain/swap lifecycle frames.
+
+    Load discipline: ``max_in_flight`` device lanes guarded by a semaphore
+    (device steps must not interleave unboundedly); a per-worker
+    ``OverloadController`` observes the semaphore wait and step latency so
+    the heartbeat can advertise a genuine AIMD window + drain estimate — the
+    ingest tier's routing weights and autoscaling gauges."""
+
+    def __init__(self, processors: Sequence[Any], *, host: str = "127.0.0.1",
+                 port: int = 50052, worker_id: Optional[str] = None,
+                 max_in_flight: int = 1, max_frame: int = DEFAULT_MAX_FRAME):
+        from arkflow_tpu.runtime.overload import OverloadConfig, OverloadController
+        from arkflow_tpu.runtime.pipeline import Pipeline
+
+        if max_in_flight < 1:
+            raise ConfigError(
+                f"worker.max_in_flight must be >= 1, got {max_in_flight}")
+        self.pipeline = Pipeline(list(processors))
+        self.host = host
+        self.port = port
+        self.worker_id = worker_id or f"{socket.gethostname()}-{os.getpid()}"
+        self.max_in_flight = max_in_flight
+        self.max_frame = int(max_frame)
+        self.draining = False
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._sem: Optional[asyncio.Semaphore] = None  # bound at start()
+        self._inflight = 0  # accepted infer requests not yet answered
+        self._served = 0  # completed OK since process start
+        self._errors = 0
+        # the PR-5 admission signals, re-used verbatim: window adapts by
+        # AIMD on the semaphore wait, drain estimate = queued * step EWMA
+        self.ctrl = OverloadController(
+            OverloadConfig.from_config({"enabled": True,
+                                        "max_window": max_in_flight * 4}),
+            name=f"worker-{self.worker_id}", workers=max_in_flight)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def connect(self) -> None:
+        """Pre-flight the hosted chain (model warmup compiles) BEFORE the
+        port opens: a worker that answers ``register`` is ready to serve."""
+        await self.pipeline.connect()
+
+    async def start(self) -> None:
+        self._sem = asyncio.Semaphore(self.max_in_flight)
+        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("cluster worker %s listening on %s:%d",
+                    self.worker_id, self.host, self.port)
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 1.0)
+            except asyncio.TimeoutError:
+                pass
+        await self.pipeline.close()
+
+    # -- introspection -----------------------------------------------------
+
+    def load_report(self) -> dict:
+        """The heartbeat payload: identity + the advertised routing/
+        autoscaling signals + nested device health and cache stats."""
+        return {
+            "worker_id": self.worker_id,
+            "proto": PROTO_VERSION,
+            "draining": self.draining,
+            "inflight": self._inflight,
+            "served": self._served,
+            "errors": self._errors,
+            "window": int(self.ctrl.window),
+            "drain_s": round(self.ctrl.estimated_drain_s(), 3),
+            "step_ewma_ms": round(self.ctrl.step_s() * 1000.0, 3),
+            "health": _runner_reports(self.pipeline.processors),
+            "caches": _cache_reports(self.pipeline.processors),
+        }
+
+    # -- request handling --------------------------------------------------
+
+    async def _serve(self, reader, writer) -> None:
+        try:
+            raw = await _read_frame(reader, self.max_frame)
+            if raw is None:
+                return
+            req = json.loads(raw.decode())
+            action = req.get("action")
+            if action == "register":
+                await _send_frame(writer, json.dumps({
+                    "ok": True,
+                    "processors": [type(p).__name__
+                                   for p in self.pipeline.processors],
+                    **self.load_report(),
+                }).encode())
+            elif action == "heartbeat":
+                await _send_frame(writer, json.dumps(
+                    {"ok": True, **self.load_report()}).encode())
+            elif action == "drain":
+                self.draining = bool(req.get("drain", True))
+                logger.info("cluster worker %s drain=%s (inflight=%d)",
+                            self.worker_id, self.draining, self._inflight)
+                await _send_frame(writer, json.dumps(
+                    {"ok": True, **self.load_report()}).encode())
+            elif action == "swap":
+                await self._do_swap(req, writer)
+            elif action == "infer":
+                await self._do_infer(req, reader, writer)
+            else:
+                await _send_frame(writer, json.dumps(
+                    {"ok": False, "error": f"unknown action {action!r}"}).encode())
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except Exception as e:
+            try:
+                if getattr(writer, "_arkflow_streaming", False):
+                    await _send_stream_error(writer, repr(e)[:500])
+                    await _end_stream(writer)
+                else:
+                    await _send_frame(writer, json.dumps(
+                        {"ok": False, "error": repr(e)[:500]}).encode())
+            except Exception:
+                pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _do_swap(self, req: dict, writer) -> None:
+        """Apply a rolling hot-swap to the hosted processors via their own
+        PR-10 managers (canary + probe + rollback happen worker-side)."""
+        ckpt = req.get("checkpoint")
+        if not ckpt or not isinstance(ckpt, str):
+            await _send_frame(writer, json.dumps(
+                {"ok": False, "error": "swap needs a 'checkpoint' path"}).encode())
+            return
+        swappers = _swappers(self.pipeline.processors)
+        if not swappers:
+            await _send_frame(writer, json.dumps(
+                {"ok": False, "error": "no hot-swappable processors on this "
+                                       "worker"}).encode())
+            return
+        results, ok_all = [], True
+        for sw in swappers:
+            try:
+                results.append({"ok": True, **(await sw.swap(ckpt))})
+            except SwapError as e:
+                ok_all = False
+                results.append({"ok": False, "error": str(e)})
+            except Exception as e:  # an unexpected bug must still answer
+                ok_all = False
+                results.append({"ok": False,
+                                "error": f"{type(e).__name__}: {e}"})
+        await _send_frame(writer, json.dumps(
+            {"ok": ok_all, "worker_id": self.worker_id,
+             "results": results}).encode())
+
+    async def _do_infer(self, req: dict, reader, writer) -> None:
+        ipc = await _read_frame(reader, self.max_frame)
+        if ipc is None:
+            raise ConnectError("infer request carried no batch frame")
+        if self.draining:
+            # retryable: the dispatcher re-routes to the ring's next worker
+            # instead of surfacing a processing error
+            await _send_frame(writer, json.dumps(
+                {"ok": False, "error": "worker is draining",
+                 "retryable": True}).encode())
+            return
+        batches = ipc_to_batches(ipc)
+        if not batches:
+            raise ConnectError("infer batch frame decoded to zero batches")
+        batch = MessageBatch(batches[0])
+        await _send_frame(writer, json.dumps({"ok": True}).encode())
+        writer._arkflow_streaming = True
+        loop = asyncio.get_running_loop()
+        self._inflight += 1
+        self.ctrl.on_enqueue()
+        t_q = loop.time()
+        try:
+            async with self._sem:  # one device, max_in_flight lanes
+                self.ctrl.on_dequeue(loop.time() - t_q, loop.time())
+                t0 = loop.time()
+                results = await self.pipeline.process(batch)
+                self.ctrl.observe_step(loop.time() - t0)
+            for out in results:
+                await _send_data(writer, batch_to_ipc(out.record_batch))
+            await _end_stream(writer)
+            self._served += 1
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            raise
+        except Exception:
+            self._errors += 1
+            raise
+        finally:
+            self._inflight -= 1
+
+
+# -- worker config / entry point -------------------------------------------
+
+
+def parse_worker_config(m: Any) -> tuple[list[dict], dict]:
+    """Worker-mode config -> (processor config list, worker options).
+
+    Accepts the natural shapes: ``{processors: [...]}``, a stream-style
+    ``{pipeline: {processors: [...]}}``, or a full engine config (the FIRST
+    stream's pipeline is hosted) — so a worker can reuse the exact
+    processor block of the single-process config it was split out of.
+    Options ride under ``worker: {id, max_in_flight, max_frame}``."""
+    if not isinstance(m, Mapping):
+        raise ConfigError("cluster worker config must be a mapping")
+    procs: Any = m.get("processors")
+    if procs is None and isinstance(m.get("pipeline"), Mapping):
+        procs = m["pipeline"].get("processors")
+    if procs is None and isinstance(m.get("streams"), list) and m["streams"]:
+        s0 = m["streams"][0]
+        if isinstance(s0, Mapping) and isinstance(s0.get("pipeline"), Mapping):
+            procs = s0["pipeline"].get("processors")
+    if not isinstance(procs, list) or not procs:
+        raise ConfigError(
+            "cluster worker config needs a non-empty processor list "
+            "(top-level 'processors:', 'pipeline.processors:', or the first "
+            "stream of an engine config)")
+    for p in procs:
+        if not isinstance(p, Mapping) or not p.get("type"):
+            raise ConfigError(f"worker processor config must be a mapping "
+                              f"with a 'type' tag, got {p!r}")
+    opts_raw = m.get("worker") or {}
+    if not isinstance(opts_raw, Mapping):
+        raise ConfigError("'worker' options must be a mapping")
+    opts: dict = {}
+    mif = opts_raw.get("max_in_flight", 1)
+    if isinstance(mif, bool) or not isinstance(mif, int) or mif < 1:
+        raise ConfigError(
+            f"worker.max_in_flight must be an int >= 1, got {mif!r}")
+    opts["max_in_flight"] = mif
+    mf = opts_raw.get("max_frame", DEFAULT_MAX_FRAME)
+    if isinstance(mf, bool) or not isinstance(mf, int) or mf < 1024:
+        raise ConfigError(
+            f"worker.max_frame must be an int >= 1024, got {mf!r}")
+    opts["max_frame"] = mf
+    wid = opts_raw.get("id")
+    if wid is not None and not isinstance(wid, str):
+        raise ConfigError(f"worker.id must be a string, got {wid!r}")
+    opts["worker_id"] = wid
+    return [dict(p) for p in procs], opts
+
+
+def build_worker_server(config: Mapping, *, host: str = "127.0.0.1",
+                        port: int = 50052,
+                        worker_id: Optional[str] = None,
+                        max_frame: Optional[int] = None) -> ClusterWorkerServer:
+    """Build (but don't start) a worker server from a parsed config mapping."""
+    procs_cfg, opts = parse_worker_config(config)
+    ensure_plugins_loaded()
+    resource = Resource()
+    processors = [build_component("processor", p, resource) for p in procs_cfg]
+    return ClusterWorkerServer(
+        processors, host=host, port=port,
+        worker_id=worker_id or opts["worker_id"],
+        max_in_flight=opts["max_in_flight"],
+        max_frame=max_frame or opts["max_frame"])
+
+
+async def run_worker(config: Mapping, *, host: str = "127.0.0.1",
+                     port: int = 50052, worker_id: Optional[str] = None,
+                     max_frame: Optional[int] = None) -> None:
+    """CLI entry: build, warm up, then serve until cancelled."""
+    server = build_worker_server(config, host=host, port=port,
+                                 worker_id=worker_id, max_frame=max_frame)
+    await server.connect()  # warmup compiles BEFORE the port opens
+    try:
+        await server.serve_forever()
+    finally:
+        await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# ingest tier: worker handles, dispatcher, fleet swap
+# ---------------------------------------------------------------------------
+
+
+class _RemoteProcessingError(Exception):
+    """The worker ran the batch and FAILED (model error, poison batch).
+
+    Not retried on another worker: a deterministic failure would fail
+    everywhere, and transient device faults heal through the stream's own
+    nack/redelivery — which re-routes by hash to the same (by then probed
+    and healed) worker."""
+
+
+class _WorkerDraining(Exception):
+    """The worker refused the batch because it is draining — routable."""
+
+
+class RemoteWorker:
+    """Ingest-side handle for one device worker: liveness, the advertised
+    load signals, client-side in-flight accounting, and the per-worker
+    autoscaling gauges."""
+
+    def __init__(self, url: str, name: str):
+        self.url = url
+        self.host, self.port = parse_remote_url(url)
+        self.worker_id: Optional[str] = None
+        self.alive = False
+        self.draining = False
+        #: advertised AIMD window (heartbeat); routing headroom bound
+        self.window = 1
+        #: advertised queue-drain estimate (heartbeat)
+        self.drain_s = 0.0
+        #: client-side outstanding requests (fresh, unlike the heartbeat)
+        self.inflight = 0
+        self.dispatched = 0
+        self.last_report: dict = {}
+        self.last_seen = 0.0
+        self.last_error: Optional[str] = None
+        reg = global_registry()
+        labels = {"stream": name, "worker": url}
+        self.m_alive = reg.gauge(
+            "arkflow_cluster_worker_alive",
+            "1 when the device worker answers register/heartbeat", labels)
+        self.m_window = reg.gauge(
+            "arkflow_cluster_worker_window",
+            "worker-advertised AIMD admission window (autoscaling signal)",
+            labels)
+        self.m_drain = reg.gauge(
+            "arkflow_cluster_worker_drain_seconds",
+            "worker-advertised queue drain estimate (autoscaling signal)",
+            labels)
+        self.m_inflight = reg.gauge(
+            "arkflow_cluster_worker_inflight",
+            "ingest-side in-flight dispatches to this worker", labels)
+        self.m_dispatched = reg.counter(
+            "arkflow_cluster_dispatch_total",
+            "batches dispatched to this worker", labels)
+
+    def note_report(self, rep: dict, now: float) -> None:
+        self.worker_id = rep.get("worker_id", self.worker_id)
+        self.alive = True
+        self.draining = bool(rep.get("draining", False))
+        self.window = max(1, int(rep.get("window", 1)))
+        self.drain_s = float(rep.get("drain_s", 0.0))
+        self.last_report = rep
+        self.last_seen = now
+        self.last_error = None
+        self.m_alive.set(1.0)
+        self.m_window.set(self.window)
+        self.m_drain.set(self.drain_s)
+
+    def note_down(self, err: BaseException) -> None:
+        self.alive = False
+        self.last_error = f"{type(err).__name__}: {err}"
+        self.m_alive.set(0.0)
+
+    def has_headroom(self) -> bool:
+        return self.inflight < self.window
+
+    def report(self) -> dict:
+        state = ("dead" if not self.alive
+                 else "draining" if self.draining else "alive")
+        out = {
+            "worker": self.url,
+            "worker_id": self.worker_id,
+            "state": state,
+            "window": self.window,
+            "drain_s": self.drain_s,
+            "inflight": self.inflight,
+            "dispatched": self.dispatched,
+        }
+        if self.last_error:
+            out["last_error"] = self.last_error
+        remote_health = self.last_report.get("health")
+        if remote_health:
+            out["remote_health"] = remote_health
+        remote_caches = self.last_report.get("caches")
+        if remote_caches:
+            out["remote_caches"] = remote_caches
+        return out
+
+
+class ClusterDispatcher:
+    """The ingest tier's ``remote_tpu`` routing core.
+
+    Owns the worker handles, the consistent-hash ring, the heartbeat loop,
+    and the dispatch/retry discipline described in the module docstring."""
+
+    def __init__(self, urls: Sequence[str], *, name: str = "cluster",
+                 route_key: str = "fingerprint", prefix_bytes: int = 64,
+                 text_field: Optional[str] = None, virtual_nodes: int = 64,
+                 heartbeat_s: float = 2.0, request_timeout_s: float = 60.0,
+                 connect_timeout_s: float = 5.0,
+                 max_frame: int = DEFAULT_MAX_FRAME):
+        from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD
+
+        if not urls:
+            raise ConfigError("remote_tpu needs a non-empty 'workers' list")
+        if len(set(urls)) != len(urls):
+            raise ConfigError(f"remote_tpu workers must be distinct, got {urls}")
+        if route_key not in ROUTE_KEYS:
+            raise ConfigError(
+                f"remote_tpu.route_key must be one of {ROUTE_KEYS}, "
+                f"got {route_key!r}")
+        self.name = name
+        self.route_key = route_key
+        self.prefix_bytes = prefix_bytes
+        self.text_field = text_field or DEFAULT_BINARY_VALUE_FIELD
+        self.heartbeat_s = heartbeat_s
+        self.request_timeout_s = request_timeout_s
+        self.connect_timeout_s = connect_timeout_s
+        self.max_frame = int(max_frame)
+        self.workers: dict[str, RemoteWorker] = {
+            url: RemoteWorker(url, name) for url in urls}
+        self.ring = HashRing(list(urls), virtual_nodes)
+        self._hb_task: Optional[asyncio.Task] = None
+        reg = global_registry()
+        labels = {"stream": name}
+        self.m_retries = reg.counter(
+            "arkflow_cluster_retry_total",
+            "dispatches that failed over to another ring worker", labels)
+        self.m_spills = reg.counter(
+            "arkflow_cluster_spill_total",
+            "dispatches routed off the hash owner for load/drain reasons",
+            labels)
+        self.m_deaths = reg.counter(
+            "arkflow_cluster_worker_down_total",
+            "times a worker was marked down after a failed call", labels)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        """Register with the fleet and start the heartbeat loop. At least
+        one worker must answer — a stream with zero reachable workers is a
+        deployment error worth failing loudly at connect; workers that come
+        up later are adopted by the heartbeat."""
+        if self._hb_task is not None:
+            return
+        await asyncio.gather(*(self._probe(w) for w in self.workers.values()),
+                             return_exceptions=True)
+        alive = [w for w in self.workers.values() if w.alive]
+        if not alive:
+            errs = "; ".join(f"{w.url}: {w.last_error}"
+                             for w in self.workers.values())
+            raise ConnectError(
+                f"remote_tpu[{self.name}]: no cluster worker reachable "
+                f"({errs})")
+        logger.info("remote_tpu[%s]: %d/%d workers registered", self.name,
+                    len(alive), len(self.workers))
+        self._hb_task = asyncio.create_task(
+            self._heartbeat_loop(), name=f"{self.name}-cluster-heartbeat")
+
+    async def close(self) -> None:
+        if self._hb_task is not None:
+            self._hb_task.cancel()
+            try:
+                await self._hb_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._hb_task = None
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.heartbeat_s)
+            await asyncio.gather(
+                *(self._probe(w) for w in self.workers.values()),
+                return_exceptions=True)
+
+    async def _probe(self, w: RemoteWorker) -> None:
+        """One register/heartbeat round-trip; flips liveness both ways."""
+        action = "heartbeat" if w.worker_id is not None else "register"
+        try:
+            rep = await self._unary(w, {"action": action})
+        except Exception as e:
+            if w.alive:
+                self.m_deaths.inc()
+                logger.warning("remote_tpu[%s]: worker %s down: %s",
+                               self.name, w.url, e)
+            w.note_down(e)
+            return
+        if not rep.get("ok") or not rep.get("worker_id"):
+            # answers-but-refuses is NOT alive: a scan-tier FlightWorker (or
+            # any wrong endpoint) replies {"ok": false, "error": "unknown
+            # action ..."} — marking it alive would pass the connect gate on
+            # a fleet with zero usable workers
+            w.note_down(ConnectError(
+                f"worker {w.url} rejected {action}: {rep.get('error')!r} "
+                "(is this really a cluster worker?)"))
+            return
+        proto = int(rep.get("proto", 1))
+        if proto > PROTO_VERSION:
+            w.note_down(ConnectError(
+                f"worker speaks protocol {proto}, this engine speaks "
+                f"{PROTO_VERSION}"))
+            return
+        if not w.alive:
+            logger.info("remote_tpu[%s]: worker %s up (id=%s)", self.name,
+                        w.url, rep.get("worker_id"))
+        w.note_report(rep, asyncio.get_running_loop().time())
+
+    # -- wire helpers ------------------------------------------------------
+
+    async def _open(self, w: RemoteWorker):
+        try:
+            return await asyncio.wait_for(
+                asyncio.open_connection(w.host, w.port),
+                self.connect_timeout_s)
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ConnectError(
+                f"cluster worker {w.url} unreachable: {e}") from e
+
+    async def _unary(self, w: RemoteWorker, request: dict,
+                     timeout: Optional[float] = None) -> dict:
+        """One request frame -> one JSON status frame."""
+        reader, writer = await self._open(w)
+        try:
+            await _send_frame(writer, json.dumps(request).encode())
+            raw = await asyncio.wait_for(
+                _read_frame(reader, self.max_frame),
+                timeout or self.request_timeout_s)
+            if raw is None:
+                raise ConnectError(
+                    f"cluster worker {w.url} closed before a status")
+            return json.loads(raw.decode())
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    # -- routing -----------------------------------------------------------
+
+    def routing_key(self, batch: MessageBatch) -> bytes:
+        """``fingerprint`` keys on the batch's stable identity (dedup /
+        response-cache affinity: redeliveries and byte-identical retries
+        hash equal). ``prefix`` keys on the first ``prefix_bytes`` of the
+        first row's payload (prompt-prefix affinity: conversations sharing
+        a system prompt land where their KV prefix is cached)."""
+        if self.route_key == "prefix":
+            try:
+                values, offsets = batch.payload_view(self.text_field)
+                end = min(int(offsets[0]) + self.prefix_bytes, int(offsets[1]))
+                return values[int(offsets[0]):end].tobytes()
+            except Exception:
+                pass  # no payload column: fall through to the fingerprint
+        return batch_fingerprint(batch)
+
+    def plan(self, key: bytes) -> list[RemoteWorker]:
+        """Candidate order for a key: ring order over live, non-draining
+        workers, weighted by each worker's advertised load signals. The hash
+        owner serves unless it has no headroom against its advertised AIMD
+        window — then the dispatch spills to the successor with the least
+        load (fewest outstanding dispatches, then smallest advertised drain
+        estimate). Bounded-load consistent hashing: affinity is traded only
+        under saturation, counted in ``arkflow_cluster_spill_total``."""
+        live = [self.workers[u] for u in self.ring.candidates(key)
+                if self.workers[u].alive and not self.workers[u].draining]
+        if len(live) < 2 or live[0].has_headroom():
+            return live
+        with_room = [w for w in live[1:] if w.has_headroom()]
+        if with_room:
+            best = min(with_room, key=lambda w: (w.inflight, w.drain_s))
+            self.m_spills.inc()
+            return [best] + [w for w in live if w is not best]
+        # the whole fleet is saturated: queue on the owner (keeping
+        # affinity) unless its advertised drain estimate is pathologically
+        # worse than the best alternative's — a wedged-but-alive owner must
+        # not absorb the queue forever
+        floor = min(w.drain_s for w in live)
+        if live[0].drain_s > 2.0 * floor + 1.0:
+            best = min(live, key=lambda w: w.drain_s)
+            self.m_spills.inc()
+            return [best] + [w for w in live if w is not best]
+        return live
+
+    async def dispatch(self, batch: MessageBatch) -> list[MessageBatch]:
+        """Route one emission to the fleet; failover along the ring on
+        transport errors. Raises on remote PROCESSING errors (no sibling
+        retry — see _RemoteProcessingError) and when every worker is down
+        (the stream's nack path then preserves at-least-once)."""
+        candidates = self.plan(self.routing_key(batch))
+        if not candidates:
+            raise ConnectError(
+                f"remote_tpu[{self.name}]: no live cluster worker "
+                f"(fleet: {[w.report()['state'] for w in self.workers.values()]})")
+        last_exc: Optional[BaseException] = None
+        for i, w in enumerate(candidates):
+            if i > 0:
+                self.m_retries.inc()
+            w.inflight += 1
+            w.m_inflight.set(w.inflight)
+            try:
+                out = await self._infer_on(w, batch)
+            except _WorkerDraining:
+                w.draining = True
+                last_exc = ConnectError(f"worker {w.url} draining")
+                continue
+            except _RemoteProcessingError as e:
+                raise ProcessError(
+                    f"cluster worker {w.url} failed the batch: {e}") from e
+            except (ConnectError, ConnectionError, OSError,
+                    asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
+                if w.alive:
+                    self.m_deaths.inc()
+                    logger.warning(
+                        "remote_tpu[%s]: worker %s failed mid-dispatch (%s); "
+                        "retrying on the ring's next worker", self.name,
+                        w.url, e)
+                w.note_down(e)
+                last_exc = e
+                continue
+            else:
+                w.dispatched += 1
+                w.m_dispatched.inc()
+                return out
+            finally:
+                w.inflight -= 1
+                w.m_inflight.set(w.inflight)
+        raise ConnectError(
+            f"remote_tpu[{self.name}]: all {len(candidates)} candidate "
+            f"workers failed for this batch (last: {last_exc}); leaving it "
+            "to the redelivery path")
+
+    async def _infer_on(self, w: RemoteWorker,
+                        batch: MessageBatch) -> list[MessageBatch]:
+        reader, writer = await self._open(w)
+        try:
+            await _send_frame(writer, json.dumps({"action": "infer"}).encode())
+            await _send_frame(writer, batch_to_ipc(batch.record_batch))
+            raw = await asyncio.wait_for(
+                _read_frame(reader, self.max_frame), self.request_timeout_s)
+            if raw is None:
+                raise ConnectError(f"worker {w.url} closed before a status")
+            status = json.loads(raw.decode())
+            if not status.get("ok"):
+                if status.get("retryable"):
+                    raise _WorkerDraining(status.get("error"))
+                raise _RemoteProcessingError(status.get("error"))
+            results: list[MessageBatch] = []
+            while True:
+                frame = await asyncio.wait_for(
+                    _read_frame(reader, self.max_frame),
+                    self.request_timeout_s)
+                if frame is None:
+                    return results
+                tag, payload = frame[:1], frame[1:]
+                if tag == ERROR_TAG:
+                    raise _RemoteProcessingError(
+                        json.loads(payload.decode()).get("error"))
+                for rb in ipc_to_batches(payload):
+                    results.append(MessageBatch(rb))
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    # -- fleet lifecycle (drain / swap legs) -------------------------------
+
+    async def set_drain(self, w: RemoteWorker, drain: bool) -> dict:
+        rep = await self._unary(w, {"action": "drain", "drain": drain})
+        if rep.get("ok"):
+            w.draining = drain
+        return rep
+
+    async def wait_drained(self, w: RemoteWorker, timeout_s: float) -> None:
+        """Poll the worker until its in-flight steps finished."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while True:
+            rep = await self._unary(w, {"action": "heartbeat"})
+            if int(rep.get("inflight", 0)) == 0:
+                return
+            if loop.time() >= deadline:
+                raise SwapError(
+                    f"worker {w.url} still has {rep.get('inflight')} "
+                    f"in-flight steps after {timeout_s:.1f}s drain budget")
+            await asyncio.sleep(min(0.1, timeout_s / 10.0))
+
+    async def swap_on(self, w: RemoteWorker, checkpoint: str) -> dict:
+        # restore+canary+probe can take a while: give it the drain budget
+        # on top of the normal request timeout
+        return await self._unary(w, {"action": "swap", "checkpoint": checkpoint},
+                                 timeout=max(self.request_timeout_s, 300.0))
+
+    # -- introspection -----------------------------------------------------
+
+    def report(self) -> dict:
+        return {
+            "workers": {u: w.report() for u, w in sorted(self.workers.items())},
+            "alive": sum(1 for w in self.workers.values() if w.alive),
+            "route_key": self.route_key,
+            "retries": self.m_retries.value,
+            "spills": self.m_spills.value,
+        }
+
+    def health_reports(self) -> list[dict]:
+        """Engine /health and /readiness aggregation: one report per worker
+        in the shape the engine's runner walk expects (``state`` keys to
+        the readiness check — an all-dead fleet flips the replica 503)."""
+        return [w.report() for w in sorted(self.workers.values(),
+                                           key=lambda w: w.url)]
+
+
+class ClusterSwapper:
+    """Fleet-wide rolling hot-swap: ``POST /admin/swap`` on the ingest
+    engine reaches this via the processor's ``swapper`` attribute and rolls
+    worker-by-worker — drain (the ring serves on N-1), swap via the
+    worker's OWN canary/probe/rollback manager, undrain. A failed worker
+    swap stops the roll: its own manager already rolled that worker back,
+    committed workers keep the new version, and the raised SwapError names
+    both sets so the operator can re-POST either checkpoint."""
+
+    def __init__(self, dispatcher: ClusterDispatcher,
+                 drain_timeout_s: float = 30.0):
+        self.dispatcher = dispatcher
+        self.drain_timeout_s = drain_timeout_s
+        self._commit_hooks: list = []
+        self._swapping = False
+        self._last: dict = {}
+
+    def add_commit_hook(self, hook) -> None:
+        """Runs when any worker flipped (the PR-10 cache discipline: a
+        flipped worker may have answered live traffic with new weights, so
+        the ingest response cache must epoch-flush even on a partial roll)."""
+        self._commit_hooks.append(hook)
+
+    def _run_commit_hooks(self) -> None:
+        for hook in self._commit_hooks:
+            try:
+                hook()
+            except Exception:
+                logger.exception("cluster swap commit hook failed")
+
+    async def swap(self, checkpoint: str) -> dict:
+        if self._swapping:
+            raise SwapError("a cluster swap is already in progress")
+        live = [w for w in self.dispatcher.workers.values() if w.alive]
+        if not live:
+            raise SwapError("no live cluster workers to swap")
+        self._swapping = True
+        committed: list[str] = []
+        try:
+            for w in sorted(live, key=lambda w: w.url):
+                try:
+                    await self.dispatcher.set_drain(w, True)
+                    await self.dispatcher.wait_drained(w, self.drain_timeout_s)
+                    rep = await self.dispatcher.swap_on(w, checkpoint)
+                except SwapError:
+                    raise
+                except Exception as e:
+                    raise SwapError(
+                        f"cluster swap aborted at worker {w.url} "
+                        f"({type(e).__name__}: {e}); committed: "
+                        f"{committed or 'none'}") from e
+                finally:
+                    try:
+                        await self.dispatcher.set_drain(w, False)
+                    except Exception:
+                        logger.exception("undrain of %s failed", w.url)
+                if not rep.get("ok"):
+                    raise SwapError(
+                        f"worker {w.url} rejected the swap: "
+                        f"{rep.get('error') or rep.get('results')}; that "
+                        f"worker rolled itself back; committed workers "
+                        f"({committed or 'none'}) keep the new version — "
+                        "re-POST the previous checkpoint to converge back")
+                committed.append(w.url)
+            self._last = {"checkpoint": checkpoint, "committed": committed}
+            return {"cluster": True, "committed": committed,
+                    "workers": len(committed)}
+        finally:
+            self._swapping = False
+            if committed:
+                # even a partial roll changed what some answers were
+                # computed with — flush the ingest-side response cache
+                self._run_commit_hooks()
+
+    def report(self) -> dict:
+        return {"cluster": True, "swapping": self._swapping,
+                "last": self._last or None}
+
+
+# ---------------------------------------------------------------------------
+# the remote_tpu processor (ingest dispatch stage)
+# ---------------------------------------------------------------------------
+
+
+class _ClusterRunnerView:
+    """Adapter giving the engine's runner-health walk (`proc.runner
+    .health_report()`) the per-worker fleet view."""
+
+    def __init__(self, dispatcher: ClusterDispatcher):
+        self._dispatcher = dispatcher
+
+    def health_report(self) -> list[dict]:
+        return self._dispatcher.health_reports()
+
+
+class RemoteTpuProcessor:
+    """Ingest-tier dispatch stage: ships each emission to the device tier
+    over the flight plane, with hash-affine routing and failover.
+
+    Composes with everything the ingest stream already does — admission /
+    AIMD / fairness run before it, coalescing buffers feed it, and an
+    optional ingest-side response cache short-circuits duplicates before
+    they pay the network + device (config ``response_cache``, same
+    semantics as ``tpu_inference``'s)."""
+
+    def __init__(self, dispatcher: ClusterDispatcher, *, response_cache=None,
+                 drain_timeout_s: float = 30.0):
+        self.dispatcher = dispatcher
+        self.cache = response_cache
+        self.swapper = ClusterSwapper(dispatcher, drain_timeout_s)
+        if self.cache is not None:
+            self.swapper.add_commit_hook(self.cache.bump_epoch)
+        #: engine /health + /readiness integration (runner-shaped view)
+        self.runner = _ClusterRunnerView(dispatcher)
+
+    def attach_overload_controller(self, controller) -> None:
+        """Stream hook: align the cache's tenant-hit label capping with the
+        admission controller (same contract as tpu_inference)."""
+        if self.cache is not None:
+            self.cache.set_tenant_policy(controller.cfg.tenants)
+
+    def cluster_report(self) -> dict:
+        """Fleet snapshot for the engine's /health payload."""
+        return self.dispatcher.report()
+
+    async def connect(self) -> None:
+        await self.dispatcher.start()
+
+    async def close(self) -> None:
+        await self.dispatcher.close()
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        if batch.num_rows == 0:
+            return []
+        if self.cache is not None:
+            key = batch_fingerprint(batch)
+            rbs = await self.cache.get_or_compute(
+                key, lambda: self._dispatch_ipc(batch), tenant=batch.tenant())
+            # cached value holds Arrow record batches (bitwise-identical
+            # responses); the wrapper is rebuilt per delivery
+            return [MessageBatch(rb) for rb in rbs]
+        return await self.dispatcher.dispatch(batch)
+
+    async def _dispatch_ipc(self, batch: MessageBatch):
+        return [b.record_batch for b in await self.dispatcher.dispatch(batch)]
+
+
+def parse_remote_tpu_config(config: Mapping) -> dict:
+    """Validate ``remote_tpu`` processor config -> dispatcher kwargs + the
+    drain timeout. Pure parse (no sockets, no metric series) so config.py
+    can run it at ``--validate`` time."""
+    from arkflow_tpu.runtime.respcache import parse_response_cache_config
+    from arkflow_tpu.utils.duration import parse_duration
+
+    workers = config.get("workers")
+    if not isinstance(workers, list) or not workers:
+        raise ConfigError("remote_tpu needs a non-empty 'workers' list of "
+                          "arkflow://host:port URLs")
+    for u in workers:
+        if not isinstance(u, str):
+            raise ConfigError(f"remote_tpu.workers entries must be strings, "
+                              f"got {u!r}")
+        parse_remote_url(u)  # raises ConfigError with the offending URL
+    if len(set(workers)) != len(workers):
+        raise ConfigError(f"remote_tpu.workers must be distinct, got {workers}")
+    route_key = config.get("route_key", "fingerprint")
+    if route_key not in ROUTE_KEYS:
+        raise ConfigError(f"remote_tpu.route_key must be one of "
+                          f"{ROUTE_KEYS}, got {route_key!r}")
+    out: dict = {"workers": [str(u) for u in workers],
+                 "route_key": str(route_key)}
+
+    def _int(key: str, default: int, minimum: int) -> int:
+        v = config.get(key, default)
+        if isinstance(v, bool) or not isinstance(v, int) or v < minimum:
+            raise ConfigError(
+                f"remote_tpu.{key} must be an int >= {minimum}, got {v!r}")
+        return v
+
+    def _dur(key: str, default: str) -> float:
+        v = config.get(key, default)
+        try:
+            s = parse_duration(v)
+        except (ConfigError, TypeError, ValueError) as e:
+            raise ConfigError(f"remote_tpu.{key} invalid: {e}") from e
+        if s <= 0:
+            raise ConfigError(f"remote_tpu.{key} must be > 0, got {v!r}")
+        return s
+
+    out["prefix_bytes"] = _int("prefix_bytes", 64, 1)
+    out["virtual_nodes"] = _int("virtual_nodes", 64, 1)
+    out["max_frame"] = _int("max_frame", DEFAULT_MAX_FRAME, 1024)
+    out["heartbeat_s"] = _dur("heartbeat", "2s")
+    out["request_timeout_s"] = _dur("request_timeout", "60s")
+    out["connect_timeout_s"] = _dur("connect_timeout", "5s")
+    out["drain_timeout_s"] = _dur("drain_timeout", "30s")
+    tf = config.get("text_field")
+    if tf is not None and not isinstance(tf, str):
+        raise ConfigError(f"remote_tpu.text_field must be a string, got {tf!r}")
+    out["text_field"] = tf
+    parse_response_cache_config(config.get("response_cache"))
+    return out
+
+
+def build_remote_tpu(config: dict, resource: Resource) -> RemoteTpuProcessor:
+    """Builder for ``type: remote_tpu`` (registered from
+    plugins/processor/remote_tpu.py)."""
+    from arkflow_tpu.runtime.respcache import build_response_cache
+
+    parsed = parse_remote_tpu_config(config)
+    name = str(config.get("name") or "cluster")
+    dispatcher = ClusterDispatcher(
+        parsed["workers"], name=name, route_key=parsed["route_key"],
+        prefix_bytes=parsed["prefix_bytes"], text_field=parsed["text_field"],
+        virtual_nodes=parsed["virtual_nodes"],
+        heartbeat_s=parsed["heartbeat_s"],
+        request_timeout_s=parsed["request_timeout_s"],
+        connect_timeout_s=parsed["connect_timeout_s"],
+        max_frame=parsed["max_frame"])
+    cache = build_response_cache(config.get("response_cache"), name=name)
+    return RemoteTpuProcessor(dispatcher, response_cache=cache,
+                              drain_timeout_s=parsed["drain_timeout_s"])

@@ -123,6 +123,23 @@ class Engine:
                     logger.exception("swap report failed for stream %s", s.name)
             if swaps:
                 info["swap"] = swaps
+            clusters = []
+            for proc in getattr(s.pipeline, "processors", None) or []:
+                # disaggregated serving (runtime/cluster.py): the remote_tpu
+                # dispatch stage aggregates per-worker register/heartbeat
+                # state — same _inner-chain walk as the cache/swap reports
+                from arkflow_tpu.runtime.cluster import _walk_inner
+
+                report = _walk_inner(proc, "cluster_report")
+                if report is None:
+                    continue
+                try:
+                    clusters.append(report())
+                except Exception:
+                    logger.exception("cluster report failed for stream %s",
+                                     s.name)
+            if clusters:
+                info["cluster"] = clusters
             out[s.name] = info
         return out
 

@@ -212,3 +212,157 @@ def test_remote_url_validation_errors_are_config_errors():
     for bad in ("arkflow://h:50051/", "arkflow://h:abc", "arkflow://h:0"):
         with pytest.raises(ConfigError):
             parse_remote_url(bad)
+
+
+# -- mid-stream error frames (tag 0x01) and the max-frame cap ---------------
+
+
+async def _fake_streaming_server(frames_after_status: list[bytes]):
+    """A minimal flight-protocol peer: reads the request frame, answers
+    ``{"ok": true}``, then plays back the given raw frames verbatim.
+    Returns (server, port)."""
+    import json
+    import struct
+
+    async def serve(reader, writer):
+        # read the request frame (length header + payload)
+        (n,) = struct.unpack(">I", await reader.readexactly(4))
+        await reader.readexactly(n)
+        status = json.dumps({"ok": True}).encode()
+        writer.write(struct.pack(">I", len(status)) + status)
+        for frame in frames_after_status:
+            writer.write(frame)
+        await writer.drain()
+        writer.close()
+
+    server = await asyncio.start_server(serve, "127.0.0.1", 0)
+    return server, server.sockets[0].getsockname()[1]
+
+
+def _frame(payload: bytes) -> bytes:
+    import struct
+
+    return struct.pack(">I", len(payload)) + payload
+
+
+def test_mid_stream_error_frame_surfaces_without_hanging():
+    """Satellite: an error raised AFTER batches have streamed must surface
+    as ReadError on the consumer — with the already-streamed batches
+    delivered and the stream not hanging."""
+    import json
+
+    rb = pa.RecordBatch.from_pydict({"a": [1, 2, 3]})
+    err = b"\x01" + json.dumps({"error": "disk died mid-scan"}).encode()
+
+    async def go():
+        server, port = await _fake_streaming_server([
+            _frame(b"\x00" + batch_to_ipc(rb)),  # one good data frame
+            _frame(err),                          # then the tagged error
+        ])
+        try:
+            client = FlightClient(f"arkflow://127.0.0.1:{port}", timeout=5.0)
+            got = []
+            with pytest.raises(ReadError, match="disk died mid-scan"):
+                async for b in client.scan("/whatever"):
+                    got.append(b)
+            assert len(got) == 1 and got[0].equals(rb)
+        finally:
+            server.close()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=10))
+
+
+def test_zero_length_end_frame_terminates_cleanly():
+    """Satellite: the zero-length end frame must terminate the stream with
+    every data frame delivered and no error."""
+    rb = pa.RecordBatch.from_pydict({"a": [1, 2]})
+
+    async def go():
+        server, port = await _fake_streaming_server([
+            _frame(b"\x00" + batch_to_ipc(rb)),
+            _frame(b"\x00" + batch_to_ipc(rb)),
+            b"\x00\x00\x00\x00",  # end
+        ])
+        try:
+            client = FlightClient(f"arkflow://127.0.0.1:{port}", timeout=5.0)
+            got = [b async for b in client.scan("/whatever")]
+            assert len(got) == 2
+        finally:
+            server.close()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=10))
+
+
+def test_worker_sends_error_tag_when_scan_fails_mid_stream(tmp_path, monkeypatch):
+    """The WORKER side of the same contract: a scan that fails after
+    yielding batches emits tag 0x01 (not a connection drop), so the client
+    sees ReadError and the delivered prefix."""
+    import arkflow_tpu.plugins.input.file as file_mod
+
+    _write_parquet(tmp_path / "t.parquet", rows=10)
+    real_scan = file_mod._scan
+
+    def flaky_scan(path, fmt, batch_rows):
+        it = real_scan(path, fmt, batch_rows)
+        yield next(it)
+        raise RuntimeError("emulated io failure after first batch")
+
+    monkeypatch.setattr(file_mod, "_scan", flaky_scan)
+
+    async def go():
+        worker = FlightWorker("127.0.0.1", 0, allow_paths=[str(tmp_path)])
+        await worker.start()
+        try:
+            client = FlightClient(f"arkflow://127.0.0.1:{worker.port}",
+                                  timeout=5.0)
+            got = []
+            with pytest.raises(ReadError, match="emulated io failure"):
+                async for b in client.scan(str(tmp_path / "t.parquet"),
+                                           batch_rows=4):
+                    got.append(b)
+            assert len(got) == 1  # the streamed prefix arrived intact
+        finally:
+            await worker.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=15))
+
+
+def test_max_frame_cap_raises_connect_error_naming_the_limit():
+    """Satellite: the u32 length header is untrusted — an oversized frame
+    fails loudly with the configured cap in the message, client-side and
+    worker-side, before any payload is buffered."""
+    import struct
+
+    async def go():
+        # client side: the peer announces a frame far beyond the cap
+        server, port = await _fake_streaming_server(
+            [struct.pack(">I", 1 << 31)])
+        try:
+            client = FlightClient(f"arkflow://127.0.0.1:{port}",
+                                  timeout=5.0, max_frame=1024)
+            with pytest.raises(ConnectError, match="max_frame"):
+                async for _ in client.scan("/whatever"):
+                    pass
+        finally:
+            server.close()
+
+        # worker side: a client announcing a huge request frame gets a loud
+        # error status naming the cap instead of a 4 GiB readexactly
+        worker = FlightWorker("127.0.0.1", 0, max_frame=1024)
+        await worker.start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", worker.port)
+            writer.write(struct.pack(">I", 1 << 30))
+            await writer.drain()
+            (n,) = struct.unpack(">I", await reader.readexactly(4))
+            import json
+
+            status = json.loads((await reader.readexactly(n)).decode())
+            assert status["ok"] is False
+            assert "max_frame" in status["error"]
+            writer.close()
+        finally:
+            await worker.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=15))

@@ -53,9 +53,19 @@ expected with ZERO failed or lost requests (offered == delivered + shed,
 and shed == 0 here), and delivered p99 stayed within the deadline SLO
 across both swaps.
 
+``--cluster`` soaks the disaggregated serving tier (runtime/cluster.py):
+two local device-tier worker processes behind a ``remote_tpu`` ingest
+stream — aggregate rows/s >= 1.7x one worker, byte-identical duplicates
+hitting ONE worker's response cache cross-process, and a SIGKILL/restart of
+a worker mid-load with zero silent loss::
+
+    python tools/chaos_soak.py --cluster --fast    # tier-1 smoke
+    python tools/chaos_soak.py --cluster --seed 3
+
 Runs on the virtual-CPU JAX platform by default (no TPU needed; ``--burst``
-never imports jax at all); set ARKFLOW_SOAK_KEEP_ENV=1 to target whatever
-backend the environment provides.
+never imports jax at all, and ``--cluster``'s parent process doesn't either
+— only its worker subprocesses); set ARKFLOW_SOAK_KEEP_ENV=1 to target
+whatever backend the environment provides.
 """
 
 from __future__ import annotations
@@ -978,6 +988,348 @@ def run_swap_soak(seconds: float = 120.0, seed: int = 7, messages: int = 64,
     }
 
 
+# -- cluster soak (runtime/cluster.py): disaggregated ingest/device tiers --
+
+
+def _cluster_worker_config(seed: int, step_ms: int) -> dict:
+    """Device-tier worker config: a tiny response-cached bert behind a fixed
+    per-batch latency fault. The sleep emulates a device step that DWARFS
+    host compute, so the soak's scaling ratio measures the cluster's routing
+    and pipelining rather than host-cpu contention (the same discipline as
+    the burst soak's worker)."""
+    tiny_model = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+                  "ffn": 64, "max_positions": 64, "num_labels": 2}
+    return {
+        "worker": {"max_in_flight": 1},
+        "processors": [{
+            "type": "fault",
+            "seed": seed,
+            "faults": [{"kind": "latency", "every": 1, "times": 0,
+                        "duration": f"{step_ms}ms"}],
+            "inner": {
+                "type": "tpu_inference",
+                "model": "bert_classifier",
+                "model_config": tiny_model,
+                "max_seq": 16,
+                "batch_buckets": [2],
+                "seq_buckets": [16],
+                "warmup": True,
+                "response_cache": {"capacity": 512},
+            },
+        }],
+    }
+
+
+def _cluster_ingest_config(name: str, urls: list[str], payloads: list[str],
+                           *, threads: int = 4, redeliver_seed=None) -> dict:
+    """Ingest-tier stream: memory source -> remote_tpu dispatch -> collect.
+    ``redeliver_seed`` wraps the source in the in-process broker sim so a
+    nacked batch is redelivered (the chaos phase's at-least-once leg)."""
+    input_cfg: dict = {"type": "memory", "messages": payloads}
+    if redeliver_seed is not None:
+        input_cfg = {
+            "type": "fault",
+            "seed": redeliver_seed,
+            "redeliver_unacked": True,
+            "inner": input_cfg,
+            "faults": [{"kind": "latency", "every": 7, "times": 0,
+                        "duration": "1ms"}],
+        }
+    return {
+        "name": name,
+        "input": input_cfg,
+        "pipeline": {
+            "thread_num": threads,
+            "max_delivery_attempts": 8,
+            "processors": [{
+                "type": "remote_tpu",
+                "name": name,
+                "workers": urls,
+                "heartbeat": "250ms",
+                "connect_timeout": "2s",
+                "request_timeout": "30s",
+            }],
+        },
+        "output": {"type": "drop"},
+        "error_output": {"type": "drop"},
+    }
+
+
+def run_cluster_soak(seconds: float = 60.0, seed: int = 7,
+                     fast: bool = False) -> dict:
+    """2-process device-tier soak (runtime/cluster.py): spawns two local
+    cluster workers, then proves
+
+    - near-linear scaling: aggregate rows/s with both workers >= 1.7x one
+      worker (each worker's step is latency-emulated, so the ratio measures
+      routing/pipelining, not host cpu);
+    - hash affinity: byte-identical duplicate batches all route to ONE
+      worker and hit its response cache cross-process;
+    - chaos: a worker SIGKILLed mid-load loses nothing (in-flight batches
+      fail over along the hash ring; the fleet serves on N-1) and, once
+      restarted, registers and serves again.
+
+    The parent process never imports jax — only the worker subprocesses do.
+    """
+    import asyncio
+    import os
+    import socket as socket_mod
+    import subprocess
+    import tempfile
+
+    import yaml
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import build_stream
+    from arkflow_tpu.runtime.cluster import ClusterDispatcher
+    from arkflow_tpu.utils.cleanenv import pin_cpu_env, strip_axon_pythonpath
+
+    ensure_plugins_loaded()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    step_ms = 50 if fast else 60
+    n_single = 24 if fast else 48      # throughput phase, one worker
+    n_dual = 2 * n_single              # throughput phase, both workers
+    k_dup = 8 if fast else 12          # affinity phase duplicates
+    m_chaos = 48 if fast else 96       # chaos phase messages
+    startup_budget = 240.0
+
+    def free_port() -> int:
+        s = socket_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="arkflow-cluster-soak-")
+    cfg_path = os.path.join(tmp, "worker.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(_cluster_worker_config(seed, step_ms), f)
+
+    ports = [free_port(), free_port()]
+    urls = [f"arkflow://127.0.0.1:{p}" for p in ports]
+    logs = [os.path.join(tmp, f"worker-{i}.log") for i in range(2)]
+
+    def spawn(i: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        strip_axon_pythonpath(env)
+        pin_cpu_env(env, n_devices=1)
+        return subprocess.Popen(
+            [sys.executable, "-m", "arkflow_tpu", "--cluster-worker",
+             "--config", cfg_path, "--host", "127.0.0.1",
+             "--port", str(ports[i]), "--worker-id", f"soak-w{i}"],
+            cwd=repo_root, env=env,
+            stdout=open(logs[i], "ab"), stderr=subprocess.STDOUT)
+
+    async def wait_ready(wait_urls: list[str], budget_s: float) -> None:
+        """Poll register until every listed worker answers (warmup compiles
+        happen before the port opens, so 'answers' means 'ready')."""
+        probe = ClusterDispatcher(wait_urls, name="cluster-soak-probe",
+                                  heartbeat_s=999.0, connect_timeout_s=1.0)
+        deadline = time.monotonic() + budget_s
+        while True:
+            await asyncio.gather(
+                *(probe._probe(w) for w in probe.workers.values()),
+                return_exceptions=True)
+            if all(w.alive for w in probe.workers.values()):
+                return
+            if time.monotonic() >= deadline:
+                down = [w.url for w in probe.workers.values() if not w.alive]
+                raise RuntimeError(
+                    f"cluster workers not ready within {budget_s:.0f}s: {down} "
+                    f"(see {tmp}/worker-*.log)")
+            await asyncio.sleep(0.5)
+
+    async def heartbeat(url: str) -> dict:
+        probe = ClusterDispatcher([url], name="cluster-soak-probe",
+                                  heartbeat_s=999.0, connect_timeout_s=1.0)
+        return await probe._unary(probe.workers[url], {"action": "heartbeat"})
+
+    class _Collect(DropOutput):
+        def __init__(self, sink: list):
+            self._sink = sink
+
+        async def write(self, batch: MessageBatch) -> None:
+            self._sink.extend(batch.to_binary())
+
+    def run_phase(cfg_map: dict, budget_s: float, driver=None) -> dict:
+        """Build + run one ingest stream to EOF (bounded); returns the
+        collected rows, the stream and wall-clock of the run itself."""
+        stream = build_stream(StreamConfig.from_mapping(cfg_map))
+        delivered: list[bytes] = []
+        shed: list[bytes] = []
+        stream.output = _Collect(delivered)
+        stream.error_output = _Collect(shed)
+
+        out: dict = {"delivered": delivered, "shed": shed, "stream": stream}
+
+        async def bounded() -> None:
+            cancel = asyncio.Event()
+            task = asyncio.create_task(stream.run(cancel))
+            driver_task = (asyncio.create_task(driver(stream, delivered))
+                           if driver is not None else None)
+            t0 = time.monotonic()
+            done, _ = await asyncio.wait({task}, timeout=budget_s)
+            out["elapsed_s"] = time.monotonic() - t0
+            out["wedged"] = not done
+            if done:
+                task.result()  # surface a crashed stream with its traceback
+            else:
+                cancel.set()
+                try:
+                    await asyncio.wait_for(task, timeout=15.0)
+                except (asyncio.TimeoutError, Exception):
+                    task.cancel()
+            if driver_task is not None:
+                try:
+                    await asyncio.wait_for(driver_task, timeout=5.0)
+                except (asyncio.TimeoutError, Exception):
+                    driver_task.cancel()
+
+        asyncio.run(bounded())
+        return out
+
+    procs: list = [None, None]
+    verdict: dict = {"mode": "cluster", "seed": seed, "step_ms": step_ms,
+                     "workers": urls}
+    t_start = time.monotonic()
+    try:
+        procs[0] = spawn(0)
+        procs[1] = spawn(1)
+        asyncio.run(wait_ready(urls, startup_budget))
+        verdict["startup_s"] = round(time.monotonic() - t_start, 3)
+
+        # -- phase 1: aggregate throughput, 1 worker vs 2 ------------------
+        pay1 = [f"tput-single {i:05d}" for i in range(n_single)]
+        one = run_phase(_cluster_ingest_config(
+            "cluster-soak-tput1", urls[:1], pay1), seconds)
+        pay2 = [f"tput-dual {i:05d}" for i in range(n_dual)]
+        two = run_phase(_cluster_ingest_config(
+            "cluster-soak-tput2", urls, pay2), seconds)
+        rows1 = len(one["delivered"]) / max(one["elapsed_s"], 1e-9)
+        rows2 = len(two["delivered"]) / max(two["elapsed_s"], 1e-9)
+        ratio = rows2 / max(rows1, 1e-9)
+        throughput = {
+            "single_rows_per_s": round(rows1, 2),
+            "dual_rows_per_s": round(rows2, 2),
+            "scaling_ratio": round(ratio, 3),
+            "single_delivered": len(one["delivered"]),
+            "dual_delivered": len(two["delivered"]),
+            "ratio_ok": (ratio >= 1.7
+                         and len(one["delivered"]) == n_single
+                         and len(two["delivered"]) == n_dual),
+        }
+        verdict["throughput"] = throughput
+
+        # -- phase 2: affinity — duplicates hit ONE worker's cache ---------
+        hb_before = {u: asyncio.run(heartbeat(u)) for u in urls}
+        dup = run_phase(_cluster_ingest_config(
+            "cluster-soak-dup", urls, ["duplicate request"] * k_dup,
+            threads=1), seconds)
+        hb_after = {u: asyncio.run(heartbeat(u)) for u in urls}
+
+        def cache_hits(hb: dict) -> int:
+            return sum(int(c.get("hits", 0)) for c in hb.get("caches", []))
+
+        served_delta = {u: int(hb_after[u].get("served", 0))
+                        - int(hb_before[u].get("served", 0)) for u in urls}
+        hits_delta = {u: cache_hits(hb_after[u]) - cache_hits(hb_before[u])
+                      for u in urls}
+        target = max(served_delta, key=lambda u: served_delta[u])
+        affinity = {
+            "delivered": len(dup["delivered"]),
+            "served_by_worker": served_delta,
+            "cache_hits_by_worker": hits_delta,
+            "one_worker_took_all": served_delta[target] == k_dup and all(
+                served_delta[u] == 0 for u in urls if u != target),
+            # cross-process response-cache affinity: the first duplicate
+            # misses, every later one hits the SAME worker's cache
+            "cache_hits_ok": hits_delta[target] >= k_dup - 1,
+        }
+        affinity["pass"] = bool(len(dup["delivered"]) == k_dup
+                                and affinity["one_worker_took_all"]
+                                and affinity["cache_hits_ok"])
+        verdict["affinity"] = affinity
+
+        # -- phase 3: kill/restart a worker under load ---------------------
+        kill_at = max(2, m_chaos // 4)
+        chaos_events: dict = {"killed": False, "restarted": False}
+
+        async def chaos_driver(stream, delivered) -> None:
+            while len(delivered) < kill_at:
+                await asyncio.sleep(0.01)
+            procs[1].kill()
+            procs[1].wait()
+            chaos_events["killed"] = True
+            chaos_events["killed_at_delivered"] = len(delivered)
+            await asyncio.sleep(1.0)
+            procs[1] = spawn(1)  # restart on the same port, same identity
+            chaos_events["restarted"] = True
+
+        pay3 = [f"chaos row {i:05d}" for i in range(m_chaos)]
+        chaos = run_phase(_cluster_ingest_config(
+            "cluster-soak-chaos", urls, pay3, redeliver_seed=seed),
+            max(seconds, 60.0), driver=chaos_driver)
+        expected = set(p.encode() for p in pay3)
+        seen = set(chaos["delivered"]) | set(chaos["shed"])
+        lost = sorted(expected - seen)
+        dispatcher = chaos["stream"].pipeline.processors[0].dispatcher
+        chaos_out = {
+            **chaos_events,
+            "wedged": chaos["wedged"],
+            "offered_rows": m_chaos,
+            "delivered_rows": len(chaos["delivered"]),
+            "shed_rows": len(chaos["shed"]),
+            "duplicate_rows": len(chaos["delivered"]) - len(set(chaos["delivered"])),
+            "lost_rows": len(lost),
+            "ring_retries": int(dispatcher.m_retries.value),
+            # offered == delivered + shed over DISTINCT rows: at-least-once
+            # may duplicate, but nothing vanishes silently
+            "identity_ok": (len(lost) == 0
+                            and len(expected & set(chaos["delivered"]))
+                            + len(expected & set(chaos["shed"]) - set(chaos["delivered"]))
+                            == m_chaos),
+        }
+        if lost:
+            chaos_out["lost_sample"] = [x.decode() for x in lost[:5]]
+
+        # the killed worker must come back: register again AND serve
+        revived = False
+        revive_error = None
+        try:
+            asyncio.run(wait_ready(urls[1:], startup_budget))
+            post = run_phase(_cluster_ingest_config(
+                "cluster-soak-revive", urls[1:],
+                [f"revive row {i}" for i in range(2)], threads=1), seconds)
+            revived = len(post["delivered"]) == 2
+        except Exception as e:
+            revive_error = f"{type(e).__name__}: {e}"
+        chaos_out["revived"] = revived
+        if revive_error:
+            chaos_out["revive_error"] = revive_error
+        chaos_out["pass"] = bool(not chaos["wedged"]
+                                 and chaos_out["identity_ok"]
+                                 and chaos_events["killed"]
+                                 and revived)
+        verdict["chaos"] = chaos_out
+
+        verdict["pass"] = bool(throughput["ratio_ok"]
+                               and affinity["pass"]
+                               and chaos_out["pass"])
+    finally:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+    verdict["elapsed_s"] = round(time.monotonic() - t_start, 3)
+    return verdict
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seconds", type=float, default=60.0,
@@ -999,6 +1351,12 @@ def main(argv=None) -> int:
                          "back and a good rolling hot-swap commits across a "
                          "device pool and a continuous generate server under "
                          "sustained load — zero failed/lost, bounded p99")
+    ap.add_argument("--cluster", action="store_true",
+                    help="disaggregated-serving soak: 2 local device-tier "
+                         "worker processes behind a remote_tpu ingest "
+                         "stream; asserts >=1.7x aggregate rows/s, "
+                         "cross-process duplicate cache affinity, and zero "
+                         "silent loss across a worker kill/restart")
     ap.add_argument("--factor", type=int, default=4,
                     help="burst mode: offered-load multiplier (default 4)")
     ap.add_argument("--fast", action="store_true",
@@ -1036,6 +1394,14 @@ def main(argv=None) -> int:
             pin_cpu_env(os.environ, n_devices=2)
         verdict = run_swap_soak(seconds=args.seconds, seed=args.seed,
                                 messages=args.messages, fast=args.fast)
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["pass"] else 1
+
+    if args.cluster:
+        # the INGEST process never imports jax; only the spawned device
+        # workers do (each pins its own virtual-CPU env)
+        verdict = run_cluster_soak(seconds=args.seconds, seed=args.seed,
+                                   fast=args.fast)
         print(json.dumps(verdict, indent=2))
         return 0 if verdict["pass"] else 1
 
