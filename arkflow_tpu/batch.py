@@ -56,6 +56,13 @@ META_EXT_PRIORITY = META_EXT_PREFIX + "priority"
 #: (HTTP header / auth subject, Kafka header, or static per-input config);
 #: an ext column so it survives redelivery like deadline/priority.
 META_EXT_TENANT = META_EXT_PREFIX + "tenant"
+#: per-batch tracing (obs/trace.py): the trace context — trace id, parent
+#: span id, head-sampling decision — as a compact JSON string. An ext
+#: column on purpose: it survives redelivery, ``split_ack`` shares,
+#: coalescer carve/merge slices and quarantine exactly like tenant/
+#: deadline/priority, and it is excluded from ``batch_fingerprint`` so
+#: tracing never perturbs dedup, routing affinity or attempt budgets.
+META_EXT_TRACE = META_EXT_PREFIX + "trace"
 
 #: The fixed (non-ext) metadata columns, in canonical order (ref lib.rs:53-63).
 META_COLUMNS = (
@@ -355,6 +362,42 @@ class MessageBatch:
         header, the auth subject, a Kafka header, or static config."""
         return self.with_ext_metadata({META_EXT_TENANT[len(META_EXT_PREFIX):]:
                                        str(tenant)})
+
+    def with_trace(self, ctx) -> "MessageBatch":
+        """Stamp (or replace) the batch's trace context
+        (``obs.trace.TraceContext``); a constant column — every row of a
+        batch shares one trace."""
+        return self.with_column(
+            META_EXT_TRACE, _repeat_array(ctx.to_json(), pa.string(),
+                                          self.num_rows))
+
+    def trace_context(self):
+        """The batch's trace context, or None when untraced/malformed.
+        Reads row 0 — a merged emission is re-stamped with its own trace
+        (source contexts per row feed its parent links instead)."""
+        from arkflow_tpu.obs.trace import TraceContext
+
+        return TraceContext.from_json(self.get_meta(META_EXT_TRACE))
+
+    def source_trace_contexts(self) -> list:
+        """Distinct trace contexts across the rows of this batch, in
+        first-seen row order — a merged emission carries one per source
+        batch; the stream's coalesce parent links read them (and their
+        sampled flags) before re-stamping."""
+        from arkflow_tpu.obs.trace import TraceContext
+
+        if not self.has_column(META_EXT_TRACE) or self.num_rows == 0:
+            return []
+        seen: dict[str, Any] = {}
+        for v in self.column(META_EXT_TRACE).unique().to_pylist():
+            ctx = TraceContext.from_json(v)
+            if ctx is not None and ctx.trace_id not in seen:
+                seen[ctx.trace_id] = ctx
+        return list(seen.values())
+
+    def source_trace_ids(self) -> list[str]:
+        """Just the distinct trace ids (see ``source_trace_contexts``)."""
+        return [c.trace_id for c in self.source_trace_contexts()]
 
     def tenant(self, default: str | None = None) -> str | None:
         """Tenant id from ``__meta_ext_tenant``, or ``default`` when the

@@ -407,9 +407,15 @@ class EngineConfig:
     streams: list[StreamConfig]
     health_check: HealthCheckConfig = field(default_factory=HealthCheckConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
+    #: per-batch tracing knobs (obs/trace.py TracingConfig): head-sampling
+    #: rate + retention bounds for the /trace endpoint; always-on by
+    #: default — the engine applies it to the process-global tracer
+    tracing: Optional[object] = None
 
     @classmethod
     def from_mapping(cls, m: Mapping[str, Any]) -> "EngineConfig":
+        from arkflow_tpu.obs.trace import TracingConfig
+
         if not isinstance(m, Mapping):
             raise ConfigError("engine config must be a mapping")
         raw_streams = m.get("streams")
@@ -418,7 +424,9 @@ class EngineConfig:
         streams = [StreamConfig.from_mapping(s) for s in raw_streams]
         health = HealthCheckConfig.from_mapping(m.get("health_check", {}) or {})
         logging_ = LoggingConfig.from_mapping(m.get("logging", {}) or {})
-        return cls(streams=streams, health_check=health, logging=logging_)
+        tracing = TracingConfig.from_mapping(m.get("tracing"))
+        return cls(streams=streams, health_check=health, logging=logging_,
+                   tracing=tracing)
 
     def validate_components(self) -> list[str]:
         """Check every component's ``type`` tag resolves against the

@@ -71,6 +71,11 @@ async def _send_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
 
 DATA_TAG = b"\x00"
 ERROR_TAG = b"\x01"
+#: cluster tracing (obs/trace.py): a worker's exported span list rides back
+#: to the ingest tier as one tagged JSON frame before the end-of-stream
+#: marker, so a batch's trace stitches across the flight hop. Absent when
+#: the request carried no trace context — old/new peers interoperate.
+TRACE_TAG = b"\x02"
 
 
 async def _send_data(writer: asyncio.StreamWriter, payload: bytes) -> None:

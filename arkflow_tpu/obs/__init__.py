@@ -5,3 +5,13 @@ from arkflow_tpu.obs.metrics import (  # noqa: F401
     MetricsRegistry,
     global_registry,
 )
+from arkflow_tpu.obs.trace import (  # noqa: F401
+    Span,
+    TraceContext,
+    Tracer,
+    TracingConfig,
+    activate,
+    global_tracer,
+    record_stage,
+    stage_span,
+)

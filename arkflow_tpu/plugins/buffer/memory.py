@@ -123,10 +123,16 @@ class MemoryBuffer(Buffer):
         #: the overflow) and cap the admission controller caps labels with
         self._tenant_policy = None
         self._held: list[tuple[MessageBatch, Ack]] = []
-        #: plain-path emissions already carved by tenant, awaiting read()
-        self._ready: deque[tuple[MessageBatch, Ack]] = deque()
+        #: emissions already carved (by tenant / flush pass), awaiting
+        #: read(): (batch, ack, wait_s) — wait_s is the oldest contributing
+        #: row's monotonic buffer wait, captured when the emission was cut
+        self._ready: deque[tuple[MessageBatch, Ack, float]] = deque()
         self._held_rows = 0
         self._first_write_at: Optional[float] = None
+        #: buffer wait of the LAST emission handed to read() — the stream's
+        #: trace layer records it as the buffer/coalescer-wait span (a
+        #: monotonic loop-clock measurement, immune to wall-clock steps)
+        self.last_emission_wait_s: Optional[float] = None
         self._cond = asyncio.Condition()
         self._closed = False
 
@@ -218,18 +224,22 @@ class MemoryBuffer(Buffer):
                 order.append(key)
             groups[key].append((b, a))
         self._held = []
+        now = asyncio.get_running_loop().time()
+        wait = (max(0.0, now - self._first_write_at)
+                if self._first_write_at is not None else 0.0)
         self._first_write_at = None
         for key in order:
             pairs = groups[key]
             self._ready.append((MessageBatch.concat([b for b, _ in pairs]),
-                                VecAck([a for _, a in pairs])))
+                                VecAck([a for _, a in pairs]), wait))
         return self._pop_ready_locked()
 
     def _pop_ready_locked(self) -> tuple[MessageBatch, Ack]:
-        emission = self._ready.popleft()
-        self._held_rows -= emission[0].num_rows
+        batch, ack, wait = self._ready.popleft()
+        self.last_emission_wait_s = wait
+        self._held_rows -= batch.num_rows
         self._cond.notify_all()  # wake writers blocked on backpressure
-        return emission
+        return batch, ack
 
     def _emit_coalesced_locked(self, *, flush: bool) -> Optional[tuple[MessageBatch, Ack]]:
         """Bucket-exact emission; ``flush`` (deadline/close) also carves the
@@ -245,18 +255,23 @@ class MemoryBuffer(Buffer):
             for _ in range(len(self._lane_rr)):
                 key = self._lane_rr[0]
                 self._lane_rr.rotate(-1)
-                emission = self._tenant_coalescers[key].pop_flush()
+                lane = self._tenant_coalescers[key]
+                emission = lane.pop_flush()
                 if emission is not None:
-                    self._ready.append(emission)
+                    self._ready.append((*emission, lane.last_pop_wait_s))
         if self._ready:
-            emission = self._ready.popleft()
+            batch, ack, wait = self._ready.popleft()
+            self.last_emission_wait_s = wait
+            emission = (batch, ack)
         else:
             emission = None
             for _ in range(len(self._lane_rr)):
                 key = self._lane_rr[0]
                 self._lane_rr.rotate(-1)
-                emission = self._tenant_coalescers[key].pop_exact()
+                lane = self._tenant_coalescers[key]
+                emission = lane.pop_exact()
                 if emission is not None:
+                    self.last_emission_wait_s = lane.last_pop_wait_s
                     break
             if emission is None:
                 return None

@@ -213,10 +213,19 @@ class TpuInferenceProcessor(Processor):
 
     async def _infer(self, batch: MessageBatch) -> dict[str, np.ndarray]:
         """One un-cached inference: extract -> device step(s)."""
+        from arkflow_tpu.obs.trace import record_stage
+
         if self.packing:
             return await self._infer_packed(batch)
+        import time as _time
+
+        t0 = _time.perf_counter()
         with self.m_extract.time():
             inputs = self._extract(batch)
+        # extraction/tokenization is infeed prep too — same stage name as
+        # the runner's pad/stage span, so the breakdown shows ONE infeed
+        # cost (the two sites sum)
+        record_stage("infeed_prep", _time.perf_counter() - t0)
         return await self.runner.infer(inputs)
 
     async def _infer_packed(self, batch: MessageBatch) -> dict[str, np.ndarray]:
@@ -247,8 +256,14 @@ class TpuInferenceProcessor(Processor):
             with self.m_extract.time():
                 return tokenize_and_carve()
 
+        import time as _time
+
+        from arkflow_tpu.obs.trace import record_stage
+
         loop = asyncio.get_running_loop()
+        t0 = _time.perf_counter()
         windows = await loop.run_in_executor(None, timed_tokenize_and_carve)
+        record_stage("infeed_prep", _time.perf_counter() - t0)
         outs = await asyncio.gather(
             *[self.runner.infer(inputs) for inputs, _ in windows])
         # scatter each window's [E_w, ...] outputs back into original row

@@ -71,6 +71,7 @@ from arkflow_tpu.components.registry import build_component, ensure_plugins_load
 from arkflow_tpu.connect.flight import (
     DEFAULT_MAX_FRAME,
     ERROR_TAG,
+    TRACE_TAG,
     _end_stream,
     _read_frame,
     _send_data,
@@ -87,6 +88,14 @@ from arkflow_tpu.errors import (
     SwapError,
 )
 from arkflow_tpu.obs import global_registry
+from arkflow_tpu.obs.trace import (
+    TraceContext,
+    Tracer,
+    TracingConfig,
+    activate,
+    global_tracer,
+    stage_span,
+)
 
 logger = logging.getLogger("arkflow.cluster")
 
@@ -234,7 +243,8 @@ class ClusterWorkerServer:
 
     def __init__(self, processors: Sequence[Any], *, host: str = "127.0.0.1",
                  port: int = 50052, worker_id: Optional[str] = None,
-                 max_in_flight: int = 1, max_frame: int = DEFAULT_MAX_FRAME):
+                 max_in_flight: int = 1, max_frame: int = DEFAULT_MAX_FRAME,
+                 tracing: Optional[TracingConfig] = None):
         from arkflow_tpu.runtime.overload import OverloadConfig, OverloadController
         from arkflow_tpu.runtime.pipeline import Pipeline
 
@@ -245,6 +255,16 @@ class ClusterWorkerServer:
         self.host = host
         self.port = port
         self.worker_id = worker_id or f"{socket.gethostname()}-{os.getpid()}"
+        #: the worker's OWN tracer (never the process-global one): spans for
+        #: an infer request accumulate here and export back to the ingest
+        #: tier in a TRACE_TAG frame — per-instance so in-process test
+        #: fleets keep their tiers separated exactly like real processes.
+        #: No explicit config = the env-aware default (ARKFLOW_TRACE=0
+        #: must silence device-tier workers too).
+        from arkflow_tpu.obs.trace import _default_config
+
+        self.tracer = Tracer(tier=f"worker:{self.worker_id}",
+                             config=tracing or _default_config())
         self.max_in_flight = max_in_flight
         self.max_frame = int(max_frame)
         self.draining = False
@@ -398,6 +418,11 @@ class ClusterWorkerServer:
                 {"ok": False, "error": "worker is draining",
                  "retryable": True}).encode())
             return
+        # cross-tier trace context: the ingest dispatcher parents the
+        # worker's spans under its hop span; absent = untraced (old peer)
+        tctx = (TraceContext.from_json(req.get("trace"))
+                if self.tracer.enabled else None)
+        t_deser = asyncio.get_running_loop().time()
         batches = ipc_to_batches(ipc)
         if not batches:
             raise ConnectError("infer batch frame decoded to zero batches")
@@ -405,23 +430,47 @@ class ClusterWorkerServer:
         await _send_frame(writer, json.dumps({"ok": True}).encode())
         writer._arkflow_streaming = True
         loop = asyncio.get_running_loop()
+        self.tracer.record(tctx, "remote_deserialize", loop.time() - t_deser)
         self._inflight += 1
         self.ctrl.on_enqueue()
         t_q = loop.time()
         try:
             async with self._sem:  # one device, max_in_flight lanes
-                self.ctrl.on_dequeue(loop.time() - t_q, loop.time())
+                q_wait = loop.time() - t_q
+                self.ctrl.on_dequeue(q_wait, loop.time())
+                self.tracer.record(tctx, "remote_queue_wait", q_wait)
                 t0 = loop.time()
-                results = await self.pipeline.process(batch)
+                # activate the worker's tracer so the hosted chain's spans
+                # (infeed prep, device step) nest under remote_step
+                with activate(self.tracer, tctx):
+                    with stage_span("remote_step"):
+                        results = await self.pipeline.process(batch)
                 self.ctrl.observe_step(loop.time() - t0)
+            t_ser = loop.time()
             for out in results:
                 await _send_data(writer, batch_to_ipc(out.record_batch))
+            self.tracer.record(tctx, "remote_serialize", loop.time() - t_ser)
+            spans = self.tracer.export_open(tctx)
+            if spans:
+                await _send_frame(writer, TRACE_TAG + json.dumps(
+                    {"spans": spans}).encode())
             await _end_stream(writer)
             self._served += 1
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            self.tracer.export_open(tctx)  # don't strand the open entry
             raise
         except Exception:
             self._errors += 1
+            # a FAILED step is exactly the trace forced sampling exists
+            # for: ship the worker-tier spans ahead of the error frame the
+            # outer handler will send (the connection is still alive here)
+            spans = self.tracer.export_open(tctx)
+            if spans:
+                try:
+                    await _send_frame(writer, TRACE_TAG + json.dumps(
+                        {"spans": spans}).encode())
+                except Exception:
+                    pass  # the error frame still matters more
             raise
         finally:
             self._inflight -= 1
@@ -474,6 +523,12 @@ def parse_worker_config(m: Any) -> tuple[list[dict], dict]:
     if wid is not None and not isinstance(wid, str):
         raise ConfigError(f"worker.id must be a string, got {wid!r}")
     opts["worker_id"] = wid
+    # a worker accepts the same top-level `tracing:` block as the engine
+    # (sample knobs matter less here — the ingest tier owns the sampling
+    # decision — but span caps and the kill switch do). Parsed even when
+    # absent: from_mapping(None) is what consults the ARKFLOW_TRACE env
+    # kill switch, which must bind device-tier workers too.
+    opts["tracing"] = TracingConfig.from_mapping(m.get("tracing"))
     return [dict(p) for p in procs], opts
 
 
@@ -490,7 +545,8 @@ def build_worker_server(config: Mapping, *, host: str = "127.0.0.1",
         processors, host=host, port=port,
         worker_id=worker_id or opts["worker_id"],
         max_in_flight=opts["max_in_flight"],
-        max_frame=max_frame or opts["max_frame"])
+        max_frame=max_frame or opts["max_frame"],
+        tracing=opts["tracing"])
 
 
 async def run_worker(config: Mapping, *, host: str = "127.0.0.1",
@@ -812,6 +868,17 @@ class ClusterDispatcher:
             raise ConnectError(
                 f"remote_tpu[{self.name}]: no live cluster worker "
                 f"(fleet: {[w.report()['state'] for w in self.workers.values()]})")
+        # prefer the ambient stream scope (hops then parent under the
+        # process span, and in-process test fleets keep tier separation);
+        # fall back to the batch's own column for direct dispatcher use
+        from arkflow_tpu.obs.trace import current_scope
+
+        scope = current_scope()
+        if scope is not None:
+            tracer, ctx = scope.tracer, scope.ctx
+        else:
+            tracer = global_tracer()
+            ctx = batch.trace_context() if tracer.enabled else None
         last_exc: Optional[BaseException] = None
         for i, w in enumerate(candidates):
             if i > 0:
@@ -819,7 +886,7 @@ class ClusterDispatcher:
             w.inflight += 1
             w.m_inflight.set(w.inflight)
             try:
-                out = await self._infer_on(w, batch)
+                out = await self._infer_on(w, batch, ctx=ctx, tracer=tracer)
             except _WorkerDraining:
                 w.draining = True
                 last_exc = ConnectError(f"worker {w.url} draining")
@@ -850,35 +917,88 @@ class ClusterDispatcher:
             f"workers failed for this batch (last: {last_exc}); leaving it "
             "to the redelivery path")
 
-    async def _infer_on(self, w: RemoteWorker,
-                        batch: MessageBatch) -> list[MessageBatch]:
+    async def _infer_on(self, w: RemoteWorker, batch: MessageBatch, *,
+                        ctx: Optional[TraceContext] = None,
+                        tracer: Optional[Tracer] = None) -> list[MessageBatch]:
+        import time as _time
+
+        from arkflow_tpu.obs.trace import _new_id
+
+        # per-hop tracing: the hop span's id is minted BEFORE the call so
+        # the worker can parent its spans under it; serialize / transport /
+        # deserialize are ingest-side children, remote_* spans arrive in the
+        # worker's TRACE_TAG frame. A retried dispatch records one hop span
+        # per attempted worker.
+        hop_id = _new_id() if ctx is not None else ""
+        t_hop = _time.perf_counter()
+        hop_ok = False
         reader, writer = await self._open(w)
         try:
-            await _send_frame(writer, json.dumps({"action": "infer"}).encode())
-            await _send_frame(writer, batch_to_ipc(batch.record_batch))
+            req: dict = {"action": "infer"}
+            if ctx is not None:
+                req["trace"] = ctx.with_parent(hop_id).to_dict()
+            t0 = _time.perf_counter()
+            ipc = batch_to_ipc(batch.record_batch)
+            if tracer is not None:
+                tracer.record(ctx, "flight_serialize",
+                              _time.perf_counter() - t0, parent_id=hop_id)
+            t_send = _time.perf_counter()
+            await _send_frame(writer, json.dumps(req).encode())
+            await _send_frame(writer, ipc)
             raw = await asyncio.wait_for(
                 _read_frame(reader, self.max_frame), self.request_timeout_s)
             if raw is None:
                 raise ConnectError(f"worker {w.url} closed before a status")
+            if tracer is not None:
+                # send -> status round trip: wire + the worker's accept path
+                # (its own decode/queue/step costs arrive as remote_* spans)
+                tracer.record(ctx, "flight_transport",
+                              _time.perf_counter() - t_send, parent_id=hop_id)
             status = json.loads(raw.decode())
             if not status.get("ok"):
                 if status.get("retryable"):
                     raise _WorkerDraining(status.get("error"))
                 raise _RemoteProcessingError(status.get("error"))
             results: list[MessageBatch] = []
+            deser_s = 0.0
             while True:
                 frame = await asyncio.wait_for(
                     _read_frame(reader, self.max_frame),
                     self.request_timeout_s)
                 if frame is None:
+                    if tracer is not None:
+                        tracer.record(ctx, "flight_deserialize", deser_s,
+                                      parent_id=hop_id)
+                    hop_ok = True
                     return results
                 tag, payload = frame[:1], frame[1:]
                 if tag == ERROR_TAG:
                     raise _RemoteProcessingError(
                         json.loads(payload.decode()).get("error"))
+                if tag == TRACE_TAG:
+                    if tracer is not None:
+                        try:
+                            tracer.adopt_spans(
+                                ctx, json.loads(payload.decode()).get("spans") or [])
+                        except (ValueError, AttributeError, TypeError):
+                            # a mangled trace frame must never fail a batch
+                            # whose results already streamed fine
+                            logger.warning("malformed trace frame from %s", w.url)
+                    continue
+                t_d = _time.perf_counter()
                 for rb in ipc_to_batches(payload):
                     results.append(MessageBatch(rb))
+                deser_s += _time.perf_counter() - t_d
         finally:
+            if tracer is not None and ctx is not None:
+                # EVERY attempt roots its subtree — a failed hop's
+                # flight/worker children must not dangle, and the failure
+                # itself is worth seeing in the tree
+                tracer.record(
+                    ctx, "cluster_hop", _time.perf_counter() - t_hop,
+                    span_id=hop_id,
+                    attrs={"worker": w.url,
+                           **({} if hop_ok else {"error": True})})
             try:
                 writer.close()
             except Exception:

@@ -26,6 +26,7 @@ from aiohttp import web
 from arkflow_tpu.components.registry import ensure_plugins_loaded
 from arkflow_tpu.config import EngineConfig
 from arkflow_tpu.obs import global_registry
+from arkflow_tpu.obs.trace import global_tracer
 from arkflow_tpu.runtime.stream import Stream, build_stream
 
 logger = logging.getLogger("arkflow.engine")
@@ -154,6 +155,10 @@ class Engine:
         def health(_req):
             body = {"status": "ok" if not self.cancel.is_set() else "shutting_down",
                     "streams": len(self.streams),
+                    # one-line tracing liveness: retained spans/traces,
+                    # sample rate and the forced-sample count — an operator
+                    # can tell tracing is alive without hitting /trace
+                    "tracing": global_tracer().summary(),
                     "stream_health": self.stream_health()}
             return web.Response(text=json.dumps(body), content_type="application/json")
 
@@ -188,6 +193,27 @@ class Engine:
         def metrics(_req):
             return web.Response(text=global_registry().exposition(),
                                 content_type="text/plain", charset="utf-8")
+
+        def trace(req):
+            """GET /trace?n=16&min_seq=0 — the slowest-N retained traces
+            (span trees, worker-tier spans stitched in) plus the per-stage
+            latency breakdown: p50/p99 and each stage's share of summed
+            end-to-end time. Sheds, deadline overruns and errors are always
+            retained (forced sampling), so the pathological traces are here
+            even at low sample rates."""
+            tracer = global_tracer()
+            try:
+                n = int(req.query.get("n", 0)) or None
+                min_seq = int(req.query.get("min_seq", 0))
+            except ValueError:
+                return web.Response(status=400,
+                                    text='{"error":"n/min_seq must be ints"}',
+                                    content_type="application/json")
+            body = {"summary": tracer.summary(),
+                    "stage_breakdown": tracer.stage_breakdown(min_seq),
+                    "slowest": tracer.slowest(n, min_seq)}
+            return web.Response(text=json.dumps(body),
+                                content_type="application/json")
 
         profile_lock = asyncio.Lock()
 
@@ -280,6 +306,7 @@ class Engine:
         app.router.add_get("/readiness", readiness)
         app.router.add_get("/liveness", liveness)
         app.router.add_get("/metrics", metrics)
+        app.router.add_get("/trace", trace)
         app.router.add_post("/admin/swap", admin_swap)
         if hc.profiling_dir:
             app.router.add_post("/debug/profile", profile)
@@ -305,6 +332,10 @@ class Engine:
 
         init_distributed()  # no-op unless ARKFLOW_COORDINATOR is set
         ensure_plugins_loaded()
+        if self.config.tracing is not None:
+            # apply the `tracing:` block to the process-global tracer BEFORE
+            # streams build (they capture it at construction)
+            global_tracer().configure(self.config.tracing)
         await self._start_health_server()
         self._install_signal_handlers()
 

@@ -33,12 +33,13 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from arkflow_tpu.batch import MessageBatch, batch_fingerprint
+from arkflow_tpu.batch import META_INGEST_TIME, MessageBatch, batch_fingerprint
 from arkflow_tpu.components.base import Ack, Buffer, Input, Output, Resource, Temporary
 from arkflow_tpu.components.registry import build_component
 from arkflow_tpu.config import StreamConfig
 from arkflow_tpu.errors import ArkError, Disconnection, EndOfInput
 from arkflow_tpu.obs import global_registry
+from arkflow_tpu.obs.trace import activate, global_tracer, stage_span
 from arkflow_tpu.runtime.overload import (
     FairQueue,
     OverloadConfig,
@@ -68,6 +69,9 @@ class _WorkItem:
     #: None routes FairQueue items to the control lane, so admission MUST
     #: stamp it before putting — the default only applies pre-admission
     tenant: Optional[str] = None
+    #: the batch's parsed TraceContext (obs/trace.py), cached at creation so
+    #: later stages never re-parse the metadata column; None = untraced
+    trace: Optional[object] = None
 
 
 class _Done:
@@ -171,12 +175,21 @@ class Stream:
             ) if error_output_breaker else None
         )
 
+        #: per-batch tracing (obs/trace.py): the process-global tracer — the
+        #: engine configured it from the `tracing:` block before streams run
+        self.tracer = global_tracer()
+
         # runtime state
         self._pause_source = False  # resolved at run() from the input chain
         self._seq_assigned = 0
         self._seq_emitted = 0
         #: delivery attempts per failing batch fingerprint; cleared on success
         self._attempts: dict[bytes, int] = {}
+        #: trace identity of failing batches, keyed like _attempts: a broker
+        #: redelivery re-reads the raw record (no metadata columns), so the
+        #: retry re-enters the SAME trace via this table instead. Populated
+        #: only on failure paths — the all-healthy hot path never hashes.
+        self._trace_ids: dict[bytes, tuple[str, bool]] = {}
         #: set by the output stage when the reorder window drains below
         #: MAX_PENDING — backpressured workers wake on it instead of polling
         self._drained = asyncio.Event()
@@ -279,10 +292,11 @@ class Stream:
                 done, _ = await asyncio.wait(
                     {read_f, cancel_wait}, return_when=asyncio.FIRST_COMPLETED
                 )
+                read_dt = loop.time() - t_read
                 if read_f in done:
                     # only completed reads count: a cancel while idle must
                     # not record time-until-shutdown as read latency
-                    self.m_read_latency.observe(loop.time() - t_read)
+                    self.m_read_latency.observe(read_dt)
                 if read_f not in done:
                     read_f.cancel()
                     try:
@@ -319,7 +333,28 @@ class Stream:
                     logger.error("[%s] input read error: %s", self.name, e)
                     await asyncio.sleep(0.1)
                     continue
-                item = _WorkItem(batch.with_ingest_time(), ack, loop.time())
+                ctx = None
+                if self.tracer.enabled:
+                    # a trace context already on the batch means redelivery
+                    # (or an upstream tier stamped it): the SAME trace
+                    # accumulates the retry's spans. First deliveries root a
+                    # fresh trace here; input_decode covers read+decode.
+                    ctx = batch.trace_context()
+                    redelivered = ctx is not None
+                    if ctx is None:
+                        # a broker redelivery of a failed batch re-enters
+                        # its original trace (fingerprint-keyed, failure
+                        # paths only); fresh batches root a new one
+                        ctx = self._redelivered_trace(batch)
+                        redelivered = ctx is not None
+                        if ctx is None:
+                            ctx = self.tracer.begin()
+                        batch = batch.with_trace(ctx)
+                    self.tracer.record(
+                        ctx, "input_decode", read_dt,
+                        attrs=({"redelivered": True} if redelivered else None))
+                item = _WorkItem(batch.with_ingest_time(), ack, loop.time(),
+                                 trace=ctx)
                 self.m_batches_in.inc()
                 self.m_rows_in.inc(batch.num_rows)
                 if self.buffer is not None:
@@ -345,13 +380,53 @@ class Stream:
                     await input_q.put(_DONE)
                 return
             batch, ack = item
-            work = _WorkItem(batch, ack, asyncio.get_running_loop().time())
+            ctx = None
+            if self.tracer.enabled:
+                batch, ctx = self._trace_emission(batch)
+            work = _WorkItem(batch, ack, asyncio.get_running_loop().time(),
+                             trace=ctx)
             if await self._admit_or_shed(work):
                 await input_q.put(work)
+
+    def _trace_emission(self, batch: MessageBatch):
+        """Trace bookkeeping for a buffer emission. A merged emission (rows
+        from several source batches) starts a NEW trace whose root span
+        records parent links to every source trace; the sources are closed
+        with status ``coalesced`` pointing at the merged id. A pass-through
+        emission keeps its context. Either way the buffer/coalescer wait is
+        recorded — from the buffer's own monotonic measurement when it
+        provides one (``last_emission_wait_s``), else from the oldest row's
+        ingest time."""
+        wait_s = getattr(self.buffer, "last_emission_wait_s", None)
+        if wait_s is None:
+            ingest = batch.get_meta(META_INGEST_TIME)
+            wait_s = (max(0.0, time.time() - float(ingest) / 1000.0)
+                      if ingest is not None else 0.0)
+        contexts = batch.source_trace_contexts()
+        if len(contexts) <= 1:
+            # no trace column (e.g. a window buffer's SQL projected the
+            # metadata away): trace via the work item only — re-stamping
+            # would inject a metadata column into user-shaped query output
+            ctx = contexts[0] if contexts else self.tracer.begin()
+            self.tracer.record(ctx, "buffer_wait", wait_s)
+            return batch, ctx
+        # merged emission: fresh trace, parent links both ways
+        sources = [c.trace_id for c in contexts]
+        ctx = self.tracer.begin()
+        self.tracer.record(ctx, "coalesce_wait", wait_s,
+                           attrs={"links": sources})
+        for src in contexts:
+            self.tracer.finish(src, "coalesced",
+                               attrs={"merged_into": ctx.trace_id})
+        return batch.with_trace(ctx), ctx
 
     async def _do_processor(self, input_q: asyncio.Queue, output_q: asyncio.Queue) -> None:
         """Worker: pipeline.process with seq stamping + backpressure (THE hot loop)."""
         loop = asyncio.get_running_loop()
+        # the stage name distinguishes WDRR scheduling waits from plain
+        # FIFO queue waits in the breakdown (same measurement point)
+        queue_stage = ("fair_queue_wait" if isinstance(input_q, FairQueue)
+                       else "queue_wait")
         while True:
             # backpressure: event-driven wakeup the moment the reorder window
             # drains (the reference sleeps 100-500ms, ref :263-273; a poll
@@ -372,6 +447,7 @@ class Stream:
                 return
             wait = loop.time() - item.enqueued_at
             self.m_queue_wait.observe(wait)
+            self.tracer.record(item.trace, queue_stage, wait)
             if self.overload is not None:
                 self.overload.on_dequeue(wait, loop.time(), tenant=item.tenant)
                 remaining = item.batch.remaining_deadline_ms(
@@ -387,7 +463,12 @@ class Stream:
             self.m_pending.set(self._seq_assigned - self._seq_emitted)
             t0 = loop.time()
             try:
-                results = await self.pipeline.process(item.batch)
+                # activate the batch's trace scope: runner/processor spans
+                # (infeed prep, device step, cluster hops) nest under the
+                # process span with zero API plumbing
+                with activate(self.tracer, item.trace):
+                    with stage_span("process"):
+                        results = await self.pipeline.process(item.batch)
                 err = None
             except Exception as e:  # processor failure -> error path
                 results = []
@@ -480,6 +561,11 @@ class Stream:
         error_output tagged ``overloaded`` (preferred — terminal, keeps the
         accounting identity), else nack so the broker redelivers after the
         brownout, else log-and-ack (counted in ``arkflow_shed_total``)."""
+        # forced sampling: a shed/expired batch is exactly the trace an
+        # operator needs — commit it regardless of the head-sampling draw
+        self.tracer.finish(item.trace,
+                           "deadline" if reason == "deadline" else "shed",
+                           attrs={"reason": reason})
         if self.error_output is not None:
             await self._error_route_or_drop(
                 item.batch, {"error": "overloaded", "shed_reason": reason},
@@ -521,17 +607,38 @@ class Stream:
         is non-empty); the all-healthy hot path never pays for it."""
         return batch_fingerprint(batch)
 
-    def _bump_attempts(self, batch: MessageBatch) -> int:
+    def _bump_attempts(self, batch: MessageBatch, trace=None) -> int:
         key = self._fingerprint(batch)
         n = self._attempts.get(key, 0) + 1
         if key not in self._attempts and len(self._attempts) >= MAX_TRACKED_ATTEMPTS:
-            self._attempts.pop(next(iter(self._attempts)))
+            evicted = next(iter(self._attempts))
+            self._attempts.pop(evicted)
+            self._trace_ids.pop(evicted, None)
         self._attempts[key] = n
+        if trace is not None:
+            # remember the failing batch's trace identity so its broker
+            # redelivery (raw record, no columns) re-enters the same trace
+            self._trace_ids[key] = (trace.trace_id, trace.sampled)
         return n
 
     def _clear_attempts(self, batch: MessageBatch) -> None:
         if self._attempts:
-            self._attempts.pop(self._fingerprint(batch), None)
+            key = self._fingerprint(batch)
+            self._attempts.pop(key, None)
+            self._trace_ids.pop(key, None)
+
+    def _redelivered_trace(self, batch: MessageBatch):
+        """Trace context of a previously-failed delivery of this batch, or
+        None. Hashes only while failures are outstanding (the table is
+        non-empty) — same discipline as the attempt budget."""
+        if not self._trace_ids:
+            return None
+        from arkflow_tpu.obs.trace import TraceContext
+
+        hit = self._trace_ids.get(self._fingerprint(batch))
+        if hit is None:
+            return None
+        return TraceContext(trace_id=hit[0], sampled=hit[1])
 
     async def _safe_ack(self, ack: Ack) -> None:
         """Acks confirm work already durably written; a failing ack must not
@@ -601,7 +708,12 @@ class Stream:
     async def _emit(self, item: _WorkItem, results: list[MessageBatch], err: Optional[Exception]) -> None:
         if err is not None:
             self.m_errors.inc()
-            attempts = self._bump_attempts(item.batch)
+            attempts = self._bump_attempts(item.batch, trace=item.trace)
+            # forced sampling: every failed attempt commits its trace (the
+            # redelivery re-enters the SAME trace id at _do_input)
+            self.tracer.finish(item.trace, "error",
+                               attrs={"error": str(err)[:200],
+                                      "attempt": attempts})
             if attempts < self.max_delivery_attempts and getattr(
                     item.ack, "redeliverable", False):
                 # transient failures (model OOM, lookup table blip) heal via
@@ -623,10 +735,12 @@ class Stream:
             return
         if not results:
             # ProcessResult::None -> drop + ack (ref :301-303)
+            self.tracer.finish(item.trace, "ok", attrs={"results": 0})
             await self._safe_ack(item.ack)
             return
         loop = asyncio.get_running_loop()
         try:
+            t_write0 = loop.time()
             for b in results:
                 t_w = loop.time()
                 await self._write_guarded(self.output, self._out_breaker,
@@ -635,9 +749,16 @@ class Stream:
                 self.m_write_latency.observe(loop.time() - t_w)
                 self.m_batches_out.inc()
                 self.m_rows_out.inc(b.num_rows)
+            self.tracer.record(item.trace, "output_write",
+                               loop.time() - t_write0,
+                               attrs=({"batches": len(results)}
+                                      if len(results) > 1 else None))
         except Exception as e:
             self.m_write_errors.inc()
-            attempts = self._bump_attempts(item.batch)
+            attempts = self._bump_attempts(item.batch, trace=item.trace)
+            self.tracer.finish(item.trace, "error",
+                               attrs={"error": f"output write failed: {e}"[:200],
+                                      "attempt": attempts})
             if self.error_output is not None and (
                     attempts >= self.max_delivery_attempts
                     or not getattr(item.ack, "redeliverable", False)):
@@ -651,6 +772,7 @@ class Stream:
             return
         self._clear_attempts(item.batch)
         ingest = item.batch.get_meta("__meta_ingest_time")
+        e2e = None
         if ingest is not None:
             e2e = max(0.0, time.time() - ingest / 1000.0)
             self.m_e2e_latency.observe(e2e)
@@ -658,6 +780,7 @@ class Stream:
                 # tenant-labeled delivered latency: what the noisy-tenant
                 # soak's per-tenant p99 SLO assertion reads
                 self.overload.observe_tenant_latency(item.tenant, e2e)
+        self.tracer.finish(item.trace, "ok", e2e_s=e2e)
         await self._safe_ack(item.ack)
 
 

@@ -268,9 +268,14 @@ class MicroBatchCoalescer:
         self._token_field = token_field
         self._token_bytes = token_bytes
         self._max_row_tokens = max_row_tokens
-        self._held: deque[tuple["MessageBatch", "Ack", Optional[np.ndarray]]] = deque()
+        #: held entries: (batch, ack, token-estimates, monotonic add time) —
+        #: the add time of the oldest row consumed by a pop becomes
+        #: ``last_pop_wait_s``, the coalescer-wait the trace layer records
+        self._held: deque[tuple["MessageBatch", "Ack", Optional[np.ndarray], float]] = deque()
         #: suspect (previously-nacked) batches, emitted alone and first
-        self._solo: deque[tuple["MessageBatch", "Ack", Optional[np.ndarray]]] = deque()
+        self._solo: deque[tuple["MessageBatch", "Ack", Optional[np.ndarray], float]] = deque()
+        #: monotonic wait of the oldest row in the LAST popped emission
+        self.last_pop_wait_s: float = 0.0
         #: fingerprint -> row count of each currently-suspect source batch
         self._suspects: dict[bytes, int] = {}
         #: cheap prefilter so healthy adds/acks skip hashing: row counts of
@@ -366,16 +371,24 @@ class MicroBatchCoalescer:
         return _SuspectObserverAck(self, batch, ack)
 
     def add(self, batch: "MessageBatch", ack: "Ack") -> None:
+        import time
+
         ack = self._observed(batch, ack)
         lens = self._row_tokens(batch) if self.token_budget is not None else None
+        entry = (batch, ack, lens, time.monotonic())
         if (batch.num_rows in self._suspect_rows
                 and self._fingerprint(batch) in self._suspects):
-            self._solo.append((batch, ack, lens))
+            self._solo.append(entry)
         else:
-            self._held.append((batch, ack, lens))
+            self._held.append(entry)
         self._rows += batch.num_rows
         if lens is not None:
             self._tokens += int(lens.sum())
+
+    def _note_wait(self, oldest_t_add: float) -> None:
+        import time
+
+        self.last_pop_wait_s = max(0.0, time.monotonic() - oldest_t_add)
 
     def _carve(self, rows: int) -> tuple["MessageBatch", "Ack"]:
         """Take exactly ``rows`` held rows as one merged emission, splitting
@@ -386,8 +399,9 @@ class MicroBatchCoalescer:
         parts: list["MessageBatch"] = []
         acks: list["Ack"] = []
         need = rows
+        self._note_wait(self._held[0][3])
         while need > 0:
-            batch, ack, _ = self._held.popleft()
+            batch, ack, _, t_add = self._held.popleft()
             if batch.num_rows <= need:
                 parts.append(batch)
                 acks.append(ack)
@@ -396,7 +410,9 @@ class MicroBatchCoalescer:
                 head_ack, tail_ack = split_ack(ack, 2)
                 parts.append(batch.slice(0, need))
                 acks.append(head_ack)
-                self._held.appendleft((batch.slice(need), tail_ack, None))
+                # the tail keeps its ORIGINAL add time: its rows have been
+                # waiting since then, and the next pop's wait must say so
+                self._held.appendleft((batch.slice(need), tail_ack, None, t_add))
                 need = 0
         self._rows -= rows
         return MessageBatch.concat(parts), VecAck(acks)
@@ -415,8 +431,10 @@ class MicroBatchCoalescer:
         took_rows = 0
         took_tokens = 0
         need = budget
+        if self._held:
+            self._note_wait(self._held[0][3])
         while need > 0 and self._held:
-            batch, ack, lens = self._held[0]
+            batch, ack, lens, t_add = self._held[0]
             total = int(lens.sum())
             if total <= need:
                 self._held.popleft()
@@ -447,7 +465,7 @@ class MicroBatchCoalescer:
             head_ack, tail_ack = split_ack(ack, 2)
             parts.append(batch.slice(0, k))
             acks.append(head_ack)
-            self._held.appendleft((batch.slice(k), tail_ack, lens[k:]))
+            self._held.appendleft((batch.slice(k), tail_ack, lens[k:], t_add))
             took_rows += k
             took_tokens += int(cs[k - 1])
             break
@@ -458,7 +476,8 @@ class MicroBatchCoalescer:
     def _pop_solo(self) -> Optional[tuple["MessageBatch", "Ack"]]:
         if not self._solo:
             return None
-        batch, ack, lens = self._solo.popleft()
+        batch, ack, lens, t_add = self._solo.popleft()
+        self._note_wait(t_add)
         self._rows -= batch.num_rows
         if lens is not None:
             self._tokens -= int(lens.sum())
@@ -497,18 +516,20 @@ class MicroBatchCoalescer:
         if not self._held:
             return None
         if self.token_budget is not None:
+            self._note_wait(self._held[0][3])
             self._tokens = 0
-            self._rows -= sum(b.num_rows for b, _, _ in self._held)
-            parts = [b for b, _, _ in self._held]
-            acks = VecAck([a for _, a, _ in self._held])
+            self._rows -= sum(b.num_rows for b, _, _, _ in self._held)
+            parts = [b for b, _, _, _ in self._held]
+            acks = VecAck([a for _, a, _, _ in self._held])
             self._held.clear()
             return MessageBatch.concat(parts), acks
         held_rows = self._rows
         fitting = [b for b in self.buckets if b <= held_rows]
         if fitting:
             return self._carve(fitting[-1])
-        parts = [b for b, _, _ in self._held]
-        acks = VecAck([a for _, a, _ in self._held])
+        self._note_wait(self._held[0][3])
+        parts = [b for b, _, _, _ in self._held]
+        acks = VecAck([a for _, a, _, _ in self._held])
         self._held.clear()
         self._rows = 0
         return MessageBatch.concat(parts), acks

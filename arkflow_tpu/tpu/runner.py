@@ -38,6 +38,7 @@ import numpy as np
 from arkflow_tpu.errors import ConfigError, RunnerDead, StepDeadlineExceeded
 from arkflow_tpu.models import get_model
 from arkflow_tpu.obs import global_registry
+from arkflow_tpu.obs.trace import record_stage
 from arkflow_tpu.parallel.mesh import (
     MeshSpec,
     batch_sharding,
@@ -344,6 +345,14 @@ class ModelRunner:
         self.m_stall_s = reg.counter(
             "arkflow_tpu_infeed_stall_seconds_total",
             "wall seconds the device sat idle between steps (host-bound)", labels)
+        # the per-gap distribution behind the stall total: the direct
+        # before/after measurement for dispatch-depth / double-buffering
+        # work (ROADMAP item 5) — p50 gap >> 0 means host prep serializes
+        # with device compute
+        self.m_idle_gap = reg.histogram(
+            "arkflow_tpu_device_idle_gap_seconds",
+            "gap between step N completing and step N+1 launching "
+            "(device idle between consecutive steps)", labels)
         self.m_prep = reg.histogram(
             "arkflow_tpu_infeed_prep_seconds",
             "host-side infeed prep (pad/stage/validate) per step", labels)
@@ -919,7 +928,9 @@ class ModelRunner:
     def _track_dispatch(self, now: float) -> None:
         if self._inflight == 0:
             if self._last_idle_start is not None:
-                self.m_stall_s.inc(now - self._last_idle_start)
+                gap = now - self._last_idle_start
+                self.m_stall_s.inc(gap)
+                self.m_idle_gap.observe(gap)
             self._busy_start = now
         self._inflight += 1
         self.m_inflight.set(self._inflight)
@@ -968,7 +979,9 @@ class ModelRunner:
             ])
             return {k: np.concatenate([c[k] for c in chunks]) for k in chunks[0]}
         await self.core.heal_gate()
+        t_prep0 = time.perf_counter()
         padded, n = await loop.run_in_executor(None, self._prep, inputs)
+        record_stage("infeed_prep", time.perf_counter() - t_prep0)
         first = self._note_shape(padded)
         bucket_rows = next(iter(padded.values())).shape[0]
         deadline = self.core.deadline_for(first)
@@ -977,8 +990,13 @@ class ModelRunner:
         self._ensure_sems()
 
         async def step(padded):
+            t_sem = time.perf_counter()
             async with self._inflight_sem:
                 t0 = time.perf_counter()
+                if t0 - t_sem > 0.0005:
+                    # waiting on the in-flight window is device queueing,
+                    # not compute — its own stage so the breakdown shows it
+                    record_stage("device_dispatch_wait", t0 - t_sem)
                 self._track_dispatch(t0)
                 try:
                     if deadline is None:
@@ -996,7 +1014,13 @@ class ModelRunner:
                     # an abandoned step counts as complete for duty-cycle
                     # accounting: the device is no longer doing useful work
                     self._track_complete(time.perf_counter())
-                self.m_infer.observe(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                self.m_infer.observe(dt)
+                # first-compile steps get their own stage: one compile can
+                # be 1000x a warm step, and mixing the two makes both the
+                # p99 and the share-of-e2e unreadable
+                record_stage("device_step_first" if first else "device_step",
+                             dt, attrs={"bucket_rows": bucket_rows})
                 return out
 
         try:

@@ -301,14 +301,24 @@ async def run_bench(seconds: float, batch: int, seq: int, tiny: bool,
         controller.result = (rows_out.value - rows_start, elapsed)
 
     controller.result = (0, 1.0)
+    from arkflow_tpu.obs.trace import global_tracer
+
+    trace_seq0 = global_tracer().commit_seq()
     await asyncio.gather(stream.run(cancel), controller())
     rows, elapsed = controller.result
+    # per-stage latency attribution for THIS phase only (trace-layer delta):
+    # a rows/s regression names its stage instead of just shrinking a number
+    breakdown = global_tracer().stage_breakdown(trace_seq0)
     return {
         "rows_per_sec": rows / elapsed if elapsed > 0 else 0.0,
         "p50_ms": e2e.quantile(0.50) * 1000.0,
         "p99_ms": e2e.quantile(0.99) * 1000.0,
         "rows": rows,
         "elapsed_s": elapsed,
+        "stage_breakdown": {
+            stage: {"p50_ms": s["p50_ms"], "p99_ms": s["p99_ms"],
+                    "share_of_e2e": s["share_of_e2e"]}
+            for stage, s in breakdown["stages"].items()},
     }
 
 
@@ -428,6 +438,7 @@ def main() -> None:
                            # knob record (uniform across phases): the SQL
                            # anchor has no model, so both are inert here
                            "packing": False, "serving_dtype": None,
+                           "stage_breakdown": res.get("stage_breakdown", {}),
                            # no device infeed in the SQL anchor: both report 0
                            **_infeed_detail(infeed0, _infeed_host_metrics())},
             }
@@ -575,6 +586,7 @@ def main() -> None:
                         # unpacked (tiny batches); see _latency_dtype
                         "packing": False,
                         "serving_dtype": _latency_dtype(tiny),
+                        "stage_breakdown": lat.get("stage_breakdown", {}),
                     },
                 }
             ),
@@ -685,6 +697,9 @@ def _print_headline(res: dict, tiny: bool, batch: int, seq: int,
                                   else os.environ.get("BENCH_SOFTMAX_DTYPE", "bfloat16")),
                 **_packing_detail(batch, seq),
                 **_flops_detail(res["rows_per_sec"], exec_rate, seq, tiny),
+                # trace-layer per-stage attribution for THIS phase: a
+                # regression names the stage that slowed down
+                "stage_breakdown": res.get("stage_breakdown", {}),
                 **lat_detail,
             },
         }

@@ -234,9 +234,12 @@ def test_infer_round_trip_preserves_metadata_and_outputs():
         d = ClusterDispatcher([_url(srv)], name="t-rt", heartbeat_s=999)
         try:
             await d.start()
+            from arkflow_tpu.obs.trace import TraceContext
+
             batch = (MessageBatch.new_binary([b"abc", b"def"])
                      .with_source("kafka").with_tenant("acme")
-                     .with_priority(2))
+                     .with_priority(2)
+                     .with_trace(TraceContext("cafe0123cafe0123")))
             out = await d.dispatch(batch)
             assert len(out) == 1
             assert out[0].to_binary() == [b"ABC", b"DEF"]
@@ -244,6 +247,8 @@ def test_infer_round_trip_preserves_metadata_and_outputs():
             assert out[0].tenant() == "acme"
             assert out[0].priority_band() == 2
             assert out[0].get_meta("__meta_source") == "kafka"
+            # the trace context survived the flight round trip too
+            assert out[0].trace_context().trace_id == "cafe0123cafe0123"
         finally:
             await d.close()
             await srv.stop()

@@ -43,6 +43,114 @@ def test_metrics_same_name_same_labels_identity():
     assert a is b and a is not c
 
 
+def _parse_prometheus_text(text: str) -> dict:
+    """Minimal conformant parser for the Prometheus text format: returns
+    {family: {"type": kind, "samples": [(name, labels_dict, value)]}} and
+    enforces the grouping rule (all samples of a family contiguous, TYPE
+    first)."""
+    import re
+
+    families: dict = {}
+    current = None
+    closed: set[str] = set()
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$')
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name not in families, f"duplicate TYPE for {name}"
+            if current is not None:
+                closed.add(current)
+            current = name
+            families[name] = {"type": kind, "samples": []}
+            continue
+        m = sample_re.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, _, labelstr, value = m.groups()
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in families:
+                base = name[: -len(suffix)]
+        assert base in families, f"sample {name} before its TYPE header"
+        assert base == current, f"family {base} not contiguous"
+        assert base not in closed, f"family {base} re-opened"
+        labels = {k: v.replace('\\"', '"').replace("\\n", "\n")
+                  .replace("\\\\", "\\")
+                  for k, v in label_re.findall(labelstr or "")}
+        families[base]["samples"].append((name, labels, float(value)))
+    return families
+
+
+def test_exposition_parses_back_and_histograms_conform():
+    """Prometheus text-format conformance: TYPE headers, contiguous
+    families (label sets minted at different times must not interleave),
+    cumulative buckets with a +Inf terminal equal to _count, and escaped
+    label values — all proven by parsing the exposition back."""
+    reg = MetricsRegistry()
+    # interleave family creation on purpose: a then b then a-with-new-labels
+    reg.counter("fam_a_total", "a", {"t": "x"}).inc(1)
+    reg.gauge("fam_b", "b").set(2)
+    reg.counter("fam_a_total", "a", {"t": "y"}).inc(3)
+    h = reg.histogram("fam_h_seconds", "h", {"stream": "s"},
+                      buckets=[0.1, 1.0])
+    for v in (0.05, 0.5, 0.7, 5.0):
+        h.observe(v)
+    # hostile label value: quotes, backslash, newline (tenant ids are
+    # attacker-influenced)
+    reg.counter("fam_evil_total", "e",
+                {"tenant": 'a"b\\c\nd'}).inc(1)
+    fams = _parse_prometheus_text(reg.exposition())
+    assert fams["fam_a_total"]["type"] == "counter"
+    assert len(fams["fam_a_total"]["samples"]) == 2  # contiguous despite
+    assert fams["fam_h_seconds"]["type"] == "histogram"
+    hs = {n: (lab, v) for n, lab, v in fams["fam_h_seconds"]["samples"]}
+    buckets = [(lab["le"], v) for n, lab, v in
+               fams["fam_h_seconds"]["samples"] if n.endswith("_bucket")]
+    # cumulative, +Inf terminal == _count
+    assert [v for _, v in buckets] == [1.0, 3.0, 4.0]
+    assert buckets[-1][0] == "+Inf"
+    assert hs["fam_h_seconds_count"][1] == 4.0
+    assert abs(hs["fam_h_seconds_sum"][1] - 6.25) < 1e-9
+    # the hostile label round-tripped exactly
+    (_, lab, _), = fams["fam_evil_total"]["samples"]
+    assert lab["tenant"] == 'a"b\\c\nd'
+
+
+def test_metrics_are_thread_safe_under_contention():
+    """Counter.inc / Gauge.inc / Histogram.observe are hit from runner
+    executor threads and the watchdog concurrently with the event loop;
+    unguarded += loses updates (the PR-4/7 regression this pins down)."""
+    import threading
+
+    reg = MetricsRegistry()
+    c = reg.counter("hammer_total")
+    g = reg.gauge("hammer_gauge")
+    h = reg.histogram("hammer_seconds", buckets=[0.5])
+    N, T = 20_000, 8
+
+    def work():
+        for _ in range(N):
+            c.inc()
+            g.inc(2.0)
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=work) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == N * T
+    assert g.value == 2.0 * N * T
+    assert h.count == N * T
+    assert h.counts[0] == N * T  # bucket counts can't lose updates either
+    assert abs(h.sum - 0.25 * N * T) < 1e-6
+
+
 def test_remap_processor():
     proc = build_component(
         "processor",

@@ -1,0 +1,631 @@
+"""End-to-end per-batch tracing (obs/trace.py): context plumbing, sampling,
+the bounded span store, trace-context survival across redelivery /
+split-ack / coalescer merges / quarantine, stage spans through a live
+stream, and cross-tier stitching over the cluster flight plane."""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+import pyarrow as pa
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from arkflow_tpu.batch import META_EXT_TRACE, MessageBatch, batch_fingerprint
+from arkflow_tpu.components import Processor, ensure_plugins_loaded
+from arkflow_tpu.config import EngineConfig, StreamConfig
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.obs.trace import (
+    FORCE_STATUSES,
+    Span,
+    TraceContext,
+    Tracer,
+    TracingConfig,
+    activate,
+    global_tracer,
+    record_stage,
+    stage_span,
+)
+
+ensure_plugins_loaded()
+
+
+def _fresh_global(sample_rate: float = 1.0, **kw) -> "Tracer":
+    t = global_tracer()
+    t.configure(TracingConfig(sample_rate=sample_rate, **kw), tier="ingest")
+    t.clear()
+    return t
+
+
+# -- context + config --------------------------------------------------------
+
+
+def test_trace_context_roundtrip_and_tolerance():
+    ctx = TraceContext("abc123", "span9", sampled=False)
+    back = TraceContext.from_json(ctx.to_json())
+    assert back == ctx
+    # dict form (the flight request embeds it un-stringified)
+    assert TraceContext.from_json(ctx.to_dict()) == ctx
+    # malformed column values never raise — the batch continues untraced
+    for bad in (None, "", "not json", "[]", '{"p":"x"}', b"\xff", 42):
+        assert TraceContext.from_json(bad) is None
+
+
+def test_tracing_config_validation():
+    cfg = TracingConfig.from_mapping({"sample_rate": 0.5, "max_traces": 7})
+    assert cfg.sample_rate == 0.5 and cfg.max_traces == 7 and cfg.enabled
+    assert TracingConfig.from_mapping(None).enabled
+    for bad in ({"sample_rate": 1.5}, {"sample_rate": -0.1},
+                {"sample_rate": True}, {"max_traces": 0},
+                {"max_spans_per_trace": "x"}, {"enabled": "yes"}, 3):
+        with pytest.raises(ConfigError):
+            TracingConfig.from_mapping(bad)
+
+
+def test_batch_trace_column_survives_slice_concat_and_quarantine_tagging():
+    ctx = TraceContext("feedbeef00000001")
+    b = MessageBatch.new_binary([b"a", b"b", b"c", b"d"]).with_trace(ctx)
+    assert b.trace_context() == ctx
+    # split-ack share slices keep the context (coalescer carve path)
+    head, tail = b.slice(0, 2), b.slice(2)
+    assert head.trace_context() == ctx and tail.trace_context() == ctx
+    # quarantine tagging (extra ext metadata) keeps it too
+    tagged = b.with_ext_metadata({"error": "boom", "delivery_attempts": "3"})
+    assert tagged.trace_context() == ctx
+    # a merged batch exposes each source's trace id, first-seen order
+    other = MessageBatch.new_binary([b"x"]).with_trace(
+        TraceContext("feedbeef00000002"))
+    merged = MessageBatch.concat([head, other])
+    assert merged.source_trace_ids() == ["feedbeef00000001",
+                                         "feedbeef00000002"]
+    # the trace column is a per-delivery artifact: fingerprints (dedup,
+    # routing affinity, attempt budgets) must not see it
+    assert batch_fingerprint(b) == batch_fingerprint(
+        MessageBatch.new_binary([b"a", b"b", b"c", b"d"]))
+
+
+# -- tracer core -------------------------------------------------------------
+
+
+def test_head_sampling_and_forced_commit():
+    t = Tracer(config=TracingConfig(sample_rate=0.0))
+    ctx = t.begin()
+    assert ctx is not None and not ctx.sampled
+    t.record(ctx, "stage_a", 0.01)
+    assert t.finish(ctx, "ok") is False  # unsampled healthy trace drops
+    for status in FORCE_STATUSES:
+        ctx = t.begin()
+        t.record(ctx, "stage_a", 0.02)
+        assert t.finish(ctx, status) is True  # pathological always commits
+    assert t.summary()["forced_samples"] == len(FORCE_STATUSES)
+    assert all(r["forced"] for r in t.slowest(10))
+    # sampled traces commit on ok
+    t2 = Tracer(config=TracingConfig(sample_rate=1.0))
+    ctx = t2.begin()
+    assert ctx.sampled
+    assert t2.finish(ctx, "ok", e2e_s=0.5) is True
+    assert t2.slowest(1)[0]["e2e_ms"] == 500.0
+
+
+def test_store_bounds_ring_spans_and_open_table():
+    t = Tracer(config=TracingConfig(max_traces=3, max_open=4,
+                                    max_spans_per_trace=2))
+    for i in range(6):
+        ctx = t.begin()
+        for _ in range(5):  # 3 over the per-trace span cap
+            t.record(ctx, "s", 0.001)
+        t.finish(ctx, "ok")
+    assert len(t.slowest(100)) == 3  # ring keeps the newest 3
+    assert all(len(r["spans"]) == 2 and r["dropped_spans"] == 3
+               for r in t.slowest(100))
+    # open-table bound: unfinished traces evict oldest-first
+    for i in range(10):
+        t.record(TraceContext(f"open-{i}"), "s", 0.001)
+    assert t.open_evicted > 0
+    assert t.summary()["traces_open"] <= 4
+
+
+def test_stage_breakdown_quantiles_and_share():
+    t = Tracer(config=TracingConfig())
+    for dur in (0.010, 0.020, 0.030):
+        ctx = t.begin()
+        t.record(ctx, "work", dur)
+        t.record(ctx, "wait", 0.010)
+        t.finish(ctx, "ok", e2e_s=dur + 0.010)
+    bd = t.stage_breakdown()
+    assert bd["traces"] == 3
+    assert bd["stages"]["work"]["count"] == 3
+    assert bd["stages"]["work"]["p50_ms"] == 20.0
+    assert bd["stages"]["wait"]["total_ms"] == 30.0
+    share = bd["stages"]["work"]["share_of_e2e"]
+    assert 0.6 < share < 0.7  # 60ms of work over 90ms summed e2e
+    # min_seq gives delta views (bench per-phase attribution)
+    seq = t.commit_seq()
+    ctx = t.begin()
+    t.record(ctx, "late", 0.001)
+    t.finish(ctx, "ok")
+    delta = t.stage_breakdown(seq)
+    assert delta["traces"] == 1 and list(delta["stages"]) == ["late"]
+
+
+def test_stage_span_scope_nesting_and_noop_off_scope():
+    t = Tracer(config=TracingConfig())
+    # outside any scope: helpers are no-ops, never errors
+    assert record_stage("orphan", 0.1) == ""
+    with stage_span("orphan2"):
+        pass
+    ctx = t.begin()
+    with activate(t, ctx):
+        with stage_span("outer"):
+            record_stage("inner", 0.005)
+    t.finish(ctx, "ok")
+    spans = {s["stage"]: s for s in t.slowest(1)[0]["spans"]}
+    assert spans["inner"]["parent_id"] == spans["outer"]["span_id"]
+    assert spans["outer"]["parent_id"] == ""  # parented at the trace root
+
+
+def test_adopt_and_export_cross_tier_spans():
+    worker = Tracer(tier="worker:w1", config=TracingConfig())
+    ingest = Tracer(tier="ingest", config=TracingConfig())
+    ctx = ingest.begin()
+    hop_ctx = ctx.with_parent("hopspan01")
+    worker.record(hop_ctx, "remote_step", 0.042)
+    exported = worker.export_open(hop_ctx)
+    assert worker.summary()["traces_open"] == 0  # popped, not leaked
+    ingest.record(ctx, "cluster_hop", 0.050, span_id="hopspan01")
+    ingest.adopt_spans(ctx, exported)
+    ingest.finish(ctx, "ok")
+    spans = {s["stage"]: s for s in ingest.slowest(1)[0]["spans"]}
+    assert spans["remote_step"]["tier"] == "worker:w1"
+    assert spans["remote_step"]["parent_id"] == "hopspan01"
+    # adopted durations survive the JSON hop
+    assert spans["remote_step"]["dur_ms"] == 42.0
+    # malformed frames are skipped, not fatal
+    ingest.adopt_spans(ctx, [{"nope": 1}, None and {}])
+
+
+def test_env_kill_switch_survives_config_application(monkeypatch):
+    """ARKFLOW_TRACE=0 must hold through the engine applying a `tracing:`
+    block that doesn't explicitly say enabled — only an explicit
+    `enabled: true` overrides the env."""
+    monkeypatch.setenv("ARKFLOW_TRACE", "0")
+    assert TracingConfig.from_mapping(None).enabled is False
+    assert TracingConfig.from_mapping({"sample_rate": 0.5}).enabled is False
+    assert TracingConfig.from_mapping({"enabled": True}).enabled is True
+    monkeypatch.delenv("ARKFLOW_TRACE")
+    assert TracingConfig.from_mapping(None).enabled is True
+
+
+def test_finish_fallback_e2e_counts_root_spans_only():
+    """Without an explicit e2e, nested children (device step inside
+    process) must not double-count the trace's latency."""
+    t = Tracer(config=TracingConfig())
+    ctx = t.begin()
+    with activate(t, ctx):
+        with stage_span("process"):
+            record_stage("device_step", 0.04)
+    # give the outer span a known size by recording a root sibling too
+    t.record(ctx, "queue_wait", 0.01)
+    t.finish(ctx, "error")  # forced path = the fallback's main consumer
+    rec = t.slowest(1)[0]
+    roots = sum(s["dur_ms"] for s in rec["spans"] if not s["parent_id"])
+    assert rec["e2e_ms"] == pytest.approx(roots, abs=0.01)
+    total = sum(s["dur_ms"] for s in rec["spans"])
+    assert rec["e2e_ms"] < total  # the nested child was NOT double-counted
+
+
+def test_disabled_tracer_is_fully_inert():
+    t = Tracer(config=TracingConfig(enabled=False))
+    assert t.begin() is None
+    assert t.record(None, "s", 1.0) == ""
+    assert t.finish(None, "error") is False
+    assert t.slowest(5) == [] and t.stage_breakdown()["traces"] == 0
+
+
+# -- stream-level: spans through a live pipeline -----------------------------
+
+
+class _Sleep(Processor):
+    """Deterministic ~stage cost so span sums are measurable."""
+
+    def __init__(self, seconds: float = 0.02, fail_calls=()):
+        self.seconds = seconds
+        self.calls = 0
+        self.fail_calls = set(fail_calls)
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        self.calls += 1
+        if self.calls in self.fail_calls:
+            raise RuntimeError(f"injected failure on call {self.calls}")
+        await asyncio.sleep(self.seconds)
+        return [batch]
+
+
+def _run_stream(cfg_map: dict, timeout: float = 30.0,
+                patch=None) -> None:
+    from arkflow_tpu.runtime import build_stream
+
+    async def go():
+        stream = build_stream(StreamConfig.from_mapping(cfg_map))
+        if patch is not None:
+            patch(stream)
+        cancel = asyncio.Event()
+        await asyncio.wait_for(stream.run(cancel), timeout=timeout)
+
+    asyncio.run(asyncio.wait_for(go(), timeout=timeout + 5))
+
+
+def test_stream_trace_covers_the_path_and_sums_to_e2e():
+    tracer = _fresh_global()
+    proc = _Sleep(0.03)
+    _run_stream({
+        "name": "t-covered",
+        "input": {"type": "memory", "messages": ["m1", "m2", "m3"]},
+        "pipeline": {"thread_num": 1, "processors": []},
+        "output": {"type": "drop"},
+    }, patch=lambda s: s.pipeline.processors.append(proc))
+    recs = [r for r in tracer.slowest(10) if r["status"] == "ok"]
+    assert len(recs) == 3
+    for rec in recs:
+        stages = {s["stage"] for s in rec["spans"]}
+        assert {"input_decode", "queue_wait", "process",
+                "output_write"} <= stages
+        # top-level spans account for the delivered latency: their sum must
+        # land within 10% of measured e2e (+2ms scheduling-noise floor)
+        covered = sum(s["dur_ms"] for s in rec["spans"]
+                      if s["stage"] in ("queue_wait", "process",
+                                        "output_write"))
+        assert covered <= rec["e2e_ms"] + 2.0
+        assert covered >= rec["e2e_ms"] * 0.9 - 2.0, (covered, rec["e2e_ms"])
+
+
+def test_stream_redelivery_keeps_the_trace_id_and_forces_error_commit():
+    tracer = _fresh_global()
+    proc = _Sleep(0.0, fail_calls={1})
+    _run_stream({
+        "name": "t-redeliver",
+        "input": {"type": "fault", "seed": 5, "redeliver_unacked": True,
+                  "inner": {"type": "memory", "messages": ["r1"]},
+                  "faults": [{"kind": "latency", "every": 100,
+                              "duration": "1ms"}]},
+        "pipeline": {"thread_num": 1, "max_delivery_attempts": 3,
+                     "processors": []},
+        "output": {"type": "drop"},
+    }, patch=lambda s: s.pipeline.processors.append(proc))
+    assert proc.calls == 2  # failed once, redelivered, succeeded
+    errors = [r for r in tracer.slowest(10) if r["status"] == "error"]
+    oks = [r for r in tracer.slowest(10) if r["status"] == "ok"]
+    assert len(errors) == 1 and len(oks) == 1
+    # the redelivery re-entered the SAME trace: both attempts share the id,
+    # and the retry's input_decode span is tagged redelivered
+    assert errors[0]["trace_id"] == oks[0]["trace_id"]
+    assert any(s.get("attrs", {}).get("redelivered")
+               for s in oks[0]["spans"] if s["stage"] == "input_decode")
+
+
+def test_stream_quarantine_preserves_trace_column_and_commits_error():
+    tracer = _fresh_global(sample_rate=0.0)
+    quarantined: list[MessageBatch] = []
+
+    class _Collect(Processor):
+        async def process(self, batch):
+            raise RuntimeError("always poisoned")
+
+    def patch(stream):
+        stream.pipeline.processors.append(_Collect())
+
+        class _Err:
+            async def connect(self):
+                pass
+
+            async def close(self):
+                pass
+
+            async def write(self, batch):
+                quarantined.append(batch)
+
+        stream.error_output = _Err()
+
+    _run_stream({
+        "name": "t-quarantine",
+        "input": {"type": "memory", "messages": ["p1"]},
+        "pipeline": {"thread_num": 1, "max_delivery_attempts": 1,
+                     "processors": []},
+        "output": {"type": "drop"},
+        "error_output": {"type": "drop"},
+    }, patch=patch)
+    assert len(quarantined) == 1
+    # the quarantined batch still carries its trace context next to the
+    # error tags — an operator can join error_output rows to /trace
+    assert quarantined[0].has_column(META_EXT_TRACE)
+    ctx = quarantined[0].trace_context()
+    errors = [r for r in tracer.slowest(10) if r["status"] == "error"]
+    assert len(errors) == 1 and errors[0]["trace_id"] == ctx.trace_id
+
+
+def test_coalesced_emission_links_source_traces():
+    tracer = _fresh_global()
+    proc = _Sleep(0.0)
+    # 6 single-row writes coalesce into 2-row bucket-exact emissions
+    _run_stream({
+        "name": "t-coalesce",
+        "input": {"type": "memory", "messages": ["a", "b", "c", "d"]},
+        "buffer": {"type": "memory", "capacity": 64, "timeout": "20ms",
+                   "coalesce": {"batch_buckets": [4], "deadline": "20ms"}},
+        "pipeline": {"thread_num": 1, "processors": []},
+        "output": {"type": "drop"},
+    }, patch=lambda s: s.pipeline.processors.append(proc))
+    recs = tracer.slowest(50)
+    merged = [r for r in recs if r["status"] == "ok"
+              and any(s["stage"] == "coalesce_wait" for s in r["spans"])]
+    coalesced = [r for r in recs if r["status"] == "coalesced"]
+    assert merged, [r["status"] for r in recs]
+    links = []
+    for r in merged:
+        for s in r["spans"]:
+            if s["stage"] == "coalesce_wait":
+                links.extend(s["attrs"]["links"])
+    # every source trace the merged emissions link to is closed with
+    # status=coalesced pointing back at its merged trace
+    assert coalesced and {r["trace_id"] for r in coalesced} <= set(links)
+    for r in coalesced:
+        assert r["attrs"]["merged_into"] in {m["trace_id"] for m in merged}
+
+
+def test_shed_trace_is_force_sampled():
+    """An admission shed commits the trace with status shed even at
+    sample_rate 0 — the burst soak asserts the same end to end."""
+    tracer = _fresh_global(sample_rate=0.0)
+    item_tr = []
+
+    async def go():
+        from arkflow_tpu.runtime.stream import Stream, _WorkItem
+
+        class _NullAck:
+            redeliverable = False
+
+            async def ack(self):
+                pass
+
+            async def nack(self):
+                pass
+
+        from arkflow_tpu.runtime.overload import OverloadConfig
+        from arkflow_tpu.runtime.pipeline import Pipeline
+        from arkflow_tpu.plugins.output.drop import DropOutput
+        from arkflow_tpu.plugins.input.memory import MemoryInput
+
+        stream = Stream(MemoryInput([]), Pipeline([]), DropOutput(),
+                        overload=OverloadConfig.from_config(
+                            {"enabled": True}, deadline_ms=1.0))
+        ctx = tracer.begin()
+        batch = (MessageBatch.new_binary([b"stale"]).with_trace(ctx)
+                 .with_deadline_ms(0))  # already expired
+        item = _WorkItem(batch, _NullAck(), 0.0, trace=ctx)
+        item_tr.append(ctx)
+        assert await stream._admit_or_shed(item) is False
+
+    asyncio.run(go())
+    recs = tracer.slowest(5)
+    assert len(recs) == 1 and recs[0]["status"] == "deadline"
+    assert recs[0]["forced"] and recs[0]["trace_id"] == item_tr[0].trace_id
+
+
+# -- cluster: cross-tier stitching over the flight plane ---------------------
+
+
+class _RemoteSleep(Processor):
+    """Worker-hosted stage with a deterministic device-ish cost."""
+
+    def __init__(self, seconds: float = 0.05):
+        self.seconds = seconds
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        with stage_span("device_step"):  # nested like the real runner
+            await asyncio.sleep(self.seconds)
+        return [batch.with_column(
+            "__value__",
+            pa.array([v.upper() for v in batch.to_binary()],
+                     type=pa.binary()))]
+
+
+def test_cluster_trace_stitches_both_tiers_and_covers_e2e():
+    """The ISSUE acceptance shape: a 2-worker cluster request yields ONE
+    stitched trace covering ingest decode -> queue -> flight hop -> worker
+    step -> response, with per-stage durations consistent with e2e."""
+    from arkflow_tpu.runtime import build_stream
+    from arkflow_tpu.runtime.cluster import ClusterWorkerServer
+
+    tracer = _fresh_global()
+
+    async def go():
+        srvs = []
+        for i in range(2):
+            srv = ClusterWorkerServer([_RemoteSleep(0.05)], host="127.0.0.1",
+                                      port=0, worker_id=f"w{i}")
+            await srv.connect()
+            await srv.start()
+            srvs.append(srv)
+        urls = [f"arkflow://127.0.0.1:{s.port}" for s in srvs]
+        cfg = StreamConfig.from_mapping({
+            "name": "t-cluster-trace",
+            "input": {"type": "memory",
+                      "messages": [f"row-{i}" for i in range(4)]},
+            "pipeline": {"thread_num": 1,
+                         "processors": [{"type": "remote_tpu",
+                                         "name": "t-cluster-trace",
+                                         "workers": urls,
+                                         "heartbeat": "60s"}]},
+            "output": {"type": "drop"},
+        })
+        stream = build_stream(cfg)
+        cancel = asyncio.Event()
+        try:
+            await asyncio.wait_for(stream.run(cancel), timeout=30)
+        finally:
+            for s in srvs:
+                await s.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=40))
+    recs = [r for r in tracer.slowest(10) if r["status"] == "ok"]
+    assert len(recs) == 4
+    for rec in recs:
+        by_stage: dict[str, dict] = {}
+        for s in rec["spans"]:
+            by_stage[s["stage"]] = s
+        # the full path, one tree: ingest stages + flight hop + worker tier
+        for stage in ("input_decode", "queue_wait", "process", "cluster_hop",
+                      "flight_serialize", "flight_transport",
+                      "flight_deserialize", "remote_deserialize",
+                      "remote_queue_wait", "remote_step", "device_step",
+                      "output_write"):
+            assert stage in by_stage, (stage, sorted(by_stage))
+        # worker spans are tier-tagged and parent under the hop span
+        assert by_stage["remote_step"]["tier"].startswith("worker:w")
+        assert (by_stage["remote_step"]["parent_id"]
+                == by_stage["cluster_hop"]["span_id"])
+        # device_step nests under remote_step on the WORKER side
+        assert (by_stage["device_step"]["parent_id"]
+                == by_stage["remote_step"]["span_id"])
+        # per-stage durations consistent: top-level ingest spans sum to
+        # within 10% of measured e2e (+2ms noise floor), and the worker's
+        # step is inside the hop which is inside process
+        covered = sum(by_stage[s]["dur_ms"] for s in
+                      ("queue_wait", "process", "output_write"))
+        assert covered >= rec["e2e_ms"] * 0.9 - 2.0, (covered, rec["e2e_ms"])
+        assert covered <= rec["e2e_ms"] + 2.0
+        assert (by_stage["device_step"]["dur_ms"]
+                <= by_stage["remote_step"]["dur_ms"] + 1.0)
+        assert (by_stage["remote_step"]["dur_ms"]
+                <= by_stage["cluster_hop"]["dur_ms"] + 1.0)
+        assert (by_stage["cluster_hop"]["dur_ms"]
+                <= by_stage["process"]["dur_ms"] + 1.0)
+    # the breakdown aggregates both tiers' stages
+    stages = tracer.stage_breakdown()["stages"]
+    assert "remote_step" in stages and "flight_transport" in stages
+
+
+def test_failed_remote_step_still_ships_worker_spans():
+    """A worker whose step FAILS exports its spans ahead of the error
+    frame — the force-sampled error trace keeps its worker-tier timing."""
+    from arkflow_tpu.errors import ProcessError
+    from arkflow_tpu.runtime.cluster import ClusterDispatcher, ClusterWorkerServer
+
+    tracer = _fresh_global()
+
+    class _Fail(Processor):
+        async def process(self, batch):
+            raise RuntimeError("deterministic poison")
+
+    async def go():
+        srv = ClusterWorkerServer([_Fail()], host="127.0.0.1", port=0,
+                                  worker_id="w-fail")
+        await srv.connect()
+        await srv.start()
+        d = ClusterDispatcher([f"arkflow://127.0.0.1:{srv.port}"],
+                              name="t-failspan", heartbeat_s=999)
+        try:
+            await d.start()
+            ctx = tracer.begin()
+            batch = MessageBatch.new_binary([b"poison"]).with_trace(ctx)
+            with pytest.raises(ProcessError):
+                await d.dispatch(batch)
+            tracer.finish(ctx, "error")
+        finally:
+            await d.close()
+            await srv.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=15))
+    rec = [r for r in tracer.slowest(5) if r["status"] == "error"][0]
+    stages = {s["stage"] for s in rec["spans"]}
+    assert {"remote_deserialize", "remote_queue_wait"} <= stages, stages
+    assert any(s["stage"] == "remote_step" and s["attrs"].get("error")
+               for s in rec["spans"])
+
+
+def test_engine_trace_endpoint_and_health_summary():
+    """GET /trace serves the stitched store; /health embeds the one-line
+    tracing summary."""
+    import json as _json
+
+    import aiohttp
+
+    from arkflow_tpu.runtime.engine import Engine
+
+    tracer = _fresh_global()
+
+    async def go():
+        cfg = EngineConfig.from_mapping({
+            "health_check": {"host": "127.0.0.1", "port": 18972},
+            "tracing": {"sample_rate": 1.0, "max_traces": 64},
+            "streams": [{
+                "name": "traced",
+                "input": {"type": "generate", "payload": "live",
+                          "interval": "20ms", "batch_size": 2},
+                "pipeline": {"thread_num": 1, "processors": []},
+                "output": {"type": "drop"},
+            }],
+        })
+        engine = Engine(cfg)
+        task = asyncio.create_task(engine.run())
+        try:
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if engine._ready and tracer.commit_seq() > 2:
+                    break
+            async with aiohttp.ClientSession() as s:
+                async with s.get("http://127.0.0.1:18972/trace?n=5") as r:
+                    assert r.status == 200
+                    body = _json.loads(await r.text())
+                assert body["summary"]["enabled"] is True
+                assert body["stage_breakdown"]["traces"] > 0
+                assert 0 < len(body["slowest"]) <= 5
+                spans = body["slowest"][0]["spans"]
+                assert any(s["stage"] == "process" for s in spans)
+                async with s.get("http://127.0.0.1:18972/trace?n=x") as r:
+                    assert r.status == 400
+                async with s.get("http://127.0.0.1:18972/health") as r:
+                    health = _json.loads(await r.text())
+                assert health["tracing"]["enabled"] is True
+                assert health["tracing"]["traces_retained"] > 0
+        finally:
+            engine.shutdown()
+            try:
+                await asyncio.wait_for(task, timeout=10)
+            except (asyncio.TimeoutError, Exception):
+                task.cancel()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=40))
+
+
+def test_device_idle_gap_histogram_exists():
+    """The runner exports arkflow_tpu_device_idle_gap_seconds — ROADMAP
+    item 5's before/after measurement — alongside the stall counter."""
+    from arkflow_tpu.obs import global_registry
+    from arkflow_tpu.tpu.bucketing import BucketPolicy
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    runner = ModelRunner(
+        "bert_classifier",
+        {"vocab_size": 128, "hidden": 16, "layers": 1, "heads": 2,
+         "ffn": 32, "max_positions": 32, "num_labels": 2},
+        buckets=BucketPolicy((2,), (16,)))
+    import numpy as np
+
+    async def go():
+        # the gap tracks the ASYNC dispatch path (the serving hot loop):
+        # two sequential steps leave one measurable idle gap between them
+        inputs = {"input_ids": np.zeros((2, 16), dtype=np.int32),
+                  "attention_mask": np.ones((2, 16), dtype=np.int32)}
+        out = await runner.infer(inputs)
+        assert out["label"].shape[0] == 2
+        await runner.infer(inputs)
+
+    asyncio.run(go())
+    reg = global_registry()
+    h = [m for m in reg.collect()
+         if m.name == "arkflow_tpu_device_idle_gap_seconds"]
+    assert h and h[0].count >= 1  # the second dispatch observed one gap

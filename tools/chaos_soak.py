@@ -76,6 +76,38 @@ import sys
 import time
 
 
+def _attach_tracing(verdict: dict, min_seq: int = 0,
+                    forced_base: int = 0) -> dict:
+    """Fold the trace layer's per-stage breakdown into a mode's verdict so
+    every soak answers "WHERE did the time go", not just "how much". Also
+    carries the forced-sample count — the fast modes assert shed/deadline
+    traces were captured even when head sampling would have dropped them.
+    ``min_seq``/``forced_base`` are per-run watermarks: the global store is
+    process-wide, and absolute counters would let another mode's traces
+    satisfy this mode's assertions (the registry-global flake class)."""
+    from arkflow_tpu.obs.trace import global_tracer
+
+    t = global_tracer()
+    verdict["stage_breakdown"] = t.stage_breakdown(min_seq)
+    verdict["tracing"] = {
+        "forced_samples": max(
+            0, t.summary()["forced_samples"] - forced_base),
+        "pathological_retained": sum(
+            1 for r in t.slowest(t.cfg.max_traces, min_seq)
+            if r["status"] in ("shed", "deadline", "error")),
+    }
+    return verdict
+
+
+def _tracing_watermark() -> tuple[int, int]:
+    """(commit_seq, forced_samples) at a mode's start — the deltas feed
+    ``_attach_tracing``."""
+    from arkflow_tpu.obs.trace import global_tracer
+
+    t = global_tracer()
+    return t.commit_seq(), t.summary()["forced_samples"]
+
+
 def _soak_config(seed: int, messages: int, pool: int, fast: bool) -> dict:
     """The soak pipeline as a plain config mapping (the fault schedule and
     every knob exercised here are exactly what a YAML stream would use)."""
@@ -165,6 +197,7 @@ def run_soak(seconds: float = 60.0, seed: int = 7, messages: int = 48,
     from arkflow_tpu.tpu.bucketing import bucket_cap_bus
 
     ensure_plugins_loaded()
+    trace_seq0, trace_forced0 = _tracing_watermark()
     if fast:
         messages = min(messages, 12)
     cfg = StreamConfig.from_mapping(_soak_config(seed, messages, pool, fast))
@@ -248,7 +281,7 @@ def run_soak(seconds: float = 60.0, seed: int = 7, messages: int = 48,
     }
     if missing:
         verdict["missing_sample"] = [m.decode() for m in missing[:5]]
-    return verdict
+    return _attach_tracing(verdict, trace_seq0, trace_forced0)
 
 
 def _burst_config(seed: int, messages: int, factor: int, fast: bool,
@@ -386,9 +419,24 @@ def run_burst_soak(seconds: float = 60.0, seed: int = 7, messages: int = 48,
             out["lost_sample"] = [m.decode() for m in lost[:5]]
         return out
 
-    controlled = run_variant(True, "burst-soak-ctrl")
-    uncontrolled = run_variant(False, "burst-soak-raw")
-    return {
+    import dataclasses
+
+    from arkflow_tpu.obs.trace import global_tracer
+
+    tracer = global_tracer()
+    seq0, forced0 = _tracing_watermark()
+    prev_cfg = tracer.cfg
+    # run with head sampling OFF: any retained trace must then be a FORCED
+    # one, proving shed/deadline-overrun traces are captured at ANY rate —
+    # the diagnostic guarantee the trace layer exists for. replace() keeps
+    # every other knob (incl. an operator's enabled=False) intact.
+    tracer.configure(dataclasses.replace(prev_cfg, sample_rate=0.0))
+    try:
+        controlled = run_variant(True, "burst-soak-ctrl")
+        uncontrolled = run_variant(False, "burst-soak-raw")
+    finally:
+        tracer.configure(prev_cfg)
+    verdict = {
         "mode": "burst",
         "pass": bool(not controlled["wedged"]
                      and controlled["identity_ok"]
@@ -403,6 +451,19 @@ def run_burst_soak(seconds: float = 60.0, seed: int = 7, messages: int = 48,
         "controlled": controlled,
         "uncontrolled": uncontrolled,
     }
+    _attach_tracing(verdict, seq0, forced0)
+    # the soak shed batches (asserted above), so forced sampling MUST have
+    # retained their traces; fast mode folds this into the verdict (unless
+    # the operator disabled tracing outright — nothing to assert then)
+    verdict["forced_sampling_ok"] = bool(
+        not tracer.enabled
+        or controlled["shed_batches"] == 0
+        or (verdict["tracing"]["forced_samples"] > 0
+            and verdict["tracing"]["pathological_retained"] > 0))
+    if fast:
+        verdict["pass"] = bool(verdict["pass"]
+                               and verdict["forced_sampling_ok"])
+    return verdict
 
 
 QUIET_TENANTS = ("alpha", "beta")
@@ -460,6 +521,8 @@ def run_noisy_tenant_soak(seconds: float = 60.0, seed: int = 7,
     import asyncio
     import random
     from collections import deque
+
+    trace_seq0, trace_forced0 = _tracing_watermark()
 
     from arkflow_tpu.batch import MessageBatch
     from arkflow_tpu.components import (
@@ -598,7 +661,7 @@ def run_noisy_tenant_soak(seconds: float = 60.0, seed: int = 7,
 
     cache = asyncio.run(_duplicate_burst_cache_phase(fast))
 
-    return {
+    verdict = {
         "mode": "noisy-tenant",
         "pass": bool(not wedged
                      and fairness["identity_ok"]
@@ -610,6 +673,17 @@ def run_noisy_tenant_soak(seconds: float = 60.0, seed: int = 7,
         "fairness": fairness,
         "cache": cache,
     }
+    _attach_tracing(verdict, trace_seq0, trace_forced0)
+    if fast and fairness["quota_sheds"] > 0:
+        # quota sheds happened THIS run: their traces must be in the store
+        # (delta-watermarked — another mode's traces can't satisfy this)
+        from arkflow_tpu.obs.trace import global_tracer
+
+        verdict["pass"] = bool(
+            verdict["pass"]
+            and (not global_tracer().enabled
+                 or verdict["tracing"]["pathological_retained"] > 0))
+    return verdict
 
 
 async def _duplicate_burst_cache_phase(fast: bool) -> dict:
@@ -771,6 +845,7 @@ def run_swap_soak(seconds: float = 120.0, seed: int = 7, messages: int = 64,
     pool (phase A) and a continuous generation server (phase B), both under
     sustained offered load with zero failed/lost requests and bounded
     delivered p99. The caller owns jax platform env setup (see main)."""
+    trace_seq0, trace_forced0 = _tracing_watermark()
     import asyncio
     import tempfile
 
@@ -978,14 +1053,14 @@ def run_swap_soak(seconds: float = 120.0, seed: int = 7, messages: int = 64,
 
     pool_phase = phase_pool()
     gen_phase = phase_generate()
-    return {
+    return _attach_tracing({
         "mode": "swap",
         "pass": bool(pool_phase["pass"] and gen_phase["pass"]),
         "seed": seed,
         "messages": messages,
         "pool": pool_phase,
         "generate": gen_phase,
-    }
+    }, trace_seq0, trace_forced0)
 
 
 # -- cluster soak (runtime/cluster.py): disaggregated ingest/device tiers --
@@ -1071,6 +1146,7 @@ def run_cluster_soak(seconds: float = 60.0, seed: int = 7,
 
     The parent process never imports jax — only the worker subprocesses do.
     """
+    trace_seq0, trace_forced0 = _tracing_watermark()
     import asyncio
     import os
     import socket as socket_mod
@@ -1327,7 +1403,9 @@ def run_cluster_soak(seconds: float = 60.0, seed: int = 7,
                 except Exception:
                     pass
     verdict["elapsed_s"] = round(time.monotonic() - t_start, 3)
-    return verdict
+    # ingest-side trace store: includes the worker-tier remote_* spans
+    # adopted over the flight plane, so the breakdown spans BOTH tiers
+    return _attach_tracing(verdict, trace_seq0, trace_forced0)
 
 
 def main(argv=None) -> int:
