@@ -158,6 +158,7 @@ class StreamConfig:
         _validate_token_coalesce(m.get("buffer"), pipeline.processors)
         _validate_response_cache(pipeline.processors)
         _validate_generate_mesh(pipeline.processors)
+        _validate_dispatch_knobs(pipeline.processors)
         _validate_swap(pipeline.processors)
         _validate_remote_tpu(pipeline.processors)
         temps = [TemporaryConfig.from_mapping(t) for t in m.get("temporary", [])]
@@ -340,6 +341,55 @@ def _validate_generate_mesh(processors: list[dict]) -> None:
                     f"tpu_generate: mesh tp={tp} must divide the model's "
                     f"kv_heads={kv_heads} (KV pages shard over heads on the "
                     "tp axis)")
+
+
+def _validate_dispatch_knobs(processors: list[dict]) -> None:
+    """Parse-time checks for the hot-path perf knobs (PR 13), looking
+    through ``fault.inner`` chaos wrappers like the other cross-checks:
+
+    - ``tpu_inference.dispatch_depth`` / ``tpu_generate.dispatch_depth``
+      must be positive ints; the generate path caps at 2 (lockstep decode
+      can only lag host bookkeeping by one step) and composes with neither
+      speculative decoding nor sampling (both at ``--validate``, not as a
+      shape/state error at stream build);
+    - ``tpu_generate.decode_kernel`` must name a known kernel.
+    """
+    for p in processors:
+        while (isinstance(p, Mapping) and p.get("type") == "fault"
+               and isinstance(p.get("inner"), Mapping)):
+            p = p["inner"]
+        if not isinstance(p, Mapping):
+            continue
+        ptype = p.get("type")
+        if ptype not in ("tpu_inference", "tpu_generate"):
+            continue
+        depth = p.get("dispatch_depth")
+        if depth is not None:
+            if isinstance(depth, bool) or not isinstance(depth, int) or depth < 1:
+                raise ConfigError(
+                    f"{ptype}.dispatch_depth must be a positive int, "
+                    f"got {depth!r}")
+        if ptype != "tpu_generate":
+            continue
+        kernel = p.get("decode_kernel")
+        if kernel is not None and kernel not in ("auto", "gather", "paged"):
+            raise ConfigError(
+                f"tpu_generate.decode_kernel must be auto|gather|paged, "
+                f"got {kernel!r}")
+        if depth is not None and depth > 2:
+            raise ConfigError(
+                "tpu_generate.dispatch_depth caps at 2: lockstep decode "
+                "can only lag host bookkeeping by one in-flight step")
+        if depth is not None and depth > 1:
+            if int(p.get("speculative_tokens", 0) or 0) > 0:
+                raise ConfigError(
+                    "tpu_generate: dispatch_depth > 1 and speculative_tokens "
+                    "are mutually exclusive (both restructure the decode loop)")
+            if float(p.get("temperature", 0.0) or 0.0) != 0.0:
+                raise ConfigError(
+                    "tpu_generate: dispatch_depth > 1 requires greedy "
+                    "decoding (temperature 0) — a lane that finished at step "
+                    "N still rides step N+1 and would consume sampling RNG")
 
 
 def _restart_config(m: Any) -> Optional[dict]:

@@ -45,6 +45,43 @@ def _constrain(x, sharding):
     return jax.lax.with_sharding_constraint(x, sharding)
 
 
+def _attend_paged(q, kp, vp, page_table, off, cfg: DecoderConfig,
+                  kv_sharding, interpret: bool):
+    """Page-table-indirect flash attention over one layer's pool slices
+    (ops/ragged_attention.paged_flash_attention): query i of row b sits at
+    absolute position ``off[b] + i`` and attends keys 0..off+i, read
+    straight from the pools — the [B, ctx, heads, dh] gather+repeat the
+    dense reference materializes per layer per step never exists.
+
+    Under tensor parallelism the kernel runs inside ``shard_map`` over the
+    ``kv_sharding`` mesh's tp axis: attention is independent per KV head,
+    q's head dim splits into the same contiguous head groups the pools
+    shard by (tp | kv_heads is validated at server build), so each shard
+    attends its local heads with zero collectives — the pools are never
+    all-gathered."""
+    from arkflow_tpu.ops.ragged_attention import paged_flash_attention
+
+    if kv_sharding is None:
+        return paged_flash_attention(q, kp, vp, page_table, off,
+                                     interpret=interpret)
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = kv_sharding.mesh
+    head_spec = P(None, None, "tp", None)  # q/out: [B, C, H, dh], H over tp
+
+    def local(q_, kp_, vp_, table_, off_):
+        return paged_flash_attention(q_, kp_, vp_, table_, off_,
+                                     interpret=interpret)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(head_spec, kv_sharding.spec, kv_sharding.spec, P(), P()),
+        out_specs=head_spec,
+        check_rep=False,
+    )(q, kp, vp, page_table, off)
+
+
 def paged_prefill(params: dict, cfg: DecoderConfig, input_ids, lengths,
                   page_table, k_pages, v_pages, return_logits: bool = False,
                   kv_sharding=None):
@@ -112,7 +149,9 @@ def paged_prefill(params: dict, cfg: DecoderConfig, input_ids, lengths,
 
 def paged_prefill_chunk(params: dict, cfg: DecoderConfig, input_ids, chunk_off,
                         chunk_len, page_table, k_pages, v_pages,
-                        return_all: bool = False, kv_sharding=None):
+                        return_all: bool = False, kv_sharding=None,
+                        attention_kernel: str = "gather",
+                        kernel_interpret: bool = False):
     """Prefill ONE CHUNK of a prompt at absolute offset ``chunk_off``.
 
     Chunked prefill keeps continuous serving responsive: a long prompt no
@@ -137,6 +176,12 @@ def paged_prefill_chunk(params: dict, cfg: DecoderConfig, input_ids, chunk_off,
     which is benign — no mask ever admits a key position beyond the
     querying token's own position, and the position->page mapping is
     deterministic, so the true token overwrites the same cell when it arrives.
+
+    ``attention_kernel``: ``"gather"`` (reference — materialize
+    ``kp[page_table]`` and run masked dense attention) or ``"paged"`` (the
+    Pallas kernel reads the page table in place; ``kernel_interpret`` runs
+    it interpreted for CPU tests). Both produce the same attention to float
+    tolerance; the serving layer gates the swap on argmax parity.
     """
     b, t = input_ids.shape
     p_slots = page_table.shape[1]
@@ -176,13 +221,18 @@ def paged_prefill_chunk(params: dict, cfg: DecoderConfig, input_ids, chunk_off,
                         kv_sharding)
         vp = _constrain(vp.at[page_idx, offset].set(v.astype(jnp.bfloat16)),
                         kv_sharding)
-        # earlier chunks' keys come back through the page gather (this
-        # chunk's own keys were just scattered, so they are included too)
-        kk = kp[page_table].reshape(b, ctx, cfg.kv_heads, dh).astype(x.dtype)
-        vv = vp[page_table].reshape(b, ctx, cfg.kv_heads, dh).astype(x.dtype)
-        kk = jnp.repeat(kk, group, axis=2)
-        vv = jnp.repeat(vv, group, axis=2)
-        attn = cm.attention(q, kk, vv, mask).reshape(b, t, cfg.heads * dh)
+        if attention_kernel == "paged":
+            attn = _attend_paged(q, kp, vp, page_table, chunk_off, cfg,
+                                 kv_sharding, kernel_interpret)
+            attn = attn.reshape(b, t, cfg.heads * dh)
+        else:
+            # earlier chunks' keys come back through the page gather (this
+            # chunk's own keys were just scattered, so they are included too)
+            kk = kp[page_table].reshape(b, ctx, cfg.kv_heads, dh).astype(x.dtype)
+            vv = vp[page_table].reshape(b, ctx, cfg.kv_heads, dh).astype(x.dtype)
+            kk = jnp.repeat(kk, group, axis=2)
+            vv = jnp.repeat(vv, group, axis=2)
+            attn = cm.attention(q, kk, vv, mask).reshape(b, t, cfg.heads * dh)
         x = x + cm.dense(lp["wo"], attn)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
         x = x + _mlp(lp, y, cfg, token_mask=pos_valid)
@@ -201,15 +251,21 @@ def paged_prefill_chunk(params: dict, cfg: DecoderConfig, input_ids, chunk_off,
 
 def paged_decode_step(params: dict, cfg: DecoderConfig, token_ids, lengths,
                       active, page_table, k_pages, v_pages,
-                      return_logits: bool = False, kv_sharding=None):
+                      return_logits: bool = False, kv_sharding=None,
+                      attention_kernel: str = "gather",
+                      kernel_interpret: bool = False):
     """One decode step over all serving slots.
 
     token_ids: [S] current token per slot; lengths: [S] tokens already in
     cache (the new token writes at position lengths[s]); active: [S] bool;
     page_table: [S, P]. Returns (next_ids [S], k_pages, v_pages).
 
-    Attention gathers each slot's pages — [S, P*page] context — and masks
-    positions >= lengths+1, so scratch-page garbage never contributes.
+    ``attention_kernel="gather"`` (reference) gathers each slot's pages —
+    a [S, P*page] dense context copy per layer — and masks positions
+    >= lengths+1, so scratch-page garbage never contributes.
+    ``"paged"`` reads the page table in place through the Pallas kernel
+    (same mask, expressed as the causal bound q_pos = lengths): the dense
+    context is never materialized and fully-invalid pages are skipped.
     """
     s = token_ids.shape[0]
     p_slots = page_table.shape[1]
@@ -247,12 +303,19 @@ def paged_decode_step(params: dict, cfg: DecoderConfig, token_ids, lengths,
         vp = _constrain(
             vp.at[write_page, write_off].set(v[:, 0].astype(jnp.bfloat16)),
             kv_sharding)
-        # gather each slot's context from the pool: [S, P, page, kh, dh]
-        kk = kp[page_table].reshape(s, ctx, cfg.kv_heads, dh).astype(x.dtype)
-        vv = vp[page_table].reshape(s, ctx, cfg.kv_heads, dh).astype(x.dtype)
-        kk = jnp.repeat(kk, group, axis=2)
-        vv = jnp.repeat(vv, group, axis=2)
-        attn = cm.attention(q, kk, vv, valid).reshape(s, 1, cfg.heads * dh)
+        if attention_kernel == "paged":
+            # the single query sits at absolute position lengths[s]; the
+            # kernel's causal bound (key <= lengths) is exactly `valid`
+            attn = _attend_paged(q, kp, vp, page_table, lengths, cfg,
+                                 kv_sharding, kernel_interpret)
+            attn = attn.reshape(s, 1, cfg.heads * dh)
+        else:
+            # gather each slot's context from the pool: [S, P, page, kh, dh]
+            kk = kp[page_table].reshape(s, ctx, cfg.kv_heads, dh).astype(x.dtype)
+            vv = vp[page_table].reshape(s, ctx, cfg.kv_heads, dh).astype(x.dtype)
+            kk = jnp.repeat(kk, group, axis=2)
+            vv = jnp.repeat(vv, group, axis=2)
+            attn = cm.attention(q, kk, vv, valid).reshape(s, 1, cfg.heads * dh)
         x = x + cm.dense(lp["wo"], attn)
         y = cm.rms_norm(lp["mlp_norm"], x, cfg.norm_eps)
         # inactive lanes must not consume expert capacity (MoE)

@@ -121,3 +121,139 @@ def ragged_flash_attention(q, k, v, lengths, *, causal: bool = False,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
     )(jnp.asarray(lengths, jnp.int32), q, k, v)
+
+
+# -- paged flash attention ---------------------------------------------------
+#
+# The decode-time twin of the ragged kernel: K/V live in the serving page
+# pools ([num_pages, page, kv_heads, dh], tpu/serving.py), and each row's
+# context is named by an int32 page table instead of being contiguous. The
+# dense-gather path in models/paged_decode.py materializes kp[page_table]
+# — a [B, P*page, heads, dh] copy of the whole context per layer per step —
+# then runs masked XLA attention over it. This kernel reads the page table
+# in place ("Ragged Paged Attention", PAPERS.md): the grid walks
+# (row, kv_head, page), the BlockSpec index map resolves each row's p-th
+# page through the scalar-prefetched table, and pages past the row's causal
+# bound resolve to the scratch page 0 so consecutive out-of-range steps
+# reuse one block copy and skip the math. GQA is folded into the query
+# tile: the ``group`` query heads sharing a KV head ride one [C*group, dh]
+# tile, so K/V are never repeated ``group``-fold in HBM or VMEM.
+
+
+def _paged_kernel(off_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
+                  o_acc, m_acc, l_acc, *, page: int, group: int,
+                  pages_per: int):
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+    ql, d = q_ref.shape[2], q_ref.shape[3]
+
+    @pl.when(pi == 0)
+    def _init():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        m_acc[:] = jnp.full_like(m_acc, _NEG)
+        l_acc[:] = jnp.zeros_like(l_acc)
+
+    off = off_ref[bi]
+    # folded query i is (chunk position i // group, q head i % group) at
+    # absolute position off + i//group; the row's last attendable key is
+    # off + C - 1, so later pages hold no admissible key for any query
+    max_pos = off + (ql // group - 1)
+
+    @pl.when(pi * page <= max_pos)
+    def _acc():
+        q = q_ref[0, 0].astype(jnp.float32)                       # [QL, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)                 # [page, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        scale = 1.0 / math.sqrt(d)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale           # [QL, page]
+        q_pos = off + jax.lax.broadcasted_iota(jnp.int32, (ql, page), 0) // group
+        k_pos = pi * page + jax.lax.broadcasted_iota(jnp.int32, (ql, page), 1)
+        scores = jnp.where(k_pos <= q_pos, scores, _NEG)
+        m = m_acc[:, :1]                                          # [QL, 1]
+        m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l_acc[:, :1] = l_acc[:, :1] * corr + p.sum(axis=-1, keepdims=True)
+        m_acc[:, :1] = m_new
+        o_acc[:] = o_acc[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(pi == pages_per - 1)
+    def _fin():
+        # every query admits at least key 0 (k_pos=0 <= q_pos always), so l
+        # is never truly zero; the floor only guards numerical underflow
+        o_ref[0, 0] = (o_acc[:] / jnp.maximum(l_acc[:, :1], 1e-30)
+                       ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_flash_attention(q, k_pages, v_pages, page_table, off, *,
+                          interpret: bool = False):
+    """Flash attention that reads K/V straight from the serving page pools.
+
+    q: [B, C, H, dh] — C queries per row at absolute positions
+    ``off[b] + i`` (decode: C=1, off=lengths; chunked prefill: off=chunk
+    offset). k_pages/v_pages: [num_pages, page, kv_heads, dh] (one layer's
+    pool slice). page_table: [B, P] int32 — entries past a row's context
+    may be 0 (the scratch page; never read through the causal mask).
+    off: [B] int32.
+
+    Query i attends keys 0..off+i — exactly the dense-gather reference's
+    ``key_pos <= positions`` mask — with GQA resolved inside the kernel
+    (no ``jnp.repeat`` of K/V). Returns [B, C, H, dh] in q's dtype.
+    """
+    b, c, h, dh = q.shape
+    n_pages, page, kvh, _ = k_pages.shape
+    if h % kvh:
+        raise ValueError(f"q heads {h} must be a multiple of kv heads {kvh}")
+    group = h // kvh
+    ql = c * group
+    pages_per = page_table.shape[1]
+    # fold the GQA group into the query tile: [B, KVH, C*G, dh] where folded
+    # index i = (chunk pos i//G, group member i%G) — all G members share the
+    # same KV head and the same absolute position
+    qf = (q.reshape(b, c, kvh, group, dh)
+          .transpose(0, 2, 1, 3, 4)
+          .reshape(b, kvh, ql, dh))
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (b, kvh, pages_per)
+    kernel = functools.partial(
+        _paged_kernel, page=page, group=group, pages_per=pages_per)
+
+    def _page_index(bi, hi, pi, off_ref, table_ref):
+        # pages past the row's causal bound resolve to the scratch page 0:
+        # the index stays constant across the remaining grid steps, so the
+        # pipeline skips the re-copy, and pl.when skips the math
+        max_pos = off_ref[bi] + (ql // group - 1)
+        return (jnp.where(pi * page <= max_pos, table_ref[bi, pi], 0), 0, hi, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, ql, dh), lambda bi, hi, pi, *_: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, page, 1, dh), _page_index),
+            pl.BlockSpec((1, page, 1, dh), _page_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, ql, dh),
+                               lambda bi, hi, pi, *_: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((ql, dh), jnp.float32),
+            pltpu.VMEM((ql, 128), jnp.float32),
+            pltpu.VMEM((ql, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, ql, dh), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(off, jnp.int32), jnp.asarray(page_table, jnp.int32),
+      qf, k_pages, v_pages)
+    return (out.reshape(b, kvh, c, group, dh)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(b, c, h, dh))

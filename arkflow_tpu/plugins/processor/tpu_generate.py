@@ -38,6 +38,20 @@ Config:
                              # finished prompts donate full KV pages, later
                              # requests with the same token prefix alias
                              # them and prefill only the rest (0 = off)
+    decode_kernel: paged     # continuous mode: auto (default — paged on
+                             # TPU, gather elsewhere) | gather (dense
+                             # reference) | paged — the Pallas kernel reads
+                             # the KV page table in place for decode +
+                             # chunked prefill (TPU backends; argmax-parity
+                             # gated with fallback to gather;
+                             # kernel_parity_check: false skips the
+                             # init-time golden check, kernel_interpret:
+                             # true for CPU tests)
+    dispatch_depth: 2        # continuous mode: 2 pipelines decode — step
+                             # N+1 dispatches from step N's device-resident
+                             # tokens before N's outputs are fetched, so
+                             # host bookkeeping overlaps device compute.
+                             # Greedy-only; exact same tokens as depth 1
     step_deadline: 2s        # continuous mode: per-step watchdog from the
                              # shared serving core (tpu/serving_core.py) — a
                              # hung step marks the server UNHEALTHY and the
@@ -75,6 +89,8 @@ class TpuGenerateProcessor(Processor):
                  temperature: float = 0.0, top_k: int = 0,
                  mesh_config: Optional[dict] = None, prefill_chunk: int = 0,
                  speculative_tokens: int = 0, prefix_cache_pages: int = 0,
+                 decode_kernel: str = "auto", kernel_interpret: bool = False,
+                 kernel_parity_check: bool = True, dispatch_depth: int = 1,
                  step_deadline_s: Optional[float] = None,
                  step_deadline_first_s: Optional[float] = None,
                  health_config=None, checkpoint: Optional[str] = None):
@@ -176,6 +192,10 @@ class TpuGenerateProcessor(Processor):
                 prefill_chunk=prefill_chunk,
                 speculative_tokens=speculative_tokens,
                 prefix_cache_pages=prefix_cache_pages,
+                decode_kernel=decode_kernel,
+                kernel_interpret=kernel_interpret,
+                kernel_parity_check=kernel_parity_check,
+                dispatch_depth=dispatch_depth,
                 mesh=self.mesh,
                 step_deadline_s=step_deadline_s,
                 step_deadline_first_s=step_deadline_first_s,
@@ -317,6 +337,10 @@ def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
         prefill_chunk=int(config.get("prefill_chunk", 0)),
         speculative_tokens=int(config.get("speculative_tokens", 0)),
         prefix_cache_pages=int(config.get("prefix_cache_pages", 0)),
+        decode_kernel=str(config.get("decode_kernel", "auto")),
+        kernel_interpret=bool(config.get("kernel_interpret", False)),
+        kernel_parity_check=bool(config.get("kernel_parity_check", True)),
+        dispatch_depth=int(config.get("dispatch_depth", 1)),
         step_deadline_s=core_cfg["step_deadline_s"],
         step_deadline_first_s=core_cfg["step_deadline_first_s"],
         health_config=core_cfg["health_config"],

@@ -38,6 +38,12 @@ Config:
     warmup: false                  # precompile bucket grid at connect
     serving_dtype: bfloat16        # float32 | bfloat16 | float16 | int8
                                    # (int8 = dynamic W8A8, 2x MXU roofline)
+    dispatch_depth: 2              # 2 = release the in-flight permit at
+                                   # DISPATCH: the next step's infeed and
+                                   # dispatch overlap this step's compute
+                                   # while the output fetch runs off the
+                                   # device's critical path (default 1;
+                                   # env ARKFLOW_DISPATCH_DEPTH)
     packing: true                  # token packing (tpu/packing.py): bin-pack
                                    # short examples into dense model rows so
                                    # flops/row tracks real token count; the
@@ -322,6 +328,11 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         serving_dtype=config.get("serving_dtype"),
         max_in_flight=(int(config["max_in_flight"])
                        if config.get("max_in_flight") is not None else None),
+        # dispatch_depth: 2 releases the in-flight permit at DISPATCH so the
+        # next step's infeed+dispatch overlaps this step's compute; output
+        # fetch runs outside the window under its own per-step deadline
+        dispatch_depth=(int(config["dispatch_depth"])
+                        if config.get("dispatch_depth") is not None else None),
         packed=packing,
         # shared self-healing knobs (step_deadline / step_deadline_first /
         # health) — parsed by the serving core both device paths sit on

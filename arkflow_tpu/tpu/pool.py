@@ -72,6 +72,7 @@ class ModelRunnerPool:
         devices=None,
         serving_dtype: Optional[str] = None,
         max_in_flight: Optional[int] = None,
+        dispatch_depth: Optional[int] = None,
         packed: bool = False,
         step_deadline_s: Optional[float] = None,
         step_deadline_first_s: Optional[float] = None,
@@ -106,6 +107,7 @@ class ModelRunnerPool:
                 devices=[devices[i]],
                 serving_dtype=serving_dtype,
                 max_in_flight=max_in_flight,
+                dispatch_depth=dispatch_depth,
                 packed=packed,
                 host_params=host_params,
                 device_label=str(i),

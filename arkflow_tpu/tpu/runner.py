@@ -158,11 +158,31 @@ class _StagingPool:
     fully completes (outputs fetched), so on backends where ``device_put``
     may alias host memory a recycled buffer can never race an in-flight
     transfer. Thread-safe: prep runs on executor threads.
+
+    Sizing invariant: ``max_per_key`` must cover every buffer set that can
+    be simultaneously checked out on one key — the dispatched-not-fetched
+    steps (``dispatch_depth`` of them at depth > 1, in-flight steps
+    otherwise) plus one set in prep. The pool itself can NEVER deadlock —
+    ``acquire`` returns None on an empty stack and the caller allocates
+    fresh — but an undersized cap silently reintroduces a per-step
+    allocation on the hot path (release drops buffers beyond the cap), so
+    the runner asserts the derived size at construction instead of finding
+    out from an allocation profile.
     """
 
-    def __init__(self, max_per_key: int):
+    def __init__(self, max_per_key: int, min_required: int = 1):
         import threading
 
+        # ``min_required`` is the owner's statement of how many sets can be
+        # simultaneously checked out on one key (in-flight steps + one in
+        # prep). The assert relates the CAP to that bound, so a future
+        # change to the sizing formula that forgets the dispatch-depth term
+        # fails here at construction instead of silently regressing the hot
+        # path to one fresh bucket-sized allocation per step (release()
+        # drops buffers beyond the cap; acquire() never blocks).
+        assert max_per_key >= min_required >= 1, (
+            f"staging max_per_key={max_per_key} cannot cover the "
+            f"{min_required} concurrently-held buffer sets per key")
         self._free: dict[tuple, list[dict[str, np.ndarray]]] = {}
         self._max = max_per_key
         self._lock = threading.Lock()
@@ -192,6 +212,7 @@ class ModelRunner:
         devices=None,
         serving_dtype: Optional[str] = None,
         max_in_flight: Optional[int] = None,
+        dispatch_depth: Optional[int] = None,
         packed: bool = False,
         host_params=None,
         device_label: Optional[str] = None,
@@ -387,6 +408,20 @@ class ModelRunner:
         if max_in_flight < 1:  # explicit config/kwarg values DO raise
             raise ConfigError(f"max_in_flight must be >= 1, got {max_in_flight}")
         self.max_in_flight = max_in_flight
+        #: dispatch depth: at 1 (default) a step holds its in-flight permit
+        #: through dispatch AND output fetch — the device queue drains to
+        #: empty before the next worker's step can dispatch whenever the
+        #: workers run at the in-flight bound. At 2 the permit is released
+        #: once the step is ENQUEUED: the fetch (device sync + host copy)
+        #: happens outside the in-flight window, so the next step's infeed +
+        #: dispatch overlaps this step's compute even at max_in_flight 1,
+        #: and staging is double-buffered per step (one set in flight, one
+        #: in prep). Env ARKFLOW_DISPATCH_DEPTH overrides the default.
+        if dispatch_depth is None:
+            dispatch_depth = _env_int("ARKFLOW_DISPATCH_DEPTH", 1, minimum=1)
+        if dispatch_depth < 1:  # explicit config/kwarg values DO raise
+            raise ConfigError(f"dispatch_depth must be >= 1, got {dispatch_depth}")
+        self.dispatch_depth = dispatch_depth
         self._inflight_sem: Optional[asyncio.Semaphore] = None
         #: loop the semaphores are bound to: a runner outliving its loop
         #: (bench/profile phases, engine restarts) must rebuild them, or the
@@ -397,15 +432,30 @@ class ModelRunner:
         #: batch sits staged ahead of the compute queue — otherwise every
         #: stream worker could park a padded batch in HBM
         self._prefetch_sem: Optional[asyncio.Semaphore] = None
+        #: bounds dispatched-not-fetched steps at dispatch_depth > 1 (held
+        #: enqueue -> outputs fetched); see _ensure_sems
+        self._depth_sem: Optional[asyncio.Semaphore] = None
         self._inflight = 0
         self._busy_start = 0.0
         self._last_idle_start: Optional[float] = None
         #: per-bucket recycled host staging buffers (unpacked path only —
         #: packed layouts have data-dependent shapes). One set per possible
-        #: concurrent step plus one in prep. ARKFLOW_STAGING=0 disables.
+        #: concurrent step plus one in prep; at dispatch_depth > 1 each
+        #: dispatched-not-fetched step ALSO holds its set (released only
+        #: after the fetch), so the cap grows with the depth — the
+        #: _StagingPool docstring states the invariant, the assert below
+        #: pins it so a future resize can't silently regress depth-2 to a
+        #: fresh allocation per step. ARKFLOW_STAGING=0 disables.
         self._staging: Optional[_StagingPool] = None
         if not packed and os.environ.get("ARKFLOW_STAGING", "1") != "0":
-            self._staging = _StagingPool(max_per_key=self.max_in_flight + 1)
+            # held sets per key: at depth > 1 the depth semaphore bounds
+            # dispatched-not-fetched steps to dispatch_depth (each holds
+            # its set until the fetch), depth 1 holds max_in_flight inside
+            # the permit; plus one set in prep either way
+            self._staging = _StagingPool(
+                max_per_key=self.max_in_flight + self.dispatch_depth,
+                min_required=(self.dispatch_depth if self.dispatch_depth > 1
+                              else self.max_in_flight) + 1)
 
         # -- self-healing device layer (step deadlines / OOM degradation /
         # -- health state machine) — shared serving core ---------------------
@@ -451,12 +501,9 @@ class ModelRunner:
         import dataclasses
 
         def _on_tpu() -> bool:
-            try:
-                dev = devices[0] if devices else jax.devices()[0]
-                return (dev.platform == "tpu"
-                        or "tpu" in getattr(dev, "device_kind", "").lower())
-            except Exception:
-                return False
+            from arkflow_tpu.tpu.serving_core import on_tpu_backend
+
+            return on_tpu_backend(devices)
 
         if (packed and hasattr(cfg, "packed_flash")
                 and not cfg.packed_flash
@@ -734,6 +781,15 @@ class ModelRunner:
         self.core.apply_chaos()
         return jax.device_get(self._dispatch(padded))
 
+    def _enqueue_step(self, padded: dict[str, Any]):
+        """Dispatch half of a depth-split step (``dispatch_depth`` > 1):
+        the jitted call only ENQUEUES on the device and returns its output
+        futures — all waiting (and the chaos hook, so an injected hang is
+        watched by the fetch deadline) happens in the fetch half. Runs on
+        an executor thread: a warm dispatch is sub-ms, but a first-seen
+        shape compiles synchronously here and must not block the loop."""
+        return self._dispatch(padded)
+
     def _note_oom(self, bucket_rows: int) -> bool:
         """Device OOM on a ``bucket_rows`` bucket: permanently cap the batch
         grid below it (``arkflow_tpu_bucket_cap``) and announce the cap so
@@ -949,11 +1005,18 @@ class ModelRunner:
         return busy / total if total > 0 else 0.0
 
     def _ensure_sems(self) -> None:
-        """(Re)bind the in-flight/prefetch semaphores to the CURRENT loop."""
+        """(Re)bind the in-flight/prefetch/depth semaphores to the CURRENT
+        loop."""
         loop = asyncio.get_running_loop()
         if self._sem_loop is not loop:
             self._inflight_sem = asyncio.Semaphore(self.max_in_flight)
             self._prefetch_sem = asyncio.Semaphore(self.max_in_flight + 1)
+            # depth > 1: bounds DISPATCHED-NOT-FETCHED steps (each holds a
+            # permit from before its enqueue until its outputs are fetched)
+            # — without it, concurrent callers releasing the in-flight
+            # permit at dispatch could queue arbitrarily many steps on the
+            # device and defeat both backpressure and the staging-pool cap
+            self._depth_sem = asyncio.Semaphore(self.dispatch_depth)
             self._sem_loop = loop
 
     async def infer(self, inputs: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
@@ -991,6 +1054,11 @@ class ModelRunner:
 
         async def step(padded):
             t_sem = time.perf_counter()
+            # first-seen shapes compile synchronously inside the dispatch;
+            # they take the classic fully-watched path so the first-compile
+            # deadline budget covers the compile, not just the fetch
+            if self.dispatch_depth > 1 and not first:
+                return await step_split(padded, t_sem)
             async with self._inflight_sem:
                 t0 = time.perf_counter()
                 if t0 - t_sem > 0.0005:
@@ -1022,6 +1090,55 @@ class ModelRunner:
                 record_stage("device_step_first" if first else "device_step",
                              dt, attrs={"bucket_rows": bucket_rows})
                 return out
+
+        async def step_split(padded, t_sem):
+            # dispatch_depth > 1: the in-flight permit covers the DISPATCH
+            # only — once the device queue holds the step, the permit frees
+            # and the next worker's step dispatches while this one's output
+            # fetch (device sync + host copy) proceeds off the critical
+            # path. The outer DEPTH permit is held from before the enqueue
+            # until the fetch completes, so dispatched-not-fetched steps
+            # never exceed dispatch_depth no matter how many callers fan
+            # out (chunked batches gather N concurrent infer calls) — that
+            # is the device-memory backpressure AND the bound the staging
+            # pool is sized against. Deadline semantics per in-flight step:
+            # the fetch budget runs from this step's own enqueue, never
+            # from when the host got around to waiting
+            # (serving_core.deadline_remaining).
+            async with self._depth_sem:
+                async with self._inflight_sem:
+                    t0 = time.perf_counter()
+                    if t0 - t_sem > 0.0005:
+                        record_stage("device_dispatch_wait", t0 - t_sem)
+                    self._track_dispatch(t0)
+                    try:
+                        dev_out = await loop.run_in_executor(
+                            None, self._enqueue_step, padded)
+                    except BaseException:
+                        self._track_complete(time.perf_counter())
+                        raise
+                    dispatched_at = time.monotonic()
+
+                def fetch():
+                    self.core.apply_chaos()
+                    return jax.device_get(dev_out)
+
+                try:
+                    if deadline is None:
+                        out = await loop.run_in_executor(None, fetch)
+                    else:
+                        out = await self.core.run_deadlined(
+                            fetch,
+                            self.core.deadline_remaining(
+                                deadline, dispatched_at),
+                            on_zombie=partial(self._release_staging, staged))
+                finally:
+                    self._track_complete(time.perf_counter())
+            dt = time.perf_counter() - t0
+            self.m_infer.observe(dt)
+            record_stage("device_step_first" if first else "device_step",
+                         dt, attrs={"bucket_rows": bucket_rows})
+            return out
 
         try:
             if self._prefetch:

@@ -63,6 +63,22 @@ class _Request:
     tokens: list[int] = field(default_factory=list)
 
 
+@dataclass
+class _InFlightDecode:
+    """One dispatched-but-unapplied decode step (``dispatch_depth`` 2).
+
+    ``nxt`` is the step's DEVICE-resident next-token array — fed straight
+    into the next dispatch so the device never waits for a host round trip.
+    ``reqs`` snapshots per-slot request identity at dispatch: a slot whose
+    request finished (or was replaced) between dispatch and apply drops its
+    token instead of crediting it to the wrong request."""
+
+    nxt: object
+    act: "np.ndarray"
+    reqs: list
+    dispatched_at: float
+
+
 class GenerationServer:
     """Greedy continuous-batching decode over ``slots`` lockstep lanes."""
 
@@ -73,6 +89,8 @@ class GenerationServer:
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                  prefill_chunk: int = 0, speculative_tokens: int = 0,
                  prefix_cache_pages: int = 0, mesh=None,
+                 decode_kernel: str = "auto", kernel_interpret: bool = False,
+                 kernel_parity_check: bool = True, dispatch_depth: int = 1,
                  step_deadline_s: Optional[float] = None,
                  step_deadline_first_s: Optional[float] = None,
                  health_config=None, name: str = "decoder_lm"):
@@ -185,10 +203,85 @@ class GenerationServer:
                 "speculative_tokens requires greedy decoding (temperature 0); "
                 "sampled acceptance is not implemented")
 
+        # decode attention kernel: "gather" materializes each slot's context
+        # from the page pools and masks (the reference path); "paged" runs
+        # the Pallas kernel that reads the page table in place
+        # (ops/ragged_attention.paged_flash_attention) for decode AND
+        # chunked prefill. "auto" (default) picks paged on TPU backends and
+        # gather elsewhere — same idiom as the runner's auto flash. Compiled
+        # Pallas needs a TPU backend; CPU tests opt in via kernel_interpret.
+        # The swap is gated on argmax parity against the gather reference
+        # (mismatch falls back, never fails).
+        self.decode_kernel = str(decode_kernel)
+        if self.decode_kernel not in ("auto", "gather", "paged"):
+            raise ConfigError(
+                f"decode_kernel must be auto|gather|paged, got {decode_kernel!r}")
+        self.kernel_interpret = bool(kernel_interpret)
+        if self.decode_kernel == "auto":
+            self.decode_kernel = (
+                "paged" if (self._on_tpu() or self.kernel_interpret)
+                else "gather")
+        elif (self.decode_kernel == "paged" and not self.kernel_interpret
+                and not self._on_tpu()):
+            logger.warning(
+                "decode_kernel: paged needs a TPU backend (or "
+                "kernel_interpret for CPU tests); serving with the dense "
+                "gather reference instead")
+            self.decode_kernel = "gather"
+
+        # dispatch depth: 2 pipelines decode — step N+1 is dispatched with
+        # step N's DEVICE-resident next-token array before N's outputs are
+        # fetched, so host bookkeeping overlaps device compute. Greedy-only:
+        # the host learns about EOS one step late, so a lane that finished
+        # at N still rides N+1 (its token is dropped on apply) — exact for
+        # argmax decoding, but a sampled RNG stream or an MoE's shared
+        # expert capacity would see the dead lane and diverge from depth-1.
+        self.dispatch_depth = int(dispatch_depth)
+        if self.dispatch_depth < 1:
+            raise ConfigError("dispatch_depth must be >= 1")
+        if self.dispatch_depth > 2:
+            raise ConfigError(
+                "dispatch_depth > 2 is not supported: lockstep decode can "
+                "only lag host bookkeeping by one step (deeper queues would "
+                "admit tokens the host has never validated)")
+        if self.dispatch_depth > 1:
+            if self.temperature != 0.0:
+                raise ConfigError(
+                    "dispatch_depth > 1 requires greedy decoding "
+                    "(temperature 0): a lane that finished at step N still "
+                    "rides step N+1, which would consume sampling RNG")
+            if self.speculative_tokens > 0:
+                raise ConfigError(
+                    "dispatch_depth > 1 and speculative_tokens are mutually "
+                    "exclusive (both restructure the decode loop)")
+            if getattr(cfg, "num_experts", 0) > 0:
+                raise ConfigError(
+                    "dispatch_depth > 1 does not compose with MoE models: "
+                    "a finished-but-still-riding lane consumes shared "
+                    "expert capacity and changes other lanes' outputs")
+        #: the one in-flight, not-yet-applied decode step (depth 2)
+        self._pipeline: Optional[_InFlightDecode] = None
+        #: monotonic count of pipelined dispatches — unlike ``_pipeline``
+        #: (None while the previous step's fetch applies), this is a stable
+        #: "did the depth-2 path engage" signal for tests/diagnostics
+        self._pipelined_dispatches = 0
+
         #: first-seen jitted-step keys — a cold (kind, shape) compiles before
         #: it executes, so the deadline watchdog grants it the first-compile
         #: budget (cleared on rebuild, like the runner's seen-shape set)
         self._seen_steps: set[tuple] = set()
+        if (self.decode_kernel == "paged" and kernel_parity_check
+                and self.mesh is None):
+            # one tiny golden batch through both kernels before the swap is
+            # trusted (PR-6 convention: parity gates the fast path, failure
+            # falls back loudly instead of serving wrong tokens). Under a
+            # mesh the gate is skipped — per-shard math is identical and the
+            # tp parity suite covers it; the init-time check stays local.
+            if not self._paged_kernel_parity_ok():
+                logger.warning(
+                    "paged decode kernel failed argmax parity vs the dense "
+                    "gather reference; serving with gather")
+                self.decode_kernel = "gather"
         self._build_jitted()
 
         # the shared serving-runner core: health state machine, step-deadline
@@ -232,11 +325,91 @@ class GenerationServer:
         self.m_tps = reg.gauge(
             "arkflow_gen_tokens_per_sec",
             "windowed generation throughput (tokens/s over the serve loop)")
+        # the dispatch-depth scoreboard (ROADMAP item 5): the same idle-gap
+        # family the batch runner exports, labeled path=generate — depth 2
+        # drives the p50 toward zero because step N+1 is already queued
+        # when step N completes
+        self.m_idle_gap = reg.histogram(
+            "arkflow_tpu_device_idle_gap_seconds",
+            "gap between step N completing and step N+1 launching "
+            "(device idle between consecutive steps)",
+            {"model": name, "path": "generate"})
+        self.m_depth = reg.gauge(
+            "arkflow_gen_dispatch_depth",
+            "configured decode dispatch depth (2 = pipelined)",
+            {"model": name})
+        self.m_depth.set(self.dispatch_depth)
+        self.m_kernel_paged = reg.gauge(
+            "arkflow_gen_decode_kernel_paged",
+            "1 when the paged flash-attention kernel serves decode/chunk "
+            "(0 = dense gather reference)", {"model": name})
+        self.m_kernel_paged.set(1 if self.decode_kernel == "paged" else 0)
+        #: device-step in-flight count + last-all-complete stamp behind the
+        #: idle-gap histogram (mirrors the runner's _track_dispatch/_complete)
+        self._gen_inflight = 0
+        self._gen_idle_since: Optional[float] = None
         #: tokens emitted by THIS server (m_tokens is registry-global)
         self._tokens_emitted = 0
         self._rate_window: Optional[tuple[float, int]] = None
 
     # -- device plumbing (jit build / sharding / reset) --------------------
+
+    def _on_tpu(self) -> bool:
+        """Backend check for the compiled Pallas path (the probe shared
+        with the runner's auto-flash resolution)."""
+        from arkflow_tpu.tpu.serving_core import on_tpu_backend
+
+        devs = (list(self.mesh.devices.flat) if self.mesh is not None
+                else None)
+        return on_tpu_backend(devs)
+
+    def _paged_kernel_parity_ok(self) -> bool:
+        """Argmax-parity gate for the paged attention kernel: one tiny
+        golden batch — prompts that cross a page boundary plus a
+        single-token tail, on non-contiguous page tables — through prefill,
+        then one decode step and one 2-token chunk with BOTH kernels. The
+        fast path only serves if every argmax agrees with the dense-gather
+        reference (PR-6 convention: parity gates the measured default).
+        Runs eagerly on this server's params; one-time init cost."""
+        from arkflow_tpu.models.paged_decode import paged_prefill_chunk
+
+        page = self.page_size
+        n0 = min(page + 1, self.max_seq)  # crosses a page boundary
+        pages_per = -(-(n0 + 3) // page)  # room for prompt + decode + chunk
+        kp, vp = init_page_pool(self.cfg, 1 + 2 * pages_per, page)
+        rng = np.random.RandomState(1234)
+        ids = np.zeros((2, n0), np.int32)
+        ids[0] = rng.randint(1, self.cfg.vocab_size, n0)
+        ids[1, 0] = rng.randint(1, self.cfg.vocab_size)
+        lens = jnp.asarray([n0, 1], jnp.int32)
+        table = np.zeros((2, pages_per), np.int32)
+        table[0] = np.arange(1, 2 * pages_per, 2)[::-1]  # non-contiguous
+        table[1] = np.arange(2, 2 * pages_per + 1, 2)
+        table = jnp.asarray(table)
+        _, kp, vp = paged_prefill(
+            self.params, self.cfg, jnp.asarray(ids), lens, table, kp, vp)
+        tok = jnp.asarray(ids[:, 0])
+        act = jnp.asarray([True, True])
+        ref, *_ = paged_decode_step(
+            self.params, self.cfg, tok, lens, act, table, kp, vp,
+            return_logits=True)
+        got, *_ = paged_decode_step(
+            self.params, self.cfg, tok, lens, act, table, kp, vp,
+            return_logits=True, attention_kernel="paged",
+            kernel_interpret=self.kernel_interpret)
+        if not bool((jnp.argmax(ref, -1) == jnp.argmax(got, -1)).all()):
+            return False
+        cids = jnp.asarray(rng.randint(1, self.cfg.vocab_size, (2, 2)),
+                           jnp.int32)
+        clen = jnp.asarray([2, 2], jnp.int32)
+        ref, *_ = paged_prefill_chunk(
+            self.params, self.cfg, cids, lens, clen, table, kp, vp,
+            return_all=True)
+        got, *_ = paged_prefill_chunk(
+            self.params, self.cfg, cids, lens, clen, table, kp, vp,
+            return_all=True, attention_kernel="paged",
+            kernel_interpret=self.kernel_interpret)
+        return bool((jnp.argmax(ref, -1) == jnp.argmax(got, -1)).all())
 
     def _init_pools(self):
         """Fresh KV page pools, placed with their tensor-parallel sharding
@@ -258,6 +431,8 @@ class GenerationServer:
 
         cfg = self.cfg
         kv_layer = self._kv_layer_sharding
+        kern = dict(attention_kernel=self.decode_kernel,
+                    kernel_interpret=self.kernel_interpret)
 
         def _pick(logits, key):
             return select_token(logits, key, self.temperature, self.top_k)
@@ -267,7 +442,7 @@ class GenerationServer:
         def _decode(tok, lens, act, table, kp, vp, key):
             logits, kp, vp = paged_decode_step(
                 self.params, cfg, tok, lens, act, table, kp, vp,
-                return_logits=True, kv_sharding=kv_layer)
+                return_logits=True, kv_sharding=kv_layer, **kern)
             return _pick(logits, key), kp, vp
 
         def _prefill(ids, lens, table, kp, vp, key):
@@ -278,12 +453,13 @@ class GenerationServer:
 
         def _chunk(ids, off, clen, table, kp, vp):
             return paged_prefill_chunk(self.params, cfg, ids, off, clen,
-                                       table, kp, vp, kv_sharding=kv_layer)
+                                       table, kp, vp, kv_sharding=kv_layer,
+                                       **kern)
 
         def _verify(ids, off, clen, table, kp, vp):
             return paged_prefill_chunk(self.params, cfg, ids, off, clen,
                                        table, kp, vp, return_all=True,
-                                       kv_sharding=kv_layer)
+                                       kv_sharding=kv_layer, **kern)
 
         if self.mesh is None:
             self._decode = jax.jit(_decode, donate_argnums=(4, 5))
@@ -319,6 +495,8 @@ class GenerationServer:
         a zombie step still owns the donated pool buffers, and the prefix
         cache's KV content died with them. Every future admission starts
         from a clean pool (leaked refs would wedge admission forever)."""
+        self._pipeline = None  # a zombie step's tokens are never applied
+        self._gen_inflight = 0
         self._prefix_cache.clear()
         self._cache_pages.clear()
         self._prefix_lengths.clear()
@@ -374,6 +552,8 @@ class GenerationServer:
         the serving detail that says whether the server is keeping up."""
         rep = self.core.health_report()
         rep["serving"] = "continuous"
+        rep["decode_kernel"] = self.decode_kernel
+        rep["dispatch_depth"] = self.dispatch_depth
         rep["draining"] = self._draining
         rep["slots"] = self.slots
         rep["slots_busy"] = sum(1 for r in self._slot_req if r is not None)
@@ -402,6 +582,23 @@ class GenerationServer:
         self._seen_steps.add(key)
         return True
 
+    def _track_gen_dispatch(self) -> None:
+        """Device-idle-gap bookkeeping at step launch: an open idle window
+        (no step in flight, or a drained device queue detected by the
+        pipelined path via ``is_ready`` — see ``_step_pipelined``) closes
+        here and records its gap."""
+        if self._gen_idle_since is not None:
+            self.m_idle_gap.observe(time.monotonic() - self._gen_idle_since)
+            self._gen_idle_since = None
+        self._gen_inflight += 1
+
+    def _track_gen_complete(self) -> None:
+        self._gen_inflight = max(0, self._gen_inflight - 1)
+        # keep the EARLIER start when the drained-queue check already
+        # opened the window (the device has been idle since then)
+        if self._gen_inflight == 0 and self._gen_idle_since is None:
+            self._gen_idle_since = time.monotonic()
+
     async def _run_device_step(self, key: tuple, fn):
         """One health-gated jitted call: the same admission gate pool
         dispatch uses, a first-compile-aware deadline watchdog, and the
@@ -417,6 +614,7 @@ class GenerationServer:
             core.apply_chaos()
             return jax.block_until_ready(fn())
 
+        self._track_gen_dispatch()
         try:
             if deadline is None:
                 out = await asyncio.get_running_loop().run_in_executor(
@@ -428,6 +626,10 @@ class GenerationServer:
         except Exception as e:
             core.health.mark_unhealthy(f"generate step failed: {e}")
             raise
+        finally:
+            # an abandoned step counts complete: the device stopped doing
+            # useful work, and the reset path rebuilds from fresh pools
+            self._track_gen_complete()
         core.health.mark_success()
         return out
 
@@ -760,6 +962,12 @@ class GenerationServer:
                           if self._slot_req[s] and s not in self._prefill_pos]
                 self._update_gauges(len(active) + len(prefilling))
                 if not active and not prefilling:
+                    # a pipelined successor can outlive its lanes (every
+                    # request EOS'd on the step that was applied AFTER it
+                    # was dispatched): apply it before idling or exiting,
+                    # or its step would leak in-flight accounting and only
+                    # be fetched by some future wave's admission drain
+                    await self._drain_pipeline()
                     if not self._pending:
                         return  # drained; next generate() restarts the loop
                     if not admitted:
@@ -769,6 +977,7 @@ class GenerationServer:
                 # with one decode step so neither starves the other
                 if prefilling and (not active or self._turn_prefill):
                     self._turn_prefill = False
+                    await self._drain_pipeline()
                     await self._prefill_one_chunk(prefilling[0])
                     continue
                 self._turn_prefill = True
@@ -787,6 +996,11 @@ class GenerationServer:
             self._reset_device_state()
 
     def _fail_all(self, err: Exception) -> None:
+        # both in-flight pipelined steps (the un-applied one and any just
+        # dispatched successor) die with their requests: their tokens are
+        # never applied, and the reset below rebuilds from fresh pools
+        self._pipeline = None
+        self._gen_inflight = 0
         self._prefill_pos.clear()
         for s in range(self.slots):
             req = self._slot_req[s]
@@ -818,12 +1032,33 @@ class GenerationServer:
                 break  # head-of-line waits for pages (FIFO fairness)
             self._pending.popleft()
             pages, shared_len = reserved
+            # catch host state up before the admission prefill dispatches:
+            # its (possibly first-compile) deadline must not also cover an
+            # in-flight decode step queued ahead of it on the device
+            await self._drain_pipeline()
             await self._admit_one(slot, req, pages, shared_len)
             admitted = True
         return admitted
 
     async def _step(self, active: list[int]) -> None:
-        """One lockstep decode over all slots (inactive lanes masked)."""
+        """One lockstep decode over all slots (inactive lanes masked).
+
+        At ``dispatch_depth`` 2 the pipelined path runs instead: step N+1
+        is dispatched from step N's device-resident tokens before N's
+        outputs reach the host, then N is applied — host bookkeeping and
+        device compute overlap. Cold/recovering states (first compile,
+        probe steps, page-pool pressure) fall back to this classic path."""
+        if self.dispatch_depth > 1 and await self._step_pipelined(active):
+            return
+        await self._drain_pipeline()
+        # the drains above may have APPLIED a pending step whose tokens
+        # finished requests in `active` (slot freed, pages returned):
+        # recompute from host truth, or _reserve_or_truncate would feed a
+        # ghost lane — allocating a page the next admission leaks, or
+        # truncating a live request to serve a slot with no request
+        active = [s for s in active if self._slot_req[s] is not None]
+        if not active:
+            return
         act = np.zeros(self.slots, bool)
         act[active] = True
         for s in active:
@@ -842,6 +1077,144 @@ class GenerationServer:
         nxt_host = np.asarray(nxt)
         for s in range(self.slots):
             if not act[s] or self._slot_req[s] is None:
+                continue
+            self._lengths[s] += 1
+            self._cur_tokens[s] = nxt_host[s]
+            self._handle_token(s, int(nxt_host[s]))
+
+    # -- pipelined dispatch (dispatch_depth 2) -------------------------------
+
+    async def _step_pipelined(self, active: list[int]) -> bool:
+        """Dispatch decode step N+1, THEN apply the in-flight step N.
+
+        The data dependency between consecutive decode steps (next step's
+        token ids are this step's outputs) is left ON the device: the
+        dispatch consumes the in-flight step's un-fetched next-token array,
+        so the device queue always holds the successor before the host
+        fetches, and host-side page accounting / EOS checks overlap device
+        compute instead of serializing with it.
+
+        What the host cannot know one step early is EOS: a lane whose
+        pending token turns out to be EOS still rides the speculative
+        dispatch; its token is dropped at apply (request identity is
+        snapshotted). Budget exhaustion IS host-known, so those lanes are
+        masked out up front. Greedy-only (validated at construction), so
+        the emitted token streams are bitwise identical to depth 1.
+
+        Returns False when the classic path should run instead: cold
+        decode jit (first-compile budget), non-HEALTHY core (probe steps
+        take the gated path), or page-pool pressure (truncation policy
+        lives in the classic path)."""
+        from arkflow_tpu.tpu.health import HEALTHY
+
+        if ("decode",) not in self._seen_steps \
+                or self.core.health.state != HEALTHY:
+            await self._drain_pipeline()
+            return False
+        act = np.zeros(self.slots, bool)
+        act[active] = True
+        pend = self._pipeline
+        eff_lens = self._lengths.copy()
+        if pend is not None:
+            eff_lens += pend.act.astype(np.int32)
+            for s in active:
+                req = self._slot_req[s]
+                if req is None or (pend.act[s] and req is not pend.reqs[s]):
+                    act[s] = False
+                elif pend.act[s] and len(req.tokens) + 1 >= req.max_new_tokens:
+                    # the pending token completes this lane's budget: it
+                    # must not ride the next dispatch
+                    act[s] = False
+        if not act.any():
+            # every lane is finishing on the pending step: apply it and let
+            # the loop re-evaluate (admission / drain / exit)
+            await self._drain_pipeline()
+            return True
+        for s in np.flatnonzero(act):
+            if not self._ensure_page_capacity(int(s), int(eff_lens[s]) + 1):
+                await self._drain_pipeline()
+                return False  # classic path owns the truncation policy
+        cur = pend.nxt if pend is not None else jnp.asarray(self._cur_tokens)
+        lens = jnp.asarray(eff_lens)
+        act_dev = jnp.asarray(act)
+        table = self._table_array()
+        self._key, sub = jax.random.split(self._key)
+        loop = asyncio.get_running_loop()
+        self._track_gen_dispatch()
+
+        # pools bound eagerly (same zombie discipline as the classic path);
+        # the dispatch only ENQUEUES — the jit returns device futures, all
+        # waiting happens in _apply_pipeline under the per-step deadline
+        def enqueue(kp=self.k_pages, vp=self.v_pages):
+            return self._decode(cur, lens, act_dev, table, kp, vp, sub)
+
+        nxt, self.k_pages, self.v_pages = await loop.run_in_executor(
+            None, enqueue)
+        rec = _InFlightDecode(nxt=nxt, act=act, reqs=list(self._slot_req),
+                              dispatched_at=time.monotonic())
+        self._pipelined_dispatches += 1
+        if pend is not None:
+            self._pipeline = None
+            await self._apply_pipeline(pend)
+            # honest idle accounting under pipelining: the in-flight count
+            # alone can't see a drained device (one step is always nominally
+            # in flight). If the successor's outputs are ALREADY computed,
+            # the device finished its whole queue during our apply and sits
+            # idle until the next enqueue — open the idle window so the gap
+            # records instead of silently reading as perfect overlap.
+            if self._gen_idle_since is None:
+                try:
+                    drained = bool(rec.nxt.is_ready())
+                except Exception:
+                    drained = False
+                if drained:
+                    self._gen_idle_since = time.monotonic()
+        self._pipeline = rec
+        return True
+
+    async def _drain_pipeline(self) -> None:
+        """Fetch + apply the in-flight decode step, if any: every non-decode
+        event (admission prefill, chunked prefill, speculative steps, swap
+        drain, loop exit) runs against caught-up host state."""
+        if self._pipeline is None:
+            return
+        pend, self._pipeline = self._pipeline, None
+        await self._apply_pipeline(pend)
+
+    async def _apply_pipeline(self, rec: _InFlightDecode) -> None:
+        """Fetch one in-flight step's tokens (deadlined from ITS dispatch
+        time — serving_core.deadline_remaining) and apply them to host
+        state. A lane whose request finished or was replaced since dispatch
+        drops its token (wasted compute, never wrong tokens)."""
+        core = self.core
+
+        def blocking():
+            core.apply_chaos()
+            return np.asarray(jax.device_get(rec.nxt))
+
+        deadline = core.deadline_for(False)  # pipelined steps are warm
+        try:
+            if deadline is None:
+                nxt_host = await asyncio.get_running_loop().run_in_executor(
+                    None, blocking)
+            else:
+                nxt_host = await core.run_deadlined(
+                    blocking, core.deadline_remaining(
+                        deadline, rec.dispatched_at))
+        except StepDeadlineExceeded:
+            raise  # core marked UNHEALTHY; the serve loop fails + resets
+        except Exception as e:
+            core.health.mark_unhealthy(f"generate step failed: {e}")
+            raise
+        finally:
+            self._track_gen_complete()
+        core.health.mark_success()
+        self.m_steps.inc()
+        for s in range(self.slots):
+            if not rec.act[s]:
+                continue
+            req = self._slot_req[s]
+            if req is None or req is not rec.reqs[s]:
                 continue
             self._lengths[s] += 1
             self._cur_tokens[s] = nxt_host[s]

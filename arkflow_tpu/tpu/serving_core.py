@@ -79,6 +79,20 @@ def is_oom_error(e: BaseException) -> bool:
     return any(re.search(rf"\b{re.escape(sig)}\b", msg) for sig in _OOM_SIGNATURES)
 
 
+def on_tpu_backend(devices=None) -> bool:
+    """Is the (first) execution device a TPU? The one backend probe the
+    auto-resolved fast paths share (runner auto-flash, serving auto decode
+    kernel) — a device_kind fix lands once, not per copy."""
+    try:
+        import jax
+
+        dev = devices[0] if devices else jax.devices()[0]
+        return (dev.platform == "tpu"
+                or "tpu" in getattr(dev, "device_kind", "").lower())
+    except Exception:
+        return False
+
+
 def parse_core_config(config: Mapping[str, Any]) -> dict:
     """Parse the shared self-healing keys a device processor config carries
     (``step_deadline`` / ``step_deadline_first`` / ``health``) into the
@@ -192,6 +206,19 @@ class ServingRunnerCore:
         if self.step_deadline_s is None:
             return None
         return self.step_deadline_first_s if first_compile else self.step_deadline_s
+
+    @staticmethod
+    def deadline_remaining(deadline_s: float, dispatched_at: float,
+                           *, floor: float = 0.05) -> float:
+        """Watchdog budget left for an ALREADY-DISPATCHED step (pipelined
+        dispatch, ``dispatch_depth`` > 1): each in-flight step's deadline
+        runs from the moment IT was enqueued on the device, not from when
+        the host gets around to fetching its outputs — otherwise a hung
+        step N would silently spend step N+1's budget too, and a miss
+        would be detected one full step late. Floored so host bookkeeping
+        jitter between dispatch and fetch can never turn an on-time step
+        into a spurious zero-budget miss."""
+        return max(deadline_s - (time.monotonic() - dispatched_at), floor)
 
     def _borrow_watchdog(self):
         """A single-thread executor for one deadlined step: reused across

@@ -952,7 +952,8 @@ def _generate_tp_child() -> None:
             "model_config": model_config, "serving": "continuous",
             "slots": 8, "page_size": 16, "max_input": 64,
             "max_new_tokens": max_new, "eos_id": -1,
-            "batch_buckets": [8], "seq_buckets": [64]}
+            "batch_buckets": [8], "seq_buckets": [64],
+            **_gen_kernel_cfg()}
 
     def tps(cfg_map) -> float:
         proc = build_component("processor", cfg_map, Resource())
@@ -991,8 +992,103 @@ def _generate_tp_child() -> None:
             # knob record (PR-6 convention): the phase serves unpacked f32
             "packing": False,
             "serving_dtype": "float32",
+            "decode_kernel": base["decode_kernel"],
+            "dispatch_depth": 1,
             "caveat": "virtual host devices share physical cores; real-slice "
                       "efficiency reads higher",
+        },
+    })
+
+
+class _GapRecorder:
+    """Raw-sample stand-in for the idle-gap histogram: the Prometheus
+    histogram's fixed buckets are too coarse for a p50/p99 readout, so the
+    bench swaps the server's metric object for this recorder (same
+    ``observe`` surface) and computes exact percentiles."""
+
+    def __init__(self):
+        self.samples: list[float] = []
+
+    def observe(self, v: float) -> None:
+        self.samples.append(float(v))
+
+    def pct(self, q: float) -> float:
+        if not self.samples:
+            return 0.0
+        s = sorted(self.samples)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _gen_kernel_cfg() -> dict:
+    """The decode-kernel knobs every generate phase records: BENCH_GEN_KERNEL
+    pins gather (reference) or paged (the Pallas page-table kernel); unset,
+    the bench measures the server's auto default — paged on TPU, gather
+    elsewhere — recorded explicitly so the phase detail never says "auto".
+    Forcing paged on CPU runs it interpreted (functional, not
+    representative of TPU speed — the phase detail carries the caveat)."""
+    kernel = os.environ.get("BENCH_GEN_KERNEL") or (
+        "paged" if _backend() == "tpu" else "gather")
+    cfg = {"decode_kernel": kernel}
+    if kernel == "paged" and _backend() != "tpu":
+        cfg["kernel_interpret"] = True
+    return cfg
+
+
+def _run_generate_depth_phase(tiny: bool, model_config: dict) -> None:
+    """Depth-1 vs depth-2 comparison on the SAME workload: the dispatch-depth
+    win is a smaller device-idle gap (step N+1 queued before N completes)
+    with bitwise-identical greedy outputs. ``BENCH_GEN_DEPTH=0`` skips."""
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Resource, build_component
+
+    rows = int(os.environ.get("BENCH_GEN_DEPTH_ROWS", "16"))
+    max_new = int(os.environ.get("BENCH_GEN_DEPTH_TOKENS", "24"))
+    base = {"type": "tpu_generate", "model": "decoder_lm",
+            "model_config": model_config, "serving": "continuous",
+            "slots": 8, "page_size": 16, "max_input": 64,
+            "max_new_tokens": max_new, "eos_id": -1,
+            "batch_buckets": [8], "seq_buckets": [64],
+            **_gen_kernel_cfg()}
+
+    def run(depth: int):
+        proc = build_component("processor", {**base, "dispatch_depth": depth},
+                               Resource())
+        rec = _GapRecorder()
+        proc._server.m_idle_gap = rec
+        batch = MessageBatch.new_binary(
+            [f"sensor event {i} nominal reading".encode() for i in range(rows)])
+
+        async def go():
+            await proc.process(MessageBatch.new_binary([b"warmup prompt"]))
+            rec.samples.clear()  # warm-step gaps only
+            t0 = time.perf_counter()
+            out = await proc.process(batch)
+            return time.perf_counter() - t0, out
+
+        elapsed, out = asyncio.run(go())
+        texts = out[0].column(proc.output_field).to_pylist() if out else []
+        return rows * max_new / elapsed if elapsed > 0 else 0.0, rec, texts
+
+    tps1, rec1, out1 = run(1)
+    tps2, rec2, out2 = run(2)
+    _emit({
+        "metric": "generate_dispatch_depth2_speedup",
+        "value": round(tps2 / tps1, 4) if tps1 > 0 else 0.0,
+        "unit": "ratio",
+        "vs_baseline": 0.0,
+        "detail": {
+            "rows": rows, "max_new_tokens": max_new,
+            "tokens_per_sec_depth1": round(tps1, 1),
+            "tokens_per_sec_depth2": round(tps2, 1),
+            "device_idle_gap_p50_ms_depth1": round(rec1.pct(0.5) * 1e3, 3),
+            "device_idle_gap_p50_ms_depth2": round(rec2.pct(0.5) * 1e3, 3),
+            "device_idle_gap_p99_ms_depth1": round(rec1.pct(0.99) * 1e3, 3),
+            "device_idle_gap_p99_ms_depth2": round(rec2.pct(0.99) * 1e3, 3),
+            # acceptance: pipelining must not change a single greedy token
+            "identical_outputs": out1 == out2,
+            **_gen_kernel_cfg(),
+            "serving": "continuous", "backend": _backend(),
+            "packing": False, "serving_dtype": "float32",
         },
     })
 
@@ -1001,7 +1097,10 @@ def _run_generate_bench(tiny: bool) -> None:
     """BENCH_MODE=generate: continuous-batching generation throughput
     (tokens/sec) through the tpu_generate processor's paged-KV server.
     A TP phase (1-chip vs tp=N on a forced host mesh) runs first unless
-    BENCH_GEN_TP=0, so the headline metric stays tokens/sec."""
+    BENCH_GEN_TP=0, then a dispatch-depth 1-vs-2 phase unless
+    BENCH_GEN_DEPTH=0, so the headline metric stays tokens/sec. Every
+    phase detail records the decode kernel, dispatch depth, and the warm
+    device-idle-gap p50 so both PR-13 wins stay separately attributable."""
     from arkflow_tpu.batch import MessageBatch
     from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
 
@@ -1013,18 +1112,24 @@ def _run_generate_bench(tiny: bool) -> None:
          "ffn": 96, "max_seq": 256}
         if tiny else {"max_seq": 2048}
     )
+    if os.environ.get("BENCH_GEN_DEPTH", "1") != "0":
+        _run_generate_depth_phase(tiny, model_config)
     max_new = int(os.environ.get("BENCH_GEN_TOKENS", "32"))
     rows = int(os.environ.get("BENCH_GEN_ROWS", "64"))
+    dispatch_depth = int(os.environ.get("BENCH_GEN_DISPATCH", "1"))
     proc = build_component(
         "processor",
         {"type": "tpu_generate", "model": "decoder_lm", "model_config": model_config,
          "serving": "continuous", "slots": 8, "page_size": 16,
          "max_input": 64, "max_new_tokens": max_new, "eos_id": -1,
          "batch_buckets": [8], "seq_buckets": [64],
+         "dispatch_depth": dispatch_depth, **_gen_kernel_cfg(),
          # BENCH_SPEC=k: self-drafted speculative decode (greedy-exact)
          "speculative_tokens": int(os.environ.get("BENCH_SPEC", "0"))},
         Resource(),
     )
+    gap_rec = _GapRecorder()
+    proc._server.m_idle_gap = gap_rec
 
     async def go() -> tuple[float, float]:
         batch = MessageBatch.new_binary(
@@ -1032,19 +1137,25 @@ def _run_generate_bench(tiny: bool) -> None:
         t_warm = time.perf_counter()
         await proc.process(MessageBatch.new_binary([b"warmup prompt"]))
         warm_s = time.perf_counter() - t_warm
+        gap_rec.samples.clear()  # warm-step gaps only
         t0 = time.perf_counter()
         await proc.process(batch)
         return time.perf_counter() - t0, warm_s
 
     elapsed, warm_s = asyncio.run(go())
     total_tokens = rows * max_new
+    server = proc._server
     detail = {"rows": rows, "max_new_tokens": max_new,
               "elapsed_s": round(elapsed, 2), "warmup_s": round(warm_s, 2),
               "serving": "continuous", "slots": 8, "backend": _backend(),
+              # PR-13 knob record: which kernel + dispatch depth served, and
+              # how idle the device sat between consecutive warm steps
+              "decode_kernel": server.decode_kernel,
+              "dispatch_depth": server.dispatch_depth,
+              "device_idle_gap_p50_ms": round(gap_rec.pct(0.5) * 1e3, 3),
               # knob record: generation serves unpacked at default precision
               "packing": False, "serving_dtype": "float32"}
-    server = getattr(proc, "_server", None)
-    if server is not None and server.m_spec_drafted.value > 0:
+    if server.m_spec_drafted.value > 0:
         detail["speculative_tokens"] = server.speculative_tokens
         detail["spec_acceptance"] = round(
             server.m_spec_accepted.value / server.m_spec_drafted.value, 3)
