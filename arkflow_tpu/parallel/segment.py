@@ -1,0 +1,176 @@
+"""Profiled model segmentation: cut layer stacks into cost-balanced stages.
+
+Implements the planning half of "Improving inference time in multi-TPU
+systems with profiled model segmentation" (PAPERS.md): given measured
+per-layer costs (``tools/profile_step.py --per-layer``) and a stage count S,
+choose S contiguous layer ranges minimizing the MAX stage cost — the
+pipeline's tick time is the slowest stage, so minimizing the max is
+minimizing steady-state latency AND maximizing throughput at once.
+
+Pure host-side math (no jax): the executor (``parallel/pipeline.py
+make_pp_infer_step``) consumes the plan, and the plan rides bench/health
+output so stage imbalance is attributable to the profile that produced it.
+
+The planner is exact: dynamic programming over (layer, stage) prefixes,
+O(S * L^2) with L = layer count — transformers have tens of layers, so
+optimality is cheap and "balanced within one layer of optimal" is a
+guarantee, not a heuristic's hope.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from arkflow_tpu.errors import ConfigError
+
+
+@dataclass(frozen=True)
+class StagePlan:
+    """S contiguous layer ranges over an L-layer stack.
+
+    ``bounds[s] = (start, end)`` half-open: stage ``s`` runs layers
+    ``start..end-1``. Every layer is covered exactly once and every stage
+    holds >= 1 layer.
+    """
+
+    bounds: tuple[tuple[int, int], ...]
+    #: the per-layer costs the cut was computed from (uniform 1.0 when no
+    #: profile was supplied) — kept so reports show WHAT was balanced
+    layer_costs: tuple[float, ...]
+
+    @property
+    def stages(self) -> int:
+        return len(self.bounds)
+
+    @property
+    def num_layers(self) -> int:
+        return self.bounds[-1][1]
+
+    @property
+    def sizes(self) -> tuple[int, ...]:
+        return tuple(e - s for s, e in self.bounds)
+
+    @property
+    def stage_costs(self) -> tuple[float, ...]:
+        return tuple(sum(self.layer_costs[s:e]) for s, e in self.bounds)
+
+    @property
+    def max_stage_cost(self) -> float:
+        return max(self.stage_costs)
+
+    @property
+    def imbalance(self) -> float:
+        """max stage cost / mean stage cost — 1.0 is a perfect cut; the
+        pipeline's bubble-adjusted efficiency degrades linearly with it."""
+        costs = self.stage_costs
+        mean = sum(costs) / len(costs)
+        return max(costs) / mean if mean > 0 else 1.0
+
+    @property
+    def uniform(self) -> bool:
+        """Every stage holds the same number of layers (the executor skips
+        per-slot activity masking entirely for uniform plans)."""
+        return len(set(self.sizes)) == 1
+
+    def report(self) -> dict:
+        """JSON-able form for bench detail / the engine's /health."""
+        return {
+            "stages": self.stages,
+            "num_layers": self.num_layers,
+            "bounds": [list(b) for b in self.bounds],
+            "stage_costs": [round(c, 6) for c in self.stage_costs],
+            "max_stage_cost": round(self.max_stage_cost, 6),
+            "imbalance": round(self.imbalance, 4),
+        }
+
+
+def plan_stages(layer_costs: Sequence[float], stages: int) -> StagePlan:
+    """Optimal contiguous S-way partition of ``layer_costs`` minimizing the
+    max stage cost.
+
+    DP over prefixes: ``best[s][i]`` = minimal achievable max-stage cost
+    covering layers ``0..i-1`` with ``s`` stages. Ties broken toward LATER
+    cut points (earlier stages absorb more layers), which keeps uniform-cost
+    vectors cutting into equal-size stages.
+    """
+    costs = [float(c) for c in layer_costs]
+    n = len(costs)
+    if n == 0:
+        raise ConfigError("plan_stages: layer_costs must be non-empty")
+    if any(c < 0 for c in costs):
+        raise ConfigError(f"plan_stages: layer costs must be >= 0, got {costs}")
+    if not isinstance(stages, int) or isinstance(stages, bool) or stages < 1:
+        raise ConfigError(f"plan_stages: stages must be an int >= 1, got {stages!r}")
+    if stages > n:
+        raise ConfigError(
+            f"plan_stages: cannot cut {n} layers into {stages} stages "
+            "(every stage needs at least one layer)")
+
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+
+    def span(i: int, j: int) -> float:
+        return prefix[j] - prefix[i]
+
+    INF = float("inf")
+    # best[i]: minimal max-stage cost for layers 0..i-1 with the current
+    # number of stages; cut[s][i]: where stage s-1 began in that optimum
+    best = [0.0 if i == 0 else INF for i in range(n + 1)]
+    cuts: list[list[int]] = []
+    for s in range(1, stages + 1):
+        nxt = [INF] * (n + 1)
+        cut_row = [0] * (n + 1)
+        # with s stages, at least s layers are covered and at least
+        # stages - s layers must remain for the later stages
+        for i in range(s, n - (stages - s) + 1):
+            b, c = INF, s - 1
+            for k in range(s - 1, i):
+                cand = max(best[k], span(k, i))
+                # <= prefers the LATEST feasible cut: uniform costs then
+                # split ceil-first (e.g. 4 layers / 3 stages -> 2,1,1)
+                if cand <= b:
+                    b, c = cand, k
+            nxt[i], cut_row[i] = b, c
+        best = nxt
+        cuts.append(cut_row)
+
+    bounds: list[tuple[int, int]] = []
+    end = n
+    for s in range(stages, 0, -1):
+        start = cuts[s - 1][end]
+        bounds.append((start, end))
+        end = start
+    bounds.reverse()
+    return StagePlan(tuple(bounds), tuple(costs))
+
+
+def uniform_plan(num_layers: int, stages: int) -> StagePlan:
+    """The no-profile default: every layer costs 1.0 (transformer stacks are
+    homogeneous, so this IS the optimal cut until a profile says otherwise)."""
+    return plan_stages([1.0] * num_layers, stages)
+
+
+def load_layer_costs(path: str, *, expect_layers: Optional[int] = None) -> list[float]:
+    """Read per-layer costs from a ``tools/profile_step.py --per-layer``
+    JSON artifact (key ``per_layer_ms``; a bare JSON list also works)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ConfigError(f"pp_profile: cannot read layer costs from {path!r}: {e}") from e
+    costs = data if isinstance(data, list) else data.get("per_layer_ms")
+    if not isinstance(costs, list) or not costs or \
+            not all(isinstance(c, (int, float)) and not isinstance(c, bool) and c >= 0
+                    for c in costs):
+        raise ConfigError(
+            f"pp_profile {path!r}: expected a non-empty 'per_layer_ms' list of "
+            "non-negative numbers (tools/profile_step.py --per-layer output)")
+    if expect_layers is not None and len(costs) != expect_layers:
+        raise ConfigError(
+            f"pp_profile {path!r} has {len(costs)} per-layer costs but the "
+            f"model has {expect_layers} layers — re-profile with the served "
+            "model_config")
+    return [float(c) for c in costs]

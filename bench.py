@@ -14,10 +14,11 @@ several examples per model row, effective rows/s tracks real token count;
 BENCH_COALESCE (default follows BENCH_PACKING: token-budget coalescing in
 the buffer carves emissions that fill the top compiled (rows, seq) shape
 after packing), BENCH_RAGGED=1 for a mixed short/long payload distribution
-(the realistic packing workload), BENCH_MODE=multichip for the data-parallel
+(the realistic packing workload), BENCH_MODE=multichip for the multi-chip
 scaling phase (1 chip vs BENCH_MC_DEVICES chips on a forced host mesh;
-BENCH_MC_STYLE=dp|pool picks dp-sharded dispatch vs replicated device pool;
-emits scaling_efficiency). The packed default phase asserts argmax parity
+BENCH_MC_STYLE=dp|pool|pp picks dp-sharded dispatch vs replicated device
+pool vs pipelined model segmentation — pp runs the full three-way dp/pool/pp
+comparison with a latency-bound phase per style; emits scaling_efficiency). The packed default phase asserts argmax parity
 against the float32 unpacked reference before its number becomes the
 headline (BENCH_SKIP_PARITY=1 skips; a parity failure falls back to the
 unpacked float32 phase so the driver always gets an honest number).
@@ -733,13 +734,42 @@ def _packing_detail(batch: int, seq: int) -> dict:
     return out
 
 
-def build_multichip_config(batch: int, seq: int, n: int, style: str) -> dict:
+def _bench_pp_mb(batch: int, n: int) -> int:
+    """pp microbatch rows for a ``batch``-row bucket over ``n`` stages:
+    BENCH_MC_MB, defaulting to the largest DIVISOR of ``batch`` that yields
+    at least ~2 microbatches per stage (M >= 2n, analytic bubble
+    (n-1)/(M+n-1) ~< 1/3). Divisor, not batch//(2n): the GPipe schedule
+    needs bucket-exact microbatches, and e.g. batch 64 over 6 stages would
+    otherwise pick mb=5, which 64 doesn't divide by — a ConfigError at
+    phase build."""
+    env = os.environ.get("BENCH_MC_MB")
+    if env is not None:
+        return int(env)
+    target = max(1, batch // (2 * n))
+    mb = 1
+    while mb * 2 <= target and batch % (mb * 2) == 0:
+        mb *= 2
+    return mb
+
+
+def build_multichip_config(batch: int, seq: int, n: int, style: str,
+                           latency: bool = False,
+                           layers: int | None = None) -> dict:
     """One phase of the multichip bench: the tiny classifier served over
-    ``n`` chips — ``style="pool"`` (replicated device pool, no collectives)
-    or ``style="dp"`` (dp-sharded GSPMD dispatch). ``n=1`` is the
-    single-chip reference phase the efficiency is computed against."""
-    model_config = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
-                    "ffn": 64, "max_positions": 64, "num_labels": 2}
+    ``n`` chips — ``style="pool"`` (replicated device pool, no collectives),
+    ``style="dp"`` (dp-sharded GSPMD dispatch), or ``style="pp"``
+    (pipelined model segmentation: the layer stack cut across chips,
+    microbatches streamed stage-to-stage). ``n=1`` is the single-chip
+    reference phase the efficiency is computed against.
+
+    ``latency=True`` builds the small-bucket LATENCY-BOUND variant: a paced
+    trickle of ``LAT_BATCH``-row requests on a grid reaching down to the
+    request size — the regime where dp starves (a small request still pads
+    up to its dp-scaled smallest global bucket, so every chip burns a full
+    per-chip bucket on 1/n of the rows) and pp keeps every chip busy on one
+    request's layers."""
+    model_config = {"vocab_size": 512, "hidden": 32, "layers": layers or 2,
+                    "heads": 4, "ffn": 64, "max_positions": 64, "num_labels": 2}
     proc: dict = {
         "type": "tpu_inference",
         "model": "bert_classifier",
@@ -758,19 +788,53 @@ def build_multichip_config(batch: int, seq: int, n: int, style: str) -> dict:
             # the runner compiles the dp-scaled global bucket (batch*n);
             # coalesce targets the same grid so emissions stay bucket-exact
             coalesce["dp"] = n
+        elif style == "pp":
+            # layers must cover the stage count (every chip owns >= 1
+            # layer) — the three-way runner passes the deepened stack to
+            # EVERY style so the comparison stays one model
+            if model_config["layers"] < n:
+                raise ValueError(
+                    f"pp phase needs layers >= {n} stages "
+                    f"(got {model_config['layers']}); pass layers=")
+            proc["mesh"] = {"pp": n}
+            proc["pp_microbatch_rows"] = _bench_pp_mb(batch, n)
+            # ONE schedule in flight: a second interleaved GPipe schedule on
+            # the same chips inflates each step's wall time with the other
+            # schedule's ticks, double-counting the measured bubble (the
+            # acceptance compares it against the analytic (S-1)/(M+S-1))
+            proc["max_in_flight"] = int(
+                os.environ.get("BENCH_MC_PP_INFLIGHT", "1"))
         else:
             proc["device_pool"] = n
     capacity = batch * (n if style == "dp" else 1)
+    if latency:
+        # bounded offered load, buffer-timeout micro-batching: p99 measures
+        # end-to-end latency of small requests, not queueing under
+        # saturation. The grid reaches down to the request size — but dp
+        # STILL pads every request to its smallest dp-scaled global bucket
+        # (LAT_BATCH x n rows for LAT_BATCH offered), which is exactly the
+        # small-bucket starvation this phase exists to measure; pp serves
+        # the same request as layer-stage microbatches with every chip busy
+        from arkflow_tpu.tpu.bucketing import pow2_buckets
+
+        proc["batch_buckets"] = pow2_buckets(LAT_BATCH, batch)
+        if style == "pp" and n > 1:
+            proc["pp_microbatch_rows"] = max(1, LAT_BATCH // 2)
+        src = {"interval": f"{LAT_INTERVAL_MS}ms", "batch_size": LAT_BATCH}
+        buffer = {"type": "memory", "capacity": capacity, "timeout": "10ms"}
+    else:
+        src = {"interval": 0, "batch_size": batch}
+        buffer = {"type": "memory", "capacity": capacity, "timeout": "5ms",
+                  "coalesce": coalesce}
     return {
         # per-phase stream name: rows/e2e metrics are labeled by stream, so
         # the 1-chip and n-chip phases never share counters
-        "name": f"bench-mc{n}-{style}",
+        "name": f"bench-mc{n}-{style}" + ("-lat" if latency else ""),
         "input": {"type": "generate",
                   "payload": "stream processing on tpu: sensor reading "
                              "nominal, no anomaly detected",
-                  "interval": 0, "batch_size": batch},
-        "buffer": {"type": "memory", "capacity": capacity, "timeout": "5ms",
-                   "coalesce": coalesce},
+                  **src},
+        "buffer": buffer,
         "pipeline": {
             # workers must cover the whole pool's queue depth (n members x
             # max_in_flight each) or the extra chips just idle
@@ -813,30 +877,65 @@ def _feature_gauges() -> tuple[bool, bool]:
             bool(donate) and all(v == 1 for v in donate))
 
 
-def _run_multichip_bench() -> None:
-    """BENCH_MODE=multichip: data-parallel scaling on an n-device mesh.
+def _pp_bubble_gauge() -> float | None:
+    """Last measured ``arkflow_pp_bubble_frac`` (None before any pp step)."""
+    from arkflow_tpu.obs import global_registry
 
-    Phase 1 serves the workload on ONE device, phase 2 on all n (dp-sharded
-    GSPMD dispatch by default; BENCH_MC_STYLE=pool for the replicated device
-    pool, which wins on real chips for small-bucket/latency-bound traffic
-    but is bounded by host cores on a virtual mesh),
-    and the headline is ``scaling_efficiency`` = rows/s(n) / (n x rows/s(1))
-    — 1.0 is linear scaling, and anything is more honest than the old
-    MULTICHIP artifacts, which benched n chips each redundantly computing
-    the full batch. Always re-execs into a clean forced-host-device child
-    env (the phase validates SCALING MECHANICS hermetically; real-chip
-    absolute numbers come from the main bench). NOTE: virtual host devices
-    share the machine's physical cores, so CPU efficiency is bounded by
-    cores/n, not by the serving stack — on a real n-chip slice each device
-    is its own silicon and the same number reads as true scaling.
+    for m in global_registry().collect():
+        if getattr(m, "name", "") == "arkflow_pp_bubble_frac":
+            return round(float(m.value), 4)
+    return None
+
+
+def _pp_knobs(style: str, batch: int, n: int, mb: int | None = None) -> dict:
+    """pp knob record for a multichip phase detail (PR-6 convention: every
+    phase names the knobs it ran with, so regressions stay attributable).
+    Null on non-pp styles — the keys are still present so artifact diffs
+    line up. ``batch`` is the bucket the phase's requests land in; ``mb``
+    overrides the saturated-phase microbatch sizing (latency phases)."""
+    if style != "pp" or n <= 1:
+        return {"pp_stages": None, "microbatches": None,
+                "pp_bubble_frac": None}
+    mb = mb if mb is not None else _bench_pp_mb(batch, n)
+    m = max(1, batch // mb)
+    return {"pp_stages": n,
+            "microbatches": m,
+            "pp_microbatch_rows": mb,
+            "pp_bubble_frac": _pp_bubble_gauge(),
+            "pp_bubble_analytic": round((n - 1) / (m + n - 1), 4)}
+
+
+def _run_multichip_bench() -> None:
+    """BENCH_MODE=multichip: multi-chip serving-scaling on an n-device mesh.
+
+    Phase 1 serves the workload on ONE device, phase 2 on all n, and the
+    headline is ``scaling_efficiency`` = rows/s(n) / (n x rows/s(1)) — 1.0
+    is linear scaling. BENCH_MC_STYLE picks the mechanism: ``dp``
+    (dp-sharded GSPMD dispatch, the default), ``pool`` (replicated device
+    pool, no collectives), or ``pp`` — which runs the full THREE-WAY
+    dp/pool/pp comparison: saturated phases for all three styles at equal
+    chip count plus a small-bucket latency-bound phase per style, emitting
+    ``scaling_efficiency`` and p99 per style (the regime comparison the
+    pipelined-segmentation paper makes: dp starves on requests that can't
+    fill a shard; pp keeps every chip busy on one request's layers).
+
+    Always re-execs into a clean forced-host-device child env (the phase
+    validates SCALING MECHANICS hermetically; real-chip absolute numbers
+    come from the main bench). NOTE: virtual host devices share the
+    machine's physical cores, so CPU efficiency is bounded by cores/n, not
+    by the serving stack — on a real n-chip slice each device is its own
+    silicon and the same number reads as true scaling. The dp-vs-pp p99
+    comparison survives this caveat in the dp-starved regime because dp's
+    padding burns n x the TOTAL work (shared cores feel total work), but
+    record it honestly.
     """
     import subprocess
     import sys
 
     n = int(os.environ.get("BENCH_MC_DEVICES", "8"))
     style = os.environ.get("BENCH_MC_STYLE", "dp")
-    if style not in ("pool", "dp"):
-        print(f"bench: BENCH_MC_STYLE must be pool|dp, got {style!r}",
+    if style not in ("pool", "dp", "pp"):
+        print(f"bench: BENCH_MC_STYLE must be pool|dp|pp, got {style!r}",
               file=sys.stderr)
         sys.exit(2)
     if os.environ.get("ARKFLOW_MC_CHILD") != "1":
@@ -857,9 +956,14 @@ def _run_multichip_bench() -> None:
     batch = int(os.environ.get("BENCH_MC_BATCH", "64"))
     seq = int(os.environ.get("BENCH_MC_SEQ", "32"))
 
+    if style == "pp":
+        _run_multichip_threeway(n, seconds, batch, seq)
+        return
+
     r1 = asyncio.run(run_bench(
         seconds, batch, seq, True,
         cfg_map=build_multichip_config(batch, seq, 1, style)))
+
     bs0 = _per_device_busy_stall()
     rn = asyncio.run(run_bench(
         seconds, batch, seq, True,
@@ -898,6 +1002,103 @@ def _run_multichip_bench() -> None:
             # measures dispatch mechanics, not precision/packing wins)
             "packing": False,
             "serving_dtype": "float32",
+            **_pp_knobs(style, batch, n),
+        },
+    })
+
+
+def _run_multichip_threeway(n: int, seconds: float, batch: int, seq: int) -> None:
+    """BENCH_MC_STYLE=pp: the honest dp/pool/pp three-way comparison.
+
+    Saturated phases per style at equal chip count (scaling_efficiency
+    against the shared 1-chip reference), then a small-bucket latency-bound
+    phase per style (paced LAT_BATCH-row requests; p99 per style, with the
+    1-chip latency reference alongside). EVERY phase — including the 1-chip
+    references — serves the same ``max(2, n)``-layer model, so pp's
+    stage-per-chip requirement never tilts the model under any style. Every
+    phase detail records the style + pp knobs; the pp detail additionally
+    records the stage plan and the measured-vs-analytic bubble."""
+    layers = max(2, n)
+    r1 = asyncio.run(run_bench(
+        seconds, batch, seq, True,
+        cfg_map=build_multichip_config(batch, seq, 1, "pool", layers=layers)))
+    styles = ("dp", "pool", "pp")
+    saturated: dict[str, dict] = {}
+    for s in styles:
+        res = asyncio.run(run_bench(
+            seconds, batch, seq, True,
+            cfg_map=build_multichip_config(batch, seq, n, s, layers=layers)))
+        eff = (res["rows_per_sec"] / (n * r1["rows_per_sec"])
+               if r1["rows_per_sec"] > 0 else 0.0)
+        saturated[s] = {
+            "rows_per_sec": round(res["rows_per_sec"], 1),
+            "scaling_efficiency": round(eff, 4),
+            "p99_ms": round(res["p99_ms"], 2),
+            "style": s,
+            **_pp_knobs(s, batch, n),
+        }
+
+    lat_seconds = float(os.environ.get("BENCH_MC_LAT_SECONDS", str(seconds)))
+    lat1 = asyncio.run(run_bench(
+        lat_seconds, batch, seq, True,
+        cfg_map=build_multichip_config(batch, seq, 1, "pool", latency=True,
+                                       layers=layers)))
+    latency: dict[str, dict] = {
+        "1chip": {"p99_ms": round(lat1["p99_ms"], 2),
+                  "p50_ms": round(lat1["p50_ms"], 2)}}
+    for s in styles:
+        res = asyncio.run(run_bench(
+            lat_seconds, batch, seq, True,
+            cfg_map=build_multichip_config(batch, seq, n, s, latency=True,
+                                           layers=layers)))
+        latency[s] = {"p99_ms": round(res["p99_ms"], 2),
+                      "p50_ms": round(res["p50_ms"], 2),
+                      **_pp_knobs(s, LAT_BATCH, n, mb=max(1, LAT_BATCH // 2))}
+    # the acceptance comparison: at equal chip count, on latency-bound
+    # small-bucket traffic, pipelined segmentation must beat dp
+    # batch-splitting on p99 (dp pads every request to its scaled bucket)
+    pp_beats_dp = latency["pp"]["p99_ms"] < latency["dp"]["p99_ms"]
+
+    from arkflow_tpu.parallel.segment import uniform_plan
+
+    mb = _bench_pp_mb(batch, n)
+    plan = uniform_plan(layers, n)
+    pp_eff = saturated["pp"]["scaling_efficiency"]
+    _emit({
+        "metric": "multichip_scaling_efficiency",
+        "value": pp_eff,
+        "unit": "ratio",
+        "vs_baseline": round(pp_eff / 0.5, 4),
+        "detail": {
+            "n_devices": n,
+            "style": "pp",
+            "comparison": "threeway",
+            "rows_per_sec_1chip": round(r1["rows_per_sec"], 1),
+            "batch_per_chip": batch,
+            "seq": seq,
+            "saturated": saturated,
+            "latency_bound": {
+                "offered_batch": LAT_BATCH,
+                "interval_ms": LAT_INTERVAL_MS,
+                **latency,
+                "pp_beats_dp_p99": pp_beats_dp,
+            },
+            "pp_plan": plan.report(),
+            "pp_microbatch_rows": mb,
+            # the STEADY-STATE pairing (the ISSUE-14 acceptance check):
+            # saturated-phase measured bubble against the saturated-phase
+            # analytic — the gauge's LAST value would be the latency
+            # phase's, whose analytic is much higher (M=2)
+            "pp_bubble_frac": saturated["pp"]["pp_bubble_frac"],
+            "pp_bubble_analytic": round((n - 1) / (max(1, batch // mb) + n - 1), 4),
+            "backend": _backend(),
+            "host_cores": os.cpu_count(),
+            "packing": False,
+            "serving_dtype": "float32",
+            # honest caveat: virtual host devices share physical cores, so
+            # per-style absolute numbers are bounded by cores/n; the dp-pp
+            # p99 gap in the starved regime reflects dp's padded TOTAL work
+            "caveat": "forced host mesh: virtual devices share host cores",
         },
     })
 

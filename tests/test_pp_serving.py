@@ -1,0 +1,485 @@
+"""Pipelined model-segmentation serving (ISSUE 14): the stage planner math,
+the pp inference executor's bitwise parity against single-device serving,
+config validation at build AND parse time, the measured bubble gauge, and
+the per-layer profiler smoke.
+
+Runs on the 8-device virtual CPU platform conftest pins — real multi-device
+pp shardings, no TPU required.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from arkflow_tpu.errors import ConfigError
+from arkflow_tpu.parallel.segment import (
+    StagePlan,
+    load_layer_costs,
+    plan_stages,
+    uniform_plan,
+)
+from arkflow_tpu.tpu.bucketing import BucketPolicy
+
+TINY_BERT = {"vocab_size": 512, "hidden": 32, "layers": 4, "heads": 4,
+             "ffn": 64, "max_positions": 64, "num_labels": 2}
+
+
+def _tiny_inputs(n=8, seq=16, seed=3):
+    rng = np.random.RandomState(seed)
+    return {"input_ids": rng.randint(1, 512, (n, seq)).astype(np.int32),
+            "attention_mask": np.ones((n, seq), np.int32)}
+
+
+def _need_devices(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices")
+
+
+# -- stage planner math ------------------------------------------------------
+
+
+def test_plan_uniform_costs_even_cut():
+    plan = plan_stages([1.0] * 12, 4)
+    assert plan.sizes == (3, 3, 3, 3)
+    assert plan.bounds == ((0, 3), (3, 6), (6, 9), (9, 12))
+    assert plan.max_stage_cost == 3.0
+    assert plan.imbalance == 1.0
+    assert plan.uniform
+    # uniform_plan is the same cut
+    assert uniform_plan(12, 4) == plan
+
+
+def test_plan_non_divisible_uniform_within_one_layer():
+    # 10 uniform layers over 4 stages: optimal max is ceil(10/4) = 3
+    plan = plan_stages([1.0] * 10, 4)
+    assert plan.max_stage_cost == 3.0  # <= optimal + one layer, and exact here
+    assert sorted(plan.sizes, reverse=True)[0] == 3
+    assert sum(plan.sizes) == 10
+    assert not plan.uniform
+
+
+def _brute_force_max_cost(costs, stages):
+    n = len(costs)
+    best = float("inf")
+    for cuts in itertools.combinations(range(1, n), stages - 1):
+        bounds = list(zip((0,) + cuts, cuts + (n,)))
+        best = min(best, max(sum(costs[a:b]) for a, b in bounds))
+    return best
+
+
+@pytest.mark.parametrize("seed,stages", [(0, 2), (1, 3), (2, 4), (3, 5)])
+def test_plan_skewed_costs_optimal(seed, stages):
+    """The DP cut is EXACT: its max-stage cost equals the brute-force
+    optimum over all contiguous partitions, on skewed cost vectors."""
+    rng = np.random.RandomState(seed)
+    costs = [float(c) for c in rng.uniform(0.1, 10.0, size=9)]
+    plan = plan_stages(costs, stages)
+    # coverage: contiguous, every layer exactly once, every stage non-empty
+    assert plan.bounds[0][0] == 0 and plan.bounds[-1][1] == 9
+    for (a0, b0), (a1, b1) in zip(plan.bounds, plan.bounds[1:]):
+        assert b0 == a1 and b0 > a0
+    assert plan.bounds[-1][1] > plan.bounds[-1][0]
+    assert plan.max_stage_cost == pytest.approx(
+        _brute_force_max_cost(costs, stages))
+    assert plan.imbalance >= 1.0
+
+
+def test_plan_degenerate_cases():
+    # S=1: one stage holding everything
+    p1 = plan_stages([3.0, 1.0, 2.0], 1)
+    assert p1.bounds == ((0, 3),) and p1.max_stage_cost == 6.0
+    # S=num_layers: one layer per stage, max = the most expensive layer
+    pn = plan_stages([3.0, 1.0, 2.0], 3)
+    assert pn.sizes == (1, 1, 1) and pn.max_stage_cost == 3.0
+    with pytest.raises(ConfigError, match="at least one layer"):
+        plan_stages([1.0, 1.0], 3)
+    with pytest.raises(ConfigError, match="non-empty"):
+        plan_stages([], 1)
+    with pytest.raises(ConfigError, match=">= 1"):
+        plan_stages([1.0], 0)
+    with pytest.raises(ConfigError, match=">= 0"):
+        plan_stages([1.0, -2.0], 1)
+
+
+def test_plan_report_and_layer_costs_artifact(tmp_path):
+    plan = plan_stages([4.0, 1.0, 1.0, 1.0], 2)
+    rep = plan.report()
+    assert rep["stages"] == 2 and rep["num_layers"] == 4
+    assert rep["max_stage_cost"] == 4.0
+    assert rep["bounds"][0] == [0, 1]  # the heavy layer stands alone
+    # profile artifact round trip (the profile_step --per-layer shape)
+    path = tmp_path / "prof.json"
+    path.write_text(json.dumps({"per_layer_ms": [4.0, 1.0, 1.0, 1.0]}))
+    assert load_layer_costs(str(path)) == [4.0, 1.0, 1.0, 1.0]
+    with pytest.raises(ConfigError, match="re-profile"):
+        load_layer_costs(str(path), expect_layers=12)
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"per_layer_ms": []}')
+    with pytest.raises(ConfigError, match="per_layer_ms"):
+        load_layer_costs(str(bad))
+    with pytest.raises(ConfigError, match="cannot read"):
+        load_layer_costs(str(tmp_path / "absent.json"))
+
+
+# -- pp inference executor: parity -------------------------------------------
+
+
+def _single_runner(buckets=None):
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    return ModelRunner("bert_classifier", TINY_BERT,
+                       buckets=buckets or BucketPolicy((2, 4, 8), (16,)),
+                       devices=[jax.devices()[0]])
+
+
+def test_pp_outputs_bitwise_identical_to_single_device():
+    _need_devices(4)
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    inputs = _tiny_inputs()
+    single = _single_runner()
+    pp = ModelRunner("bert_classifier", TINY_BERT,
+                     buckets=BucketPolicy((2, 4, 8), (16,)),
+                     mesh_spec=MeshSpec(pp=4), pp_microbatch_rows=2)
+    a, b = single.infer_sync(inputs), pp.infer_sync(inputs)
+    assert set(a) == set(b)
+    for k in a:
+        # stage streaming must not change per-row math AT ALL: the same
+        # layer ops run in the same order, merely split across chips —
+        # bitwise, not allclose
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=k)
+
+
+def test_pp_uneven_profiled_plan_parity():
+    """A skewed profile produces an UNEVEN cut (padded stage slots skipped
+    via lax.cond) — outputs must still be bitwise identical."""
+    _need_devices(2)
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    inputs = _tiny_inputs()
+    single = _single_runner()
+    pp = ModelRunner("bert_classifier", TINY_BERT,
+                     buckets=BucketPolicy((2, 4, 8), (16,)),
+                     mesh_spec=MeshSpec(pp=2), pp_microbatch_rows=2,
+                     pp_layer_costs=[5.0, 1.0, 1.0, 1.0])
+    assert pp._pp_plan.sizes == (1, 3)  # the heavy layer stands alone
+    a, b = single.infer_sync(inputs), pp.infer_sync(inputs)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=k)
+
+
+def test_pp_composes_with_dp_parity():
+    _need_devices(4)
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    inputs = _tiny_inputs()
+    single = _single_runner()
+    pp = ModelRunner("bert_classifier", TINY_BERT,
+                     buckets=BucketPolicy((2, 4, 8), (16,)),
+                     mesh_spec=MeshSpec(dp=2, pp=2), pp_microbatch_rows=2)
+    # dp scales the bucket grid exactly like plain dp serving
+    assert pp.buckets.batch_buckets == (4, 8, 16)
+    a, b = single.infer_sync(inputs), pp.infer_sync(inputs)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=k)
+
+
+def test_pp_decoder_parity():
+    _need_devices(2)
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    tiny = dict(vocab_size=128, dim=32, layers=4, heads=4, kv_heads=2,
+                ffn=64, max_seq=32)
+    rng = np.random.RandomState(0)
+    inputs = {"input_ids": rng.randint(1, 128, (4, 16)).astype(np.int32)}
+    single = ModelRunner("decoder_lm", tiny, buckets=BucketPolicy((4,), (16,)),
+                         devices=[jax.devices()[0]])
+    pp = ModelRunner("decoder_lm", tiny, buckets=BucketPolicy((4,), (16,)),
+                     mesh_spec=MeshSpec(pp=2), pp_microbatch_rows=1)
+    a, b = single.infer_sync(inputs), pp.infer_sync(inputs)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=k)
+
+
+def test_pp_async_infer_parity_and_spans():
+    _need_devices(4)
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    inputs = _tiny_inputs()
+    single = _single_runner(BucketPolicy((8,), (16,)))
+    pp = ModelRunner("bert_classifier", TINY_BERT,
+                     buckets=BucketPolicy((8,), (16,)),
+                     mesh_spec=MeshSpec(pp=4), pp_microbatch_rows=2)
+    pp.warmup()
+    ref = single.infer_sync(inputs)
+
+    async def go():
+        return await asyncio.gather(*[pp.infer(inputs) for _ in range(3)])
+
+    for out in asyncio.run(go()):
+        np.testing.assert_array_equal(np.asarray(ref["logits"]),
+                                      np.asarray(out["logits"]))
+
+
+# -- measured bubble ---------------------------------------------------------
+
+
+def test_pp_bubble_gauge_within_2x_of_analytic():
+    """Warmup probes the per-tick cost; steady-state steps then measure the
+    bubble. The ISSUE-14 acceptance: measured within 2x of the analytic
+    (S-1)/(M+S-1)."""
+    _need_devices(4)
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    pp = ModelRunner("bert_classifier", TINY_BERT,
+                     buckets=BucketPolicy((8,), (16,)),
+                     mesh_spec=MeshSpec(pp=4), pp_microbatch_rows=2)
+    pp.warmup()
+    assert pp._pp_tick_s, "warmup must probe tick costs"
+    inputs = _tiny_inputs()
+    for _ in range(4):
+        pp.infer_sync(inputs)
+    bubble = float(pp.m_pp_bubble.value)
+    s, m = 4, 4  # 8 rows / 2-row microbatches over 4 stages
+    analytic = (s - 1) / (m + s - 1)
+    assert 0.0 <= bubble <= 1.0
+    assert bubble <= 2.0 * analytic, (bubble, analytic)
+    rep = pp.pp_report()
+    assert rep["bubble_frac"] == pytest.approx(bubble, abs=1e-3)
+    assert rep["tick_ms"]  # per-seq probe recorded
+
+
+def test_pp_health_report_carries_plan():
+    _need_devices(2)
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    pp = ModelRunner("bert_classifier", TINY_BERT,
+                     buckets=BucketPolicy((4,), (16,)),
+                     mesh_spec=MeshSpec(pp=2), pp_microbatch_rows=2,
+                     pp_layer_costs=[2.0, 1.0, 1.0, 1.0])
+    rep = pp.health_report()
+    assert rep["pp"]["stages"] == 2
+    # [2,1,1,1] over 2 stages: optimal max is 3 ([2,1 | 1,1])
+    assert rep["pp"]["bounds"] == [[0, 2], [2, 4]]
+    assert rep["pp"]["max_stage_cost"] == 3.0
+    assert rep["pp"]["imbalance"] > 1.0
+    assert rep["pp"]["microbatch_rows"] == 2
+
+
+# -- hot-swap on the pp runner -----------------------------------------------
+
+
+def test_pp_swap_identical_weights_serves_identically():
+    """place_params repacks a hot-swap candidate into the stage-padded
+    layout, so a flip on a pp runner serves the same bytes."""
+    _need_devices(2)
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.runner import ModelRunner, init_host_params
+
+    inputs = _tiny_inputs()
+    pp = ModelRunner("bert_classifier", TINY_BERT,
+                     buckets=BucketPolicy((8,), (16,)),
+                     mesh_spec=MeshSpec(pp=2), pp_microbatch_rows=2)
+    before = pp.infer_sync(inputs)
+    host = init_host_params(pp.family, pp.cfg, seed=0)
+    placed = pp.place_params(host)
+    old = pp.adopt_params(placed)
+    assert old is not placed
+    after = pp.infer_sync(inputs)
+    for k in before:
+        np.testing.assert_array_equal(np.asarray(before[k]),
+                                      np.asarray(after[k]), err_msg=k)
+
+
+# -- validation: build-time + parse-time -------------------------------------
+
+
+def test_pp_build_validation():
+    _need_devices(4)
+    from arkflow_tpu.parallel.mesh import MeshSpec
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    buckets = BucketPolicy((2, 4, 8), (16,))
+    with pytest.raises(ConfigError, match="exceeds the model's"):
+        ModelRunner("bert_classifier", {**TINY_BERT, "layers": 2},
+                    buckets=buckets, mesh_spec=MeshSpec(pp=4))
+    with pytest.raises(ConfigError, match="dp only"):
+        ModelRunner("bert_classifier", TINY_BERT, buckets=buckets,
+                    mesh_spec=MeshSpec(tp=2, pp=2))
+    with pytest.raises(ConfigError, match="packing"):
+        ModelRunner("bert_classifier", TINY_BERT, buckets=buckets,
+                    mesh_spec=MeshSpec(pp=2), packed=True)
+    with pytest.raises(ConfigError, match="pp_stage_fns"):
+        ModelRunner("lstm_ae", {"window": 8, "features": 1, "hidden": 8,
+                                "latent": 4},
+                    buckets=buckets, mesh_spec=MeshSpec(pp=2))
+    with pytest.raises(ConfigError, match="does not divide"):
+        ModelRunner("bert_classifier", TINY_BERT,
+                    buckets=BucketPolicy((2, 6), (16,)),
+                    mesh_spec=MeshSpec(pp=2), pp_microbatch_rows=4)
+    with pytest.raises(ConfigError, match="cover"):
+        ModelRunner("bert_classifier", TINY_BERT, buckets=buckets,
+                    mesh_spec=MeshSpec(pp=2), pp_layer_costs=[1.0, 2.0])
+
+
+def test_pp_parse_time_validation():
+    """config.py validates tpu_inference mesh-pp knobs at parse time —
+    through fault.inner chaos wrappers — so --validate catches them before
+    jax ever loads."""
+    from arkflow_tpu.config import StreamConfig
+
+    def stream(proc):
+        return {
+            "name": "pp-mesh",
+            "input": {"type": "memory", "messages": ["x"]},
+            "pipeline": {"processors": [proc]},
+            "output": {"type": "drop"},
+        }
+
+    inf = {"type": "tpu_inference", "model": "bert_classifier"}
+    # pp > layers: family default (12) and explicit model_config both checked
+    with pytest.raises(ConfigError, match="exceeds the model's"):
+        StreamConfig.from_mapping(stream({**inf, "mesh": {"pp": 16}}))
+    with pytest.raises(ConfigError, match="exceeds the model's"):
+        StreamConfig.from_mapping(stream(
+            {"type": "fault",
+             "inner": {**inf, "model_config": {"layers": 2},
+                       "mesh": {"pp": 4}}}))
+    # composition rules, also through chaos wrappers
+    with pytest.raises(ConfigError, match="dp only"):
+        StreamConfig.from_mapping(stream(
+            {**inf, "mesh": {"pp": 2, "sp": 2}}))
+    with pytest.raises(ConfigError, match="dp only"):
+        StreamConfig.from_mapping(stream(
+            {"type": "fault", "inner": {**inf, "mesh": {"pp": 2, "tp": 2}}}))
+    with pytest.raises(ConfigError, match="mutually exclusive"):
+        StreamConfig.from_mapping(stream(
+            {**inf, "mesh": {"pp": 2}, "device_pool": 2}))
+    with pytest.raises(ConfigError, match="packing"):
+        StreamConfig.from_mapping(stream(
+            {**inf, "mesh": {"pp": 2}, "packing": True}))
+    # knob typing
+    with pytest.raises(ConfigError, match="mesh.pp"):
+        StreamConfig.from_mapping(stream({**inf, "mesh": {"pp": "two"}}))
+    with pytest.raises(ConfigError, match="pp_microbatch_rows"):
+        StreamConfig.from_mapping(stream(
+            {**inf, "mesh": {"pp": 2}, "pp_microbatch_rows": 0}))
+    with pytest.raises(ConfigError, match="pp_layer_costs"):
+        StreamConfig.from_mapping(stream(
+            {**inf, "mesh": {"pp": 2}, "pp_layer_costs": [1.0, "x"]}))
+    with pytest.raises(ConfigError, match="pp_profile"):
+        StreamConfig.from_mapping(stream(
+            {**inf, "mesh": {"pp": 2}, "pp_profile": 7}))
+    # valid pp specs parse (dp x pp composes; plain dp/tp untouched)
+    StreamConfig.from_mapping(stream(
+        {**inf, "mesh": {"dp": 2, "pp": 2}, "pp_microbatch_rows": 2}))
+    StreamConfig.from_mapping(stream({**inf, "mesh": {"dp": 4}}))
+
+
+# -- end-to-end stream + builder wiring --------------------------------------
+
+
+def test_pp_stream_end_to_end_delivers():
+    """Config-built stream (builder parses mesh pp + pp knobs) serves
+    through the pipelined runner and delivers every row."""
+    _need_devices(2)
+    from arkflow_tpu.components import ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.runtime import build_stream
+
+    ensure_plugins_loaded()
+    cfg = StreamConfig.from_mapping({
+        "name": "pp-e2e",
+        "input": {"type": "memory",
+                  "messages": [f"pp row {i}" for i in range(8)]},
+        "buffer": {"type": "memory", "capacity": 16, "timeout": "10ms",
+                   "coalesce": {"batch_buckets": [4], "deadline": "5ms"}},
+        "pipeline": {
+            "thread_num": 2,
+            "processors": [{
+                "type": "tpu_inference",
+                "model": "bert_classifier",
+                "model_config": TINY_BERT,
+                "max_seq": 16,
+                "batch_buckets": [2, 4],
+                "seq_buckets": [16],
+                "mesh": {"pp": 2},
+                "pp_microbatch_rows": 2,
+                "pp_layer_costs": [1.0, 1.0, 1.0, 1.0],
+            }],
+        },
+        "output": {"type": "drop"},
+    })
+    stream = build_stream(cfg)
+    runner = stream.pipeline.processors[0].runner
+    assert runner._pp_plan is not None and runner._pp_plan.stages == 2
+    asyncio.run(asyncio.wait_for(stream.run(asyncio.Event()), timeout=60))
+    assert stream.m_rows_out.value >= 8
+
+
+def test_bench_multichip_pp_config_parses():
+    """The bench's pp phase config passes the same parse-time validation a
+    YAML stream would (keeps bench and config.py from drifting apart)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        from bench import build_multichip_config
+    finally:
+        sys.path.pop(0)
+    from arkflow_tpu.config import StreamConfig
+
+    for latency in (False, True):
+        cfg = build_multichip_config(32, 16, 4, "pp", latency=latency, layers=4)
+        parsed = StreamConfig.from_mapping(cfg)
+        proc = parsed.pipeline.processors[0]
+        assert proc["mesh"] == {"pp": 4}
+        assert proc["pp_microbatch_rows"] >= 1
+
+
+# -- per-layer profiler smoke ------------------------------------------------
+
+
+def test_profile_step_per_layer_smoke():
+    """CI smoke for ``tools/profile_step.py --per-layer``: emits a
+    planner-consumable JSON artifact with one median per layer."""
+    from arkflow_tpu.utils.cleanenv import cpu_child_env
+
+    env = cpu_child_env(n_devices=1)
+    env["PROF_TINY"] = "1"
+    env["PROF_BATCH"] = "16"
+    env["PROF_SEQ"] = "16"
+    env["PROF_REPS"] = "3"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "profile_step.py"),
+         "--per-layer"],
+        env=env, capture_output=True, timeout=420, cwd=repo)
+    assert res.returncode == 0, res.stderr.decode(errors="replace")[-2000:]
+    out = json.loads(res.stdout.decode().strip().splitlines()[-1])
+    assert out["layers"] == 2
+    assert len(out["per_layer_ms"]) == 2
+    assert all(c > 0 for c in out["per_layer_ms"])
+    assert out["embed_ms"] > 0 and out["head_ms"] > 0
+    # the artifact feeds the planner directly
+    plan = plan_stages(out["per_layer_ms"], 2)
+    assert plan.stages == 2 and plan.num_layers == 2

@@ -20,6 +20,13 @@ batch stream through a 1-member and an N-member replicated device pool
 (rows/s at N / (N x rows/s at 1)). Host-mesh mode defaults to the tiny
 classifier (PROF_TINY=0 for BERT-base — slow on CPU); PROF_STEPS bounds the
 measured steps per phase.
+
+``--per-layer`` (or PROF_PER_LAYER=1) profiles the model LAYER BY LAYER via
+the family's pp stage functions and emits per-layer median costs as JSON —
+the input of the pipelined-segmentation stage planner
+(``parallel/segment.py``; wire the artifact to ``tpu_inference.pp_profile``
+or paste ``per_layer_ms`` into ``pp_layer_costs``). PROF_MODEL picks the
+family (default bert_classifier), PROF_TINY=1 the CPU-sized config.
 """
 
 from __future__ import annotations
@@ -46,6 +53,89 @@ def _cli_devices() -> int:
     if "--devices" in sys.argv:
         return int(sys.argv[sys.argv.index("--devices") + 1])
     return int(os.environ.get("PROF_DEVICES", "0"))
+
+
+def _main_per_layer() -> None:
+    """--per-layer: per-layer median costs for the pp stage planner.
+
+    Times each layer INDEPENDENTLY through the family's ``pp_stage_fns``
+    layer body (the exact math a pipeline stage runs), plus the embed and
+    head ends, so the planner balances what the executor will actually
+    execute. One executable serves every layer (homogeneous stacks share
+    shapes); heterogeneous families would get per-layer executables and
+    genuinely different medians — either way the numbers are measured, not
+    assumed."""
+    import jax
+    import numpy as np
+
+    from arkflow_tpu.models import get_model
+    from arkflow_tpu.tpu.jaxcache import enable_persistent_cache
+
+    enable_persistent_cache()
+    tiny = os.environ.get("PROF_TINY", "0") == "1"
+    model = os.environ.get("PROF_MODEL", "bert_classifier")
+    fam = get_model(model)
+    extras = fam.extras or {}
+    if "pp_stage_fns" not in extras:
+        print(f"profile_step: model {model!r} has no pp_stage_fns "
+              "(per-layer profiling follows pp serving support)",
+              file=sys.stderr)
+        sys.exit(2)
+    model_config = (
+        {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+         "ffn": 64, "max_positions": 64, "num_labels": 2}
+        if tiny and model == "bert_classifier" else {})
+    cfg = fam.make_config(**model_config)
+    batch = int(os.environ.get("PROF_BATCH", "64" if tiny else "1024"))
+    seq = int(os.environ.get("PROF_SEQ", "32"))
+    reps = int(os.environ.get("PROF_REPS", "10"))
+    dev = jax.devices()[0]
+    print(f"# per-layer: device={dev} model={model} batch={batch} seq={seq}",
+          file=sys.stderr, flush=True)
+
+    params = fam.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    inputs = {}
+    for name, (dtype, trailing) in fam.input_spec(cfg).items():
+        dims = tuple(seq if d == "seq" else d for d in trailing)
+        if name == "input_ids":
+            inputs[name] = rng.randint(
+                1, cfg.vocab_size, (batch, *dims)).astype(dtype)
+        else:
+            inputs[name] = np.ones((batch, *dims), dtype)
+
+    pre, layer, post = extras["pp_stage_fns"](cfg)
+    pre_j = jax.jit(pre)
+    layer_j = jax.jit(layer)
+    post_j = jax.jit(post)
+
+    x, aux = pre_j(params, inputs)
+    jax.block_until_ready(x)
+    t_embed = _median_ms(lambda: jax.device_get(pre_j(params, inputs)[0]),
+                         reps=reps)
+
+    n_layers = int(jax.tree_util.tree_leaves(params["layers"])[0].shape[0])
+    per_layer = []
+    for i in range(n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[i], params["layers"])
+        jax.device_get(layer_j(lp, x, aux))  # compile (first layer only)
+        per_layer.append(round(_median_ms(
+            lambda: jax.device_get(layer_j(lp, x, aux)), reps=reps), 4))
+
+    jax.device_get(post_j(params, x, aux))
+    t_head = _median_ms(
+        lambda: jax.device_get(post_j(params, x, aux)), reps=reps)
+
+    print(json.dumps({
+        "model": model,
+        "batch": batch,
+        "seq": seq,
+        "layers": n_layers,
+        "per_layer_ms": per_layer,
+        "embed_ms": round(t_embed, 4),
+        "head_ms": round(t_head, 4),
+        "host_cores": os.cpu_count(),
+    }), flush=True)
 
 
 def _main_multichip(n: int) -> None:
@@ -130,6 +220,9 @@ def _main_multichip(n: int) -> None:
 
 
 def main() -> None:
+    if "--per-layer" in sys.argv or os.environ.get("PROF_PER_LAYER") == "1":
+        _main_per_layer()
+        return
     n_devices = _cli_devices()
     if n_devices > 1:
         _main_multichip(n_devices)
