@@ -161,6 +161,7 @@ class StreamConfig:
         _validate_inference_mesh(pipeline.processors)
         _validate_dispatch_knobs(pipeline.processors)
         _validate_swap(pipeline.processors)
+        _validate_tuner(pipeline.processors)
         _validate_remote_tpu(pipeline.processors)
         temps = [TemporaryConfig.from_mapping(t) for t in m.get("temporary", [])]
         input_cfg = dict(m["input"])
@@ -268,6 +269,33 @@ def _validate_swap(processors: list[dict]) -> None:
         ptype = p.get("type")
         if ptype in ("tpu_inference", "tpu_generate") and p.get("swap") is not None:
             parse_swap_config(p["swap"], who=str(ptype))
+
+
+def _validate_tuner(processors: list[dict]) -> None:
+    """Parse-time validation of the ``tuner:`` traffic-adaptive-shapes block
+    on ``tpu_inference`` (tpu/tuner.py owns the parse rules; it imports no
+    jax), looking through ``fault.inner`` chaos wrappers like the other
+    cross-checks — a bad interval/margin knob fails at ``--validate``
+    instead of at stream build."""
+    from arkflow_tpu.tpu.tuner import parse_tuner_config
+
+    for p in processors:
+        while (isinstance(p, Mapping) and p.get("type") == "fault"
+               and isinstance(p.get("inner"), Mapping)):
+            p = p["inner"]
+        if not isinstance(p, Mapping) or p.get("type") != "tpu_inference":
+            continue
+        if p.get("tuner") is None:
+            continue
+        cfg = parse_tuner_config(p["tuner"], who="tpu_inference")
+        mesh = p.get("mesh")
+        pp = mesh.get("pp", 1) if isinstance(mesh, Mapping) else 1
+        if cfg is not None and cfg.enabled and isinstance(pp, int) and pp > 1:
+            raise ConfigError(
+                "tpu_inference: 'tuner' does not compose with mesh pp "
+                "(pipelined stages serve one schedule at a time; a warm "
+                "compile would interleave collectives with the live GPipe "
+                "ring)")
 
 
 def _validate_remote_tpu(processors: list[dict]) -> None:

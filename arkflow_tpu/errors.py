@@ -85,5 +85,13 @@ class SwapError(ArkError):
     a SwapError never implies an interruption of traffic."""
 
 
+class TunerError(ArkError):
+    """A runtime shape retune (``tpu/tuner.py``) was rejected or rolled
+    back: the post-flip probe failed on the proposed grid, so every flipped
+    unit re-adopted the incumbent bucket configuration. Like ``SwapError``,
+    a TunerError never implies an interruption of traffic — the incumbent
+    shapes served throughout, and no coalescer or cache was touched."""
+
+
 class UnsupportedSql(ArkError):
     """Raised by the Arrow-native SQL planner when a query needs the fallback engine."""

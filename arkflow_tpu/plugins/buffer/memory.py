@@ -118,6 +118,11 @@ class MemoryBuffer(Buffer):
                         f"(capacity x {self.BACKPRESSURE_FACTOR} rows x "
                         f"max_row_tokens; raise capacity or shrink the "
                         f"budget)")
+            # shape-tuner flips (tpu/tuner.py): the buffer owns the coalesce
+            # deadline and the kwargs late tenant lanes are minted from, so
+            # it registers as a bus-level shape listener alongside its
+            # lanes' own cap registrations
+            bucket_cap_bus().register_listener(self)
         #: the stream's tenant policy (attach_overload hook): supplies the
         #: SAME reserved set (configured tenants keep their own lane, never
         #: the overflow) and cap the admission controller caps labels with
@@ -167,6 +172,41 @@ class MemoryBuffer(Buffer):
         tenants and honors ``max_tracked`` exactly like admission labels —
         a premium tenant's rows must never merge into the overflow lane."""
         self._tenant_policy = controller.cfg.tenants
+
+    def retarget_shapes(self, batch_buckets, token_budget, deadline_s,
+                        *, expect=None) -> bool:
+        """Shape-tuner flip (stream-bound via ``ShapeTuner.bind_listener``,
+        or the ``BucketCapBus.retarget`` broadcast): adopt a new coalesce
+        grid/budget/deadline when the CURRENT grid matches ``expect`` (the
+        tuner's incumbent — a broadcast must not disturb a different
+        stream's bucket-exactness, and a bound commit that does NOT match
+        signals a misconfiguration the tuner logs). Updates the kwargs
+        future tenant lanes are minted from, retargets every live lane, and
+        moves the deadline; buckets above the buffer's backpressure bound
+        are dropped (the write() bound is a hard capacity contract the
+        tuner cannot see). Returns True when the retarget applied."""
+        if self._coalesce_kwargs is None:
+            return False
+        current = tuple(sorted(int(b)
+                               for b in self._coalesce_kwargs["batch_buckets"]))
+        if expect is not None and current != tuple(sorted(expect)):
+            return False
+        bound = self.capacity * self.BACKPRESSURE_FACTOR
+        buckets = [int(b) for b in batch_buckets if int(b) <= bound]
+        if not buckets:
+            return False
+        self._coalesce_kwargs["batch_buckets"] = buckets
+        if token_budget is not None \
+                and self._coalesce_kwargs.get("token_budget") is not None:
+            mrt = self._coalesce_kwargs.get("max_row_tokens")
+            if mrt is not None:
+                token_budget = min(token_budget, bound * mrt)
+            self._coalesce_kwargs["token_budget"] = token_budget
+        for lane in self._tenant_coalescers.values():
+            lane.retarget(buckets, token_budget)
+        if deadline_s is not None:
+            self._deadline_s = deadline_s
+        return True
 
     def _lane(self, batch: MessageBatch) -> MicroBatchCoalescer:
         from arkflow_tpu.runtime.overload import MAX_TENANT_LABELS, cap_tenant_label

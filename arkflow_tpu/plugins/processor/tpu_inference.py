@@ -95,6 +95,16 @@ Config:
         rows: 4                    # /admin/swap works without this block):
         min_agreement: 1.0         # golden-batch rows + required argmax
       drain_timeout: 30s           # agreement; drain budget is generate-only
+    tuner:                         # traffic-adaptive shapes (tpu/tuner.py):
+      interval: 30s                # observe live token lengths, propose
+      min_improvement: 0.02        # quantile-aligned seq edges + token
+      target_fill: 0.97            # budget + deadline + example_scale, warm
+      max_compiles: 64             # every new shape off-path, then flip with
+                                   # a health-gated probe + rollback. A
+                                   # proposal must beat the incumbent's
+                                   # predicted waste by min_improvement
+                                   # (hysteresis — no flapping); POST
+                                   # /admin/tune forces a cycle
 """
 
 from __future__ import annotations
@@ -118,11 +128,16 @@ if TYPE_CHECKING:  # jax-importing modules load lazily in the builder
 class TpuInferenceProcessor(Processor):
     def __init__(self, runner: ModelRunner, *, text_field: str, tensor_field: Optional[str],
                  tokenizer, max_seq: int, outputs: Optional[list[str]], warmup: bool = False,
-                 packing: bool = False, response_cache=None, swapper=None):
+                 packing: bool = False, response_cache=None, swapper=None,
+                 tuner=None):
         self.runner = runner
         #: live hot-swap manager (tpu/swap.py): the engine's POST /admin/swap
         #: and the fault plugin's swap_corrupt/swap_crash arming reach it here
         self.swapper = swapper
+        #: traffic-adaptive shape tuner (tpu/tuner.py): observes every
+        #: batch's token lengths, and the engine's POST /admin/tune +
+        #: /health reach it here; None = static shapes (the old behavior)
+        self.tuner = tuner
         self.text_field = text_field
         self.tensor_field = tensor_field
         self.tokenizer = tokenizer
@@ -145,9 +160,13 @@ class TpuInferenceProcessor(Processor):
     def attach_overload_controller(self, controller) -> None:
         """Stream hook (runtime/overload.attach_overload): hand the tenant
         policy to the response cache so its tenant-hit labels cap with the
-        same reserved set / bound as the admission controller."""
+        same reserved set / bound as the admission controller, and the
+        controller itself to the tuner (its step EWMA + AIMD window join
+        the workload sketch's report)."""
         if self.cache is not None:
             self.cache.set_tenant_policy(controller.cfg.tenants)
+        if self.tuner is not None:
+            self.tuner.attach_overload_controller(controller)
 
     # -- input extraction --------------------------------------------------
 
@@ -173,7 +192,12 @@ class TpuInferenceProcessor(Processor):
         if needs_tokens:
             # bucket sequence length by the longest text in the batch
             ids, mask = self._encode_texts(batch, self.max_seq)
-            used = int(mask.sum(axis=1).max()) if mask.size else 1
+            lengths = mask.sum(axis=1)
+            if self.tuner is not None:
+                # the tuner's workload sketch: true tokenized lengths, one
+                # O(rows) ring insert — the observe half of the loop
+                self.tuner.observe(lengths)
+            used = int(lengths.max()) if mask.size else 1
             sb = self.runner.buckets.seq_bucket(used)
             inputs["input_ids"] = ids[:, :sb]
             if "attention_mask" in spec:
@@ -217,6 +241,12 @@ class TpuInferenceProcessor(Processor):
         if not self._warmed:
             self._warmed = True
             await asyncio.get_running_loop().run_in_executor(None, self.runner.warmup)
+        if self.tuner is not None:
+            self.tuner.start()
+
+    async def close(self) -> None:
+        if self.tuner is not None:
+            await self.tuner.stop()
 
     async def process(self, batch: MessageBatch) -> list[MessageBatch]:
         if batch.num_rows == 0:
@@ -270,6 +300,8 @@ class TpuInferenceProcessor(Processor):
             # own _prep, so a big batch never stalls other streams
             ids, mask = self._encode_texts(batch, self.max_seq)
             lengths = mask.sum(axis=1).astype(np.int64)
+            if self.tuner is not None:  # executor thread: the sketch locks
+                self.tuner.observe(lengths)
             sb = self.runner.buckets.seq_bucket(
                 int(lengths.max()) if len(lengths) else 1)
             pk = pack_tokens(ids, lengths, sb)
@@ -396,6 +428,16 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         # swap-aware cache: a committed swap epoch-flushes so a post-swap
         # duplicate can never be answered with pre-swap bytes
         swapper.add_commit_hook(cache.bump_epoch)
+    from arkflow_tpu.tpu.tuner import build_shape_tuner, parse_tuner_config
+
+    # traffic-adaptive shapes (tpu/tuner.py): observes live token lengths
+    # and retunes seq edges / token budget / deadline / example_scale with
+    # warm-then-flip discipline; the cache registers for the config epoch
+    # so a post-flip duplicate never returns bytes from the old padding
+    tuner = build_shape_tuner(
+        runner, model=str(model),
+        cfg=parse_tuner_config(config.get("tuner"), who="tpu_inference"),
+        packed=packing, cache=cache)
     return TpuInferenceProcessor(
         runner,
         text_field=config.get("text_field", DEFAULT_BINARY_VALUE_FIELD),
@@ -407,4 +449,5 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         packing=packing,
         response_cache=cache,
         swapper=swapper,
+        tuner=tuner,
     )

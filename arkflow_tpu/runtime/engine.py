@@ -82,6 +82,23 @@ class Engine:
                 node = getattr(node, "_inner", None)
         return swappers
 
+    @staticmethod
+    def _stream_tuners(stream: Stream) -> list:
+        """Shape tuners of every adaptive processor of a stream
+        (tpu/tuner.py), walking ``_inner`` chains like the swap managers —
+        the surface POST /admin/tune and /health drive."""
+        tuners = []
+        for proc in getattr(stream.pipeline, "processors", None) or []:
+            node, seen = proc, set()
+            while node is not None and id(node) not in seen:
+                seen.add(id(node))
+                tn = getattr(node, "tuner", None)
+                if tn is not None and hasattr(tn, "run_cycle"):
+                    tuners.append(tn)
+                    break
+                node = getattr(node, "_inner", None)
+        return tuners
+
     def stream_health(self) -> dict:
         """Restart accounting + per-runner device health, per stream."""
         out: dict[str, dict] = {}
@@ -124,6 +141,14 @@ class Engine:
                     logger.exception("swap report failed for stream %s", s.name)
             if swaps:
                 info["swap"] = swaps
+            tuners = []
+            for tn in self._stream_tuners(s):
+                try:
+                    tuners.append(tn.report())
+                except Exception:  # introspection must not break /health
+                    logger.exception("tuner report failed for stream %s", s.name)
+            if tuners:
+                info["tuner"] = tuners
             clusters = []
             for proc in getattr(s.pipeline, "processors", None) or []:
                 # disaggregated serving (runtime/cluster.py): the remote_tpu
@@ -302,12 +327,62 @@ class Engine:
                 text=json.dumps({"ok": ok_all, "results": results}),
                 content_type="application/json")
 
+        async def admin_tune(req):
+            """POST /admin/tune {"stream": "name"?} — force one shape-tuner
+            observe->propose->warm->flip cycle (tpu/tuner.py) on every
+            adaptive processor of the targeted stream(s). The hysteresis
+            margin still applies — a stable workload answers "rejected",
+            not a flap. 200 = every cycle ran (committed, rejected or
+            skipped are all valid outcomes), 409 = a flip rolled back
+            (incumbent grid still serving), 404 = no adaptive processors."""
+            from arkflow_tpu.errors import TunerError
+
+            target = None
+            if req.can_read_body:
+                try:
+                    body = await req.json()
+                except Exception:
+                    return web.Response(
+                        status=400, text='{"error":"body must be JSON"}',
+                        content_type="application/json")
+                if body is not None and not isinstance(body, dict):
+                    return web.Response(
+                        status=400, text='{"error":"body must be an object"}',
+                        content_type="application/json")
+                target = (body or {}).get("stream")
+            results: dict[str, list] = {}
+            ok_all, found = True, False
+            for s in self.streams:
+                if target is not None and s.name != target:
+                    continue
+                for tn in self._stream_tuners(s):
+                    found = True
+                    try:
+                        rep = {"ok": True, **(await tn.run_cycle(force=True))}
+                    except TunerError as e:
+                        ok_all, rep = False, {"ok": False, "error": str(e)}
+                    except Exception as e:  # an unexpected bug must still answer
+                        ok_all = False
+                        rep = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                    results.setdefault(s.name, []).append(rep)
+            if not found:
+                return web.Response(
+                    status=404,
+                    text=json.dumps({"error": "no shape-tunable processors"
+                                     + (f" in stream {target!r}" if target else "")}),
+                    content_type="application/json")
+            return web.Response(
+                status=200 if ok_all else 409,
+                text=json.dumps({"ok": ok_all, "results": results}),
+                content_type="application/json")
+
         app.router.add_get(hc.path, health)
         app.router.add_get("/readiness", readiness)
         app.router.add_get("/liveness", liveness)
         app.router.add_get("/metrics", metrics)
         app.router.add_get("/trace", trace)
         app.router.add_post("/admin/swap", admin_swap)
+        app.router.add_post("/admin/tune", admin_tune)
         if hc.profiling_dir:
             app.router.add_post("/debug/profile", profile)
         runner = web.AppRunner(app, access_log=None)

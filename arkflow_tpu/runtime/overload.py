@@ -844,6 +844,19 @@ class OverloadController:
 
     # -- introspection -----------------------------------------------------
 
+    def signals(self) -> dict:
+        """The compact observation bundle the shape tuner samples (a strict
+        subset of :meth:`report`, cheap enough for every cycle): the step
+        EWMA the deadline math rides on, the live AIMD window, and the
+        queue-wait p50 the window adapts against."""
+        return {
+            "step_ewma_ms": round(self.step_s() * 1000.0, 3),
+            "window": int(self.window),
+            "max_window": self.max_window,
+            "queued": self.queued,
+            "queue_wait_p50_ms": round(self.queue_wait_p50_s() * 1000.0, 3),
+        }
+
     def report(self) -> dict:
         """Controller snapshot for the engine's ``/health`` payload."""
         self._idle_recover()

@@ -216,6 +216,21 @@ class Stream:
         attach_overload(self.buffer, self.overload)
         for proc in getattr(self.pipeline, "processors", None) or []:
             attach_overload(proc, self.overload)
+        # shape-tuner wiring (tpu/tuner.py): bind each adaptive processor's
+        # tuner to THIS stream's buffer, so a committed flip retargets
+        # exactly this stream's coalescer lanes — never another stream's
+        # that merely configured the same grid (walks _inner chaos chains
+        # like attach_overload)
+        if self.buffer is not None and hasattr(self.buffer, "retarget_shapes"):
+            for proc in getattr(self.pipeline, "processors", None) or []:
+                node, seen = proc, set()
+                while node is not None and id(node) not in seen:
+                    seen.add(id(node))
+                    tn = getattr(node, "tuner", None)
+                    if tn is not None and hasattr(tn, "bind_listener"):
+                        tn.bind_listener(self.buffer)
+                        break
+                    node = getattr(node, "_inner", None)
         self._pause_source = (self.overload is not None
                               and input_pauses_on_overload(self.input))
 

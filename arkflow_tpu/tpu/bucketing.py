@@ -169,6 +169,11 @@ class BucketCapBus:
         self._lock = threading.Lock()
         self._coalescers: "weakref.WeakSet[MicroBatchCoalescer]" = weakref.WeakSet()
         self._cap: Optional[int] = None
+        #: shape listeners (memory buffers): objects with a
+        #: ``retarget_shapes(batch_buckets, token_budget, deadline_s)``
+        #: method — they own the coalesce deadline and the kwargs late
+        #: tenant lanes are minted from, which no single coalescer can see
+        self._listeners: "weakref.WeakSet" = weakref.WeakSet()
 
     @property
     def cap(self) -> Optional[int]:
@@ -180,17 +185,83 @@ class BucketCapBus:
             if self._cap is not None:
                 coalescer.cap(self._cap)
 
+    def register_listener(self, listener) -> None:
+        """Register a buffer-level shape listener for future retargets.
+        Unlike caps, committed retargets are NOT replayed onto late
+        registrations: a cap is a device fact, a retarget is one stream's
+        tuning preference — a component built later starts on its
+        configured grid and follows from the tuner's next commit (the row
+        grid a commit ``expect``-matches against never changes, so the next
+        commit always reaches it)."""
+        with self._lock:
+            self._listeners.add(listener)
+
     def announce(self, cap: int) -> None:
         with self._lock:
             self._cap = cap if self._cap is None else min(self._cap, cap)
             for c in list(self._coalescers):
                 c.cap(self._cap)
 
+    def _clamped(self, buckets: tuple[int, ...],
+                 token_budget: Optional[int]) -> tuple[tuple[int, ...], Optional[int]]:
+        """An OOM cap always wins over a retarget: clamp the broadcast grid
+        (and scale the budget like ``MicroBatchCoalescer.cap`` does) so a
+        tuner commit can never re-grow buckets the device proved it cannot
+        hold."""
+        if self._cap is None or not buckets:
+            return buckets, token_budget
+        fitting = tuple(b for b in buckets if b <= self._cap)
+        if not fitting:
+            fitting = (max(1, int(self._cap)),)
+        if token_budget is not None and fitting[-1] != buckets[-1]:
+            token_budget = max(1, int(token_budget * fitting[-1] / buckets[-1]))
+        return fitting, token_budget
+
+    def clamp(self, batch_buckets: Sequence[int],
+              token_budget: Optional[int] = None
+              ) -> tuple[tuple[int, ...], Optional[int]]:
+        """Apply the current OOM cap (if any) to a grid/budget pair —
+        stream-bound retargets (which bypass the broadcast) clamp through
+        here so a cap is honored no matter which path a flip takes."""
+        with self._lock:
+            return self._clamped(tuple(int(b) for b in batch_buckets),
+                                 token_budget)
+
+    def retarget(self, batch_buckets: Sequence[int], *,
+                 token_budget: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 expect: Optional[Sequence[int]] = None) -> None:
+        """Shape-tuner commit fanout: live coalescers whose CURRENT grid
+        matches ``expect`` (None = all) adopt the new grid/budget, and
+        buffer listeners additionally adopt the new coalesce deadline.
+        Scoped by ``expect`` on purpose — the bus is process-global, and a
+        retune of one stream's shapes must not disturb another stream's
+        bucket-exactness. The OOM cap, when present, clamps the broadcast
+        (a cap is a statement about the device; a retarget is merely a
+        preference)."""
+        bb = tuple(sorted(int(b) for b in batch_buckets))
+        exp = tuple(sorted(int(b) for b in expect)) if expect is not None else None
+        with self._lock:
+            cb, ct = self._clamped(bb, token_budget)
+            for c in list(self._coalescers):
+                if exp is None or c.buckets == exp:
+                    c.retarget(cb, ct)
+            for listener in list(self._listeners):
+                try:
+                    listener.retarget_shapes(cb, ct, deadline_s, expect=exp)
+                except Exception:
+                    import logging
+
+                    logging.getLogger("arkflow.tpu").exception(
+                        "bucket retarget listener failed")
+
     def reset(self) -> None:
-        """Test hook: forget the cap (coalescers already shrunk stay shrunk)."""
+        """Test hook: forget the cap and any registrations (coalescers
+        already shrunk/retargeted stay as they are)."""
         with self._lock:
             self._cap = None
             self._coalescers.clear()
+            self._listeners.clear()
 
 
 _GLOBAL_CAP_BUS = BucketCapBus()
@@ -317,6 +388,25 @@ class MicroBatchCoalescer:
                 1, int(self.token_budget * fitting[-1] / self.target))
         self.buckets = fitting
         self.target = fitting[-1]
+
+    def retarget(self, batch_buckets: Sequence[int],
+                 token_budget: Optional[int] = None) -> None:
+        """Adopt a NEW target grid (shape-tuner flip; see ``BucketCapBus.
+        retarget``). Unlike ``cap`` this may move buckets in either
+        direction — the tuner only broadcasts after the runner's grid
+        already flipped and every new shape is warm, so emissions carved at
+        the new target land on compiled executables. Already-held rows
+        simply drain at the new target. The token budget updates only when
+        the coalescer is ALREADY in token mode (a mode flip would change
+        emission semantics under the buffer's feet); ``None`` leaves the
+        budget untouched."""
+        buckets = tuple(sorted(int(b) for b in batch_buckets))
+        if not buckets or buckets[0] <= 0:
+            return
+        self.buckets = buckets
+        self.target = buckets[-1]
+        if token_budget is not None and self.token_budget is not None:
+            self.token_budget = max(1, int(token_budget))
 
     # -- token estimation (token-budget mode) -------------------------------
 

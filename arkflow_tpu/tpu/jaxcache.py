@@ -104,3 +104,16 @@ def enable_persistent_cache() -> Optional[str]:
         logger.warning("persistent compilation cache unavailable: %s", e)
         _configured = None
     return _configured
+
+
+def cache_info() -> dict:
+    """JSON-able snapshot of the persistent compile cache — the shape
+    tuner's warm phase reports through this so an operator can tell whether
+    a retune's compiles were real or cache replays."""
+    if not _configured:
+        return {"enabled": False}
+    try:
+        entries = sum(1 for e in os.scandir(_configured) if e.is_file())
+    except OSError:
+        entries = None
+    return {"enabled": True, "dir": _configured, "entries": entries}

@@ -200,6 +200,43 @@ class ModelRunnerPool:
         the pool's replication is exactly what makes the roll zero-downtime."""
         return [(f"member {i}", m) for i, m in enumerate(self.members)]
 
+    # -- live shape retune surface (tpu/tuner.py) ---------------------------
+
+    def count_new_shapes(self, policy: BucketPolicy) -> int:
+        """Executables a retune would still compile, pool-wide. Member 0's
+        count is the honest COST estimate (the others replay member 0's
+        compiles from the persistent cache, like ``warmup``)."""
+        return self.members[0].count_new_shapes(policy)
+
+    def warm_shapes(self, policy: BucketPolicy) -> int:
+        """Pre-compile a proposed grid on every member (serial, like
+        ``warmup``: member 0 pays the compiles, the rest replay them)."""
+        return sum(m.warm_shapes(policy) for m in self.members)
+
+    async def warm_shapes_live(self, policy: BucketPolicy) -> int:
+        """Serving-safe warm (see ``ModelRunner.warm_shapes_live``),
+        member by member."""
+        count = 0
+        for m in self.members:
+            count += await m.warm_shapes_live(policy)
+        return count
+
+    def retarget_buckets(self, policy: BucketPolicy) -> BucketPolicy:
+        """Flip every member onto the new grid; returns member 0's prior
+        policy (all members share one grid by construction)."""
+        old = self.members[0].buckets
+        for m in self.members:
+            m.retarget_buckets(policy)
+        return old
+
+    def dispatch_counts(self) -> dict[tuple, int]:
+        """Pool-wide traffic dispatches per padded shape key."""
+        out: dict[tuple, int] = {}
+        for m in self.members:
+            for k, v in m.dispatch_counts().items():
+                out[k] = out.get(k, 0) + v
+        return out
+
     # -- dispatch ----------------------------------------------------------
 
     def _pick(self, exclude: set[int]) -> Optional[int]:

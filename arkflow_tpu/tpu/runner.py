@@ -357,20 +357,26 @@ class ModelRunner:
             buckets=[0.125, 0.25, 0.5, 0.75, 0.9, 1.0],
         )
         self.m_compiles = reg.counter("arkflow_tpu_compiles_total", "bucket compiles", labels)
+        self.m_warm_compiles = reg.counter(
+            "arkflow_tpu_warm_compiles_total",
+            "bucket executables compiled OFF the serving path (shape-tuner "
+            "warm/probe; compiles_total stays flat across a tuned flip)", labels)
         self.m_exec_rows = reg.counter(
             "arkflow_tpu_exec_rows_total",
             "bucket rows dispatched to the device, padding included (the "
             "honest FLOPs denominator; rows_total counts true examples)", labels)
         self.m_tokens = reg.counter(
             "arkflow_tpu_tokens_total",
-            "true (non-padding) tokens dispatched by packed runners — the "
+            "true (non-padding) tokens dispatched — packed runners and "
+            "unpacked token models (attention-mask sum) alike; the "
             "numerator of effective tokens/sec", labels)
         self.m_token_capacity = reg.counter(
             "arkflow_tpu_token_capacity_total",
-            "token slots dispatched by packed runners (bucket rows x padded "
-            "seq): 1 - tokens_total/capacity is the capacity-weighted padding "
-            "waste — the honest aggregate; the per-step waste histogram "
-            "over-weights small tail windows", labels)
+            "token slots dispatched (bucket rows x padded seq): "
+            "1 - tokens_total/capacity is the capacity-weighted padding "
+            "waste INCLUDING seq padding — the honest aggregate; the "
+            "per-step waste histogram over-weights small tail windows and "
+            "reads row fill only for unpacked runners", labels)
         self.m_inflight = reg.gauge(
             "arkflow_tpu_steps_inflight", "device steps dispatched, not yet complete", labels)
         self.m_busy_s = reg.counter(
@@ -408,6 +414,11 @@ class ModelRunner:
             "1 when input donation (input-output aliasing) is enabled", labels)
         self.m_donate_on.set(1 if self._donate else 0)
         self._seen_shapes: set[tuple] = set()
+        #: traffic dispatches per padded shape key (warmup excluded) — the
+        #: shape tuner's observe-side ground truth for which compiled
+        #: shapes live traffic actually lands on; guarded by the flash lock
+        #: alongside _seen_shapes (same call site, same threads)
+        self._dispatch_counts: dict[tuple, int] = {}
         self._in_warmup = False
         #: device queue depth. 2 = double buffering (prep/dispatch n+1
         #: overlaps compute of n) — enough when dispatch latency ~ 0. Over
@@ -831,6 +842,15 @@ class ModelRunner:
             self.m_fill.observe(n / bb)
             self.m_waste.observe((bb - n) / bb if bb else 0.0)
             self.m_exec_rows.inc(bb)
+            if "attention_mask" in arrs:
+                # token models: true tokens vs dispatched token slots, so
+                # 1 - tokens/capacity is the capacity-weighted padding waste
+                # INCLUDING seq padding — the quantity the shape tuner's
+                # seq-edge retuning moves, invisible to the row-only
+                # histogram above (bench/soak read these counters)
+                mask_shape = shapes["attention_mask"]
+                self.m_tokens.inc(int(arrs["attention_mask"].sum()))
+                self.m_token_capacity.inc(int(bb * mask_shape[1]))
         return out, n
 
     # -- staging buffer recycling ------------------------------------------
@@ -871,11 +891,18 @@ class ModelRunner:
         ``_seen_shapes.clear()`` (which holds the same lock)."""
         key = self._shape_key(padded)
         with self._flash_lock:
+            if not self._in_warmup:
+                self._dispatch_counts[key] = self._dispatch_counts.get(key, 0) + 1
             if key not in self._seen_shapes:
                 self._seen_shapes.add(key)
                 self.m_compiles.inc()
                 return True
         return False
+
+    def dispatch_counts(self) -> dict[tuple, int]:
+        """Traffic dispatches per padded shape key (warmup excluded)."""
+        with self._flash_lock:
+            return dict(self._dispatch_counts)
 
     # -- pipelined-parallel bubble accounting -------------------------------
 
@@ -1096,6 +1123,114 @@ class ModelRunner:
         """A single runner is one flippable unit (the pool overrides this
         with its per-member rolling order)."""
         return [("runner", self)]
+
+    # -- live shape retune surface (tpu/tuner.py) ---------------------------
+
+    def grid_shapes(self, policy: BucketPolicy) -> list[dict[str, tuple]]:
+        """Every padded-input shape signature ``policy`` can put on the
+        device — the same reachable set ``warmup`` walks, but for an
+        arbitrary (e.g. tuner-proposed) policy, without dispatching."""
+        has_seq = any("seq" in t for _, t in self.spec.values())
+        seqs = list(policy.seq_buckets) if has_seq else [None]
+        if self.packed:
+            pairs = [(pb, eb) for eb in policy.example_buckets()
+                     for pb in policy.batch_buckets if pb <= eb]
+        else:
+            pairs = [(bb, bb) for bb in policy.batch_buckets]
+        shapes: list[dict[str, tuple]] = []
+        for pb, eb in pairs:
+            for sl in seqs:
+                shape: dict[str, tuple] = {}
+                for name, (dtype, trailing) in self.spec.items():
+                    lead = eb if self.packed and "seq" not in trailing else pb
+                    dims = tuple(sl if d == "seq" else d for d in trailing)
+                    shape[name] = (lead, *dims)
+                shapes.append(shape)
+        return shapes
+
+    @staticmethod
+    def _grid_shape_key(shape: dict[str, tuple]) -> tuple:
+        # identical structure to _shape_key (name-sorted (name, shape)
+        # pairs), so warm-marked shapes are exactly what _note_shape sees
+        return tuple(sorted(shape.items()))
+
+    def count_new_shapes(self, policy: BucketPolicy) -> int:
+        """How many executables ``policy`` would still have to compile —
+        the tuner's compile-cost gate reads this before proposing a flip."""
+        shapes = self.grid_shapes(policy)
+        with self._flash_lock:
+            return sum(1 for s in shapes
+                       if self._grid_shape_key(s) not in self._seen_shapes)
+
+    def _compile_shape(self, shape: dict[str, tuple]) -> None:
+        """Compile (and discard) one padded shape through the jitted step."""
+        fake = {name: np.zeros(s, self.spec[name][0])
+                for name, s in shape.items()}
+        jax.device_get(self._dispatch(fake))
+
+    def _mark_warmed(self, key: tuple) -> None:
+        with self._flash_lock:
+            if key not in self._seen_shapes:
+                self._seen_shapes.add(key)
+                self.m_warm_compiles.inc()
+
+    def warm_shapes(self, policy: BucketPolicy) -> int:
+        """Pre-compile every not-yet-seen shape of ``policy`` OFF the
+        serving path (shape-tuner warm phase). Compiles go through the
+        persistent XLA cache like any other, and each warmed shape is
+        marked seen WITHOUT touching ``arkflow_tpu_compiles_total`` — so
+        after the flip, live traffic on the new grid never compiles and
+        the serving-path compile counter stays flat; warm compiles count in
+        ``arkflow_tpu_warm_compiles_total`` instead. Blocking (XLA
+        compiles) and un-deadlined: for use off live traffic (tests,
+        tools); the tuner's cycle path uses :meth:`warm_shapes_live`."""
+        count = 0
+        for shape in self.grid_shapes(policy):
+            key = self._grid_shape_key(shape)
+            with self._flash_lock:
+                if key in self._seen_shapes:
+                    continue
+            self._compile_shape(shape)
+            self._mark_warmed(key)
+            count += 1
+        return count
+
+    async def warm_shapes_live(self, policy: BucketPolicy) -> int:
+        """``warm_shapes`` for use WHILE serving: each compile holds the
+        in-flight permit — serializing with live device schedules, the same
+        discipline the pp tick probe follows — and runs under the
+        first-compile deadline on a watchdog thread, so a wedged compile is
+        abandoned (the runner heals through its normal probe path) instead
+        of blocking the caller forever."""
+        self._ensure_sems()
+        loop = asyncio.get_running_loop()
+        count = 0
+        for shape in self.grid_shapes(policy):
+            key = self._grid_shape_key(shape)
+            with self._flash_lock:
+                if key in self._seen_shapes:
+                    continue
+            async with self._inflight_sem:
+                deadline = self.core.deadline_for(True)
+                if deadline is None:
+                    await loop.run_in_executor(
+                        None, self._compile_shape, shape)
+                else:
+                    await self.core.run_deadlined(
+                        partial(self._compile_shape, shape), deadline)
+            self._mark_warmed(key)
+            count += 1
+        return count
+
+    def retarget_buckets(self, policy: BucketPolicy) -> BucketPolicy:
+        """Atomically flip the serving bucket grid (shape-tuner flip);
+        returns the prior policy (the rollback token). In-flight steps
+        already padded keep their old shapes — both grids are compiled, so
+        the transition window serves both without a recompile."""
+        with self._flash_lock:
+            old, self.buckets = self.buckets, policy
+        self.m_bucket_cap.set(policy.max_batch())
+        return old
 
     def health_report(self) -> dict:
         """JSON-able health snapshot for the engine's ``/health`` endpoint."""

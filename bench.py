@@ -1475,7 +1475,31 @@ def _infeed_detail(before: tuple, after: tuple) -> dict:
     return {
         "infeed_prep_ms": round((d_prep_s + d_extract_s) / d_steps * 1000.0, 3),
         "padding_waste_frac": round(waste, 4),
+        # traffic-adaptive shapes (tpu/tuner.py): the committed shape epoch
+        # plus the planner's predicted waste next to the MEASURED
+        # padding_waste_frac above, so a retuned phase's artifact carries
+        # its own predicted-vs-measured honesty check (0/absent = no tuner)
+        **_tuner_detail(),
     }
+
+
+def _tuner_detail() -> dict:
+    """Shape-tuner state for phase detail: {} when no tuner ran."""
+    from arkflow_tpu.obs import global_registry
+
+    epoch = predicted = None
+    for m in global_registry().collect():
+        name = getattr(m, "name", "")
+        if name == "arkflow_tuner_epoch":
+            epoch = max(epoch or 0, int(m.value))
+        elif name == "arkflow_tuner_predicted_waste":
+            predicted = float(m.value)
+    if epoch is None:
+        return {}
+    out = {"tuner_epoch": epoch}
+    if predicted is not None:
+        out["tuner_predicted_waste"] = round(predicted, 4)
+    return out
 
 
 def _busy_stall_from_registry() -> tuple[float, float]:

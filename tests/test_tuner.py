@@ -1,0 +1,594 @@
+"""Traffic-adaptive shapes (tpu/tuner.py): planner determinism + golden
+proposals on skewed/bimodal/shifting sketches, hysteresis (no flapping),
+warm-then-flip with zero on-path recompiles, probe-failure rollback,
+live-coalescer retarget over the BucketCapBus, response-cache config-epoch
+regression, parse-time ``tuner:`` validation through chaos wrappers, and
+/health + /admin/tune over a live engine."""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from arkflow_tpu.errors import ConfigError, TunerError  # noqa: E402
+from arkflow_tpu.tpu.bucketing import (  # noqa: E402
+    BucketPolicy,
+    MicroBatchCoalescer,
+    bucket_cap_bus,
+)
+from arkflow_tpu.tpu.tuner import (  # noqa: E402
+    ShapeConfig,
+    ShapeTuner,
+    SketchView,
+    TunerConfig,
+    WorkloadSketch,
+    parse_tuner_config,
+    plan_shapes,
+    predict_waste,
+    quantile_aligned_edges,
+)
+
+TINY_BERT = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+             "ffn": 64, "num_labels": 2}
+
+
+def _view(lengths, rate=500.0):
+    lengths = np.asarray(lengths, np.int64)
+    return SketchView(lengths=lengths, arrival_rows_per_sec=rate,
+                      rows_seen=int(lengths.size))
+
+
+def _runner(batch=(4, 8), seq=(32, 64)):
+    from arkflow_tpu.tpu.runner import ModelRunner
+
+    return ModelRunner("bert_classifier", TINY_BERT,
+                       buckets=BucketPolicy(tuple(batch), tuple(seq)))
+
+
+def _tuner(runner, **over):
+    cfg = TunerConfig(**{"min_samples": 64, "min_improvement": 0.01,
+                         "max_compiles": 64, **over})
+    return ShapeTuner(runner, model="bert_classifier", cfg=cfg, packed=False)
+
+
+def _serve_lengths(runner, tuner, lengths_per_batch, batches=16, rows=8,
+                   max_seq=64):
+    """Feed the tuner sketch + run real steps at the given length."""
+    rng = np.random.default_rng(0)
+
+    async def go():
+        for i in range(batches):
+            length = int(lengths_per_batch[i % len(lengths_per_batch)])
+            ids = rng.integers(1, 500, size=(rows, max_seq)).astype(np.int32)
+            mask = np.zeros((rows, max_seq), np.int32)
+            mask[:, :length] = 1
+            tuner.observe(mask.sum(axis=1))
+            sb = runner.buckets.seq_bucket(length)
+            await runner.infer({"input_ids": ids[:, :sb],
+                                "attention_mask": mask[:, :sb]})
+
+    asyncio.run(go())
+
+
+# -- config parsing ----------------------------------------------------------
+
+
+def test_parse_tuner_config_defaults_and_validation():
+    assert parse_tuner_config(None) is None
+    assert parse_tuner_config(False) is None
+    assert parse_tuner_config(True) == TunerConfig()
+    cfg = parse_tuner_config({"interval": "5s", "min_improvement": 0.05,
+                              "target_fill": 0.9, "align": 16,
+                              "max_compiles": 8, "min_samples": 32,
+                              "window": 512, "deadline_min": "20ms",
+                              "deadline_max": "2s", "deadline_slack": 2.0,
+                              "max_seq_buckets": 3})
+    assert cfg.interval_s == 5.0 and cfg.align == 16 and cfg.window == 512
+    assert parse_tuner_config({"enabled": False}).enabled is False
+    # interval: 0 = admin-driven only, legal
+    assert parse_tuner_config({"interval": 0}).interval_s == 0.0
+    for bad in ({"bogus": 1}, {"min_improvement": 2.0}, {"align": 0},
+                {"enabled": "yes"}, {"window": 4}, {"deadline_slack": 0.5},
+                {"target_fill": 0.0}, {"max_compiles": True},
+                {"deadline_min": "2s", "deadline_max": "1s"}, "nope"):
+        with pytest.raises(ConfigError):
+            parse_tuner_config(bad)
+
+
+def test_parse_time_validation_through_chaos_wrappers():
+    from arkflow_tpu.config import StreamConfig
+
+    def cfg_with(tuner):
+        return {
+            "input": {"type": "memory", "messages": ["x"]},
+            "pipeline": {"processors": [{
+                "type": "fault", "faults": [],
+                "inner": {"type": "tpu_inference", "model": "bert_classifier",
+                          "tuner": tuner},
+            }]},
+            "output": {"type": "drop"},
+        }
+
+    StreamConfig.from_mapping(cfg_with({"interval": "10s"}))  # ok
+    with pytest.raises(ConfigError, match="tuner"):
+        StreamConfig.from_mapping(cfg_with({"interval": "10s", "nope": 1}))
+    with pytest.raises(ConfigError, match="min_improvement"):
+        StreamConfig.from_mapping(cfg_with({"min_improvement": -1}))
+
+
+# -- the planner -------------------------------------------------------------
+
+
+def test_planner_deterministic():
+    rng = np.random.default_rng(3)
+    lengths = rng.integers(8, 20, size=2048)
+    inc = ShapeConfig(batch_buckets=(8, 16, 32), seq_buckets=(32, 64, 128))
+    a = plan_shapes(_view(lengths), inc, TunerConfig())
+    b = plan_shapes(_view(lengths.copy()), inc, TunerConfig())
+    assert a.report() == b.report()
+    # and the evaluator itself is pure
+    assert predict_waste(_view(lengths), a.shape) == \
+        predict_waste(_view(lengths), a.shape)
+
+
+def test_planner_skewed_short_golden():
+    """Short traffic on a blind pow2 grid: the proposal must cut a tight
+    interior edge, keep the TOP bucket (truncation contract), keep the row
+    grid (capacity contract), and predict a big waste win."""
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(8, 20, size=2048)  # p99 ~ 19
+    inc = ShapeConfig(batch_buckets=(8, 16, 32), seq_buckets=(32, 64, 128))
+    p = plan_shapes(_view(lengths), inc, TunerConfig())
+    assert p.shape.seq_buckets[-1] == 128          # top never moves
+    assert p.shape.batch_buckets == inc.batch_buckets
+    assert p.shape.seq_buckets[0] <= 24            # hugs the observed p50
+    assert p.improvement > 0.10
+    assert p.predicted_waste < p.incumbent_waste
+    assert p.shape.deadline_s is not None          # rate observed -> deadline
+
+
+def test_planner_bimodal_golden():
+    """Two length modes arriving in runs (how mixes shift in practice):
+    the grid must hold an edge near EACH mode."""
+    rng = np.random.default_rng(1)
+    runs = []
+    for i in range(16):
+        if i % 2 == 0:
+            runs.append(rng.integers(8, 16, size=128))
+        else:
+            runs.append(rng.integers(90, 118, size=128))
+    lengths = np.concatenate(runs)
+    inc = ShapeConfig(batch_buckets=(8, 16, 32), seq_buckets=(32, 64, 128))
+    p = plan_shapes(_view(lengths), inc, TunerConfig())
+    grid = p.shape.seq_buckets
+    assert any(e <= 24 for e in grid)              # short-mode edge
+    assert any(96 <= e < 128 for e in grid)        # long-mode edge
+    assert grid[-1] == 128
+    assert p.improvement > 0.05
+
+
+def test_planner_shifting_mix_retunes():
+    """The planner follows the window: a short-mix view and a long-mix view
+    produce different grids, each hugging its own mix."""
+    rng = np.random.default_rng(2)
+    inc = ShapeConfig(batch_buckets=(8,), seq_buckets=(32, 64))
+    short = plan_shapes(_view(rng.integers(6, 13, size=512)), inc, TunerConfig())
+    long_ = plan_shapes(_view(rng.integers(34, 47, size=512)), inc, TunerConfig())
+    assert short.shape.seq_buckets != long_.shape.seq_buckets
+    assert short.shape.seq_buckets[0] <= 16
+    assert long_.shape.seq_buckets[0] >= 40
+
+
+def test_planner_packed_budget_and_example_scale():
+    """Packed: the token budget comes from simulating the real first-fit
+    packing, and example_scale extends the example grid to cover a budget
+    emission's example count."""
+    rng = np.random.default_rng(4)
+    lengths = rng.integers(8, 20, size=2048)
+    inc = ShapeConfig(batch_buckets=(8, 16, 32), seq_buckets=(32, 64, 128),
+                      packed=True, example_scale=4, token_budget=32 * 128)
+    p = plan_shapes(_view(lengths), inc, TunerConfig())
+    s = p.shape
+    assert s.packed and s.token_budget is not None
+    assert s.token_budget <= 32 * 128              # never above top capacity
+    assert p.predicted_fill >= 0.85
+    # a budget emission holds ~budget/mean_len examples; the example grid
+    # (top_rows * example_scale) must reach them
+    examples = s.token_budget / float(np.mean(lengths))
+    assert 32 * s.example_scale >= examples * 0.9
+    assert plan_shapes(_view(lengths), inc, TunerConfig()).report() == p.report()
+
+
+def test_quantile_edges_align_and_top():
+    lengths = np.array([9, 10, 11, 50, 51, 52] * 100, np.int64)
+    grid = quantile_aligned_edges(lengths, 128, align=8, qs=(0.25, 0.9))
+    assert grid[-1] == 128
+    assert all(e % 8 == 0 for e in grid[:-1])
+    assert all(8 <= e < 128 for e in grid[:-1])
+
+
+def test_predict_waste_tighter_edge_wins():
+    lengths = np.full(512, 12, np.int64)
+    base = ShapeConfig(batch_buckets=(8,), seq_buckets=(32,))
+    tight = ShapeConfig(batch_buckets=(8,), seq_buckets=(16, 32))
+    w_base, _ = predict_waste(_view(lengths), base)
+    w_tight, _ = predict_waste(_view(lengths), tight)
+    assert w_tight < w_base
+
+
+def test_sketch_window_rate_and_wraparound():
+    t = [0.0]
+    sk = WorkloadSketch(window=16, clock=lambda: t[0])
+    for i in range(10):
+        sk.observe(np.full(8, 10 + i))
+        t[0] += 0.1  # 8 rows / 0.1s = 80 rows/s
+    v = sk.snapshot()
+    assert v.n == 16                      # ring holds the window
+    assert v.rows_seen == 80
+    assert set(np.unique(v.lengths)) == {18, 19}  # only the newest two batches
+    assert 40 < v.arrival_rows_per_sec <= 80      # EWMA converging on 80
+    # arrival order preserved through the wrap
+    assert list(v.lengths) == [18] * 8 + [19] * 8
+
+
+# -- warm / flip / rollback on a live runner ---------------------------------
+
+
+def test_hysteresis_no_flap_on_stable_workload():
+    runner = _runner()
+    tuner = _tuner(runner)
+    _serve_lengths(runner, tuner, [12], batches=12)
+
+    async def go():
+        first = await tuner.run_cycle(force=True)
+        assert first["action"] == "committed"
+        assert tuner.epoch == 1
+        # the workload did not change: every further cycle must reject,
+        # never flap the grid back and forth
+        for _ in range(3):
+            rep = await tuner.run_cycle(force=True)
+            assert rep["action"] == "rejected"
+        assert tuner.epoch == 1
+        assert int(tuner.m_rejected.value) >= 3
+
+    asyncio.run(go())
+
+
+def test_warm_then_flip_zero_onpath_recompiles():
+    runner = _runner()
+    tuner = _tuner(runner)
+    _serve_lengths(runner, tuner, [12], batches=12)
+    c0 = runner.m_compiles.value
+
+    async def go():
+        rep = await tuner.run_cycle(force=True)
+        assert rep["action"] == "committed"
+        # the flip itself: zero serving-path compiles, all warm-path
+        assert runner.m_compiles.value == c0
+        assert runner.m_warm_compiles.value > 0
+        assert runner.buckets.seq_buckets[0] <= 24  # retargeted
+        # serving ON the new grid: still zero compiles (shapes were warmed)
+        rng = np.random.default_rng(1)
+        for _ in range(4):
+            ids = rng.integers(1, 500, size=(8, 16)).astype(np.int32)
+            mask = np.zeros((8, 16), np.int32)
+            mask[:, :12] = 1
+            await runner.infer({"input_ids": ids, "attention_mask": mask})
+        assert runner.m_compiles.value == c0
+
+    asyncio.run(go())
+
+
+def test_probe_failure_rollback_restores_and_flushes_nothing():
+    runner = _runner()
+    tuner = _tuner(runner)
+    flushed = []
+    tuner.add_commit_hook(lambda: flushed.append(1))
+    # a live coalescer on the incumbent grid, registered like the buffer's
+    # lanes: a ROLLBACK must leave it untouched
+    coal = MicroBatchCoalescer([4, 8])
+    bus = bucket_cap_bus()
+    bus.register(coal)
+    try:
+        _serve_lengths(runner, tuner, [12], batches=12)
+        grid0 = runner.buckets
+        tuner.inject_fault("probe_fail")
+
+        async def go():
+            with pytest.raises(TunerError):
+                await tuner.run_cycle(force=True)
+
+        asyncio.run(go())
+        assert runner.buckets.seq_buckets == grid0.seq_buckets  # restored
+        assert tuner.epoch == 0
+        assert int(tuner.m_rollbacks.value) == 1
+        assert coal.buckets == (4, 8)       # nothing broadcast
+        assert flushed == []                # nothing flushed
+        assert tuner._last_decision["action"] == "rolled_back"
+    finally:
+        bus.reset()
+
+
+def test_live_coalescer_retarget_via_bus_and_expect_scoping():
+    bus = bucket_cap_bus()
+    mine = MicroBatchCoalescer([4, 8], token_budget=256)
+    other = MicroBatchCoalescer([16, 64])  # a different stream's grid
+    bus.register(mine)
+    bus.register(other)
+    try:
+        bus.retarget((4, 8), token_budget=512, expect=(4, 8))
+        assert mine.buckets == (4, 8) and mine.token_budget == 512
+        assert other.buckets == (16, 64)    # expect-scoped: untouched
+        # an OOM cap always clamps a retarget (cap wins over preference)
+        bus.announce(4)
+        bus.retarget((4, 8), token_budget=512, expect=(4,))
+        assert mine.buckets == (4,)         # capped after announce
+        bus.retarget((4, 8), token_budget=512, expect=None)
+        assert mine.buckets == (4,) and mine.token_budget == 256
+    finally:
+        bus.reset()
+
+
+def test_memory_buffer_follows_retarget():
+    from arkflow_tpu.components import Resource
+    from arkflow_tpu.components.registry import build_component, ensure_plugins_loaded
+
+    ensure_plugins_loaded()
+    bus = bucket_cap_bus()
+    try:
+        buf = build_component(
+            "buffer",
+            {"type": "memory", "capacity": 64, "timeout": "50ms",
+             "coalesce": {"batch_buckets": [4, 8], "deadline": "20ms"}},
+            Resource())
+        assert buf._deadline_s == 0.02
+        bus.retarget((4, 8, 16), deadline_s=0.005, expect=(4, 8))
+        assert buf._deadline_s == 0.005
+        assert buf._coalesce_kwargs["batch_buckets"] == [4, 8, 16]
+        assert buf._coalescer.buckets == (4, 8, 16)  # live lane followed
+        # a mismatched expect leaves it alone
+        bus.retarget((32,), deadline_s=0.5, expect=(99,))
+        assert buf._deadline_s == 0.005
+        # buckets above the backpressure bound are dropped, never adopted
+        bus.retarget((8, 100000), deadline_s=None, expect=(4, 8, 16))
+        assert buf._coalesce_kwargs["batch_buckets"] == [8]
+    finally:
+        bus.reset()
+
+
+def test_bound_listener_scopes_commit_to_own_stream():
+    """A tuner with a stream-bound buffer (the production wiring) must
+    retarget exactly that buffer on commit — a FOREIGN coalescer that
+    merely configured the same grid, registered on the process-global bus,
+    stays untouched."""
+    from arkflow_tpu.components import Resource
+    from arkflow_tpu.components.registry import build_component, ensure_plugins_loaded
+
+    ensure_plugins_loaded()
+    bus = bucket_cap_bus()
+    try:
+        runner = _runner(batch=(4, 8), seq=(32, 64))
+        tuner = _tuner(runner)
+        buf = build_component(
+            "buffer",
+            {"type": "memory", "capacity": 64, "timeout": "50ms",
+             "coalesce": {"batch_buckets": [4, 8], "deadline": "20ms"}},
+            Resource())
+        tuner.bind_listener(buf)                 # what the stream wires
+        foreign = MicroBatchCoalescer([4, 8])    # same grid, other stream
+        bus.register(foreign)
+        _serve_lengths(runner, tuner, [12], batches=12)
+
+        async def go():
+            rep = await tuner.run_cycle(force=True)
+            assert rep["action"] == "committed"
+
+        asyncio.run(go())
+        assert buf._deadline_s != 0.02           # bound buffer followed
+        assert buf._coalescer.buckets == (4, 8)
+        assert foreign.buckets == (4, 8)         # foreign grid untouched
+        # and the foreign coalescer's budget/deadline state was never set
+        assert foreign.token_budget is None
+    finally:
+        bus.reset()
+
+
+def test_cache_config_epoch_regression():
+    """A committed flip must epoch-flush the response cache: the same bytes
+    re-sent after a retune recompute instead of returning bytes produced
+    under the old padding."""
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Resource
+    from arkflow_tpu.components.registry import build_component, ensure_plugins_loaded
+
+    ensure_plugins_loaded()
+    bus = bucket_cap_bus()
+    try:
+        proc = build_component(
+            "processor",
+            {"type": "tpu_inference", "model": "bert_classifier",
+             "model_config": TINY_BERT, "max_seq": 64,
+             "batch_buckets": [4, 8], "seq_buckets": [32, 64],
+             "response_cache": {"capacity": 32},
+             "tuner": {"min_samples": 32, "min_improvement": 0.005,
+                       "interval": 0}},
+            Resource())
+        assert proc.tuner is not None
+        batch = MessageBatch.new_binary([b"epoch regression row"] * 4)
+
+        async def go():
+            await proc.process(batch)
+            await proc.process(batch)           # byte-identical -> HIT
+            rep1 = proc.cache.report()
+            assert rep1["hits"] == 1 and rep1["epoch"] == 0
+            # make the incumbent obviously wasteful so the cycle commits
+            proc.tuner.observe(np.full(256, 10))
+            rep = await proc.tuner.run_cycle(force=True)
+            assert rep["action"] == "committed"
+            rep2 = proc.cache.report()
+            assert rep2["epoch"] == 1           # config epoch folded
+            await proc.process(batch)           # post-flip duplicate: MISS
+            rep3 = proc.cache.report()
+            assert rep3["hits"] == 1 and rep3["misses"] == rep2["misses"] + 1
+
+        asyncio.run(go())
+    finally:
+        bus.reset()
+
+
+def test_pool_warm_flip_and_rollback():
+    from arkflow_tpu.tpu.pool import ModelRunnerPool
+
+    pool = ModelRunnerPool("bert_classifier", TINY_BERT, pool_size=2,
+                           buckets=BucketPolicy((4,), (32,)))
+    tuner = ShapeTuner(pool, model="bert_classifier",
+                       cfg=TunerConfig(min_samples=32, min_improvement=0.01),
+                       packed=False)
+    rng = np.random.default_rng(0)
+
+    async def go():
+        for _ in range(8):
+            ids = rng.integers(1, 500, size=(4, 32)).astype(np.int32)
+            mask = np.zeros((4, 32), np.int32)
+            mask[:, :10] = 1
+            tuner.observe(mask.sum(axis=1))
+            await pool.infer({"input_ids": ids, "attention_mask": mask})
+        rep = await tuner.run_cycle(force=True)
+        assert rep["action"] == "committed"
+        for m in pool.members:                 # every member flipped
+            assert m.buckets.seq_buckets[0] <= 16
+        grids = [m.buckets for m in pool.members]
+        tuner.observe(np.full(512, 24))        # shift -> new proposal
+        tuner.inject_fault("probe_fail")
+        with pytest.raises(TunerError):
+            await tuner.run_cycle(force=True)
+        for m, g in zip(pool.members, grids):  # every member rolled back
+            assert m.buckets.seq_buckets == g.seq_buckets
+
+    asyncio.run(go())
+
+
+# -- engine surface ----------------------------------------------------------
+
+
+def test_engine_health_and_admin_tune_endpoint():
+    import aiohttp
+
+    from arkflow_tpu.config import EngineConfig
+    from arkflow_tpu.runtime.engine import Engine
+
+    port = 18117
+    cfg = EngineConfig.from_mapping({
+        "streams": [{
+            "name": "tune-stream",
+            "input": {"type": "generate", "payload": "tuned live row words",
+                      "interval": "20ms", "batch_size": 2},
+            "pipeline": {"thread_num": 1, "processors": [{
+                "type": "tpu_inference", "model": "bert_classifier",
+                "model_config": TINY_BERT, "max_seq": 16,
+                "batch_buckets": [2], "seq_buckets": [16],
+                "tuner": {"min_samples": 8, "interval": 0,
+                          "min_improvement": 0.01},
+            }]},
+            "output": {"type": "drop"},
+        }],
+        "health_check": {"enabled": True, "host": "127.0.0.1", "port": port},
+    })
+    engine = Engine(cfg)
+
+    async def go():
+        run_task = asyncio.create_task(engine.run())
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                deadline = time.monotonic() + 30
+                up = False
+                while time.monotonic() < deadline and not up:
+                    await asyncio.sleep(0.1)
+                    try:
+                        async with s.get(base + "/health") as r:
+                            up = r.status == 200
+                    except aiohttp.ClientError:
+                        continue
+                assert up, "health server never came up"
+                # bad body -> 400
+                async with s.post(base + "/admin/tune", data=b"}{") as r:
+                    assert r.status == 400
+                # unknown stream -> 404
+                async with s.post(base + "/admin/tune",
+                                  json={"stream": "nope"}) as r:
+                    assert r.status == 404
+                # wait for enough observed rows, then force a cycle
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline:
+                    async with s.post(base + "/admin/tune", json={}) as r:
+                        body = json.loads(await r.text())
+                        assert r.status == 200, body
+                        action = body["results"]["tune-stream"][0]["action"]
+                        if action != "skipped":
+                            break
+                    await asyncio.sleep(0.2)
+                assert action in ("committed", "rejected")
+                # /health carries the tuner section
+                async with s.get(base + "/health") as r:
+                    health = json.loads(await r.text())
+                tn = health["stream_health"]["tune-stream"]["tuner"][0]
+                assert tn["enabled"] is True
+                assert tn["sketch"]["rows_seen"] > 0
+                assert "incumbent" in tn and "bucket_dispatches" in tn
+                # a chaos probe failure surfaces as 409, incumbent serving
+                proc = engine.streams[0].pipeline.processors[0]
+                proc.tuner.observe(np.full(64, 14))  # ensure a fresh flip
+                proc.tuner.inject_fault("probe_fail")
+                async with s.post(base + "/admin/tune", json={}) as r:
+                    body = json.loads(await r.text())
+                    rep = body["results"]["tune-stream"][0]
+                    if not rep["ok"]:
+                        assert r.status == 409
+                        assert "rolled back" in rep["error"]
+                    else:
+                        # the proposal was rejected before any probe ran;
+                        # the armed fault was never consumed — disarm
+                        proc.tuner._chaos.clear()
+        finally:
+            engine.shutdown()
+            bucket_cap_bus().reset()
+            try:
+                await asyncio.wait_for(run_task, timeout=15)
+            except (asyncio.TimeoutError, Exception):
+                run_task.cancel()
+
+    asyncio.run(go())
+
+
+# -- soak acceptance ----------------------------------------------------------
+
+
+def test_tuner_soak_fast_mode_smoke():
+    """Acceptance gate (tools/chaos_soak.py --tuner --fast): on the
+    shifting-length soak the tuner-enabled run beats the static default on
+    BOTH rows/s and capacity-weighted padding waste, with zero on-path
+    recompiles after warmup, a forced probe-failure rollback restoring the
+    incumbent grid, and zero rows lost across every flip."""
+    import importlib
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    try:
+        chaos_soak = importlib.import_module("chaos_soak")
+    finally:
+        sys.path.pop(0)
+    verdict = chaos_soak.run_tuner_soak(seconds=120.0, seed=7, fast=True)
+    assert verdict["pass"], json.dumps(verdict, indent=2)
+    assert verdict["tuned_beats_static_rows_per_sec"]
+    assert verdict["tuned_beats_static_waste"]
+    assert verdict["zero_onpath_recompiles"]
+    assert verdict["probe_failure_rollback_ok"]
+    assert verdict["static"]["lost_rows"] == 0
+    assert verdict["tuned"]["lost_rows"] == 0

@@ -1548,6 +1548,267 @@ def run_cluster_soak(seconds: float = 60.0, seed: int = 7,
     return _attach_tracing(verdict, trace_seq0, trace_forced0)
 
 
+# -- traffic-adaptive shapes soak (tpu/tuner.py) ------------------------------
+
+# wide enough that the DEVICE step dominates e2e (at hidden 32 the step is
+# <10% of e2e and the tuned seq-grid compute win drowns in loop noise; at
+# this size a b8 step measures ~5ms at seq 16 vs ~9ms at seq 32, so rows/s
+# reflects the shapes, not the event loop)
+_TUNER_TINY_BERT = {"vocab_size": 512, "hidden": 128, "layers": 4, "heads": 4,
+                    "ffn": 512, "num_labels": 2}
+
+
+def _tuner_soak_config(name: str, tuned: bool, fast: bool) -> dict:
+    """Coalesced unpacked BERT serving on a deliberately-blind pow2 seq grid
+    [32, 64]; the tuned variant adds the ``tuner:`` block (long autonomous
+    interval — the soak drives cycles explicitly for determinism)."""
+    proc = {
+        "type": "tpu_inference", "model": "bert_classifier",
+        "model_config": dict(_TUNER_TINY_BERT), "max_seq": 64,
+        "batch_buckets": [8], "seq_buckets": [32, 64],
+        "warmup": True,
+    }
+    if tuned:
+        proc["tuner"] = {
+            # longer than any phase: the autonomous loop never fires, so
+            # the driver's forced cycles are the ONLY ones — a background
+            # cycle could otherwise consume the armed probe fault and turn
+            # the rollback assertion nondeterministic
+            "interval": "60s", "min_samples": 48, "min_improvement": 0.02,
+            "max_compiles": 16, "window": 128 if fast else 512,
+            "deadline_min": "5ms", "deadline_max": "100ms",
+        }
+    return {
+        "name": name,
+        "input": {"type": "memory", "messages": ["placeholder"]},
+        "buffer": {"type": "memory", "capacity": 64, "timeout": "200ms",
+                   "coalesce": {"batch_buckets": [8], "deadline": "25ms"}},
+        "pipeline": {"thread_num": 2, "processors": [proc]},
+        "output": {"type": "drop"},
+    }
+
+
+def run_tuner_soak(seconds: float = 90.0, seed: int = 7,
+                   fast: bool = False) -> dict:
+    """Shifting-length-distribution soak for the runtime shape tuner.
+
+    The same seeded schedule — a SHORT word-count mix that flips to a LONG
+    mix mid-run — serves twice: once on the static pow2 default, once with
+    the ``tuner:`` block enabled. The verdict asserts the tuned run beats
+    the static default on BOTH rows/s and capacity-weighted
+    ``padding_waste_frac``, that every tuner-minted shape compiled on the
+    warm path (``arkflow_tpu_compiles_total`` flat on the serving path vs
+    the static run), that a chaos-forced probe failure mid-run rolls back
+    to the incumbent grid with zero lost rows, and that no row was silently
+    lost across any flip."""
+    import asyncio
+    import random
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import Ack, Input, NoopAck, ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.errors import EndOfInput, TunerError
+    from arkflow_tpu.obs import global_registry
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import build_stream
+    from arkflow_tpu.tpu.bucketing import bucket_cap_bus
+
+    ensure_plugins_loaded()
+    trace_seq0, trace_forced0 = _tracing_watermark()
+    reg = global_registry()
+
+    # sized so each phase saturates for several seconds on a 2-core CPU —
+    # long enough that the tuner's mid-run commits cover most of each mix
+    rows_total = 6000 if fast else 16000
+    rows_per_batch = 4
+    half = rows_total // 2
+
+    def make_schedule() -> list[bytes]:
+        """Row i's payload: unique id + k filler words; k draws SHORT for
+        the first half, LONG for the second (the mid-run mix flip). The
+        hash tokenizer counts words, so token length == k + specials."""
+        rng = random.Random(seed)
+        rows = []
+        for i in range(rows_total):
+            k = rng.randint(4, 10) if i < half else rng.randint(34, 46)
+            rows.append((f"t{i:05d} " + "w " * (k - 1)).strip().encode())
+        return rows
+
+    class _ShiftingSource(Input):
+        def __init__(self, rows: list[bytes]):
+            self._rows = list(rows)
+            self._pos = 0
+
+        async def connect(self) -> None:
+            return None
+
+        async def read(self) -> tuple[MessageBatch, Ack]:
+            if self._pos >= len(self._rows):
+                raise EndOfInput()
+            chunk = self._rows[self._pos:self._pos + rows_per_batch]
+            self._pos += len(chunk)
+            await asyncio.sleep(0)  # saturating, but never starves the loop
+            return (MessageBatch.new_binary(chunk).with_source("tuner-soak"),
+                    NoopAck())
+
+    def counters() -> dict:
+        return {
+            "tokens": reg.sum_values("arkflow_tpu_tokens_total"),
+            "capacity": reg.sum_values("arkflow_tpu_token_capacity_total"),
+            "compiles": reg.sum_values("arkflow_tpu_compiles_total"),
+            "warm_compiles": reg.sum_values("arkflow_tpu_warm_compiles_total"),
+            "rollbacks": reg.sum_values("arkflow_tuner_rollbacks_total"),
+            "commits": reg.sum_values("arkflow_tuner_commits_total"),
+        }
+
+    def run_phase(tuned: bool, budget_s: float) -> dict:
+        cfg = StreamConfig.from_mapping(
+            _tuner_soak_config(f"tuner-soak-{'on' if tuned else 'off'}",
+                               tuned, fast))
+        stream = build_stream(cfg)
+        stream.input = _ShiftingSource(make_schedule())
+        delivered: list[bytes] = []
+        t_first: list[float] = []
+
+        class _Collect(DropOutput):
+            async def write(self, batch: MessageBatch) -> None:
+                if not t_first:
+                    t_first.append(time.monotonic())
+                delivered.extend(batch.to_binary())
+
+        stream.output = _Collect()
+        proc = stream.pipeline.processors[0]
+        before = counters()
+        phase: dict = {"tuned": tuned}
+
+        async def driver() -> None:
+            """Tuned phase only: force cycles at deterministic points —
+            commit on the short mix, a chaos probe-failure rollback after
+            the mix flips, then the real long-mix commit."""
+            tuner = proc.tuner
+
+            async def wait_rows(n: int, budget: float) -> None:
+                deadline = time.monotonic() + budget
+                while len(delivered) < n and time.monotonic() < deadline:
+                    await asyncio.sleep(0.02)
+
+            async def force() -> str:
+                try:
+                    rep = await tuner.run_cycle(force=True)
+                    return rep["action"]
+                except TunerError:
+                    return "rolled_back"
+
+            # 1. short mix: window full of short rows -> first commit, so
+            # most of the short half serves on the retuned grid
+            win = 128 if fast else 512
+            await wait_rows(win + 8 * rows_per_batch, budget_s * 0.5)
+            outcomes = [await force()]
+            # 2. after the flip: window dominated by the long mix; arm the
+            # probe fault so the beneficial flip ROLLS BACK...
+            await wait_rows(half + win + 2 * rows_per_batch, budget_s * 0.5)
+            for _ in range(3):
+                tuner.inject_fault("probe_fail")
+                grid_before = proc.runner.buckets.seq_buckets
+                out = await force()
+                outcomes.append(out)
+                if out == "rolled_back":
+                    phase["rollback_grid_restored"] = (
+                        proc.runner.buckets.seq_buckets == grid_before)
+                    break
+                tuner._chaos.clear()  # proposal never probed; disarm
+                await wait_rows(len(delivered) + 64, budget_s * 0.25)
+            # 3. ...then commits cleanly once the chaos is gone
+            for _ in range(3):
+                out = await force()
+                outcomes.append(out)
+                if out == "committed":
+                    break
+                await wait_rows(len(delivered) + 64, budget_s * 0.25)
+            phase["forced_outcomes"] = outcomes
+
+        async def bounded() -> bool:
+            cancel = asyncio.Event()
+            task = asyncio.create_task(stream.run(cancel))
+            drv = (asyncio.create_task(driver()) if tuned else None)
+            done, _ = await asyncio.wait({task}, timeout=budget_s)
+            if drv is not None:
+                drv.cancel()
+                try:
+                    await drv
+                except (asyncio.CancelledError, Exception):
+                    pass
+            if done:
+                task.result()
+                return False
+            cancel.set()
+            try:
+                await asyncio.wait_for(task, timeout=15.0)
+            except (asyncio.TimeoutError, Exception):
+                task.cancel()
+            return True
+
+        t0 = time.monotonic()
+        wedged = asyncio.run(bounded())
+        t_end = time.monotonic()
+        after = counters()
+        expected = {f"t{i:05d}".encode() for i in range(rows_total)}
+        got = {p.split(b" ", 1)[0] for p in delivered}
+        serve_t = t_end - (t_first[0] if t_first else t0)
+        d_cap = after["capacity"] - before["capacity"]
+        phase.update({
+            "wedged": wedged,
+            "delivered_rows": len(delivered),
+            "lost_rows": len(expected - got),
+            "rows_per_sec": round(len(delivered) / max(serve_t, 1e-6), 1),
+            "padding_waste_frac": round(
+                1.0 - (after["tokens"] - before["tokens"]) / d_cap, 4)
+            if d_cap > 0 else None,
+            "serving_compiles": int(after["compiles"] - before["compiles"]),
+            "warm_compiles": int(after["warm_compiles"] - before["warm_compiles"]),
+        })
+        if tuned:
+            phase["tuner"] = proc.tuner.report()
+            phase["commits"] = int(after["commits"] - before["commits"])
+            phase["rollbacks"] = int(after["rollbacks"] - before["rollbacks"])
+        return phase
+
+    budget_each = max(20.0, seconds / 2)
+    try:
+        static = run_phase(tuned=False, budget_s=budget_each)
+        tuned = run_phase(tuned=True, budget_s=budget_each)
+    finally:
+        bucket_cap_bus().reset()  # in-process callers get a clean slate
+
+    beats_rows = (not static["wedged"] and not tuned["wedged"]
+                  and tuned["rows_per_sec"] > static["rows_per_sec"])
+    beats_waste = (static["padding_waste_frac"] is not None
+                   and tuned["padding_waste_frac"] is not None
+                   and tuned["padding_waste_frac"] < static["padding_waste_frac"])
+    # the acceptance bar: every tuner-minted shape compiled on the warm
+    # path — the tuned run's SERVING-path compile count is no higher than
+    # the static run's (both pay only their connect-time warmup)
+    zero_onpath = (tuned["serving_compiles"] <= static["serving_compiles"]
+                   and tuned["warm_compiles"] > 0)
+    rollback_ok = (tuned.get("rollbacks", 0) >= 1
+                   and tuned.get("rollback_grid_restored") is True)
+    verdict = {
+        "mode": "tuner",
+        "pass": bool(beats_rows and beats_waste and zero_onpath and rollback_ok
+                     and tuned.get("commits", 0) >= 1
+                     and static["lost_rows"] == 0 and tuned["lost_rows"] == 0),
+        "seed": seed,
+        "rows": rows_total,
+        "static": static,
+        "tuned": tuned,
+        "tuned_beats_static_rows_per_sec": beats_rows,
+        "tuned_beats_static_waste": beats_waste,
+        "zero_onpath_recompiles": zero_onpath,
+        "probe_failure_rollback_ok": rollback_ok,
+    }
+    return _attach_tracing(verdict, trace_seq0, trace_forced0)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seconds", type=float, default=60.0,
@@ -1575,6 +1836,14 @@ def main(argv=None) -> int:
                          "stream; asserts >=1.7x aggregate rows/s, "
                          "cross-process duplicate cache affinity, and zero "
                          "silent loss across a worker kill/restart")
+    ap.add_argument("--tuner", action="store_true",
+                    help="traffic-adaptive-shapes soak: a shifting-length "
+                         "distribution (short->long mix flip mid-run) serves "
+                         "on the static default AND with the runtime shape "
+                         "tuner; asserts the tuned run beats static on rows/s "
+                         "AND padding_waste_frac with zero on-path recompiles "
+                         "after warmup, a forced probe-failure rollback, and "
+                         "zero silent loss across flips")
     ap.add_argument("--factor", type=int, default=4,
                     help="burst mode: offered-load multiplier (default 4)")
     ap.add_argument("--fast", action="store_true",
@@ -1620,6 +1889,17 @@ def main(argv=None) -> int:
         # workers do (each pins its own virtual-CPU env)
         verdict = run_cluster_soak(seconds=args.seconds, seed=args.seed,
                                    fast=args.fast)
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["pass"] else 1
+
+    if args.tuner:
+        if os.environ.get("ARKFLOW_SOAK_KEEP_ENV") != "1":
+            # tiny single-device serving: pin virtual CPU BEFORE jax loads
+            from arkflow_tpu.utils.cleanenv import pin_cpu_env
+
+            pin_cpu_env(os.environ, n_devices=1)
+        verdict = run_tuner_soak(seconds=args.seconds, seed=args.seed,
+                                 fast=args.fast)
         print(json.dumps(verdict, indent=2))
         return 0 if verdict["pass"] else 1
 
