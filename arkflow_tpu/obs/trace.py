@@ -58,7 +58,9 @@ from arkflow_tpu.obs.metrics import global_registry
 
 #: statuses that force-commit a trace regardless of the head-sampling
 #: decision: these are exactly the requests an operator needs to see
-FORCE_STATUSES = ("shed", "deadline", "error")
+#: ``fleet`` = an autoscaling-controller decision (runtime/fleet.py): rare,
+#: operator-relevant, and meaningless to head-sample — always committed
+FORCE_STATUSES = ("shed", "deadline", "error", "fleet")
 
 
 def _new_id(nbytes: int = 8) -> str:

@@ -14,11 +14,30 @@ Config:
     text_field: __value__       # prefix mode: payload column
     virtual_nodes: 64           # hash-ring vnodes per worker
     heartbeat: 2s               # register/heartbeat probe interval
+    heartbeat_timeout: 10s      # staleness bound: quiet members are marked
+                                # dead proactively (default max(5x heartbeat,
+                                # 10s); must exceed the heartbeat period)
     request_timeout: 60s        # per-dispatch wire timeout
     connect_timeout: 5s
     drain_timeout: 30s          # per-worker drain budget in rolling swaps
     max_frame: 1073741824       # wire frame cap in bytes (default 1 GiB)
     response_cache: {capacity: 1024, ttl: 30s}   # optional ingest-side dedup
+    fleet:                      # optional autoscaling controller
+      min_workers: 1            # floor (default: len(workers)); respawned
+      max_workers: 4            # scale-out ceiling
+      interval: 2s              # control-loop period
+      scale_out_sustain: 10s    # pressure persistence before +1 worker
+      scale_in_sustain: 30s     # headroom persistence before -1 worker
+      drain_high: 3s            # drain estimate counting as queue pressure
+      idle_frac: 0.25           # idle when inflight <= idle_frac * window
+      cooldown: 15s             # min gap between membership changes
+      respawn: true             # hold min_workers after preemptions
+      template: worker.yaml     # worker config (mapping or path) to spawn
+      spawn_host: 127.0.0.1
+      spawn_timeout: 240s       # spawn warmup + register budget
+      drain_timeout: 30s        # retire drain budget on scale-in
+
+See docs/CONFIG.md "Cluster serving" and "Elastic fleet" for semantics.
 """
 
 from __future__ import annotations

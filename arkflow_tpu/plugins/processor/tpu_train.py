@@ -91,7 +91,11 @@ class TpuTrainProcessor(Processor):
         self._lock = asyncio.Lock()  # one optimizer step at a time
 
         try:
-            cpu = jax.devices("cpu")[0]
+            # local_devices, not devices: under multi-host jax.distributed
+            # the global list leads with process 0's device, which is not
+            # addressable from other processes.
+            cpus = jax.local_devices(backend="cpu")
+            cpu = cpus[0] if cpus else None
         except RuntimeError:
             cpu = None
         ctx = jax.default_device(cpu) if cpu is not None else None

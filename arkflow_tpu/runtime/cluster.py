@@ -226,6 +226,33 @@ def _swappers(processors: Sequence[Any]) -> list:
     return out
 
 
+def _shape_reports(processors: Sequence[Any]) -> list:
+    """Per-processor serving shape grids, positional (None = no model
+    stage). Rides the heartbeat so the ingest fleet controller can replay
+    the incumbent grid into a freshly spawned worker's warmup — the tuner's
+    committed shapes win over the static config the template carries."""
+    out: list = []
+    for proc in processors:
+        shape = None
+        tuner = _walk_inner(proc, "tuner")
+        incumbent = getattr(tuner, "_incumbent", None)
+        if incumbent is not None and hasattr(incumbent, "report"):
+            try:
+                shape = incumbent.report()
+            except Exception:
+                logger.exception("worker shape report failed")
+        if shape is None:
+            runner = _walk_inner(proc, "runner")
+            buckets = getattr(runner, "buckets", None)
+            if buckets is not None and hasattr(buckets, "batch_buckets"):
+                shape = {"batch_buckets": list(buckets.batch_buckets),
+                         "seq_buckets": list(buckets.seq_buckets),
+                         "example_scale": int(
+                             getattr(buckets, "example_scale", 1))}
+        out.append(shape)
+    return out if any(s is not None for s in out) else []
+
+
 # ---------------------------------------------------------------------------
 # device tier: the cluster worker server
 # ---------------------------------------------------------------------------
@@ -244,7 +271,8 @@ class ClusterWorkerServer:
     def __init__(self, processors: Sequence[Any], *, host: str = "127.0.0.1",
                  port: int = 50052, worker_id: Optional[str] = None,
                  max_in_flight: int = 1, max_frame: int = DEFAULT_MAX_FRAME,
-                 tracing: Optional[TracingConfig] = None):
+                 tracing: Optional[TracingConfig] = None,
+                 grace_s: float = 30.0):
         from arkflow_tpu.runtime.overload import OverloadConfig, OverloadController
         from arkflow_tpu.runtime.pipeline import Pipeline
 
@@ -268,6 +296,13 @@ class ClusterWorkerServer:
         self.max_in_flight = max_in_flight
         self.max_frame = int(max_frame)
         self.draining = False
+        #: SIGTERM/SIGINT grace budget: how long a self-draining worker
+        #: waits for in-flight batches before exiting anyway (spot
+        #: preemption notices are time-boxed; blowing the budget means the
+        #: still-running batches nack through redelivery, not vanish)
+        self.grace_s = float(grace_s)
+        self._stopping = asyncio.Event()
+        self._drain_task: Optional[asyncio.Task] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._sem: Optional[asyncio.Semaphore] = None  # bound at start()
         self._inflight = 0  # accepted infer requests not yet answered
@@ -295,10 +330,24 @@ class ClusterWorkerServer:
                     self.worker_id, self.host, self.port)
 
     async def serve_forever(self) -> None:
+        """Serve until cancelled OR gracefully stopped (a SIGTERM-initiated
+        self-drain completes by setting the stop event — see
+        :meth:`begin_self_drain`)."""
         if self._server is None:
             await self.start()
         async with self._server:
-            await self._server.serve_forever()
+            serve = asyncio.create_task(self._server.serve_forever())
+            stop = asyncio.create_task(self._stopping.wait())
+            try:
+                await asyncio.wait({serve, stop},
+                                   return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                for t in (serve, stop):
+                    t.cancel()
+                    try:
+                        await t
+                    except (asyncio.CancelledError, Exception):
+                        pass
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -308,6 +357,56 @@ class ClusterWorkerServer:
             except asyncio.TimeoutError:
                 pass
         await self.pipeline.close()
+
+    # -- preemption-safe self-drain (the SIGTERM primitive) ----------------
+
+    def begin_self_drain(self, reason: str = "signal") -> None:
+        """Flip to draining and schedule the graceful exit: new ``infer``
+        requests are refused (retryable → the ingest ring re-routes them),
+        in-flight batches get ``grace_s`` to finish, then the serve loop
+        stops. Idempotent — a double SIGTERM doesn't shorten the budget.
+
+        Usable standalone (any embedder can call it); ``run_worker`` wires
+        it to SIGTERM/SIGINT so a spot preemption or a fleet-controller
+        retire is routine, not a mid-batch kill."""
+        if self.draining and self._drain_task is not None:
+            return
+        self.draining = True
+        logger.info("cluster worker %s self-draining (%s): %d in-flight, "
+                    "grace %.1fs", self.worker_id, reason, self._inflight,
+                    self.grace_s)
+        self._drain_task = asyncio.get_running_loop().create_task(
+            self._drain_then_stop())
+
+    async def _drain_then_stop(self) -> None:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.grace_s
+        while self._inflight > 0 and loop.time() < deadline:
+            await asyncio.sleep(0.05)
+        if self._inflight > 0:
+            logger.warning(
+                "cluster worker %s: %d batches still in flight after %.1fs "
+                "grace; exiting anyway (they nack through redelivery)",
+                self.worker_id, self._inflight, self.grace_s)
+        else:
+            logger.info("cluster worker %s drained clean; exiting",
+                        self.worker_id)
+        self._stopping.set()
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT = preemption notice, not a crash: self-drain
+        under the grace budget instead of dying mid-batch."""
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(
+                    sig, self.begin_self_drain, sig.name)
+            except (NotImplementedError, RuntimeError, ValueError):
+                # non-main thread or platform without loop signal support:
+                # the embedder owns signals then
+                return
 
     # -- introspection -----------------------------------------------------
 
@@ -326,6 +425,7 @@ class ClusterWorkerServer:
             "step_ewma_ms": round(self.ctrl.step_s() * 1000.0, 3),
             "health": _runner_reports(self.pipeline.processors),
             "caches": _cache_reports(self.pipeline.processors),
+            "shapes": _shape_reports(self.pipeline.processors),
         }
 
     # -- request handling --------------------------------------------------
@@ -486,7 +586,8 @@ def parse_worker_config(m: Any) -> tuple[list[dict], dict]:
     ``{pipeline: {processors: [...]}}``, or a full engine config (the FIRST
     stream's pipeline is hosted) — so a worker can reuse the exact
     processor block of the single-process config it was split out of.
-    Options ride under ``worker: {id, max_in_flight, max_frame}``."""
+    Options ride under ``worker: {id, max_in_flight, max_frame, grace}``
+    (``grace`` = the SIGTERM self-drain budget, default 30s)."""
     if not isinstance(m, Mapping):
         raise ConfigError("cluster worker config must be a mapping")
     procs: Any = m.get("processors")
@@ -523,6 +624,16 @@ def parse_worker_config(m: Any) -> tuple[list[dict], dict]:
     if wid is not None and not isinstance(wid, str):
         raise ConfigError(f"worker.id must be a string, got {wid!r}")
     opts["worker_id"] = wid
+    from arkflow_tpu.utils.duration import parse_duration
+
+    grace = opts_raw.get("grace", "30s")
+    try:
+        grace_s = parse_duration(grace)
+    except (ConfigError, TypeError, ValueError) as e:
+        raise ConfigError(f"worker.grace invalid: {e}") from e
+    if grace_s <= 0:
+        raise ConfigError(f"worker.grace must be > 0, got {grace!r}")
+    opts["grace_s"] = grace_s
     # a worker accepts the same top-level `tracing:` block as the engine
     # (sample knobs matter less here — the ingest tier owns the sampling
     # decision — but span caps and the kill switch do). Parsed even when
@@ -546,16 +657,49 @@ def build_worker_server(config: Mapping, *, host: str = "127.0.0.1",
         worker_id=worker_id or opts["worker_id"],
         max_in_flight=opts["max_in_flight"],
         max_frame=max_frame or opts["max_frame"],
-        tracing=opts["tracing"])
+        tracing=opts["tracing"],
+        grace_s=opts["grace_s"])
 
 
 async def run_worker(config: Mapping, *, host: str = "127.0.0.1",
                      port: int = 50052, worker_id: Optional[str] = None,
                      max_frame: Optional[int] = None) -> None:
-    """CLI entry: build, warm up, then serve until cancelled."""
+    """CLI entry: build, warm up, then serve until cancelled, stopped by a
+    SIGTERM self-drain, or (multi-host follower) released by the primary.
+
+    With a ``distributed:`` block (or the ``ARKFLOW_*`` distributed env)
+    naming more than one process, the worker joins a multi-host
+    ``jax.distributed`` mesh: every process builds the IDENTICAL processor
+    chain (so ``mesh: {pp: N}`` spans the global device list), process 0
+    opens the serving port and broadcasts each infer batch, processes > 0
+    run the lockstep follower loop (parallel/distributed.py) — one model
+    too big for one process, served across several."""
+    from arkflow_tpu.parallel.distributed import multihost_from_config
+
+    mh = multihost_from_config(config)
     server = build_worker_server(config, host=host, port=port,
                                  worker_id=worker_id, max_frame=max_frame)
+    if mh is not None and not mh.is_primary:
+        from arkflow_tpu.parallel.distributed import run_follower
+
+        # follower: same warmup (lockstep with the primary's), then replay
+        # the primary's broadcast batches instead of serving a port
+        await server.pipeline.connect()
+        try:
+            await run_follower(mh, server.pipeline)
+        finally:
+            await server.pipeline.close()
+        return
+    if mh is not None:
+        from arkflow_tpu.parallel.distributed import LockstepPipeline
+
+        # primary: every pipeline entry (warmup's compiles excepted — the
+        # followers run connect() themselves, in the same order) fans the
+        # batch out to the followers BEFORE processing, keeping the
+        # multi-host collectives lockstep across processes
+        server.pipeline = LockstepPipeline(mh, server.pipeline)
     await server.connect()  # warmup compiles BEFORE the port opens
+    server.install_signal_handlers()
     try:
         await server.serve_forever()
     finally:
@@ -676,6 +820,7 @@ class ClusterDispatcher:
                  text_field: Optional[str] = None, virtual_nodes: int = 64,
                  heartbeat_s: float = 2.0, request_timeout_s: float = 60.0,
                  connect_timeout_s: float = 5.0,
+                 heartbeat_timeout_s: Optional[float] = None,
                  max_frame: int = DEFAULT_MAX_FRAME):
         from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD
 
@@ -694,6 +839,19 @@ class ClusterDispatcher:
         self.heartbeat_s = heartbeat_s
         self.request_timeout_s = request_timeout_s
         self.connect_timeout_s = connect_timeout_s
+        #: heartbeats older than this mark the member DEAD proactively — a
+        #: SIGKILLed or network-wedged worker must fall out of the routing
+        #: table on the heartbeat clock, not at the next 60s transport
+        #: timeout. Also caps the probe round-trip itself, so one wedged
+        #: member can't stall the whole heartbeat sweep.
+        self.heartbeat_timeout_s = (
+            heartbeat_timeout_s if heartbeat_timeout_s is not None
+            else max(5.0 * heartbeat_s, 10.0))
+        if self.heartbeat_timeout_s <= heartbeat_s:
+            raise ConfigError(
+                f"remote_tpu.heartbeat_timeout ({self.heartbeat_timeout_s}s) "
+                f"must exceed the heartbeat period ({heartbeat_s}s)")
+        self.virtual_nodes = virtual_nodes
         self.max_frame = int(max_frame)
         self.workers: dict[str, RemoteWorker] = {
             url: RemoteWorker(url, name) for url in urls}
@@ -747,15 +905,41 @@ class ClusterDispatcher:
     async def _heartbeat_loop(self) -> None:
         while True:
             await asyncio.sleep(self.heartbeat_s)
+            self._expire_stale()
             await asyncio.gather(
                 *(self._probe(w) for w in self.workers.values()),
                 return_exceptions=True)
 
+    def _is_stale(self, w: RemoteWorker, now: float) -> bool:
+        return (w.alive and w.last_seen > 0.0
+                and now - w.last_seen > self.heartbeat_timeout_s)
+
+    def _expire_stale(self, now: Optional[float] = None) -> None:
+        """Proactively kill members whose heartbeats went quiet (the
+        SIGKILL / network-wedge case: the socket may still accept, so no
+        transport failure ever fires). Runs on the heartbeat clock AND at
+        plan time, so routing never waits on the sweep."""
+        if now is None:
+            now = asyncio.get_running_loop().time()
+        for w in self.workers.values():
+            if self._is_stale(w, now):
+                self.m_deaths.inc()
+                logger.warning(
+                    "remote_tpu[%s]: worker %s heartbeats stale for %.1fs "
+                    "(timeout %.1fs); marking dead", self.name, w.url,
+                    now - w.last_seen, self.heartbeat_timeout_s)
+                w.note_down(ConnectError(
+                    f"heartbeats stale for {now - w.last_seen:.1f}s"))
+
     async def _probe(self, w: RemoteWorker) -> None:
-        """One register/heartbeat round-trip; flips liveness both ways."""
+        """One register/heartbeat round-trip; flips liveness both ways.
+        Bounded by the heartbeat timeout, NOT the request timeout — a
+        wedged member answering nothing must not pin the sweep for the
+        full infer budget."""
         action = "heartbeat" if w.worker_id is not None else "register"
         try:
-            rep = await self._unary(w, {"action": action})
+            rep = await self._unary(w, {"action": action},
+                                    timeout=self.heartbeat_timeout_s)
         except Exception as e:
             if w.alive:
                 self.m_deaths.inc()
@@ -837,9 +1021,19 @@ class ClusterDispatcher:
         window — then the dispatch spills to the successor with the least
         load (fewest outstanding dispatches, then smallest advertised drain
         estimate). Bounded-load consistent hashing: affinity is traded only
-        under saturation, counted in ``arkflow_cluster_spill_total``."""
+        under saturation, counted in ``arkflow_cluster_spill_total``.
+
+        Stale members are expired here too (not only on the heartbeat
+        clock): a dead worker's hash range falls to its ring successor the
+        moment any batch routes, so affinity keys re-home deterministically
+        with zero dispatches burned on the corpse."""
+        try:
+            self._expire_stale()
+        except RuntimeError:
+            pass  # no running loop (sync planning in tests): skip expiry
         live = [self.workers[u] for u in self.ring.candidates(key)
-                if self.workers[u].alive and not self.workers[u].draining]
+                if u in self.workers
+                and self.workers[u].alive and not self.workers[u].draining]
         if len(live) < 2 or live[0].has_headroom():
             return live
         with_room = [w for w in live[1:] if w.has_headroom()]
@@ -1004,7 +1198,33 @@ class ClusterDispatcher:
             except Exception:
                 pass
 
-    # -- fleet lifecycle (drain / swap legs) -------------------------------
+    # -- fleet lifecycle (drain / swap legs / elastic membership) ----------
+
+    def add_worker(self, url: str) -> RemoteWorker:
+        """Adopt a worker into the routing table and hash ring at runtime
+        (fleet scale-out). Idempotent on url. Virtual-node hashing means
+        only the keys that land on the newcomer's points remap — existing
+        workers' response/prefix caches stay warm."""
+        existing = self.workers.get(url)
+        if existing is not None:
+            return existing
+        parse_remote_url(url)  # raises ConfigError on malformed urls
+        w = RemoteWorker(url, self.name)
+        self.workers[url] = w
+        self.ring.add(url)
+        logger.info("remote_tpu[%s]: worker %s added to the ring (fleet "
+                    "size %d)", self.name, url, len(self.workers))
+        return w
+
+    def remove_worker(self, url: str) -> None:
+        """Retire a worker from the table and ring (fleet scale-in or a
+        departed spawn). Its key ranges fall to the ring successors; no-op
+        for unknown urls."""
+        if self.workers.pop(url, None) is None:
+            return
+        self.ring.remove(url)
+        logger.info("remote_tpu[%s]: worker %s removed from the ring "
+                    "(fleet size %d)", self.name, url, len(self.workers))
 
     async def set_drain(self, w: RemoteWorker, drain: bool) -> dict:
         rep = await self._unary(w, {"action": "drain", "drain": drain})
@@ -1157,12 +1377,14 @@ class RemoteTpuProcessor:
     semantics as ``tpu_inference``'s)."""
 
     def __init__(self, dispatcher: ClusterDispatcher, *, response_cache=None,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0, fleet=None):
         self.dispatcher = dispatcher
         self.cache = response_cache
         self.swapper = ClusterSwapper(dispatcher, drain_timeout_s)
         if self.cache is not None:
             self.swapper.add_commit_hook(self.cache.bump_epoch)
+        #: elastic-fleet controller (runtime/fleet.py); None = static fleet
+        self.fleet = fleet
         #: engine /health + /readiness integration (runner-shaped view)
         self.runner = _ClusterRunnerView(dispatcher)
 
@@ -1173,13 +1395,21 @@ class RemoteTpuProcessor:
             self.cache.set_tenant_policy(controller.cfg.tenants)
 
     def cluster_report(self) -> dict:
-        """Fleet snapshot for the engine's /health payload."""
-        return self.dispatcher.report()
+        """Fleet snapshot for the engine's /health payload (including the
+        controller's per-event decision log when elastic)."""
+        rep = self.dispatcher.report()
+        if self.fleet is not None:
+            rep["fleet"] = self.fleet.report()
+        return rep
 
     async def connect(self) -> None:
         await self.dispatcher.start()
+        if self.fleet is not None:
+            await self.fleet.start()
 
     async def close(self) -> None:
+        if self.fleet is not None:
+            await self.fleet.close()
         await self.dispatcher.close()
 
     async def process(self, batch: MessageBatch) -> list[MessageBatch]:
@@ -1247,11 +1477,28 @@ def parse_remote_tpu_config(config: Mapping) -> dict:
     out["request_timeout_s"] = _dur("request_timeout", "60s")
     out["connect_timeout_s"] = _dur("connect_timeout", "5s")
     out["drain_timeout_s"] = _dur("drain_timeout", "30s")
+    # staleness bound: default 5 heartbeat periods (floor 10s); must exceed
+    # one period or every member would flap dead between beats
+    if config.get("heartbeat_timeout") is not None:
+        ht = _dur("heartbeat_timeout", "10s")
+    else:
+        ht = max(5.0 * out["heartbeat_s"], 10.0)
+    if ht <= out["heartbeat_s"]:
+        raise ConfigError(
+            f"remote_tpu.heartbeat_timeout ({ht}s) must exceed the "
+            f"heartbeat period ({out['heartbeat_s']}s)")
+    out["heartbeat_timeout_s"] = ht
     tf = config.get("text_field")
     if tf is not None and not isinstance(tf, str):
         raise ConfigError(f"remote_tpu.text_field must be a string, got {tf!r}")
     out["text_field"] = tf
     parse_response_cache_config(config.get("response_cache"))
+    # elastic-fleet block (runtime/fleet.py owns the parse rules); pure —
+    # config.py reaches this through fault.inner chains at --validate time
+    from arkflow_tpu.runtime.fleet import parse_fleet_config
+
+    out["fleet"] = parse_fleet_config(
+        config.get("fleet"), static_workers=len(out["workers"]))
     return out
 
 
@@ -1269,7 +1516,22 @@ def build_remote_tpu(config: dict, resource: Resource) -> RemoteTpuProcessor:
         heartbeat_s=parsed["heartbeat_s"],
         request_timeout_s=parsed["request_timeout_s"],
         connect_timeout_s=parsed["connect_timeout_s"],
+        heartbeat_timeout_s=parsed["heartbeat_timeout_s"],
         max_frame=parsed["max_frame"])
     cache = build_response_cache(config.get("response_cache"), name=name)
+    fleet = None
+    fleet_cfg = parsed["fleet"]
+    if fleet_cfg is not None:
+        from arkflow_tpu.runtime.fleet import (
+            FleetController,
+            SubprocessSpawner,
+        )
+
+        spawner = None
+        if fleet_cfg.template is not None:
+            spawner = SubprocessSpawner(fleet_cfg.template,
+                                        host=fleet_cfg.spawn_host)
+        fleet = FleetController(dispatcher, spawner, fleet_cfg, name=name)
     return RemoteTpuProcessor(dispatcher, response_cache=cache,
-                              drain_timeout_s=parsed["drain_timeout_s"])
+                              drain_timeout_s=parsed["drain_timeout_s"],
+                              fleet=fleet)

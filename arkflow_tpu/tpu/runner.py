@@ -129,7 +129,11 @@ def init_host_params(family, cfg, seed: int, checkpoint: Optional[str] = None):
     and transferred to the execution device(s) in one hop. Shared by
     ``ModelRunner`` and the device pool (which inits once for N members)."""
     try:
-        cpu = jax.devices("cpu")[0]
+        # local_devices, not devices: under multi-host ``jax.distributed``
+        # the global list leads with process 0's device, and pinning an
+        # eager init op to a non-addressable device is a hard error.
+        cpus = jax.local_devices(backend="cpu")
+        cpu = cpus[0] if cpus else None
     except RuntimeError:
         cpu = None
     with jax.default_device(cpu) if cpu is not None else _nullcontext():

@@ -417,6 +417,227 @@ def test_plan_weights_spill_by_window_and_drain_estimate():
     assert d.plan(key)[0] is not owner  # wedged owner must not absorb all
 
 
+# -- elastic lifecycle: staleness, self-drain, successor handoff -------------
+
+
+def test_heartbeat_staleness_marks_quiet_worker_dead():
+    """Regression (satellite): a worker that stops heartbeating but keeps
+    its socket half-open used to stay 'alive' until a 60s transport timeout
+    wedged routing on it. The staleness sweep must kill it on the heartbeat
+    clock, and plan() must re-home its range without burning a dispatch."""
+    async def go():
+        up = _Upper()
+        srv = await _start_worker([up], "w-quiet")
+        url = _url(srv)
+        d = ClusterDispatcher([url], name="t-stale", heartbeat_s=0.05,
+                              heartbeat_timeout_s=0.5)
+        try:
+            await d.start()
+            w = d.workers[url]
+            assert w.alive
+            deaths0 = int(d.m_deaths.value)
+            # the worker goes quiet: rewind its last_seen past the timeout
+            # (the real-world cause is a SIGKILL or a network wedge — the
+            # socket may still accept, so no transport error ever fires)
+            now = asyncio.get_running_loop().time()
+            w.last_seen = now - 1.0
+            d._expire_stale(now)
+            assert not w.alive
+            assert "stale" in (w.last_error or "")
+            assert int(d.m_deaths.value) == deaths0 + 1
+            # routing already excludes it — successor handoff needs no probe
+            assert d.plan(b"any key") == []
+        finally:
+            await d.close()
+            await srv.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=15))
+
+
+def test_heartbeat_timeout_validation_and_default():
+    with pytest.raises(ConfigError, match="heartbeat_timeout"):
+        ClusterDispatcher(["arkflow://h:1"], name="t-bad", heartbeat_s=5.0,
+                          heartbeat_timeout_s=5.0)
+    d = ClusterDispatcher(["arkflow://h:1"], name="t-def", heartbeat_s=3.0)
+    assert d.heartbeat_timeout_s == 15.0  # 5x the period, floored at 10s
+    ok = parse_remote_tpu_config({"workers": ["arkflow://h:1"],
+                                  "heartbeat": "1s",
+                                  "heartbeat_timeout": "4s"})
+    assert ok["heartbeat_timeout_s"] == 4.0
+    with pytest.raises(ConfigError, match="heartbeat_timeout"):
+        parse_remote_tpu_config({"workers": ["arkflow://h:1"],
+                                 "heartbeat": "5s",
+                                 "heartbeat_timeout": "2s"})
+
+
+class _Slow(Processor):
+    """Holds each batch for a beat — lets tests catch a worker mid-flight."""
+
+    def __init__(self, hold_s=0.3):
+        self.hold_s = hold_s
+        self.calls = 0
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        self.calls += 1
+        await asyncio.sleep(self.hold_s)
+        return [batch]
+
+
+def test_self_drain_finishes_inflight_then_stops():
+    """Satellite: ``begin_self_drain`` (the SIGTERM primitive) refuses new
+    work retryably, lets in-flight batches finish inside the grace budget,
+    then stops the serve loop — usable standalone by any embedder."""
+    async def go():
+        srv = await _start_worker([_Slow(0.4)], "w-drain", grace_s=10.0)
+        url = _url(srv)
+        serve = asyncio.create_task(srv.serve_forever())
+        d = ClusterDispatcher([url], name="t-selfdrain", heartbeat_s=999)
+        try:
+            await d.start()
+            w = d.workers[url]
+            inflight = asyncio.create_task(
+                d.dispatch(MessageBatch.new_binary([b"in flight"])))
+            await asyncio.sleep(0.1)  # batch is now holding inside _Slow
+            srv.begin_self_drain("test")
+            assert srv.draining
+            # new work is refused RETRYABLY (the ring/nack path takes it)
+            with pytest.raises(ConnectError, match="no live|draining"):
+                await d.dispatch(MessageBatch.new_binary([b"late"]))
+            # the in-flight batch still completes...
+            out = await inflight
+            assert out[0].to_binary() == [b"in flight"]
+            # ...and the serve loop exits on its own, well under the grace
+            await asyncio.wait_for(serve, timeout=5.0)
+        finally:
+            if not serve.done():
+                serve.cancel()
+            await d.close()
+            await srv.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=20))
+
+
+def test_self_drain_grace_budget_expires_loudly():
+    """A batch that outlives the grace budget does NOT pin the process:
+    the worker exits anyway and the batch nacks through redelivery."""
+    async def go():
+        srv = await _start_worker([_Slow(30.0)], "w-grace", grace_s=0.3)
+        serve = asyncio.create_task(srv.serve_forever())
+        d = ClusterDispatcher([_url(srv)], name="t-grace", heartbeat_s=999)
+        try:
+            await d.start()
+            hung = asyncio.create_task(
+                d.dispatch(MessageBatch.new_binary([b"stuck"])))
+            await asyncio.sleep(0.1)
+            srv.begin_self_drain("test")
+            await asyncio.wait_for(serve, timeout=5.0)  # grace_s, not 30s
+            hung.cancel()
+        finally:
+            if not serve.done():
+                serve.cancel()
+            await d.close()
+            await srv.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=20))
+
+
+def test_sigterm_handler_triggers_self_drain():
+    """The wired path: a real SIGTERM to the process flips the worker into
+    self-drain and the serve loop exits cleanly (spot preemption is
+    routine, not a mid-batch kill)."""
+    import os
+    import signal
+
+    async def go():
+        srv = await _start_worker([_Upper()], "w-sig", grace_s=5.0)
+        srv.install_signal_handlers()
+        serve = asyncio.create_task(srv.serve_forever())
+        await asyncio.sleep(0.05)
+        os.kill(os.getpid(), signal.SIGTERM)
+        await asyncio.wait_for(serve, timeout=5.0)
+        assert srv.draining
+        await srv.stop()
+
+    # asyncio.run gives the handler its own loop; closing the loop restores
+    # the process's default SIGTERM disposition, so pytest is unaffected
+    asyncio.run(asyncio.wait_for(go(), timeout=15))
+
+
+class _CachedUpper(Processor):
+    """Jax-free stand-in for a response-cached model stage: same cache
+    object and discipline as tpu_inference (fingerprint key, get_or_compute
+    in front of the expensive step), so worker heartbeats carry its stats."""
+
+    def __init__(self):
+        from arkflow_tpu.runtime.respcache import ResponseCache
+
+        self.calls = 0
+        self.cache = ResponseCache(64, name="cached-upper")
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        async def compute():
+            self.calls += 1
+            vals = [v.upper() for v in batch.to_binary()]
+            return [batch.with_column("__value__",
+                                      pa.array(vals, type=pa.binary()))]
+
+        return await self.cache.get_or_compute(batch_fingerprint(batch),
+                                               compute)
+
+
+def test_preempted_owner_hands_range_to_ring_successor_with_cache():
+    """Satellite: kill the owner of a known fingerprint mid-load; the
+    redelivered batch must land on the ring successor DETERMINISTICALLY,
+    and byte-identical duplicates then hit the successor's response cache
+    (affinity re-homed, not scattered)."""
+    async def go():
+        procs = {u: _CachedUpper() for u in "abc"}
+        srvs = {u: await _start_worker([procs[u]], f"w-{u}") for u in "abc"}
+        urls = {u: _url(srvs[u]) for u in "abc"}
+        d = ClusterDispatcher(list(urls.values()), name="t-handoff",
+                              heartbeat_s=0.05, heartbeat_timeout_s=0.5,
+                              connect_timeout_s=1.0)
+        try:
+            await d.start()
+            batch = MessageBatch.new_binary([b"the known fingerprint"])
+            key = d.routing_key(batch)
+            ring_order = d.ring.candidates(key)
+            owner_url, successor_url = ring_order[0], ring_order[1]
+            by_url = {urls[u]: u for u in "abc"}
+            owner, successor = by_url[owner_url], by_url[successor_url]
+
+            out = await d.dispatch(batch)
+            assert out[0].to_binary() == [b"THE KNOWN FINGERPRINT"]
+            assert procs[owner].calls == 1 and procs[successor].calls == 0
+
+            # the owner is preempted mid-load (socket gone, no drain)
+            await srvs[owner].stop()
+            # ... the stream's nack path redelivers the SAME batch; it must
+            # route to the ring successor, not a random survivor
+            out = await d.dispatch(batch)
+            assert out[0].to_binary() == [b"THE KNOWN FINGERPRINT"]
+            assert procs[successor].calls == 1
+            assert not d.workers[owner_url].alive
+            # plan() now leads with the successor — deterministic handoff
+            assert [w.url for w in d.plan(key)][0] == successor_url
+
+            # byte-identical duplicates hit the successor's response cache:
+            # one compute total, the rest are cross-process cache hits
+            for _ in range(3):
+                out = await d.dispatch(batch)
+                assert out[0].to_binary() == [b"THE KNOWN FINGERPRINT"]
+            assert procs[successor].calls == 1
+            assert procs[successor].cache.n_hits >= 3
+            third = by_url[ring_order[2]]
+            assert procs[third].calls == 0
+        finally:
+            await d.close()
+            for srv in srvs.values():
+                await srv.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=20))
+
+
 # -- rolling fleet swap ------------------------------------------------------
 
 
@@ -699,11 +920,53 @@ def test_init_distributed_wraps_initialize_failures(monkeypatch):
         raise RuntimeError("DNS lookup failed for host0")
 
     monkeypatch.setattr(jax.distributed, "initialize", explode)
+    # process 0 BINDS the coordinator address, so there is no reachability
+    # probe in its way — the failure comes from initialize itself
     with pytest.raises(ConfigError) as ei:
         init_distributed(coordinator="host0:1234", num_processes=2,
-                         process_id=1)
+                         process_id=0)
     msg = str(ei.value)
     assert "DNS lookup failed" in msg and "host0:1234" in msg
+
+
+def test_init_distributed_fails_fast_on_unreachable_coordinator():
+    """Satellite: a non-zero process whose coordinator address is wrong (or
+    whose process 0 never came up) gets a ConfigError naming the address
+    within the probe budget — not an opaque multi-minute hang inside
+    ``jax.distributed.initialize``."""
+    import time as time_mod
+
+    from arkflow_tpu.parallel.distributed import init_distributed
+
+    t0 = time_mod.monotonic()
+    with pytest.raises(ConfigError) as ei:
+        # port 1 is never listening; pid > 0 probes before touching jax
+        init_distributed(coordinator="127.0.0.1:1", num_processes=2,
+                         process_id=1, probe_timeout_s=1.0)
+    assert time_mod.monotonic() - t0 < 10.0
+    msg = str(ei.value)
+    assert "unreachable" in msg and "127.0.0.1:1" in msg
+
+    with pytest.raises(ConfigError, match="host:port"):
+        init_distributed(coordinator="no-port-here", num_processes=2,
+                         process_id=1)
+
+
+def test_parse_distributed_config_block(monkeypatch):
+    from arkflow_tpu.parallel.distributed import parse_distributed_config
+
+    for env in ("ARKFLOW_COORDINATOR", "ARKFLOW_NUM_PROCESSES",
+                "ARKFLOW_PROCESS_ID"):
+        monkeypatch.delenv(env, raising=False)
+    assert parse_distributed_config(None) is None
+    out = parse_distributed_config({"coordinator": "h:1", "num_processes": 2,
+                                    "process_id": 1,
+                                    "coordinator_timeout": "5s"})
+    assert out["num_processes"] == 2 and out["coordinator_timeout_s"] == 5.0
+    with pytest.raises(ConfigError, match="unknown keys"):
+        parse_distributed_config({"coordinator": "h:1", "bogus": True})
+    with pytest.raises(ConfigError, match="coordinator"):
+        parse_distributed_config({"num_processes": 2})
 
 
 # -- acceptance: the 2-process cluster soak (fast tier-1 mode) ---------------
@@ -728,3 +991,30 @@ def test_chaos_soak_cluster_fast_mode_smoke():
     assert verdict["chaos"]["killed"] and verdict["chaos"]["revived"]
     assert verdict["chaos"]["lost_rows"] == 0
     assert verdict["chaos"]["identity_ok"]
+
+
+def test_chaos_soak_preempt_fast_mode_smoke():
+    """Acceptance gate (tools/chaos_soak.py --preempt --fast): elastic
+    fleet under preemption — two SIGKILLs mid-load are detected via
+    heartbeat staleness, the controller respawns back to the floor, every
+    offered row is delivered exactly once (zero silent loss), and a
+    sustained-pressure ramp fires a warm-shape scale-out whose newcomer
+    is adopted with zero failed dispatches."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        from chaos_soak import run_preempt_soak
+    finally:
+        sys.path.pop(0)
+
+    verdict = run_preempt_soak(seconds=90.0, seed=7, fast=True)
+    assert verdict["pass"], verdict
+    storm = verdict["storm"]
+    assert len(storm["kills"]) == 2 and storm["detected"] == 2
+    assert storm["respawned"]
+    assert storm["lost_rows"] == 0 and storm["identity_ok"]
+    assert storm["gap_slo_ok"]
+    ramp = verdict["ramp"]
+    assert ramp["scale_out_fired"] and ramp["newcomer_adopted"]
+    assert ramp["warm_shapes"]
+    assert ramp["failed_dispatches"] == 0
+    assert ramp["delivered"] == ramp["offered"]

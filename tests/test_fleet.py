@@ -1,0 +1,404 @@
+"""Elastic fleet (runtime/fleet.py): `fleet:` config parsing (incl. the
+parse-time template check and the stream-config fault.inner walk), warm
+shape-grid overlay, the sustain tracker, and FleetController decisions —
+respawn-below-floor, sustained-pressure scale-out with warm replay,
+max_workers cap, and least-loaded scale-in over a real drain frame. Worker
+servers host trivial in-test processors; no jax, no subprocesses (the
+SubprocessSpawner path is covered by the --preempt chaos soak)."""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Processor, ensure_plugins_loaded
+from arkflow_tpu.config import StreamConfig
+from arkflow_tpu.errors import ConfigError, ConnectError
+from arkflow_tpu.runtime.cluster import ClusterDispatcher, ClusterWorkerServer
+from arkflow_tpu.runtime.fleet import (
+    FleetController,
+    SubprocessSpawner,
+    _Sustain,
+    free_port,
+    overlay_shapes,
+    parse_fleet_config,
+)
+
+ensure_plugins_loaded()
+
+#: minimal valid worker template — parse_fleet_config type-checks mapping
+#: templates at parse time through parse_worker_config
+TEMPLATE = {"processors": [
+    {"type": "python", "script": "def process(b): return b"}]}
+
+
+class _Echo(Processor):
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        return [batch]
+
+
+async def _start_worker(worker_id: str) -> ClusterWorkerServer:
+    srv = ClusterWorkerServer([_Echo()], host="127.0.0.1", port=0,
+                              worker_id=worker_id)
+    await srv.connect()
+    await srv.start()
+    return srv
+
+
+def _url(srv: ClusterWorkerServer) -> str:
+    return f"arkflow://127.0.0.1:{srv.port}"
+
+
+class _FakeSpawner:
+    """Spawner double that launches REAL in-process worker servers — the
+    controller's adopt-probe and drain/retire paths run against live
+    sockets, only the subprocess machinery is faked."""
+
+    def __init__(self):
+        self.spawned: list[list] = []  # shapes passed to each spawn
+        self.retired: list[str] = []
+        self.servers: dict[str, ClusterWorkerServer] = {}
+        self._owned: set[str] = set()
+
+    async def spawn(self, shapes=()):
+        self.spawned.append(list(shapes))
+        srv = await _start_worker(f"spawned-{len(self.spawned)}")
+        url = _url(srv)
+        self.servers[url] = srv
+        self._owned.add(url)
+        return url
+
+    def owns(self, url: str) -> bool:
+        return url in self._owned
+
+    def reap(self, url: str) -> None:
+        self._owned.discard(url)
+
+    async def retire(self, url: str, *, grace_s: float = 30.0) -> None:
+        self.retired.append(url)
+        srv = self.servers.pop(url, None)
+        self._owned.discard(url)
+        if srv is not None:
+            await srv.stop()
+
+    async def close(self) -> None:
+        for url in list(self.servers):
+            await self.retire(url)
+
+
+# -- config parsing ----------------------------------------------------------
+
+
+def test_parse_fleet_config_defaults_and_off_switches():
+    assert parse_fleet_config(None) is None
+    assert parse_fleet_config(False) is None
+    assert parse_fleet_config({"enabled": False}) is None
+    cfg = parse_fleet_config(True, static_workers=2)
+    assert cfg.min_workers == 2  # floor defaults to the static topology
+    assert cfg.max_workers == 4
+    assert cfg.respawn is True
+    cfg = parse_fleet_config(
+        {"min_workers": 1, "max_workers": 3, "interval": "500ms",
+         "scale_out_sustain": "4s", "cooldown": "2s", "idle_frac": 0.5,
+         "template": TEMPLATE})
+    assert cfg.interval_s == 0.5
+    assert cfg.scale_out_sustain_s == 4.0
+    assert cfg.idle_frac == 0.5
+    assert cfg.report()["max_workers"] == 3
+
+
+def test_parse_fleet_config_rejects_bad_blocks():
+    with pytest.raises(ConfigError, match="unknown keys"):
+        parse_fleet_config({"bogus_knob": 1})
+    with pytest.raises(ConfigError, match="max_workers"):
+        parse_fleet_config({"min_workers": 3, "max_workers": 2})
+    with pytest.raises(ConfigError, match="idle_frac"):
+        parse_fleet_config({"idle_frac": 0.0})
+    with pytest.raises(ConfigError, match="idle_frac"):
+        parse_fleet_config({"idle_frac": 1.5})
+    with pytest.raises(ConfigError, match="interval"):
+        parse_fleet_config({"interval": "0s"})
+    with pytest.raises(ConfigError, match="interval"):
+        parse_fleet_config({"interval": "soonish"})
+    with pytest.raises(ConfigError, match="template"):
+        parse_fleet_config({"template": 42})
+    with pytest.raises(ConfigError, match="spawn_host"):
+        parse_fleet_config({"spawn_host": ""})
+    with pytest.raises(ConfigError, match="mapping or boolean"):
+        parse_fleet_config(["not", "a", "mapping"])
+
+
+def test_fleet_template_validated_at_parse_time():
+    """A malformed embedded template must fail at --validate, not at the
+    first scale-out mid-incident."""
+    with pytest.raises(ConfigError, match="processors"):
+        parse_fleet_config({"template": {"processors": "not a list"}})
+
+
+def test_fleet_validates_at_stream_parse_time_through_fault_wrappers():
+    base = {"input": {"type": "memory", "messages": []},
+            "output": {"type": "drop"}}
+    with pytest.raises(ConfigError, match="unknown keys"):
+        StreamConfig.from_mapping({
+            **base,
+            "pipeline": {"processors": [{
+                "type": "fault",
+                "inner": {"type": "remote_tpu",
+                          "workers": ["arkflow://h:1"],
+                          "fleet": {"bogus_knob": 1}}}]},
+        })
+    # a good fleet block parses through the same chain
+    StreamConfig.from_mapping({
+        **base,
+        "pipeline": {"processors": [{
+            "type": "remote_tpu", "workers": ["arkflow://h:1"],
+            "fleet": {"min_workers": 1, "max_workers": 2,
+                      "template": TEMPLATE}}]},
+    })
+
+
+def test_subprocess_spawner_requires_template():
+    with pytest.raises(ConfigError, match="template"):
+        SubprocessSpawner(None)
+    assert isinstance(free_port(), int)
+
+
+# -- warm replay overlay -----------------------------------------------------
+
+
+def test_overlay_shapes_through_fault_chains():
+    tmpl = {"processors": [
+        {"type": "fault", "error_rate": 0.1,
+         "inner": {"type": "tpu_inference", "model": "bert_classifier",
+                   "batch_buckets": [1]}},
+        {"type": "python", "script": "def process(b): return b"}]}
+    shapes = [{"batch_buckets": [2, 8], "seq_buckets": [64, 128],
+               "example_scale": None}, None]
+    out = overlay_shapes(tmpl, shapes)
+    inner = out["processors"][0]["inner"]
+    assert inner["batch_buckets"] == [2, 8]
+    assert inner["seq_buckets"] == [64, 128]
+    assert "example_scale" not in inner  # None entries leave keys alone
+    assert out["processors"][0]["error_rate"] == 0.1  # wrapper untouched
+    assert out["processors"][1] == tmpl["processors"][1]
+    # the template itself is never mutated (it respawns more workers later)
+    assert tmpl["processors"][0]["inner"]["batch_buckets"] == [1]
+
+
+def test_overlay_shapes_tolerates_odd_templates():
+    # pipeline-nested processors get the overlay too
+    out = overlay_shapes({"pipeline": {"processors": [{"type": "x"}]}},
+                         [{"batch_buckets": [4]}])
+    assert out["pipeline"]["processors"][0]["batch_buckets"] == [4]
+    # more shapes than processors: extras ignored, no raise
+    out = overlay_shapes({"processors": [{"type": "x"}]},
+                         [None, {"batch_buckets": [4]}])
+    assert "batch_buckets" not in out["processors"][0]
+    # no processors at all: identity
+    assert overlay_shapes({"foo": 1}, [{"batch_buckets": [4]}]) == {"foo": 1}
+
+
+def test_sustain_tracker_is_edge_triggered():
+    s = _Sustain()
+    assert s.observe(False, 0.0) == 0.0
+    assert s.observe(True, 1.0) == 0.0  # edge: clock starts now
+    assert s.observe(True, 4.0) == 3.0
+    assert s.observe(False, 5.0) == 0.0  # any dip resets
+    assert s.observe(True, 6.0) == 0.0
+    assert s.since == 6.0
+
+
+# -- controller decisions ----------------------------------------------------
+
+
+def _make_cfg(**overrides):
+    block = {"min_workers": 1, "max_workers": 3, "interval": "100ms",
+             "scale_out_sustain": "5s", "scale_in_sustain": "5s",
+             "cooldown": "1ms", "template": TEMPLATE}
+    block.update(overrides)
+    return parse_fleet_config(block, static_workers=1, who="test")
+
+
+def test_respawn_below_floor_outranks_cooldown():
+    """A preempted worker is replaced IMMEDIATELY: holding min_workers is
+    the spot-preemption policy, and it must not wait out a cooldown started
+    by an unrelated earlier action."""
+    async def go():
+        srv = await _start_worker("static-0")
+        url = _url(srv)
+        d = ClusterDispatcher([url], name="t-fleet-respawn", heartbeat_s=999)
+        sp = _FakeSpawner()
+        clk = {"t": 0.0}
+        fc = FleetController(d, sp, _make_cfg(cooldown="1h"),
+                             name="t-fleet-respawn", clock=lambda: clk["t"])
+        try:
+            await d.start()
+            fc._last_action_t = 0.0  # a fresh action: cooldown is armed
+            clk["t"] = 5.0  # deep inside the 1h cooldown
+            # the static worker is preempted (SIGKILL — staleness flips it)
+            await srv.stop()
+            d.workers[url].note_down(ConnectError("heartbeats stale for 2s"))
+            ev = await fc.tick()
+            assert ev is not None and ev["action"] == "respawn"
+            assert "below min_workers" in ev["reason"]
+            new_url = ev["worker"]
+            assert new_url != url and d.workers[new_url].alive
+            rep = fc.report()
+            assert rep["departures"] == 1
+            assert rep["scale_outs"] == 0  # a respawn is not a scale-out
+            assert rep["size"] == 1
+            assert [e["action"] for e in rep["events"]] == [
+                "departure", "respawn"]
+        finally:
+            await fc.close()
+            await d.close()
+            await srv.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=20))
+
+
+def test_sustained_window_exhaustion_scales_out_with_warm_shapes():
+    async def go():
+        srv = await _start_worker("static-0")
+        url = _url(srv)
+        d = ClusterDispatcher([url], name="t-fleet-out", heartbeat_s=999)
+        sp = _FakeSpawner()
+        clk = {"t": 0.0}
+        fc = FleetController(d, sp, _make_cfg(), name="t-fleet-out",
+                             clock=lambda: clk["t"])
+        try:
+            await d.start()
+            w = d.workers[url]
+            # the incumbent advertises the grid traffic settled on
+            w.last_report = dict(w.last_report)
+            w.last_report["shapes"] = [{"batch_buckets": [2, 8],
+                                        "seq_buckets": [64]}]
+            # window exhaustion: no headroom against the advertised window
+            w.inflight = w.window
+            assert await fc.tick() is None  # blip: pressure clock starts
+            clk["t"] = 6.0  # > scale_out_sustain (5s)
+            w.inflight = w.window  # still exhausted
+            ev = await fc.tick()
+            assert ev is not None and ev["action"] == "scale_out"
+            assert "window exhaustion" in ev["reason"]
+            assert ev["warm_shapes"] is True
+            # the newcomer was spawned FROM the incumbent grid (warm replay)
+            assert sp.spawned == [[{"batch_buckets": [2, 8],
+                                    "seq_buckets": [64]}]]
+            assert d.workers[ev["worker"]].alive
+            rep = fc.report()
+            assert rep["scale_outs"] == 1 and rep["size"] == 2
+        finally:
+            await fc.close()
+            await d.close()
+            await srv.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=20))
+
+
+def test_scale_out_capped_at_max_workers_and_rearms():
+    async def go():
+        srv = await _start_worker("static-0")
+        url = _url(srv)
+        d = ClusterDispatcher([url], name="t-fleet-cap", heartbeat_s=999)
+        sp = _FakeSpawner()
+        clk = {"t": 0.0}
+        fc = FleetController(d, sp, _make_cfg(max_workers=1),
+                             name="t-fleet-cap", clock=lambda: clk["t"])
+        try:
+            await d.start()
+            w = d.workers[url]
+            w.inflight = w.window
+            assert await fc.tick() is None
+            clk["t"] = 6.0
+            w.inflight = w.window
+            assert await fc.tick() is None  # capped: decision logged, no-op
+            assert sp.spawned == []
+            rep = fc.report()
+            assert rep["events"][-1]["action"] == "scale_out_capped"
+            # the pressure clock re-armed — the cap is logged once per
+            # sustain period, not every tick
+            assert fc._pressure.since == 6.0
+        finally:
+            await fc.close()
+            await d.close()
+            await srv.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=20))
+
+
+def test_sustained_idleness_scales_in_least_loaded_spawned_worker():
+    """Scale-in picks the controller's own spawn over the operator's static
+    topology, drains it through the REAL drain frame, then retires it."""
+    async def go():
+        srv = await _start_worker("static-0")
+        url = _url(srv)
+        d = ClusterDispatcher([url], name="t-fleet-in", heartbeat_s=999)
+        sp = _FakeSpawner()
+        clk = {"t": 0.0}
+        fc = FleetController(d, sp, _make_cfg(), name="t-fleet-in",
+                             clock=lambda: clk["t"])
+        try:
+            await d.start()
+            spawned_url = await sp.spawn(())
+            await d._probe(d.workers[url])
+            await d._probe(d.add_worker(spawned_url))
+            assert d.workers[spawned_url].alive
+            # fleet is idle (zero in-flight) — the sustain clock starts
+            assert await fc.tick() is None
+            clk["t"] = 6.0  # > scale_in_sustain (5s)
+            ev = await fc.tick()
+            assert ev is not None and ev["action"] == "scale_in"
+            assert ev["worker"] == spawned_url  # own spawn, never static
+            assert sp.retired == [spawned_url]
+            assert spawned_url not in d.workers  # out of ring + table
+            assert d.workers[url].alive
+            rep = fc.report()
+            assert rep["scale_ins"] == 1 and rep["size"] == 1
+        finally:
+            await fc.close()
+            await d.close()
+            await srv.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=20))
+
+
+def test_departed_spawn_is_reaped_from_the_routing_table():
+    """A preempted controller-spawned worker never comes back on its port —
+    its corpse must leave the ring so the replacement (fresh port) doesn't
+    share key ranges with a permanently dead address."""
+    async def go():
+        srv = await _start_worker("static-0")
+        url = _url(srv)
+        d = ClusterDispatcher([url], name="t-fleet-reap", heartbeat_s=999)
+        sp = _FakeSpawner()
+        clk = {"t": 0.0}
+        fc = FleetController(d, sp, _make_cfg(min_workers=1),
+                             name="t-fleet-reap", clock=lambda: clk["t"])
+        try:
+            await d.start()
+            spawned_url = await sp.spawn(())
+            await d._probe(d.add_worker(spawned_url))
+            # the spawn is preempted: process gone, heartbeats stale
+            await sp.servers[spawned_url].stop()
+            sp.servers.pop(spawned_url)
+            d.workers[spawned_url].note_down(
+                ConnectError("heartbeats stale for 2s"))
+            ev = await fc.tick()
+            assert ev is None  # floor still held by the static worker
+            assert spawned_url not in d.workers  # corpse reaped
+            assert not sp.owns(spawned_url)
+            rep = fc.report()
+            assert rep["departures"] == 1 and rep["size"] == 1
+        finally:
+            await fc.close()
+            await d.close()
+            await srv.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=20))

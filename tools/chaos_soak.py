@@ -65,10 +65,27 @@ a worker mid-load with zero silent loss::
     python tools/chaos_soak.py --cluster --fast    # tier-1 smoke
     python tools/chaos_soak.py --cluster --seed 3
 
+``--preempt`` soaks the elastic fleet (runtime/fleet.py): three device-tier
+worker processes behind a ``remote_tpu`` stream with the autoscaling
+controller on — a preemption storm SIGKILLs workers one by one mid-load
+(the controller detects each departure off missed heartbeats and respawns
+to hold the floor), then a load ramp against a deliberately undersized
+fleet must trigger a scale-out, with the newcomer warmed on the incumbent
+shape grid::
+
+    python tools/chaos_soak.py --preempt --fast    # tier-1 smoke
+    python tools/chaos_soak.py --preempt --seed 3
+
+Preempt PASS means: every kill was detected and counted, the fleet
+respawned back to its floor under load, delivered p99 inter-arrival gap
+stayed within the SLO (serving never wedged through a preemption), offered
+== delivered + shed over distinct rows (zero silent loss), and the ramp
+fired ``scale_out`` with zero dispatch failures before any shed.
+
 Runs on the virtual-CPU JAX platform by default (no TPU needed; ``--burst``
-never imports jax at all, and ``--cluster``'s parent process doesn't either
-— only its worker subprocesses); set ARKFLOW_SOAK_KEEP_ENV=1 to target
-whatever backend the environment provides.
+never imports jax at all, and ``--cluster``/``--preempt`` parent processes
+don't either — only their worker subprocesses); set ARKFLOW_SOAK_KEEP_ENV=1
+to target whatever backend the environment provides.
 """
 
 from __future__ import annotations
@@ -1548,6 +1565,334 @@ def run_cluster_soak(seconds: float = 60.0, seed: int = 7,
     return _attach_tracing(verdict, trace_seq0, trace_forced0)
 
 
+# -- elastic-fleet preemption soak (runtime/fleet.py) -------------------------
+
+
+def run_preempt_soak(seconds: float = 120.0, seed: int = 7,
+                     fast: bool = False) -> dict:
+    """Elastic-fleet soak (runtime/fleet.py): 3 worker processes behind a
+    ``remote_tpu`` stream with the autoscaling controller enabled, proving
+
+    - **preemption storm**: workers SIGKILLed one by one mid-load are
+      detected off missed heartbeats (not a transport error — the staleness
+      sweep), counted as departures, and respawned from the template to hold
+      ``min_workers``, while delivered rows keep flowing (p99 inter-delivery
+      gap within the SLO) and offered == delivered + shed over distinct rows
+      (zero silent loss through the ring-successor handoff + redelivery);
+    - **load ramp scale-out**: sustained window exhaustion against a
+      deliberately undersized fleet fires ``scale_out`` — the newcomer is
+      spawned warm on the incumbent shape grid and adopted into the ring —
+      with ZERO failed dispatches (scale-out beats shed).
+
+    The parent process never imports jax — only worker subprocesses do.
+    """
+    trace_seq0, trace_forced0 = _tracing_watermark()
+    import asyncio
+    import os
+    import subprocess
+    import tempfile
+
+    import yaml
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import build_stream
+    from arkflow_tpu.runtime.cluster import ClusterDispatcher
+    from arkflow_tpu.runtime.fleet import (FleetController, SubprocessSpawner,
+                                           free_port, parse_fleet_config)
+    from arkflow_tpu.utils.cleanenv import pin_cpu_env, strip_axon_pythonpath
+
+    ensure_plugins_loaded()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    step_ms = 100
+    n_static = 3
+    rows = 800 if fast else 1600        # storm phase offered load
+    first_kill_s = 3.0 if fast else 10.0
+    kill_gap_s = 4.0 if fast else 20.0  # min spacing between kills
+    slo_gap_s = 15.0                    # p99 inter-delivery gap SLO
+    startup_budget = 240.0
+    storm_budget = max(seconds, 90.0 if fast else 180.0)
+    ramp_budget = 90.0
+
+    template = _cluster_worker_config(seed, step_ms)
+    tmp = tempfile.mkdtemp(prefix="arkflow-preempt-soak-")
+    cfg_path = os.path.join(tmp, "worker.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(template, f)
+
+    # one child env for EVERY worker this soak starts — the static fleet and
+    # the controller's template spawns alike pin the virtual-CPU platform
+    # and see the repo on PYTHONPATH (the parent never imports jax)
+    child_env = dict(os.environ)
+    strip_axon_pythonpath(child_env)
+    pin_cpu_env(child_env, n_devices=1)
+    child_env["PYTHONPATH"] = repo_root + (
+        os.pathsep + child_env["PYTHONPATH"]
+        if child_env.get("PYTHONPATH") else "")
+
+    ports = [free_port() for _ in range(n_static)]
+    urls = [f"arkflow://127.0.0.1:{p}" for p in ports]
+
+    def spawn(i: int) -> subprocess.Popen:
+        log = open(os.path.join(tmp, f"static-w{i}.log"), "ab")
+        return subprocess.Popen(
+            [sys.executable, "-m", "arkflow_tpu", "--cluster-worker",
+             "--config", cfg_path, "--host", "127.0.0.1",
+             "--port", str(ports[i]), "--worker-id", f"preempt-w{i}"],
+            cwd=repo_root, env=child_env, stdout=log,
+            stderr=subprocess.STDOUT)
+
+    async def wait_ready(wait_urls: list[str], budget_s: float) -> None:
+        probe = ClusterDispatcher(wait_urls, name="preempt-soak-probe",
+                                  heartbeat_s=999.0, connect_timeout_s=1.0)
+        deadline = time.monotonic() + budget_s
+        while True:
+            await asyncio.gather(
+                *(probe._probe(w) for w in probe.workers.values()),
+                return_exceptions=True)
+            if all(w.alive for w in probe.workers.values()):
+                return
+            if time.monotonic() >= deadline:
+                down = [w.url for w in probe.workers.values() if not w.alive]
+                raise RuntimeError(
+                    f"workers not ready within {budget_s:.0f}s: {down} "
+                    f"(see {tmp}/*.log)")
+            await asyncio.sleep(0.5)
+
+    class _Collect(DropOutput):
+        """Collects rows WITH arrival timestamps (for the gap SLO)."""
+
+        def __init__(self, sink: list, times: list):
+            self._sink = sink
+            self._times = times
+
+        async def write(self, batch: MessageBatch) -> None:
+            t = time.monotonic()
+            rws = batch.to_binary()
+            self._sink.extend(rws)
+            self._times.extend([t] * len(rws))
+
+    def p99_gap(times: list) -> float:
+        gaps = sorted(b - a for a, b in zip(times, times[1:]))
+        if not gaps:
+            return 0.0
+        return gaps[int(0.99 * (len(gaps) - 1))]
+
+    # -- phase 1: preemption storm under load ------------------------------
+    def storm_config(payloads: list[str]) -> dict:
+        cfg = _cluster_ingest_config("preempt-soak-storm", urls, payloads,
+                                     redeliver_seed=seed)
+        rt = cfg["pipeline"]["processors"][0]
+        # staleness on the heartbeat clock: a SIGKILLed worker must fall out
+        # of the ring in ~1.25s, not at the 30s request timeout
+        rt["heartbeat_timeout"] = "1250ms"
+        rt["fleet"] = {
+            "min_workers": n_static,
+            "max_workers": n_static + 1,
+            "interval": "400ms",
+            "scale_out_sustain": "60s",   # storm phase tests RESPAWN only
+            "cooldown": "1s",
+            "template": cfg_path,
+        }
+        return cfg
+
+    storm_events: dict = {"kills": [], "detected": 0, "respawned": False}
+    procs: list = [None] * n_static
+
+    async def storm_driver(stream, delivered) -> None:
+        fleet = stream.pipeline.processors[0].fleet
+        # the controller's spawns must pin the same child env the static
+        # fleet got, and leave logs where the verdict points
+        fleet.spawner.env = child_env
+        fleet.spawner.log_dir = tmp
+        t0 = time.monotonic()
+        for k in range(2):
+            target = t0 + first_kill_s + k * kill_gap_s
+            while time.monotonic() < target and len(delivered) < rows:
+                await asyncio.sleep(0.05)
+            victim = procs[1 + k]
+            victim.kill()
+            victim.wait()
+            storm_events["kills"].append(round(time.monotonic() - t0, 2))
+            deadline = time.monotonic() + 25.0
+            while time.monotonic() < deadline:
+                if fleet.report()["departures"] > k:
+                    storm_events["detected"] += 1
+                    break
+                await asyncio.sleep(0.1)
+            if k == 0:
+                # hold the storm until the controller respawned the floor —
+                # rows keep serving on the survivors meanwhile
+                deadline = time.monotonic() + 45.0
+                while time.monotonic() < deadline:
+                    if fleet.report()["size"] >= n_static:
+                        storm_events["respawned"] = True
+                        break
+                    await asyncio.sleep(0.2)
+        storm_events["fleet_report"] = fleet.report()
+
+    def run_storm() -> dict:
+        stream = build_stream(StreamConfig.from_mapping(
+            storm_config([f"storm row {i:05d}" for i in range(rows)])))
+        delivered: list = []
+        times: list = []
+        shed: list = []
+        stream.output = _Collect(delivered, times)
+        stream.error_output = _Collect(shed, [])
+        out: dict = {"delivered": delivered, "times": times, "shed": shed}
+
+        async def bounded() -> None:
+            cancel = asyncio.Event()
+            task = asyncio.create_task(stream.run(cancel))
+            driver = asyncio.create_task(storm_driver(stream, delivered))
+            done, _ = await asyncio.wait({task}, timeout=storm_budget)
+            out["wedged"] = not done
+            if done:
+                task.result()
+            else:
+                cancel.set()
+                try:
+                    await asyncio.wait_for(task, timeout=15.0)
+                except (asyncio.TimeoutError, Exception):
+                    task.cancel()
+            try:
+                await asyncio.wait_for(driver, timeout=5.0)
+            except (asyncio.TimeoutError, Exception):
+                driver.cancel()
+
+        asyncio.run(bounded())
+        return out
+
+    # -- phase 2: load ramp fires a scale-out ------------------------------
+    async def run_ramp() -> dict:
+        fc_cfg = parse_fleet_config({
+            "min_workers": 1, "max_workers": 2,
+            "interval": "300ms", "scale_out_sustain": "1500ms",
+            "cooldown": "1s", "template": cfg_path,
+        }, static_workers=1, who="preempt-soak")
+        d = ClusterDispatcher(urls[:1], name="preempt-soak-ramp",
+                              heartbeat_s=999.0, connect_timeout_s=2.0)
+        spawner = SubprocessSpawner(cfg_path, host="127.0.0.1",
+                                    env=child_env, log_dir=tmp)
+        fc = FleetController(d, spawner, fc_cfg, name="preempt-soak-ramp")
+        ok_rows = 0
+        failed = 0
+        pending: set = set()
+        i = 0
+
+        async def offer(n: int) -> None:
+            nonlocal ok_rows, failed
+            try:
+                outs = await d.dispatch(
+                    MessageBatch.new_binary([f"ramp row {n:05d}".encode()]))
+                ok_rows += sum(len(o.to_binary()) for o in outs)
+            except Exception:
+                failed += 1
+
+        scale_event = None
+        try:
+            for w in d.workers.values():
+                await d._probe(w)
+            deadline = time.monotonic() + ramp_budget
+            while time.monotonic() < deadline:
+                # sustained offered load: keep more dispatches outstanding
+                # than the single worker's advertised window can ever cover
+                while len(pending) < 8:
+                    t = asyncio.create_task(offer(i))
+                    i += 1
+                    pending.add(t)
+                    t.add_done_callback(pending.discard)
+                for w in list(d.workers.values()):
+                    try:
+                        await d._probe(w)
+                    except Exception:
+                        pass
+                ev = await fc.tick()
+                if ev and ev.get("action") == "scale_out":
+                    scale_event = ev
+                    break
+                await asyncio.sleep(0.25)
+            if pending:
+                await asyncio.wait(pending, timeout=60.0)
+            report = fc.report()
+            newcomer = [u for u in d.workers if u not in urls]
+            newcomer_alive = bool(newcomer
+                                  and d.workers[newcomer[0]].alive)
+        finally:
+            await fc.close()
+            await spawner.close()
+        return {
+            "offered": i, "delivered": ok_rows, "failed_dispatches": failed,
+            "scale_out_fired": scale_event is not None,
+            "warm_shapes": bool(scale_event and scale_event.get("warm_shapes")),
+            "newcomer_adopted": newcomer_alive,
+            "scale_outs": report["scale_outs"],
+            "events": report["events"],
+        }
+
+    verdict: dict = {"mode": "preempt", "seed": seed, "step_ms": step_ms,
+                     "workers": urls, "logs": tmp}
+    t_start = time.monotonic()
+    try:
+        for n in range(n_static):
+            procs[n] = spawn(n)
+        asyncio.run(wait_ready(urls, startup_budget))
+        verdict["startup_s"] = round(time.monotonic() - t_start, 3)
+
+        storm = run_storm()
+        expected = set(f"storm row {i:05d}".encode() for i in range(rows))
+        seen = set(storm["delivered"]) | set(storm["shed"])
+        lost = sorted(expected - seen)
+        gap99 = p99_gap(storm["times"])
+        fleet_rep = storm_events.pop("fleet_report", {})
+        storm_out = {
+            **storm_events,
+            "wedged": storm["wedged"],
+            "offered_rows": rows,
+            "delivered_rows": len(storm["delivered"]),
+            "shed_rows": len(storm["shed"]),
+            "duplicate_rows": len(storm["delivered"])
+            - len(set(storm["delivered"])),
+            "lost_rows": len(lost),
+            "departures": fleet_rep.get("departures", 0),
+            "fleet_events": fleet_rep.get("events", []),
+            "p99_gap_s": round(gap99, 3),
+            "identity_ok": len(lost) == 0,
+            "gap_slo_ok": gap99 <= slo_gap_s,
+        }
+        if lost:
+            storm_out["lost_sample"] = [x.decode() for x in lost[:5]]
+        storm_out["pass"] = bool(not storm["wedged"]
+                                 and storm_out["identity_ok"]
+                                 and storm_out["gap_slo_ok"]
+                                 and len(storm_events["kills"]) == 2
+                                 and storm_events["detected"] == 2
+                                 and storm_events["respawned"])
+        verdict["storm"] = storm_out
+
+        ramp = asyncio.run(run_ramp())
+        ramp["pass"] = bool(ramp["scale_out_fired"]
+                            and ramp["newcomer_adopted"]
+                            and ramp["warm_shapes"]
+                            and ramp["failed_dispatches"] == 0
+                            and ramp["delivered"] == ramp["offered"])
+        verdict["ramp"] = ramp
+
+        verdict["pass"] = bool(storm_out["pass"] and ramp["pass"])
+    finally:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+    verdict["elapsed_s"] = round(time.monotonic() - t_start, 3)
+    return _attach_tracing(verdict, trace_seq0, trace_forced0)
+
+
 # -- traffic-adaptive shapes soak (tpu/tuner.py) ------------------------------
 
 # wide enough that the DEVICE step dominates e2e (at hidden 32 the step is
@@ -1836,6 +2181,13 @@ def main(argv=None) -> int:
                          "stream; asserts >=1.7x aggregate rows/s, "
                          "cross-process duplicate cache affinity, and zero "
                          "silent loss across a worker kill/restart")
+    ap.add_argument("--preempt", action="store_true",
+                    help="elastic-fleet soak: 3 worker processes behind a "
+                         "remote_tpu stream with the autoscaling controller "
+                         "on; SIGKILLs workers mid-load (controller detects "
+                         "+ respawns, zero silent loss, p99 gap within SLO) "
+                         "then ramps load on an undersized fleet until a "
+                         "warm-shape scale-out fires with zero failures")
     ap.add_argument("--tuner", action="store_true",
                     help="traffic-adaptive-shapes soak: a shifting-length "
                          "distribution (short->long mix flip mid-run) serves "
@@ -1888,6 +2240,14 @@ def main(argv=None) -> int:
         # the INGEST process never imports jax; only the spawned device
         # workers do (each pins its own virtual-CPU env)
         verdict = run_cluster_soak(seconds=args.seconds, seed=args.seed,
+                                   fast=args.fast)
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["pass"] else 1
+
+    if args.preempt:
+        # like --cluster: the parent never imports jax — worker subprocesses
+        # get their own pinned virtual-CPU env from the soak itself
+        verdict = run_preempt_soak(seconds=args.seconds, seed=args.seed,
                                    fast=args.fast)
         print(json.dumps(verdict, indent=2))
         return 0 if verdict["pass"] else 1
