@@ -41,15 +41,23 @@ from arkflow_tpu.errors import ConfigError, ConnectError, ReadError
 logger = logging.getLogger("arkflow.flight")
 
 
-def batch_to_ipc(rb: pa.RecordBatch) -> bytes:
-    """One record batch as a self-contained IPC stream."""
+def batch_to_ipc(rb: pa.RecordBatch) -> pa.Buffer:
+    """One record batch as a self-contained IPC stream, returned as the
+    Arrow buffer itself — NOT ``bytes``. ``.to_pybytes()`` here used to copy
+    every payload a second time before the transport copied it onto the
+    wire; a ``pa.Buffer`` supports the buffer protocol (``len``,
+    ``memoryview``, pickle), so every consumer — flight frames, the
+    process-pool submit, the shard hop — hands it on zero-copy. Callers
+    that truly need ``bytes`` wrap with ``bytes(...)`` explicitly."""
     sink = pa.BufferOutputStream()
     with pa.ipc.new_stream(sink, rb.schema) as w:
         w.write_batch(rb)
-    return sink.getvalue().to_pybytes()
+    return sink.getvalue()
 
 
-def ipc_to_batches(data: bytes) -> list[pa.RecordBatch]:
+def ipc_to_batches(data) -> list[pa.RecordBatch]:
+    """Inverse of ``batch_to_ipc``; accepts bytes or any buffer-protocol
+    payload (memoryview of a wire frame, a ``pa.Buffer``)."""
     with pa.ipc.open_stream(pa.BufferReader(data)) as r:
         return list(r)
 
@@ -64,8 +72,16 @@ def ipc_to_batches(data: bytes) -> list[pa.RecordBatch]:
 DEFAULT_MAX_FRAME = 1 << 30
 
 
-async def _send_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
-    writer.write(struct.pack(">I", len(payload)) + payload)
+async def _send_frame(writer: asyncio.StreamWriter, payload) -> None:
+    """Write one length-prefixed frame. ``payload`` may be ``bytes`` or any
+    buffer-protocol object (``pa.Buffer`` from ``batch_to_ipc`` rides
+    through untouched — the only copy is the kernel's)."""
+    if isinstance(payload, (bytes, bytearray)):
+        writer.write(struct.pack(">I", len(payload)) + payload)
+    else:
+        view = memoryview(payload)
+        writer.write(struct.pack(">I", view.nbytes))
+        writer.write(view)
     await writer.drain()
 
 
@@ -78,8 +94,17 @@ ERROR_TAG = b"\x01"
 TRACE_TAG = b"\x02"
 
 
-async def _send_data(writer: asyncio.StreamWriter, payload: bytes) -> None:
-    await _send_frame(writer, DATA_TAG + payload)
+async def _send_data(writer: asyncio.StreamWriter, payload) -> None:
+    """One tagged data frame; like ``_send_frame``, the payload may be a
+    buffer-protocol object — tag and length go out as one small header
+    write, the Arrow buffer follows without an intermediate concat copy."""
+    if isinstance(payload, (bytes, bytearray)):
+        writer.write(struct.pack(">I", len(payload) + 1) + DATA_TAG + payload)
+    else:
+        view = memoryview(payload)
+        writer.write(struct.pack(">I", view.nbytes + 1) + DATA_TAG)
+        writer.write(view)
+    await writer.drain()
 
 
 async def _send_stream_error(writer: asyncio.StreamWriter, err: str) -> None:

@@ -30,6 +30,7 @@ from typing import Optional, Sequence
 import pyarrow as pa
 
 from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.connect.flight import batch_to_ipc as _rb_to_ipc
 from arkflow_tpu.errors import ConfigError, ProcessError
 
 #: processors that hold device/XLA state — never run them in pool workers
@@ -41,15 +42,16 @@ _worker_loop = None  # ONE persistent loop per worker: connections opened at
 # batch on a fresh asyncio.run loop would leave them attached to a dead loop
 
 
-def batch_to_ipc(batch: MessageBatch) -> bytes:
-    sink = pa.BufferOutputStream()
-    with pa.ipc.new_stream(sink, batch.record_batch.schema) as w:
-        w.write_batch(batch.record_batch)
-    return sink.getvalue().to_pybytes()
+def batch_to_ipc(batch: MessageBatch) -> pa.Buffer:
+    """Serialize for the process hop — the ONE IPC helper (connect/flight)
+    shared with the cluster plane and the ingest-shard hop. Returns the
+    Arrow buffer itself: pickle ships its bytes once; the old
+    ``.to_pybytes()`` here copied every payload a second time first."""
+    return _rb_to_ipc(batch.record_batch)
 
 
-def ipc_to_batch(data: bytes) -> MessageBatch:
-    with pa.ipc.open_stream(data) as reader:
+def ipc_to_batch(data) -> MessageBatch:
+    with pa.ipc.open_stream(pa.BufferReader(data)) as reader:
         table = reader.read_all()
     return MessageBatch.from_table(table)
 

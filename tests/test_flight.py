@@ -256,7 +256,7 @@ def test_mid_stream_error_frame_surfaces_without_hanging():
 
     async def go():
         server, port = await _fake_streaming_server([
-            _frame(b"\x00" + batch_to_ipc(rb)),  # one good data frame
+            _frame(b"\x00" + bytes(batch_to_ipc(rb))),  # one good data frame
             _frame(err),                          # then the tagged error
         ])
         try:
@@ -279,8 +279,8 @@ def test_zero_length_end_frame_terminates_cleanly():
 
     async def go():
         server, port = await _fake_streaming_server([
-            _frame(b"\x00" + batch_to_ipc(rb)),
-            _frame(b"\x00" + batch_to_ipc(rb)),
+            _frame(b"\x00" + bytes(batch_to_ipc(rb))),
+            _frame(b"\x00" + bytes(batch_to_ipc(rb))),
             b"\x00\x00\x00\x00",  # end
         ])
         try:

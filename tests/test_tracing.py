@@ -151,6 +151,26 @@ def test_stage_breakdown_quantiles_and_share():
     assert delta["traces"] == 1 and list(delta["stages"]) == ["late"]
 
 
+def test_stage_breakdown_nested_spans_do_not_inflate_share():
+    """A nested span (device_step inside process) overlaps its parent;
+    share_of_e2e must count top-level spans only, so the shares of
+    disjoint top-level stages sum to <= 1.0 — a nested-only stage reports
+    nested: true + its parent stage and a 0.0 top-level share instead."""
+    t = Tracer(config=TracingConfig())
+    ctx = t.begin()
+    with activate(t, ctx):
+        with stage_span("process"):
+            record_stage("device_step", 0.08)
+    t.record(ctx, "queue_wait", 0.02)
+    t.finish(ctx, "ok", e2e_s=0.12)
+    stages = t.stage_breakdown()["stages"]
+    dev = stages["device_step"]
+    assert dev["nested"] is True and dev["nested_under"] == "process"
+    assert dev["share_of_e2e"] == 0.0  # no top-level spans
+    assert dev["total_ms"] == pytest.approx(80.0, abs=1.0)  # cost visible
+    assert sum(s["share_of_e2e"] for s in stages.values()) <= 1.0
+
+
 def test_stage_span_scope_nesting_and_noop_off_scope():
     t = Tracer(config=TracingConfig())
     # outside any scope: helpers are no-ops, never errors
