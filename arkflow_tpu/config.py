@@ -319,8 +319,9 @@ def _validate_remote_tpu(processors: list[dict]) -> None:
     """Parse-time validation of the ``remote_tpu`` cluster-dispatch stage
     (runtime/cluster.py owns the parse rules; it imports no jax), looking
     through ``fault.inner`` chaos wrappers like the other cross-checks — a
-    bad worker URL or routing knob fails at ``--validate`` instead of at
-    stream connect."""
+    bad worker URL, routing knob, ``decode_candidates``, or one-sided
+    ``fleet.roles`` split (prefill capacity with no decode capacity, or
+    vice versa) fails at ``--validate`` instead of at stream connect."""
     from arkflow_tpu.runtime.cluster import parse_remote_tpu_config
 
     for p in processors:

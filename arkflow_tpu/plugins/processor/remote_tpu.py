@@ -21,6 +21,9 @@ Config:
     connect_timeout: 5s
     drain_timeout: 30s          # per-worker drain budget in rolling swaps
     max_frame: 1073741824       # wire frame cap in bytes (default 1 GiB)
+    decode_candidates: 3        # disagg: decode destinations offered to a
+                                # prefill worker per dispatch, occupancy-
+                                # ordered from heartbeats (role split only)
     response_cache: {capacity: 1024, ttl: 30s}   # optional ingest-side dedup
     fleet:                      # optional autoscaling controller
       min_workers: 1            # floor (default: len(workers)); respawned
@@ -36,8 +39,19 @@ Config:
       spawn_host: 127.0.0.1
       spawn_timeout: 240s       # spawn warmup + register budget
       drain_timeout: 30s        # retire drain budget on scale-in
+      roles:                    # optional per-role floors/ceilings for a
+        prefill: {min: 1, max: 2}   # disaggregated fleet; must leave both
+        decode: {min: 1, max: 2}    # sides servable (one-sided splits are
+                                    # a ConfigError)
 
-See docs/CONFIG.md "Cluster serving" and "Elastic fleet" for semantics.
+Workers declare ``worker.role: prefill | decode | both`` (default
+``both``) in their own config. When any live worker is role-split, the
+dispatcher plans prompts onto prefill workers by prefix hash and hands
+them an occupancy-ordered list of decode destinations; finished KV pages
+stream decode-ward over ``kv_push`` frames.
+
+See docs/CONFIG.md "Cluster serving", "Elastic fleet", and
+"Disaggregated prefill/decode" for semantics.
 """
 
 from __future__ import annotations

@@ -206,6 +206,11 @@ class TpuGenerateProcessor(Processor):
             #: step-fault arming both look for ``.runner`` — the generation
             #: server IS this processor's device runner
             self.runner = self._server
+            #: prefill/decode disaggregation adapter: a prefill-role
+            #: cluster worker (runtime/cluster.py) finds this through the
+            #: same ``_inner``-chain walk as ``.runner``/``.swapper`` and
+            #: drives prefill_rows -> kv_push -> finalize_rows
+            self.disagg = self
 
         reg = global_registry()
         self.m_tokens = reg.counter("arkflow_generated_tokens_total", "tokens generated",
@@ -270,6 +275,30 @@ class TpuGenerateProcessor(Processor):
             [self._detok(flat[offsets[i]:offsets[i + 1]])
              for i in range(len(offsets) - 1)],
             pa.string())
+
+    # -- prefill/decode disaggregation (continuous mode only) --------------
+
+    async def prefill_rows(self, batch: MessageBatch) -> list[dict]:
+        """Prefill each row on the local scratch page pool and return the
+        KV-page exports (one per row, in row order) for the cluster worker
+        to stream to a decode destination."""
+        texts = batch.to_binary(self.text_field)
+        ids, mask = self.tokenizer.encode_batch(texts, self.max_input)
+        lengths = mask.sum(axis=1).astype(np.int32)
+        return list(await asyncio.gather(*[
+            self._server.prefill_export(ids[i, :lengths[i]].tolist(),
+                                        max_new_tokens=self.max_new_tokens)
+            for i in range(ids.shape[0])
+        ]))
+
+    def finalize_rows(self, batch: MessageBatch,
+                      token_lists: list) -> list[MessageBatch]:
+        """Detokenize the decode worker's relayed token lists into the
+        output column, exactly as the local continuous path would."""
+        self.m_tokens.inc(sum(len(t) for t in token_lists))
+        texts_out = [self._detok(list(t)) for t in token_lists]
+        return [batch.with_column(self.output_field,
+                                  pa.array(texts_out, pa.string()))]
 
     async def process(self, batch: MessageBatch) -> list[MessageBatch]:
         if batch.num_rows == 0:

@@ -32,6 +32,25 @@ Wire protocol (extends the flight framing; ``arkflow://host:port``):
   status frame, then tagged data frames (processed batches), then the
   zero-length end frame. A processing error after streaming began uses the
   0x01 error tag, exactly like remote scans.
+- ``kv_push``   — prefill/decode disaggregation: a prefill-role worker
+  streams one finished prompt's KV pages to a decode-role worker. The
+  request frame carries the page-table metadata (prompt ids, first token,
+  page geometry, shard count); ``2 * shards`` raw frames follow — the K
+  then V page slabs, one frame per tp shard (split along kv_heads, the
+  axis the receiving pool shards on). The receiver adopts the pages into
+  its own pool and decodes to completion, answering ONE status frame with
+  the full token list. A draining or role-mismatched receiver refuses
+  retryably (after consuming the slab frames), so the prefill side
+  re-plans to the next decode candidate.
+
+Roles (``worker: {role: prefill|decode|both}``, default ``both``): prompts
+route to prefill-capable workers by prefix hash (prefix-cache affinity
+survives the split verbatim); the prefill worker picks its decode
+destination from the occupancy-ordered candidate list the dispatcher
+attaches to the request (slot/page pressure advertised in heartbeats). A
+decode-role worker refuses ``infer`` retryably, a prefill-role worker
+refuses ``kv_push`` retryably — misrouted work re-routes instead of
+wedging.
 
 Routing (``remote_tpu`` dispatch stage): consistent hashing on
 ``batch_fingerprint`` (or the prompt prefix) over a virtual-node ring, so a
@@ -104,6 +123,72 @@ logger = logging.getLogger("arkflow.cluster")
 PROTO_VERSION = 1
 
 ROUTE_KEYS = ("fingerprint", "prefix")
+
+#: prefill/decode disaggregation roles a worker can declare
+WORKER_ROLES = ("prefill", "decode", "both")
+
+
+# ---------------------------------------------------------------------------
+# KV-page export wire codec (numpy only — the ingest tier must never
+# import jax, and the slabs cross processes as raw frames)
+# ---------------------------------------------------------------------------
+
+
+def _wire_dtype(name: str):
+    """Resolve a dtype name from the wire; bf16 lives in ml_dtypes (which
+    ships with jax but imports without it)."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def kv_export_to_wire(export: Mapping) -> tuple[dict, list[bytes]]:
+    """Split a ``GenerationServer.prefill_export`` payload into the JSON
+    metadata dict and the ordered raw slab frames (K shards then V shards,
+    one frame per tp shard — the receiver reassembles along kv_heads)."""
+    import numpy as np
+
+    meta = {k: export[k] for k in
+            ("prompt", "max_new_tokens", "first_token") if k in export}
+    meta["tokens"] = [int(t) for t in export.get("tokens") or []]
+    if export.get("done"):
+        meta["done"] = True
+        return meta, []
+    meta["page_size"] = int(export["page_size"])
+    meta["shards"] = int(export["shards"])
+    meta["dtype"] = str(export["dtype"])
+    meta["shape"] = [int(d) for d in export["k"][0].shape]
+    frames = [np.ascontiguousarray(a).tobytes()
+              for a in list(export["k"]) + list(export["v"])]
+    return meta, frames
+
+
+def kv_export_from_wire(meta: Mapping, frames: Sequence[bytes]) -> dict:
+    """Inverse of :func:`kv_export_to_wire`: rebuild the export dict the
+    decode side's ``generate_from_pages`` adopts. Bitwise: the slabs are
+    reinterpreted at their original dtype/shape, never converted."""
+    import numpy as np
+
+    out = dict(meta)
+    if out.get("done"):
+        return out
+    shards = int(meta["shards"])
+    if len(frames) != 2 * shards:
+        raise ConnectError(
+            f"kv_push carried {len(frames)} slab frames, expected "
+            f"{2 * shards} (K+V x {shards} shards)")
+    shape = tuple(int(d) for d in meta["shape"])
+    dt = _wire_dtype(str(meta["dtype"]))
+    out["k"] = [np.frombuffer(frames[i], dtype=dt).reshape(shape)
+                for i in range(shards)]
+    out["v"] = [np.frombuffer(frames[shards + i], dtype=dt).reshape(shape)
+                for i in range(shards)]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -272,13 +357,17 @@ class ClusterWorkerServer:
                  port: int = 50052, worker_id: Optional[str] = None,
                  max_in_flight: int = 1, max_frame: int = DEFAULT_MAX_FRAME,
                  tracing: Optional[TracingConfig] = None,
-                 grace_s: float = 30.0):
+                 grace_s: float = 30.0, role: str = "both"):
         from arkflow_tpu.runtime.overload import OverloadConfig, OverloadController
         from arkflow_tpu.runtime.pipeline import Pipeline
 
         if max_in_flight < 1:
             raise ConfigError(
                 f"worker.max_in_flight must be >= 1, got {max_in_flight}")
+        if role not in WORKER_ROLES:
+            raise ConfigError(
+                f"worker.role must be one of {WORKER_ROLES}, got {role!r}")
+        self.role = role
         self.pipeline = Pipeline(list(processors))
         self.host = host
         self.port = port
@@ -308,6 +397,11 @@ class ClusterWorkerServer:
         self._inflight = 0  # accepted infer requests not yet answered
         self._served = 0  # completed OK since process start
         self._errors = 0
+        # prefill/decode disaggregation counters (heartbeat-visible)
+        self._kv_pushed = 0        # exports this worker shipped downstream
+        self._kv_push_retries = 0  # decode candidates that refused/failed over
+        self._kv_adopted = 0       # exports adopted + decoded locally
+        self._kv_refused = 0       # kv_push receives refused (drain/role)
         # the PR-5 admission signals, re-used verbatim: window adapts by
         # AIMD on the semaphore wait, drain estimate = queued * step EWMA
         self.ctrl = OverloadController(
@@ -412,10 +506,17 @@ class ClusterWorkerServer:
 
     def load_report(self) -> dict:
         """The heartbeat payload: identity + the advertised routing/
-        autoscaling signals + nested device health and cache stats."""
-        return {
+        autoscaling signals + nested device health and cache stats.
+
+        Generation occupancy (``gen_slots_busy`` / ``page_pool_occupancy``)
+        is lifted out of the nested health reports into first-class fields:
+        decode placement and the fleet controller read REAL decode pressure
+        from here, not just the AIMD window."""
+        health = _runner_reports(self.pipeline.processors)
+        rep = {
             "worker_id": self.worker_id,
             "proto": PROTO_VERSION,
+            "role": self.role,
             "draining": self.draining,
             "inflight": self._inflight,
             "served": self._served,
@@ -423,10 +524,26 @@ class ClusterWorkerServer:
             "window": int(self.ctrl.window),
             "drain_s": round(self.ctrl.estimated_drain_s(), 3),
             "step_ewma_ms": round(self.ctrl.step_s() * 1000.0, 3),
-            "health": _runner_reports(self.pipeline.processors),
+            "kv_pushed": self._kv_pushed,
+            "kv_push_retries": self._kv_push_retries,
+            "kv_adopted": self._kv_adopted,
+            "kv_refused": self._kv_refused,
+            "health": health,
             "caches": _cache_reports(self.pipeline.processors),
             "shapes": _shape_reports(self.pipeline.processors),
         }
+        gen = [h for h in health if h.get("serving") == "continuous"]
+        if gen:
+            rep["gen_slots"] = sum(int(h.get("slots", 0)) for h in gen)
+            rep["gen_slots_busy"] = sum(int(h.get("slots_busy", 0))
+                                        for h in gen)
+            rep["page_pool_occupancy"] = round(
+                max(float(h.get("page_pool_occupancy", 0.0)) for h in gen), 4)
+            ttfts = [h["ttft"] for h in gen if isinstance(h.get("ttft"), dict)]
+            if ttfts:
+                rep["ttft_p99_ms"] = max(float(t.get("p99_ms", 0.0))
+                                         for t in ttfts)
+        return rep
 
     # -- request handling --------------------------------------------------
 
@@ -457,6 +574,8 @@ class ClusterWorkerServer:
                 await self._do_swap(req, writer)
             elif action == "infer":
                 await self._do_infer(req, reader, writer)
+            elif action == "kv_push":
+                await self._do_kv_push(req, reader, writer)
             else:
                 await _send_frame(writer, json.dumps(
                     {"ok": False, "error": f"unknown action {action!r}"}).encode())
@@ -518,6 +637,13 @@ class ClusterWorkerServer:
                 {"ok": False, "error": "worker is draining",
                  "retryable": True}).encode())
             return
+        if self.role == "decode":
+            # a decode-role worker only adopts kv_push pages; prompts
+            # re-route to a prefill-capable worker on the ring
+            await _send_frame(writer, json.dumps(
+                {"ok": False, "error": "worker role is 'decode': accepts "
+                 "kv_push only", "retryable": True}).encode())
+            return
         # cross-tier trace context: the ingest dispatcher parents the
         # worker's spans under its hop span; absent = untraced (old peer)
         tctx = (TraceContext.from_json(req.get("trace"))
@@ -542,9 +668,22 @@ class ClusterWorkerServer:
                 t0 = loop.time()
                 # activate the worker's tracer so the hosted chain's spans
                 # (infeed prep, device step) nest under remote_step
+                decode_urls = [str(u) for u in req.get("decode_workers") or []]
+                disagg = (self._disagg_handle()
+                          if self.role == "prefill" and decode_urls else None)
                 with activate(self.tracer, tctx):
-                    with stage_span("remote_step"):
-                        results = await self.pipeline.process(batch)
+                    if disagg is not None:
+                        # prefill role two-hop: prefill locally, stream the
+                        # KV pages to a decode candidate, relay its tokens
+                        with stage_span("remote_step"):
+                            exports = await disagg.prefill_rows(batch)
+                        with stage_span("remote_kv_push"):
+                            token_lists = [await self._push_export(e, decode_urls)
+                                           for e in exports]
+                        results = disagg.finalize_rows(batch, token_lists)
+                    else:
+                        with stage_span("remote_step"):
+                            results = await self.pipeline.process(batch)
                 self.ctrl.observe_step(loop.time() - t0)
             t_ser = loop.time()
             for out in results:
@@ -572,6 +711,158 @@ class ClusterWorkerServer:
                 except Exception:
                     pass  # the error frame still matters more
             raise
+        finally:
+            self._inflight -= 1
+
+    # -- prefill/decode disaggregation -------------------------------------
+
+    def _disagg_handle(self) -> Optional[Any]:
+        """The hosted chain's disaggregation adapter (a continuous
+        ``tpu_generate`` processor exposes itself as ``.disagg`` — same
+        ``_inner``-chain convention as ``.runner``/``.swapper``)."""
+        for proc in self.pipeline.processors:
+            d = _walk_inner(proc, "disagg")
+            if d is not None and hasattr(d, "prefill_rows"):
+                return d
+        return None
+
+    def _generation_server(self) -> Optional[Any]:
+        """The hosted continuous generation server (adopt target)."""
+        for proc in self.pipeline.processors:
+            runner = _walk_inner(proc, "runner")
+            if runner is not None and hasattr(runner, "generate_from_pages"):
+                return runner
+        return None
+
+    async def _push_export(self, export: Mapping,
+                           urls: Sequence[str]) -> list[int]:
+        """Ship one prompt's KV pages to the first decode candidate that
+        accepts, in the occupancy order the dispatcher planned. A retryable
+        refusal (draining / role mismatch) or a transport error re-plans to
+        the next candidate; a processing failure on an ACCEPTED push is
+        terminal (the decode side already owns the request). All candidates
+        exhausted raises ConnectError — the infer stream errors, and the
+        ingest tier's normal nack/redelivery re-prefills."""
+        if export.get("done"):
+            return [int(t) for t in export.get("tokens") or []]
+        meta, frames = kv_export_to_wire(export)
+        last: Optional[BaseException] = None
+        for url in urls:
+            host, port = parse_remote_url(url)
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, port), 5.0)
+            except (OSError, asyncio.TimeoutError) as e:
+                self._kv_push_retries += 1
+                last = e
+                continue
+            try:
+                try:
+                    await _send_frame(writer, json.dumps(
+                        {"action": "kv_push", "meta": meta}).encode())
+                    for fr in frames:
+                        await _send_frame(writer, fr)
+                    raw = await asyncio.wait_for(
+                        _read_frame(reader, self.max_frame), 120.0)
+                    if raw is None:
+                        raise ConnectError(
+                            f"decode worker {url} closed before a status")
+                    status = json.loads(raw.decode())
+                except (ConnectionError, OSError, asyncio.TimeoutError,
+                        asyncio.IncompleteReadError, ConnectError) as e:
+                    self._kv_push_retries += 1
+                    last = e
+                    continue
+            finally:
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+            if status.get("ok"):
+                self._kv_pushed += 1
+                return [int(t) for t in status.get("tokens") or []]
+            if status.get("retryable"):
+                self._kv_push_retries += 1
+                last = ConnectError(
+                    f"decode worker {url} refused kv_push: {status.get('error')}")
+                continue
+            raise ProcessError(
+                f"decode worker {url} failed adopted decode: "
+                f"{status.get('error')}")
+        raise ConnectError(
+            f"kv_push: no decode worker accepted the pages "
+            f"({len(urls)} candidates tried; last: {last!r})")
+
+    async def _do_kv_push(self, req: dict, reader, writer) -> None:
+        """Adopt a prefill worker's KV pages and decode to completion.
+
+        The slab frames are consumed BEFORE any refusal (same ordering as
+        ``infer`` under drain: the peer already committed the frames to the
+        socket), then draining / role-mismatch refuse RETRYABLY so the
+        prefill side re-plans to the ring's next decode candidate instead
+        of surfacing a processing error."""
+        meta = req.get("meta")
+        if not isinstance(meta, Mapping):
+            await _send_frame(writer, json.dumps(
+                {"ok": False,
+                 "error": "kv_push needs a 'meta' mapping"}).encode())
+            return
+        frames: list[bytes] = []
+        if not meta.get("done"):
+            shards = meta.get("shards", 1)
+            if (isinstance(shards, bool) or not isinstance(shards, int)
+                    or not 1 <= shards <= 64):
+                await _send_frame(writer, json.dumps(
+                    {"ok": False,
+                     "error": f"kv_push shards invalid: {shards!r}"}).encode())
+                return
+            for _ in range(2 * shards):
+                fr = await _read_frame(reader, self.max_frame)
+                if fr is None:
+                    raise ConnectError(
+                        "kv_push ended before all page-slab frames")
+                frames.append(bytes(fr))
+        if self.draining:
+            self._kv_refused += 1
+            await _send_frame(writer, json.dumps(
+                {"ok": False, "error": "worker is draining",
+                 "retryable": True}).encode())
+            return
+        if self.role == "prefill":
+            self._kv_refused += 1
+            await _send_frame(writer, json.dumps(
+                {"ok": False, "error": "worker role is 'prefill': cannot "
+                 "adopt KV pages it would never decode",
+                 "retryable": True}).encode())
+            return
+        server = self._generation_server()
+        if server is None:
+            await _send_frame(writer, json.dumps(
+                {"ok": False, "error": "no continuous generation server "
+                 "hosted on this worker"}).encode())
+            return
+        export = kv_export_from_wire(meta, frames)
+        loop = asyncio.get_running_loop()
+        self._inflight += 1
+        self.ctrl.on_enqueue()
+        t_q = loop.time()
+        try:
+            async with self._sem:  # adopted decode holds a device lane too
+                self.ctrl.on_dequeue(loop.time() - t_q, loop.time())
+                t0 = loop.time()
+                tokens = await server.generate_from_pages(export)
+                self.ctrl.observe_step(loop.time() - t0)
+            self._kv_adopted += 1
+            self._served += 1
+            await _send_frame(writer, json.dumps(
+                {"ok": True, "worker_id": self.worker_id,
+                 "tokens": [int(t) for t in tokens]}).encode())
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            raise
+        except Exception as e:
+            self._errors += 1
+            await _send_frame(writer, json.dumps(
+                {"ok": False, "error": repr(e)[:500]}).encode())
         finally:
             self._inflight -= 1
 
@@ -624,6 +915,11 @@ def parse_worker_config(m: Any) -> tuple[list[dict], dict]:
     if wid is not None and not isinstance(wid, str):
         raise ConfigError(f"worker.id must be a string, got {wid!r}")
     opts["worker_id"] = wid
+    role = opts_raw.get("role", "both")
+    if role not in WORKER_ROLES:
+        raise ConfigError(
+            f"worker.role must be one of {WORKER_ROLES}, got {role!r}")
+    opts["role"] = role
     from arkflow_tpu.utils.duration import parse_duration
 
     grace = opts_raw.get("grace", "30s")
@@ -658,7 +954,8 @@ def build_worker_server(config: Mapping, *, host: str = "127.0.0.1",
         max_in_flight=opts["max_in_flight"],
         max_frame=max_frame or opts["max_frame"],
         tracing=opts["tracing"],
-        grace_s=opts["grace_s"])
+        grace_s=opts["grace_s"],
+        role=opts["role"])
 
 
 async def run_worker(config: Mapping, *, host: str = "127.0.0.1",
@@ -742,6 +1039,13 @@ class RemoteWorker:
         #: client-side outstanding requests (fresh, unlike the heartbeat)
         self.inflight = 0
         self.dispatched = 0
+        #: advertised disaggregation role (heartbeat; default both)
+        self.role = "both"
+        #: decode-side occupancy (heartbeat): generation slots and KV page
+        #: pool pressure — real decode saturation, not just the AIMD window
+        self.gen_slots = 0
+        self.gen_slots_busy = 0
+        self.page_occupancy = 0.0
         self.last_report: dict = {}
         self.last_seen = 0.0
         self.last_error: Optional[str] = None
@@ -771,6 +1075,11 @@ class RemoteWorker:
         self.draining = bool(rep.get("draining", False))
         self.window = max(1, int(rep.get("window", 1)))
         self.drain_s = float(rep.get("drain_s", 0.0))
+        role = rep.get("role", "both")
+        self.role = role if role in WORKER_ROLES else "both"
+        self.gen_slots = int(rep.get("gen_slots", 0) or 0)
+        self.gen_slots_busy = int(rep.get("gen_slots_busy", 0) or 0)
+        self.page_occupancy = float(rep.get("page_pool_occupancy", 0.0) or 0.0)
         self.last_report = rep
         self.last_seen = now
         self.last_error = None
@@ -783,8 +1092,21 @@ class RemoteWorker:
         self.last_error = f"{type(err).__name__}: {err}"
         self.m_alive.set(0.0)
 
+    def serves(self, role: str) -> bool:
+        """True when this worker accepts work of the given role."""
+        return self.role == "both" or self.role == role
+
     def has_headroom(self) -> bool:
-        return self.inflight < self.window
+        if self.inflight >= self.window:
+            return False
+        # decode-side saturation folded in: every generation slot busy or
+        # a nearly-full KV page pool means new work queues regardless of
+        # what the AIMD window (which adapts a cycle behind) still admits
+        if self.gen_slots and self.gen_slots_busy >= self.gen_slots:
+            return False
+        if self.page_occupancy >= 0.95:
+            return False
+        return True
 
     def report(self) -> dict:
         state = ("dead" if not self.alive
@@ -793,11 +1115,16 @@ class RemoteWorker:
             "worker": self.url,
             "worker_id": self.worker_id,
             "state": state,
+            "role": self.role,
             "window": self.window,
             "drain_s": self.drain_s,
             "inflight": self.inflight,
             "dispatched": self.dispatched,
         }
+        if self.gen_slots:
+            out["gen_slots"] = self.gen_slots
+            out["gen_slots_busy"] = self.gen_slots_busy
+            out["page_pool_occupancy"] = self.page_occupancy
         if self.last_error:
             out["last_error"] = self.last_error
         remote_health = self.last_report.get("health")
@@ -821,7 +1148,8 @@ class ClusterDispatcher:
                  heartbeat_s: float = 2.0, request_timeout_s: float = 60.0,
                  connect_timeout_s: float = 5.0,
                  heartbeat_timeout_s: Optional[float] = None,
-                 max_frame: int = DEFAULT_MAX_FRAME):
+                 max_frame: int = DEFAULT_MAX_FRAME,
+                 decode_candidates: int = 3):
         from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD
 
         if not urls:
@@ -851,6 +1179,13 @@ class ClusterDispatcher:
             raise ConfigError(
                 f"remote_tpu.heartbeat_timeout ({self.heartbeat_timeout_s}s) "
                 f"must exceed the heartbeat period ({heartbeat_s}s)")
+        if decode_candidates < 1:
+            raise ConfigError(
+                f"remote_tpu.decode_candidates must be >= 1, "
+                f"got {decode_candidates}")
+        #: how many occupancy-ordered decode destinations ride along with
+        #: each prefill dispatch (failover depth for the second hop)
+        self.decode_candidates = int(decode_candidates)
         self.virtual_nodes = virtual_nodes
         self.max_frame = int(max_frame)
         self.workers: dict[str, RemoteWorker] = {
@@ -1014,7 +1349,8 @@ class ClusterDispatcher:
                 pass  # no payload column: fall through to the fingerprint
         return batch_fingerprint(batch)
 
-    def plan(self, key: bytes) -> list[RemoteWorker]:
+    def plan(self, key: bytes, *,
+             role: Optional[str] = None) -> list[RemoteWorker]:
         """Candidate order for a key: ring order over live, non-draining
         workers, weighted by each worker's advertised load signals. The hash
         owner serves unless it has no headroom against its advertised AIMD
@@ -1022,6 +1358,11 @@ class ClusterDispatcher:
         load (fewest outstanding dispatches, then smallest advertised drain
         estimate). Bounded-load consistent hashing: affinity is traded only
         under saturation, counted in ``arkflow_cluster_spill_total``.
+
+        With ``role`` set (a role-split fleet), only workers serving that
+        role are candidates — the ring walk skips the others, so prefix
+        affinity over the PREFILL sub-ring survives exactly as it would on
+        an undivided fleet.
 
         Stale members are expired here too (not only on the heartbeat
         clock): a dead worker's hash range falls to its ring successor the
@@ -1033,7 +1374,8 @@ class ClusterDispatcher:
             pass  # no running loop (sync planning in tests): skip expiry
         live = [self.workers[u] for u in self.ring.candidates(key)
                 if u in self.workers
-                and self.workers[u].alive and not self.workers[u].draining]
+                and self.workers[u].alive and not self.workers[u].draining
+                and (role is None or self.workers[u].serves(role))]
         if len(live) < 2 or live[0].has_headroom():
             return live
         with_room = [w for w in live[1:] if w.has_headroom()]
@@ -1052,12 +1394,43 @@ class ClusterDispatcher:
             return [best] + [w for w in live if w is not best]
         return live
 
+    def role_split(self) -> bool:
+        """True when any live worker declared a non-``both`` role — the
+        fleet is running disaggregated and dispatch goes two-hop."""
+        return any(w.role != "both"
+                   for w in self.workers.values() if w.alive)
+
+    def decode_targets(self) -> list[RemoteWorker]:
+        """Decode placement order: live, non-draining decode-capable
+        workers sorted by real decode pressure from the heartbeats — slot
+        occupancy first, then KV page pressure, then outstanding
+        dispatches. The prefill worker tries them in this order, so pages
+        land where slots are actually free (capped at
+        ``decode_candidates``)."""
+        cands = [w for w in self.workers.values()
+                 if w.alive and not w.draining and w.serves("decode")]
+        cands.sort(key=lambda w: (
+            (w.gen_slots_busy / w.gen_slots) if w.gen_slots else 0.0,
+            w.page_occupancy, w.inflight, w.url))
+        return cands[: self.decode_candidates]
+
     async def dispatch(self, batch: MessageBatch) -> list[MessageBatch]:
         """Route one emission to the fleet; failover along the ring on
         transport errors. Raises on remote PROCESSING errors (no sibling
         retry — see _RemoteProcessingError) and when every worker is down
-        (the stream's nack path then preserves at-least-once)."""
-        candidates = self.plan(self.routing_key(batch))
+        (the stream's nack path then preserves at-least-once).
+
+        On a role-split fleet the plan is two-hop: prompts go to a
+        prefill-capable worker chosen by prefix hash (hop 1), carrying the
+        occupancy-ordered decode candidate list; the prefill worker streams
+        finished KV pages to the first accepting decode worker (hop 2) and
+        relays its tokens on this same infer stream."""
+        decode_urls: list[str] = []
+        if self.role_split():
+            candidates = self.plan(self.routing_key(batch), role="prefill")
+            decode_urls = [w.url for w in self.decode_targets()]
+        else:
+            candidates = self.plan(self.routing_key(batch))
         if not candidates:
             raise ConnectError(
                 f"remote_tpu[{self.name}]: no live cluster worker "
@@ -1080,7 +1453,8 @@ class ClusterDispatcher:
             w.inflight += 1
             w.m_inflight.set(w.inflight)
             try:
-                out = await self._infer_on(w, batch, ctx=ctx, tracer=tracer)
+                out = await self._infer_on(w, batch, ctx=ctx, tracer=tracer,
+                                           decode_urls=decode_urls)
             except _WorkerDraining:
                 w.draining = True
                 last_exc = ConnectError(f"worker {w.url} draining")
@@ -1113,7 +1487,8 @@ class ClusterDispatcher:
 
     async def _infer_on(self, w: RemoteWorker, batch: MessageBatch, *,
                         ctx: Optional[TraceContext] = None,
-                        tracer: Optional[Tracer] = None) -> list[MessageBatch]:
+                        tracer: Optional[Tracer] = None,
+                        decode_urls: Sequence[str] = ()) -> list[MessageBatch]:
         import time as _time
 
         from arkflow_tpu.obs.trace import _new_id
@@ -1129,6 +1504,11 @@ class ClusterDispatcher:
         reader, writer = await self._open(w)
         try:
             req: dict = {"action": "infer"}
+            if decode_urls:
+                # two-hop disagg plan: the prefill worker pushes finished
+                # KV pages to these, in this occupancy order (skipping
+                # itself — a 'both' worker just decodes locally)
+                req["decode_workers"] = [u for u in decode_urls if u != w.url]
             if ctx is not None:
                 req["trace"] = ctx.with_parent(hop_id).to_dict()
             t0 = _time.perf_counter()
@@ -1473,6 +1853,7 @@ def parse_remote_tpu_config(config: Mapping) -> dict:
     out["prefix_bytes"] = _int("prefix_bytes", 64, 1)
     out["virtual_nodes"] = _int("virtual_nodes", 64, 1)
     out["max_frame"] = _int("max_frame", DEFAULT_MAX_FRAME, 1024)
+    out["decode_candidates"] = _int("decode_candidates", 3, 1)
     out["heartbeat_s"] = _dur("heartbeat", "2s")
     out["request_timeout_s"] = _dur("request_timeout", "60s")
     out["connect_timeout_s"] = _dur("connect_timeout", "5s")
@@ -1517,7 +1898,8 @@ def build_remote_tpu(config: dict, resource: Resource) -> RemoteTpuProcessor:
         request_timeout_s=parsed["request_timeout_s"],
         connect_timeout_s=parsed["connect_timeout_s"],
         heartbeat_timeout_s=parsed["heartbeat_timeout_s"],
-        max_frame=parsed["max_frame"])
+        max_frame=parsed["max_frame"],
+        decode_candidates=parsed["decode_candidates"])
     cache = build_response_cache(config.get("response_cache"), name=name)
     fleet = None
     fleet_cfg = parsed["fleet"]

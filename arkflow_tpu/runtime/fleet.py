@@ -97,9 +97,14 @@ class FleetConfig:
     spawn_timeout_s: float = 240.0
     #: drain budget when retiring a worker on scale-in
     drain_s: float = 30.0
+    #: per-role floors/ceilings for a disaggregated (prefill/decode) fleet:
+    #: ``{role: (min, max)}``. When set, respawn floors, pressure-driven
+    #: scale-out and idle scale-in are decided PER ROLE (spawned workers
+    #: get ``worker.role`` overlaid on the template); None = role-blind.
+    roles: Any = None
 
     def report(self) -> dict:
-        return {
+        rep = {
             "enabled": self.enabled,
             "min_workers": self.min_workers,
             "max_workers": self.max_workers,
@@ -111,6 +116,10 @@ class FleetConfig:
             "cooldown_s": self.cooldown_s,
             "respawn": self.respawn,
         }
+        if self.roles:
+            rep["roles"] = {r: {"min": lo, "max": hi}
+                            for r, (lo, hi) in sorted(self.roles.items())}
+        return rep
 
 
 def parse_fleet_config(cfg: Any, *, static_workers: int = 1,
@@ -133,7 +142,7 @@ def parse_fleet_config(cfg: Any, *, static_workers: int = 1,
     known = {"enabled", "min_workers", "max_workers", "interval",
              "scale_out_sustain", "scale_in_sustain", "drain_high",
              "idle_frac", "cooldown", "respawn", "template", "spawn_host",
-             "spawn_timeout", "drain_timeout"}
+             "spawn_timeout", "drain_timeout", "roles"}
     unknown = set(cfg) - known
     if unknown:
         raise ConfigError(
@@ -195,6 +204,55 @@ def parse_fleet_config(cfg: Any, *, static_workers: int = 1,
         raise ConfigError(
             f"{who}.fleet.spawn_host must be a non-empty string, "
             f"got {spawn_host!r}")
+    roles_raw = cfg.get("roles")
+    roles = None
+    if roles_raw is not None:
+        from arkflow_tpu.runtime.cluster import WORKER_ROLES
+
+        if not isinstance(roles_raw, Mapping) or not roles_raw:
+            raise ConfigError(
+                f"{who}.fleet.roles must be a non-empty mapping of "
+                f"role -> {{min, max}}, got {roles_raw!r}")
+        roles = {}
+        for rname, spec in roles_raw.items():
+            if rname not in WORKER_ROLES:
+                raise ConfigError(
+                    f"{who}.fleet.roles: unknown role {rname!r} "
+                    f"(known: {list(WORKER_ROLES)})")
+            if not isinstance(spec, Mapping):
+                raise ConfigError(
+                    f"{who}.fleet.roles.{rname} must be a mapping with "
+                    f"min/max, got {spec!r}")
+            bad = set(spec) - {"min", "max"}
+            if bad:
+                raise ConfigError(
+                    f"{who}.fleet.roles.{rname}: unknown keys "
+                    f"{sorted(bad)} (known: ['max', 'min'])")
+            lo = spec.get("min", 0)
+            if isinstance(lo, bool) or not isinstance(lo, int) or lo < 0:
+                raise ConfigError(
+                    f"{who}.fleet.roles.{rname}.min must be an int >= 0, "
+                    f"got {lo!r}")
+            hi = spec.get("max", max(lo, 1))
+            if isinstance(hi, bool) or not isinstance(hi, int) or hi < lo:
+                raise ConfigError(
+                    f"{who}.fleet.roles.{rname}.max must be an int >= "
+                    f"min ({lo}), got {hi!r}")
+            roles[str(rname)] = (lo, hi)
+        # A role split must be able to serve both sides: a fleet whose
+        # ceilings only ever admit prefill-capable workers (or only
+        # decode-capable ones) can never finish a request — catch it at
+        # --validate instead of as an eternal ConnectError at runtime.
+        def _cap(role: str) -> int:
+            return sum(hi for r, (_lo, hi) in roles.items()
+                       if r == role or r == "both")
+        if _cap("prefill") == 0 or _cap("decode") == 0:
+            missing = "prefill" if _cap("prefill") == 0 else "decode"
+            raise ConfigError(
+                f"{who}.fleet.roles is one-sided: no capacity for "
+                f"{missing!r} (every request needs both a prefill- and a "
+                f"decode-capable worker; add a {missing!r} or 'both' "
+                f"entry with max >= 1)")
     return FleetConfig(
         enabled=True,
         min_workers=min_workers,
@@ -210,6 +268,7 @@ def parse_fleet_config(cfg: Any, *, static_workers: int = 1,
         spawn_host=spawn_host,
         spawn_timeout_s=_dur("spawn_timeout", "240s"),
         drain_s=_dur("drain_timeout", "30s"),
+        roles=roles,
     )
 
 
@@ -309,13 +368,21 @@ class SubprocessSpawner:
             yaml.safe_dump(cfg, f)
         return path
 
-    async def spawn(self, shapes: Sequence[Optional[dict]] = ()) -> str:
+    async def spawn(self, shapes: Sequence[Optional[dict]] = (),
+                    role: Optional[str] = None) -> str:
         """Launch one worker; returns its ``arkflow://`` URL immediately —
         readiness (warmup compiles before the port opens) is the
-        controller's adopt-probe's problem, with its own budget."""
+        controller's adopt-probe's problem, with its own budget.
+
+        ``role`` overlays ``worker.role`` on the template, so one template
+        serves every role of a disaggregated fleet."""
         import subprocess
 
         cfg = overlay_shapes(self._template_mapping(), shapes)
+        if role is not None:
+            w = dict(cfg.get("worker") or {})
+            w["role"] = role
+            cfg["worker"] = w
         port = free_port(self.host)
         url = f"arkflow://{self.host}:{port}"
         cfg_path = self._write_config(cfg)
@@ -407,6 +474,9 @@ class FleetController:
         self._task: Optional[asyncio.Task] = None
         self._pressure = _Sustain()
         self._idle = _Sustain()
+        #: per-role sustain trackers (disaggregated fleets)
+        self._role_pressure: dict[str, _Sustain] = {}
+        self._role_idle: dict[str, _Sustain] = {}
         self._last_action_t: Optional[float] = None
         self._events: collections.deque = collections.deque(maxlen=64)
         self._known_dead: set[str] = set()
@@ -520,6 +590,9 @@ class FleetController:
         n_live = self._refresh_size()
         live = self._live()
 
+        if self.cfg.roles:
+            return await self._tick_roles(now, n_live, live)
+
         # preemption floor first: holding min_workers outranks policy timers
         if self.cfg.respawn and n_live < self.cfg.min_workers:
             return await self._scale_out(
@@ -569,6 +642,74 @@ class FleetController:
                 f"{total_window})")
         return None
 
+    async def _tick_roles(self, now: float, n_live: int,
+                          live: list) -> Optional[dict]:
+        """Role-aware decision pass for a disaggregated fleet: floors,
+        pressure and idleness are judged per role (a starved prefill tier
+        must not be masked by idle decode slots, and vice versa). Spawned
+        workers get the role overlaid on the template; the global
+        ``max_workers`` ceiling still binds across roles."""
+        in_cooldown = (self._last_action_t is not None
+                       and now - self._last_action_t < self.cfg.cooldown_s)
+
+        def _own(role: str) -> list:
+            return [w for w in live if getattr(w, "role", "both") == role]
+
+        # respawn floors first, in deterministic role order
+        for role, (lo, _hi) in sorted(self.cfg.roles.items()):
+            n_role = len(_own(role))
+            if self.cfg.respawn and n_role < lo:
+                return await self._scale_out(
+                    f"role '{role}' below floor ({n_role} < {lo}) after "
+                    f"departure", kind="respawn", role=role)
+
+        # pressure scale-out: judged over the workers that can SERVE the
+        # role ('both' members count for either side)
+        for role, (_lo, hi) in sorted(self.cfg.roles.items()):
+            capable = [w for w in live
+                       if getattr(w, "role", "both") in (role, "both")]
+            exhausted = bool(capable) and all(
+                not w.has_headroom() for w in capable)
+            min_drain = min((w.drain_s for w in capable), default=0.0)
+            queue_growth = bool(capable) and min_drain > self.cfg.drain_high_s
+            tr = self._role_pressure.setdefault(role, _Sustain())
+            p_for = tr.observe(exhausted or queue_growth, now)
+            if p_for < self.cfg.scale_out_sustain_s or in_cooldown:
+                continue
+            if len(_own(role)) >= hi or n_live >= self.cfg.max_workers:
+                self._event(
+                    "scale_out_capped",
+                    f"role '{role}' pressure sustained {p_for:.1f}s but at "
+                    f"role max ({hi}) or fleet max ({self.cfg.max_workers})")
+                tr.since = now  # re-arm, don't spam the log
+                return None
+            why = ("window exhaustion" if exhausted else
+                   f"queue-wait growth (min drain_s {min_drain:.2f} > "
+                   f"{self.cfg.drain_high_s})")
+            return await self._scale_out(
+                f"role '{role}': {why} sustained {p_for:.1f}s "
+                f">= {self.cfg.scale_out_sustain_s:.1f}s", role=role)
+
+        # idle scale-in, per role, above each role's floor
+        for role, (lo, _hi) in sorted(self.cfg.roles.items()):
+            own = _own(role)
+            if not own:
+                continue
+            total_window = sum(w.window for w in own)
+            total_inflight = sum(w.inflight for w in own)
+            idle = (total_inflight <= self.cfg.idle_frac * total_window
+                    and all(w.drain_s <= self.cfg.drain_high_s for w in own))
+            tr = self._role_idle.setdefault(role, _Sustain())
+            i_for = tr.observe(idle, now)
+            if (i_for >= self.cfg.scale_in_sustain_s
+                    and len(own) > lo and not in_cooldown):
+                return await self._scale_in(
+                    f"role '{role}' headroom sustained {i_for:.1f}s >= "
+                    f"{self.cfg.scale_in_sustain_s:.1f}s (inflight "
+                    f"{total_inflight} <= {self.cfg.idle_frac} * window "
+                    f"{total_window})", candidates=own)
+        return None
+
     async def _note_departures(self) -> None:
         """Count workers newly seen dead (missed heartbeats flip them via
         the dispatcher's staleness check; a crashed child also shows here)
@@ -594,7 +735,8 @@ class FleetController:
                 self._known_dead.discard(url)
 
     async def _scale_out(self, reason: str, *,
-                         kind: str = "scale_out") -> Optional[dict]:
+                         kind: str = "scale_out",
+                         role: Optional[str] = None) -> Optional[dict]:
         if self.spawner is None:
             self._event(f"{kind}_skipped", f"{reason}; no spawner/template "
                         "configured")
@@ -602,7 +744,12 @@ class FleetController:
             return None
         shapes = self.incumbent_shapes()
         try:
-            url = await self.spawner.spawn(shapes)
+            # role passed only when set: role-blind spawners (tests, older
+            # embedders) keep their (shapes)-only signature
+            if role is not None:
+                url = await self.spawner.spawn(shapes, role=role)
+            else:
+                url = await self.spawner.spawn(shapes)
         except Exception as e:
             self._event(f"{kind}_failed", f"{reason}; spawn failed: "
                         f"{type(e).__name__}: {e}")
@@ -648,8 +795,9 @@ class FleetController:
                 return False
             await asyncio.sleep(min(0.25, self.cfg.interval_s))
 
-    async def _scale_in(self, reason: str) -> Optional[dict]:
-        live = self._live()
+    async def _scale_in(self, reason: str,
+                        candidates: Optional[list] = None) -> Optional[dict]:
+        live = candidates if candidates is not None else self._live()
         # least-loaded: fewest outstanding dispatches, then smallest drain
         # estimate; prefer retiring our own spawns over static members (the
         # yaml fleet is the operator's floor topology)

@@ -36,7 +36,7 @@ import logging
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Mapping, Optional
 
 import jax
 import jax.numpy as jnp
@@ -61,6 +61,18 @@ class _Request:
     max_new_tokens: int
     future: asyncio.Future
     tokens: list[int] = field(default_factory=list)
+    #: wall-clock (monotonic) submit stamp for the TTFT histogram
+    submitted_at: float = 0.0
+    #: set once the first decoded token has been observed for this request
+    ttft_stamped: bool = False
+    #: disaggregated serving: stop after prefill and resolve the future
+    #: with a KV-page export instead of decoding locally
+    prefill_only: bool = False
+    #: disaggregated serving: a received KV-page export to adopt instead
+    #: of prefilling (the decode half of a prefill/decode split)
+    adopt: Optional[dict] = None
+    #: export payload built by ``_export_and_finish`` (prefill_only path)
+    export: Optional[dict] = None
 
 
 @dataclass
@@ -344,6 +356,18 @@ class GenerationServer:
             "1 when the paged flash-attention kernel serves decode/chunk "
             "(0 = dense gather reference)", {"model": name})
         self.m_kernel_paged.set(1 if self.decode_kernel == "paged" else 0)
+        # time-to-first-token: the latency-bound regime's headline metric —
+        # stamped once per request at its first decoded token (or at page
+        # export on a prefill-role worker, where the first token ships with
+        # the pages); adopted requests arrive already stamped upstream
+        self.m_ttft = reg.histogram(
+            "arkflow_gen_ttft_seconds",
+            "submit-to-first-decoded-token latency per request",
+            {"model": name})
+        #: per-server TTFT reservoir behind health_report() percentiles
+        #: (m_ttft is registry-global and would mix servers in-process)
+        self._ttft_samples: deque[float] = deque(maxlen=2048)
+        self._ttft_count = 0
         #: device-step in-flight count + last-all-complete stamp behind the
         #: idle-gap histogram (mirrors the runner's _track_dispatch/_complete)
         self._gen_inflight = 0
@@ -566,6 +590,15 @@ class GenerationServer:
             "capacity_pages": self.prefix_cache_pages,
         }
         rep["tokens_per_sec"] = round(float(self.m_tps.value), 1)
+        if self._ttft_count:
+            ordered = sorted(self._ttft_samples)
+
+            def _pct(q: float) -> float:
+                i = min(len(ordered) - 1, int(q * len(ordered)))
+                return round(ordered[i] * 1000.0, 3)
+
+            rep["ttft"] = {"count": self._ttft_count,
+                           "p50_ms": _pct(0.50), "p99_ms": _pct(0.99)}
         if self.mesh is not None:
             from arkflow_tpu.parallel.mesh import tp_size
 
@@ -647,7 +680,94 @@ class GenerationServer:
                 f"prompt({len(prompt_ids)}) + max_new({max_new_tokens}) exceeds "
                 f"max_seq={self.max_seq}")
         req = _Request(list(prompt_ids), max_new_tokens,
-                       asyncio.get_running_loop().create_future())
+                       asyncio.get_running_loop().create_future(),
+                       submitted_at=time.monotonic())
+        self._pending.append(req)
+        self.m_waiting.set(len(self._pending))
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.create_task(self._serve_loop())
+        return await req.future
+
+    async def prefill_export(self, prompt_ids: list[int],
+                             max_new_tokens: int = 64) -> dict:
+        """Disaggregated prefill: run (chunked) prefill for one prompt, then
+        stop and resolve with a KV-page export instead of decoding — the
+        prefill half of a prefill/decode role split.
+
+        The export carries the prompt's KV pages as host numpy slabs, split
+        one-per-tp-shard along the kv_heads axis so a host-mesh receiver can
+        frame each shard separately, plus the first decoded token (prefill
+        produces it for free). When generation is already complete at the
+        first token (EOS, or ``max_new_tokens <= 1``) the export is marked
+        ``done`` and ships no pages. Pages are unreffed (and donated to the
+        prefix cache) locally once exported — the scratch pool recycles.
+        """
+        if self._closed:
+            raise ConfigError("generation server is closed")
+        if len(prompt_ids) == 0:
+            return {"done": True, "tokens": [], "prompt": [],
+                    "max_new_tokens": int(max_new_tokens)}
+        if len(prompt_ids) + max_new_tokens > self.max_seq:
+            raise ConfigError(
+                f"prompt({len(prompt_ids)}) + max_new({max_new_tokens}) exceeds "
+                f"max_seq={self.max_seq}")
+        req = _Request(list(prompt_ids), max_new_tokens,
+                       asyncio.get_running_loop().create_future(),
+                       submitted_at=time.monotonic(), prefill_only=True)
+        self._pending.append(req)
+        self.m_waiting.set(len(self._pending))
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.create_task(self._serve_loop())
+        return await req.future
+
+    async def generate_from_pages(self, export: Mapping) -> list[int]:
+        """Disaggregated decode: adopt a KV-page export produced by a
+        prefill worker's :meth:`prefill_export` and decode to completion.
+
+        Fresh pages are reserved from this server's pool and the slabs are
+        uploaded through the same ``.at[pages].set`` path prefill writes
+        through (re-sharded to the pool's kv io sharding under a mesh), so
+        the paged kernel decodes from them with no relayout — the page
+        table it is handed just points at the adopted pages. Returns the
+        full token list including the shipped first token, exactly what
+        :meth:`generate` would have returned locally."""
+        if self._closed:
+            raise ConfigError("generation server is closed")
+        if export.get("done"):
+            return [int(t) for t in export.get("tokens") or []]
+        prompt = [int(t) for t in export["prompt"]]
+        max_new = int(export["max_new_tokens"])
+        if not prompt:
+            return []
+        if len(prompt) + max_new > self.max_seq:
+            raise ConfigError(
+                f"adopted prompt({len(prompt)}) + max_new({max_new}) exceeds "
+                f"max_seq={self.max_seq}")
+        if int(export["page_size"]) != self.page_size:
+            raise ConfigError(
+                f"adopted pages have page_size={export['page_size']}, "
+                f"pool uses {self.page_size} (geometry must match end to end)")
+        k_shards = export["k"]
+        slab_shape = tuple(k_shards[0].shape)
+        pool_shape = tuple(self.k_pages.shape)
+        kv_total = sum(int(s.shape[3]) for s in k_shards)
+        expect = (pool_shape[0], self._pages_needed(len(prompt)),
+                  pool_shape[2], pool_shape[3], pool_shape[4])
+        if (slab_shape[0], slab_shape[1], slab_shape[2], kv_total,
+                slab_shape[4]) != expect:
+            raise ConfigError(
+                f"adopted page slabs {slab_shape} x{len(k_shards)} shards do "
+                f"not match pool geometry {pool_shape} for a "
+                f"{len(prompt)}-token prompt")
+        first = int(export["first_token"])
+        req = _Request(prompt, max_new,
+                       asyncio.get_running_loop().create_future(),
+                       tokens=[first], submitted_at=time.monotonic(),
+                       ttft_stamped=True, adopt=dict(export))
+        if first == self.eos_id or max_new <= 1:
+            # complete at the first token: nothing to decode, don't touch
+            # the pool (mirrors _handle_token's EOS/budget handling)
+            return [] if first == self.eos_id else [first]
         self._pending.append(req)
         self.m_waiting.set(len(self._pending))
         if self._loop_task is None or self._loop_task.done():
@@ -759,7 +879,10 @@ class GenerationServer:
         effects (no cache eviction, no metric counts) — a head-of-line
         stall must not wipe the cache's future savings."""
         n = len(req.prompt)
-        key = self._lookup_prefix(req.prompt)
+        # adopted page sets upload the FULL prompt KV: aliasing cached
+        # prefix pages would scatter the upload into shared pages — fresh
+        # pages only (the finished request still donates to the cache)
+        key = None if req.adopt is not None else self._lookup_prefix(req.prompt)
         shared = list(self._prefix_cache[key]) if key is not None else []
         fresh_needed = self._pages_needed(n + 1) - len(shared)
         if len(self._free_pages) + self._evictable_pages(key) < fresh_needed:
@@ -800,6 +923,9 @@ class GenerationServer:
         self._slot_req[slot] = req
         n = len(req.prompt)
         self._slot_pages[slot] = pages
+        if req.adopt is not None:
+            await self._admit_adopted(slot, req)
+            return
         if shared_len > 0:
             self.m_prefix_hits.inc()
             self.m_prefix_pages.inc(shared_len // self.page_size)
@@ -831,13 +957,58 @@ class GenerationServer:
                 kp, vp, sub))
         self._lengths[slot] = n
         self._cur_tokens[slot] = int(nxt[0])
+        if req.prefill_only:
+            await self._export_and_finish(slot)
+            return
         self._handle_token(slot, int(nxt[0]))
+
+    async def _admit_adopted(self, slot: int, req: _Request) -> None:
+        """Seed the slot from a received KV-page export: upload the slabs
+        into this pool's reserved pages and join decode directly — no
+        prefill compute. The first token rode in with the pages."""
+        exp = req.adopt
+        n = len(req.prompt)
+        pages = self._slot_pages[slot]
+        idx = np.asarray(pages[: self._pages_needed(n)], np.int32)
+        k_slab = np.concatenate([np.asarray(s) for s in exp["k"]], axis=3)
+        v_slab = np.concatenate([np.asarray(s) for s in exp["v"]], axis=3)
+
+        def upload(kp=self.k_pages, vp=self.v_pages):
+            k = jnp.asarray(k_slab).astype(kp.dtype)
+            v = jnp.asarray(v_slab).astype(vp.dtype)
+            kp = kp.at[:, jnp.asarray(idx)].set(k)
+            vp = vp.at[:, jnp.asarray(idx)].set(v)
+            if self._kv_io_sharding is not None:
+                kp = jax.device_put(kp, self._kv_io_sharding)
+                vp = jax.device_put(vp, self._kv_io_sharding)
+            return jax.block_until_ready(kp), jax.block_until_ready(vp)
+
+        self.k_pages, self.v_pages = (
+            await asyncio.get_running_loop().run_in_executor(None, upload))
+        # drop the heavy slabs now that they're on device
+        req.adopt = None
+        self._lengths[slot] = n
+        self._cur_tokens[slot] = int(exp["first_token"])
+        # the first token is pre-seeded in req.tokens (counted on the
+        # prefill side); the slot decodes from position n next step
+
+    def _stamp_ttft(self, req: _Request) -> None:
+        """First decoded token for this request: record TTFT exactly once
+        (EOS-as-first-token still counts — the model answered)."""
+        if req.ttft_stamped or req.submitted_at <= 0.0:
+            return
+        req.ttft_stamped = True
+        dt = time.monotonic() - req.submitted_at
+        self.m_ttft.observe(dt)
+        self._ttft_samples.append(dt)
+        self._ttft_count += 1
 
     def _handle_token(self, slot: int, token: int) -> None:
         """Record one generated token; completes the request on EOS/limit."""
         req = self._slot_req[slot]
         if req is None:
             return
+        self._stamp_ttft(req)
         if token == self.eos_id:
             self._finish(slot)
             return
@@ -861,7 +1032,8 @@ class GenerationServer:
         self._lengths[slot] = 0
         self._cur_tokens[slot] = 0
         if req is not None and not req.future.done():
-            req.future.set_result(req.tokens)
+            req.future.set_result(
+                req.tokens if req.export is None else req.export)
 
     async def _prefill_one_chunk(self, slot: int) -> None:
         """One fixed-size prefill chunk for an admitting slot (one device
@@ -898,7 +1070,70 @@ class GenerationServer:
         nxt = select_token(logits, sub, self.temperature, self.top_k)
         self._lengths[slot] = n
         self._cur_tokens[slot] = int(nxt[0])
+        if req.prefill_only:
+            await self._export_and_finish(slot)
+            return
         self._handle_token(slot, int(nxt[0]))
+
+    async def _export_and_finish(self, slot: int) -> None:
+        """Prefill-only completion: fetch the prompt's KV pages to host,
+        attach the export to the request, and finish the slot (which still
+        donates the prompt pages to the prefix cache — repeat prefixes on
+        this prefill worker skip their shared span like any local request).
+
+        Only the pages covering prompt positions ``0..n-1`` ship: the page
+        holding position ``n`` (where the first decode step writes) may be
+        prefix-shared or unwritten, and the receiver allocates it fresh."""
+        req = self._slot_req[slot]
+        if req is None:
+            return
+        n = len(req.prompt)
+        first = int(self._cur_tokens[slot])
+        self._stamp_ttft(req)
+        done = first == self.eos_id or req.max_new_tokens <= 1
+        if not done:
+            req.tokens.append(first)
+            self.m_tokens.inc()
+            self._tokens_emitted += 1
+            pages = self._slot_pages[slot][: self._pages_needed(n)]
+            idx = jnp.asarray(np.asarray(pages, np.int32))
+            shards = 1
+            if self.mesh is not None:
+                from arkflow_tpu.parallel.mesh import tp_size
+
+                shards = tp_size(self.mesh)
+
+            def fetch(kp=self.k_pages, vp=self.v_pages):
+                return (np.asarray(jax.device_get(kp[:, idx])),
+                        np.asarray(jax.device_get(vp[:, idx])))
+
+            k_slab, v_slab = (
+                await asyncio.get_running_loop().run_in_executor(None, fetch))
+            req.export = {
+                "prompt": list(req.prompt),
+                "max_new_tokens": int(req.max_new_tokens),
+                "first_token": first,
+                "page_size": int(self.page_size),
+                "shards": int(shards),
+                "dtype": str(k_slab.dtype),
+                "tokens": [first],
+                # shard-per-frame along kv_heads (axis 3): each entry is
+                # exactly one tp shard's slab, framed separately on the wire
+                "k": np.split(k_slab, shards, axis=3),
+                "v": np.split(v_slab, shards, axis=3),
+            }
+        else:
+            req.export = {
+                "done": True,
+                "prompt": list(req.prompt),
+                "max_new_tokens": int(req.max_new_tokens),
+                "first_token": first,
+                "tokens": [] if first == self.eos_id else [first],
+            }
+            if first != self.eos_id:
+                self.m_tokens.inc()
+                self._tokens_emitted += 1
+        self._finish(slot)
 
     def _ensure_page_capacity(self, slot: int, total: Optional[int] = None) -> bool:
         """Grow the slot's page list to cover positions < ``total``

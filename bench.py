@@ -1183,7 +1183,7 @@ def _generate_tp_child() -> None:
             "batch_buckets": [8], "seq_buckets": [64],
             **_gen_kernel_cfg()}
 
-    def tps(cfg_map) -> float:
+    def tps(cfg_map) -> tuple[float, dict]:
         proc = build_component("processor", cfg_map, Resource())
         batch = MessageBatch.new_binary(
             [f"sensor event {i} nominal reading".encode() for i in range(rows)])
@@ -1195,10 +1195,11 @@ def _generate_tp_child() -> None:
             return time.perf_counter() - t0
 
         elapsed = asyncio.run(go())
-        return rows * max_new / elapsed if elapsed > 0 else 0.0
+        ttft = proc._server.health_report().get("ttft", {})
+        return (rows * max_new / elapsed if elapsed > 0 else 0.0), ttft
 
-    tps1 = tps(base)
-    tpsn = tps({**base, "mesh": {"tp": n}})
+    tps1, ttft1 = tps(base)
+    tpsn, ttftn = tps({**base, "mesh": {"tp": n}})
     eff = tpsn / (n * tps1) if tps1 > 0 else 0.0
     _emit({
         "metric": "generate_tp_scaling_efficiency",
@@ -1211,6 +1212,8 @@ def _generate_tp_child() -> None:
             "mesh": {"tp": n},
             "tokens_per_sec_1chip": round(tps1, 1),
             "tokens_per_sec_tp": round(tpsn, 1),
+            "ttft_p99_ms_1chip": ttft1.get("p99_ms", 0.0),
+            "ttft_p99_ms_tp": ttftn.get("p99_ms", 0.0),
             "rows": rows,
             "max_new_tokens": max_new,
             "serving": "continuous",
@@ -1295,10 +1298,11 @@ def _run_generate_depth_phase(tiny: bool, model_config: dict) -> None:
 
         elapsed, out = asyncio.run(go())
         texts = out[0].column(proc.output_field).to_pylist() if out else []
-        return rows * max_new / elapsed if elapsed > 0 else 0.0, rec, texts
+        ttft = proc._server.health_report().get("ttft", {})
+        return rows * max_new / elapsed if elapsed > 0 else 0.0, rec, texts, ttft
 
-    tps1, rec1, out1 = run(1)
-    tps2, rec2, out2 = run(2)
+    tps1, rec1, out1, ttft1 = run(1)
+    tps2, rec2, out2, ttft2 = run(2)
     _emit({
         "metric": "generate_dispatch_depth2_speedup",
         "value": round(tps2 / tps1, 4) if tps1 > 0 else 0.0,
@@ -1312,6 +1316,8 @@ def _run_generate_depth_phase(tiny: bool, model_config: dict) -> None:
             "device_idle_gap_p50_ms_depth2": round(rec2.pct(0.5) * 1e3, 3),
             "device_idle_gap_p99_ms_depth1": round(rec1.pct(0.99) * 1e3, 3),
             "device_idle_gap_p99_ms_depth2": round(rec2.pct(0.99) * 1e3, 3),
+            "ttft_p99_ms_depth1": ttft1.get("p99_ms", 0.0),
+            "ttft_p99_ms_depth2": ttft2.get("p99_ms", 0.0),
             # acceptance: pipelining must not change a single greedy token
             "identical_outputs": out1 == out2,
             **_gen_kernel_cfg(),
@@ -1327,8 +1333,10 @@ def _run_generate_bench(tiny: bool) -> None:
     A TP phase (1-chip vs tp=N on a forced host mesh) runs first unless
     BENCH_GEN_TP=0, then a dispatch-depth 1-vs-2 phase unless
     BENCH_GEN_DEPTH=0, so the headline metric stays tokens/sec. Every
-    phase detail records the decode kernel, dispatch depth, and the warm
-    device-idle-gap p50 so both PR-13 wins stay separately attributable."""
+    phase detail records the decode kernel, dispatch depth, the warm
+    device-idle-gap p50, and the server's TTFT percentiles
+    (``arkflow_gen_ttft_seconds``) so throughput wins never hide a
+    first-token latency regression."""
     from arkflow_tpu.batch import MessageBatch
     from arkflow_tpu.components import Resource, build_component, ensure_plugins_loaded
 
@@ -1383,6 +1391,12 @@ def _run_generate_bench(tiny: bool) -> None:
               "device_idle_gap_p50_ms": round(gap_rec.pct(0.5) * 1e3, 3),
               # knob record: generation serves unpacked at default precision
               "packing": False, "serving_dtype": "float32"}
+    # TTFT as the serving health report tells it (arkflow_gen_ttft_seconds):
+    # the latency half of the throughput/latency trade every knob above
+    # moves, and the headline the disagg topology optimises for.
+    ttft = server.health_report().get("ttft")
+    if ttft:
+        detail["ttft"] = ttft
     if server.m_spec_drafted.value > 0:
         detail["speculative_tokens"] = server.speculative_tokens
         detail["spec_acceptance"] = round(

@@ -101,9 +101,29 @@ multiples of the duplicate factor, a sharded ``queue_wait`` share below
 and shards timeshare — the verdict records the honest ratio and gates on
 the invariants instead, like the multichip bench's forced host mesh).
 
+``--disagg`` soaks prefill/decode disaggregation on the cluster plane
+(runtime/cluster.py + tpu/serving.py): a mixed-length generation load
+serves co-hosted (2 ``both`` workers) and disaggregated (1 prefill + 1
+decode worker, KV pages streamed over ``kv_push``) at equal worker count,
+then prefix-affinity on the prefill sub-ring and a mid-stream decode
+SIGKILL::
+
+    python tools/chaos_soak.py --disagg --fast    # tier-1 smoke
+    python tools/chaos_soak.py --disagg --seed 3
+
+Disagg PASS means: the disaggregated topology beats co-hosted on BOTH
+worker-side TTFT p99 and tokens/sec when the host has >= 3 cores (on
+smaller hosts everything timeshares — the verdict records the honest
+ratios and gates on the invariants, hostshard-style), every KV page flowed
+cross-process (``kv_pushed`` == ``kv_adopted``, zero refusals counted as
+losses), duplicate prompts land on ONE prefill worker, and a decode worker
+SIGKILLed mid-stream loses nothing — in-flight requests nack through
+normal redelivery and re-prefill, offered == delivered + shed over
+distinct rows, and the restarted decode worker adopts pages again.
+
 Runs on the virtual-CPU JAX platform by default (no TPU needed; ``--burst``
-never imports jax at all, and ``--cluster``/``--preempt`` parent processes
-don't either — only their worker subprocesses); set ARKFLOW_SOAK_KEEP_ENV=1
+never imports jax at all, and ``--cluster``/``--preempt``/``--disagg``
+parent processes don't either — only their worker subprocesses); set ARKFLOW_SOAK_KEEP_ENV=1
 to target whatever backend the environment provides.
 """
 
@@ -1584,6 +1604,416 @@ def run_cluster_soak(seconds: float = 60.0, seed: int = 7,
     return _attach_tracing(verdict, trace_seq0, trace_forced0)
 
 
+# -- prefill/decode disaggregation soak (runtime/cluster.py + serving) --------
+
+
+def _disagg_worker_config(role: str, seed: int) -> dict:
+    """Role-tuned continuous-generation worker config. The point of the
+    split IS the per-role tuning a co-hosted worker can't have: the
+    prefill worker runs chunked prefill against a scratch pool (no decode
+    slots to starve), the decode worker runs a wide slot grid (no prefill
+    compute stealing its steps), and the ``both`` worker carries the
+    compromise grid co-hosting forces."""
+    gen: dict = {
+        "type": "tpu_generate",
+        "model": "decoder_lm",
+        "model_config": {"vocab_size": 512, "dim": 64, "layers": 2,
+                         "heads": 4, "kv_heads": 2, "ffn": 96,
+                         "max_seq": 160},
+        "serving": "continuous",
+        "max_input": 96,
+        "max_new_tokens": 24,
+        "eos_id": -1,          # never emitted: fixed tokens per request,
+        "seed": seed,          # so tokens/s compares apples to apples
+        "page_size": 8,
+        "seq_buckets": [32, 96],
+        "prefill_chunk": 32,   # same chunking everywhere: the comparison
+    }                          # measures the topology, not the kernel
+    if role == "prefill":
+        gen.update({"slots": 4, "prefix_cache_pages": 64})
+        mif = 6
+    elif role == "decode":
+        gen.update({"slots": 12})
+        mif = 12
+    else:
+        gen.update({"slots": 6, "prefix_cache_pages": 64})
+        mif = 6
+    return {"worker": {"max_in_flight": mif, "role": role},
+            "processors": [gen]}
+
+
+def _disagg_ingest_config(name: str, urls: list[str], payloads: list[str],
+                          *, route_key: str = "fingerprint",
+                          threads: int = 8, redeliver_seed=None) -> dict:
+    """Ingest-tier stream for the disagg soak: memory source ->
+    ``remote_tpu`` two-hop dispatch -> collect. Prefix routing keeps the
+    affinity phase honest; the perf phases route by fingerprint so both
+    topologies see a balanced spread."""
+    input_cfg: dict = {"type": "memory", "messages": payloads}
+    if redeliver_seed is not None:
+        input_cfg = {
+            "type": "fault",
+            "seed": redeliver_seed,
+            "redeliver_unacked": True,
+            "inner": input_cfg,
+            "faults": [{"kind": "latency", "every": 7, "times": 0,
+                        "duration": "1ms"}],
+        }
+    return {
+        "name": name,
+        "input": input_cfg,
+        "pipeline": {
+            "thread_num": threads,
+            "max_delivery_attempts": 8,
+            "processors": [{
+                "type": "remote_tpu",
+                "name": name,
+                "workers": urls,
+                "route_key": route_key,
+                "prefix_bytes": 32,
+                "decode_candidates": 2,
+                "heartbeat": "250ms",
+                "connect_timeout": "2s",
+                "request_timeout": "60s",
+            }],
+        },
+        "output": {"type": "drop"},
+        "error_output": {"type": "drop"},
+    }
+
+
+def run_disagg_soak(seconds: float = 90.0, seed: int = 7,
+                    fast: bool = False) -> dict:
+    """Prefill/decode disaggregation soak (runtime/cluster.py +
+    tpu/serving.py): real continuous-generation worker processes, proving
+
+    - **the double win**: a mixed long-prompt/long-generation load serves
+      co-hosted (2 ``both`` workers) then disaggregated (1 prefill + 1
+      decode at the SAME worker count, KV pages streamed over ``kv_push``);
+      disagg must beat co-hosted on BOTH worker-side TTFT p99 and
+      tokens/sec. The ratio assertion is gated on >= 3 host cores
+      (hostshard-style: on smaller hosts the processes timeshare and the
+      verdict records the honest ratios behind soft floors);
+    - **prefill-ring affinity**: with 2 prefill workers on the ring,
+      duplicate prompts under prefix routing all land on ONE prefill
+      worker (prefix-cache affinity survives the role split verbatim);
+    - **decode-kill chaos**: the decode worker is SIGKILLed mid-stream;
+      in-flight requests nack through normal redelivery and re-prefill,
+      offered == delivered + shed over distinct rows (zero silent loss),
+      and the restarted decode worker registers and adopts pages again.
+
+    The parent process never imports jax — only the worker subprocesses do.
+    """
+    trace_seq0, trace_forced0 = _tracing_watermark()
+    import asyncio
+    import os
+    import socket as socket_mod
+    import subprocess
+    import tempfile
+
+    import yaml
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import build_stream
+    from arkflow_tpu.runtime.cluster import ClusterDispatcher
+    from arkflow_tpu.utils.cleanenv import pin_cpu_env, strip_axon_pythonpath
+
+    ensure_plugins_loaded()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cores = os.cpu_count() or 1
+    cores_ok = cores >= 3          # parent + the 2 measured workers
+    n_mix = 18 if fast else 48     # perf phases: mixed-length requests
+    k_dup = 6 if fast else 16      # affinity phase duplicates
+    n_chaos = 16 if fast else 64   # chaos phase messages
+    max_new = 24                   # fixed decode budget per request
+    startup_budget = 300.0
+
+    def free_port() -> int:
+        s = socket_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="arkflow-disagg-soak-")
+    roles = ["both", "both", "prefill", "prefill", "decode"]
+    names = ["both0", "both1", "pre0", "pre1", "dec0"]
+    cfg_paths = []
+    for name, role in zip(names, roles):
+        path = os.path.join(tmp, f"{name}.yaml")
+        with open(path, "w") as f:
+            yaml.safe_dump(_disagg_worker_config(role, seed), f)
+        cfg_paths.append(path)
+    ports = [free_port() for _ in names]
+    urls = {n: f"arkflow://127.0.0.1:{p}" for n, p in zip(names, ports)}
+
+    def spawn(i: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        strip_axon_pythonpath(env)
+        pin_cpu_env(env, n_devices=1)
+        return subprocess.Popen(
+            [sys.executable, "-m", "arkflow_tpu", "--cluster-worker",
+             "--config", cfg_paths[i], "--host", "127.0.0.1",
+             "--port", str(ports[i]), "--worker-id", f"disagg-{names[i]}"],
+            cwd=repo_root, env=env,
+            stdout=open(os.path.join(tmp, f"{names[i]}.log"), "ab"),
+            stderr=subprocess.STDOUT)
+
+    async def wait_ready(wait_urls: list[str], budget_s: float) -> None:
+        probe = ClusterDispatcher(wait_urls, name="disagg-soak-probe",
+                                  heartbeat_s=999.0, connect_timeout_s=1.0)
+        deadline = time.monotonic() + budget_s
+        while True:
+            await asyncio.gather(
+                *(probe._probe(w) for w in probe.workers.values()),
+                return_exceptions=True)
+            if all(w.alive for w in probe.workers.values()):
+                return
+            if time.monotonic() >= deadline:
+                down = [w.url for w in probe.workers.values() if not w.alive]
+                raise RuntimeError(
+                    f"disagg workers not ready within {budget_s:.0f}s: "
+                    f"{down} (see {tmp}/*.log)")
+            await asyncio.sleep(0.5)
+
+    async def heartbeat(url: str) -> dict:
+        probe = ClusterDispatcher([url], name="disagg-soak-probe",
+                                  heartbeat_s=999.0, connect_timeout_s=1.0)
+        return await probe._unary(probe.workers[url],
+                                  {"action": "heartbeat"})
+
+    def hb(url: str) -> dict:
+        return asyncio.run(heartbeat(url))
+
+    class _Collect(DropOutput):
+        def __init__(self, sink: list):
+            self._sink = sink
+            self.t_first = None
+            self.t_last = None
+
+        async def write(self, batch: MessageBatch) -> None:
+            now = time.monotonic()
+            if self.t_first is None:
+                self.t_first = now
+            self.t_last = now
+            self._sink.extend(batch.to_binary())
+
+    def run_phase(cfg_map: dict, budget_s: float, driver=None) -> dict:
+        stream = build_stream(StreamConfig.from_mapping(cfg_map))
+        delivered: list[bytes] = []
+        shed: list[bytes] = []
+        out_sink, err_sink = _Collect(delivered), _Collect(shed)
+        stream.output = out_sink
+        stream.error_output = err_sink
+        out: dict = {"delivered": delivered, "shed": shed, "stream": stream,
+                     "out_sink": out_sink}
+
+        async def bounded() -> None:
+            cancel = asyncio.Event()
+            task = asyncio.create_task(stream.run(cancel))
+            driver_task = (asyncio.create_task(driver(stream, delivered))
+                           if driver is not None else None)
+            t0 = time.monotonic()
+            done, _ = await asyncio.wait({task}, timeout=budget_s)
+            out["elapsed_s"] = time.monotonic() - t0
+            out["wedged"] = not done
+            if done:
+                task.result()
+            else:
+                cancel.set()
+                try:
+                    await asyncio.wait_for(task, timeout=15.0)
+                except (asyncio.TimeoutError, Exception):
+                    task.cancel()
+            if driver_task is not None:
+                try:
+                    await asyncio.wait_for(driver_task, timeout=5.0)
+                except (asyncio.TimeoutError, Exception):
+                    driver_task.cancel()
+
+        asyncio.run(bounded())
+        return out
+
+    def rows_per_s(phase: dict) -> float:
+        sink = phase["out_sink"]
+        if sink.t_first is None:
+            return 0.0
+        return len(phase["delivered"]) / max(
+            sink.t_last - sink.t_first, 0.05)
+
+    # mixed-length load: 1/3 long prompts (prefill-heavy), 2/3 short
+    # (latency-bound) — the regime role specialization is for
+    def mixed(tag: str, n: int) -> list[str]:
+        out = []
+        for i in range(n):
+            if i % 3 == 0:
+                out.append(f"{tag} {i:05d} " + "gamma delta " * 40)
+            else:
+                out.append(f"{tag} {i:05d} quick probe")
+        return out
+
+    procs: dict = {n: None for n in names}
+    verdict: dict = {"mode": "disagg", "seed": seed, "host_cores": cores,
+                     "cores_ok": cores_ok, "max_new_tokens": max_new}
+    t_start = time.monotonic()
+    budget = max(seconds, 120.0)
+    try:
+        for i in range(len(names)):
+            procs[names[i]] = spawn(i)
+        asyncio.run(wait_ready(list(urls.values()), startup_budget))
+        verdict["startup_s"] = round(time.monotonic() - t_start, 3)
+
+        # -- phase 1: co-hosted baseline (2 'both' workers) ----------------
+        co = run_phase(_disagg_ingest_config(
+            "disagg-soak-co", [urls["both0"], urls["both1"]],
+            mixed("co", n_mix)), budget)
+        co_hb = [hb(urls["both0"]), hb(urls["both1"])]
+        co_ttft = max(float(h.get("ttft_p99_ms", 0.0) or 0.0)
+                      for h in co_hb)
+        # the both workers are done: free their cores before measuring
+        # the disagg wave (equal worker count = equal live processes)
+        for n in ("both0", "both1"):
+            procs[n].kill()
+            procs[n].wait()
+
+        # -- phase 2: disaggregated, equal worker count (1 pre + 1 dec) ----
+        di = run_phase(_disagg_ingest_config(
+            "disagg-soak-di", [urls["pre0"], urls["dec0"]],
+            mixed("di", n_mix)), budget)
+        pre_hb, dec_hb = hb(urls["pre0"]), hb(urls["dec0"])
+        di_ttft = float(pre_hb.get("ttft_p99_ms", 0.0) or 0.0)
+        co_rows, di_rows = rows_per_s(co), rows_per_s(di)
+        ttft_ratio = co_ttft / max(di_ttft, 1e-9)
+        tput_ratio = di_rows / max(co_rows, 1e-9)
+        # the ratio floors bind only when the host can actually run the
+        # tiers in parallel; soft floors keep degraded hosts honest
+        ttft_floor, tput_floor = (1.0, 1.0) if cores_ok else (0.2, 0.2)
+        perf = {
+            "cohosted_ttft_p99_ms": round(co_ttft, 3),
+            "disagg_ttft_p99_ms": round(di_ttft, 3),
+            "ttft_ratio": round(ttft_ratio, 3),
+            "cohosted_tokens_per_s": round(co_rows * max_new, 2),
+            "disagg_tokens_per_s": round(di_rows * max_new, 2),
+            "tput_ratio": round(tput_ratio, 3),
+            "cohosted_delivered": len(co["delivered"]),
+            "disagg_delivered": len(di["delivered"]),
+            "kv_pushed": int(pre_hb.get("kv_pushed", 0)),
+            "kv_adopted": int(dec_hb.get("kv_adopted", 0)),
+            "ratio_gated_on_cores": not cores_ok,
+            "double_win": bool(ttft_ratio >= ttft_floor
+                               and tput_ratio >= tput_floor),
+        }
+        perf["pass"] = bool(not co["wedged"] and not di["wedged"]
+                            and len(co["delivered"]) == n_mix
+                            and len(di["delivered"]) == n_mix
+                            and co_ttft > 0.0 and di_ttft > 0.0
+                            # every request's pages flowed cross-process
+                            and perf["kv_pushed"] == n_mix
+                            and perf["kv_adopted"] == n_mix
+                            and perf["double_win"])
+        verdict["perf"] = perf
+
+        # -- phase 3: prefix affinity on the prefill sub-ring --------------
+        pre_urls = [urls["pre0"], urls["pre1"]]
+        before = {u: hb(u) for u in pre_urls}
+        aff = run_phase(_disagg_ingest_config(
+            "disagg-soak-aff", pre_urls + [urls["dec0"]],
+            ["affinity probe prompt"] * k_dup, route_key="prefix",
+            threads=2), budget)
+        after = {u: hb(u) for u in pre_urls}
+        served = {u: int(after[u].get("served", 0))
+                  - int(before[u].get("served", 0)) for u in pre_urls}
+        target = max(served, key=lambda u: served[u])
+        affinity = {
+            "delivered": len(aff["delivered"]),
+            "served_by_prefill_worker": served,
+            "one_prefill_took_all": (served[target] == k_dup and all(
+                served[u] == 0 for u in pre_urls if u != target)),
+        }
+        affinity["pass"] = bool(len(aff["delivered"]) == k_dup
+                                and affinity["one_prefill_took_all"])
+        verdict["affinity"] = affinity
+
+        # -- phase 4: decode worker SIGKILLed mid-stream -------------------
+        kill_at = max(2, n_chaos // 4)
+        chaos_events: dict = {"killed": False, "restarted": False}
+        dec_i = names.index("dec0")
+
+        async def chaos_driver(stream, delivered) -> None:
+            while len(delivered) < kill_at:
+                await asyncio.sleep(0.01)
+            procs["dec0"].kill()
+            procs["dec0"].wait()
+            chaos_events["killed"] = True
+            chaos_events["killed_at_delivered"] = len(delivered)
+            await asyncio.sleep(1.0)
+            procs["dec0"] = spawn(dec_i)  # same port, same identity
+            chaos_events["restarted"] = True
+
+        pay = [f"chaos row {i:05d} tick" for i in range(n_chaos)]
+        chaos = run_phase(_disagg_ingest_config(
+            "disagg-soak-chaos", [urls["pre0"], urls["dec0"]], pay,
+            redeliver_seed=seed), max(budget, 120.0), driver=chaos_driver)
+        expected = set(p.encode() for p in pay)
+        seen = set(chaos["delivered"]) | set(chaos["shed"])
+        lost = sorted(expected - seen)
+        chaos_out = {
+            **chaos_events,
+            "wedged": chaos["wedged"],
+            "offered_rows": n_chaos,
+            "delivered_rows": len(chaos["delivered"]),
+            "shed_rows": len(chaos["shed"]),
+            "lost_rows": len(lost),
+            # offered == delivered + shed over DISTINCT rows: redelivery
+            # may duplicate, nothing vanishes silently
+            "identity_ok": (len(lost) == 0
+                            and len(expected & set(chaos["delivered"]))
+                            + len(expected & set(chaos["shed"])
+                                  - set(chaos["delivered"])) == n_chaos),
+        }
+        if lost:
+            chaos_out["lost_sample"] = [x.decode() for x in lost[:5]]
+
+        # the decode worker must come back AND adopt pages again
+        revived = False
+        adopts_again = False
+        revive_error = None
+        try:
+            asyncio.run(wait_ready([urls["dec0"]], startup_budget))
+            post = run_phase(_disagg_ingest_config(
+                "disagg-soak-revive", [urls["pre0"], urls["dec0"]],
+                [f"revive row {i}" for i in range(3)], threads=1), budget)
+            revived = len(post["delivered"]) == 3
+            adopts_again = int(hb(urls["dec0"]).get("kv_adopted", 0)) >= 3
+        except Exception as e:
+            revive_error = f"{type(e).__name__}: {e}"
+        chaos_out["revived"] = revived
+        chaos_out["adopts_again"] = adopts_again
+        if revive_error:
+            chaos_out["revive_error"] = revive_error
+        chaos_out["pass"] = bool(not chaos["wedged"]
+                                 and chaos_out["identity_ok"]
+                                 and chaos_events["killed"]
+                                 and revived and adopts_again)
+        verdict["chaos"] = chaos_out
+
+        verdict["pass"] = bool(perf["pass"] and affinity["pass"]
+                               and chaos_out["pass"])
+    finally:
+        for p in procs.values():
+            if p is not None and p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+    verdict["elapsed_s"] = round(time.monotonic() - t_start, 3)
+    return _attach_tracing(verdict, trace_seq0, trace_forced0)
+
+
 # -- elastic-fleet preemption soak (runtime/fleet.py) -------------------------
 
 
@@ -2503,6 +2933,13 @@ def main(argv=None) -> int:
                          "stream; asserts >=1.7x aggregate rows/s, "
                          "cross-process duplicate cache affinity, and zero "
                          "silent loss across a worker kill/restart")
+    ap.add_argument("--disagg", action="store_true",
+                    help="prefill/decode disaggregation soak: role-split "
+                         "generation workers vs co-hosted at equal worker "
+                         "count on a mixed-length load; asserts the TTFT-p99 "
+                         "+ tokens/sec double win (core-count gated), "
+                         "prefix affinity on the prefill sub-ring, and zero "
+                         "silent loss through a mid-stream decode SIGKILL")
     ap.add_argument("--preempt", action="store_true",
                     help="elastic-fleet soak: 3 worker processes behind a "
                          "remote_tpu stream with the autoscaling controller "
@@ -2570,6 +3007,14 @@ def main(argv=None) -> int:
         # workers do (each pins its own virtual-CPU env)
         verdict = run_cluster_soak(seconds=args.seconds, seed=args.seed,
                                    fast=args.fast)
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["pass"] else 1
+
+    if args.disagg:
+        # like --cluster: the parent never imports jax — worker subprocesses
+        # get their own pinned virtual-CPU env from the soak itself
+        verdict = run_disagg_soak(seconds=args.seconds, seed=args.seed,
+                                  fast=args.fast)
         print(json.dumps(verdict, indent=2))
         return 0 if verdict["pass"] else 1
 
