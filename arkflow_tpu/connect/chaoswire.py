@@ -1,0 +1,320 @@
+"""Network chaos for the flight plane.
+
+Process death is the easy failure; the network fails weirder. This module
+injects the weird ones, deterministically, in two shapes:
+
+- ``ChaosWire`` — an in-process transport wrapper armed on the ingest
+  dispatcher (``ClusterDispatcher.chaos``). ``arm(kind)`` queues a fault;
+  the next flight connection the dispatcher opens is wrapped and the fault
+  fires on that connection's I/O. This is what the ``fault`` processor's
+  ``net_*`` kinds drive, so network faults are schedulable exactly like
+  ``hang``/``oom`` (seeded, ``at``/``every``/``rate`` triggers).
+
+- ``ChaosProxy`` — a frame-aware TCP proxy for soaks and integration
+  tests: it parses the ``[u32 len][payload]`` flight framing per direction,
+  so it can corrupt payload bytes without breaking the length header,
+  stall *mid-frame* (slow-loris: header + half the payload, then nothing),
+  or black-hole one direction (requests pass, responses vanish — the
+  canonical one-way partition that keeps a worker alive-but-unreachable).
+  Modes switch live (``proxy.mode = "blackhole"``) so a soak can partition
+  a worker mid-load and heal it later, against a real subprocess worker.
+
+Fault kinds (shared vocabulary with the ``fault`` plugin's ``net_*`` specs):
+
+- ``delay``     every I/O on the connection sleeps ``duration_s`` first
+- ``stall``     the first read stalls ``duration_s`` mid-frame (slow-loris)
+- ``blackhole`` reads never complete; writes succeed (one-way partition)
+- ``reset``     the first I/O raises ``ConnectionResetError`` (abrupt RST)
+- ``corrupt``   one seeded byte of the first payload read is flipped
+
+All randomness (corruption offsets, jitter) comes from one seeded RNG, so a
+given (seed, operation sequence) replays the same chaos.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+import struct
+from dataclasses import dataclass, field
+from typing import Optional
+
+from arkflow_tpu.connect.flight import CRC_BIT
+from arkflow_tpu.errors import ConfigError
+
+logger = logging.getLogger("arkflow.chaoswire")
+
+NET_KINDS = frozenset({"delay", "stall", "blackhole", "reset", "corrupt"})
+
+
+@dataclass
+class _NetFault:
+    kind: str
+    duration_s: float = 0.0
+    #: shared across the reader/writer halves so one-shot kinds (reset,
+    #: stall, corrupt) fire exactly once per connection
+    state: dict = field(default_factory=dict)
+
+    @property
+    def spent(self) -> bool:
+        return bool(self.state.get("spent"))
+
+    def spend(self) -> None:
+        self.state["spent"] = True
+
+
+class ChaosWire:
+    """Seeded in-process chaos transport. ``arm()`` queues faults; the next
+    ``wrap()`` (one flight connection) consumes everything queued."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+        self._pending: list[_NetFault] = []
+        #: total faults that actually fired, by kind — soaks assert on this
+        self.fired: dict[str, int] = {}
+
+    def arm(self, kind: str, *, duration_s: float = 0.0) -> None:
+        if kind not in NET_KINDS:
+            raise ConfigError(
+                f"chaoswire: unknown net fault kind {kind!r} "
+                f"(allowed: {sorted(NET_KINDS)})")
+        if kind in ("delay", "stall") and duration_s <= 0.0:
+            duration_s = 0.05
+        self._pending.append(_NetFault(kind, duration_s))
+
+    def pending(self) -> bool:
+        return bool(self._pending)
+
+    def _note_fired(self, kind: str) -> None:
+        self.fired[kind] = self.fired.get(kind, 0) + 1
+
+    def wrap(self, reader: asyncio.StreamReader,
+             writer: asyncio.StreamWriter):
+        """Wrap one (reader, writer) pair, consuming all armed faults."""
+        faults, self._pending = self._pending, []
+        if not faults:
+            return reader, writer
+        return (_ChaosReader(reader, faults, self._rng, self),
+                _ChaosWriter(writer, faults, self))
+
+
+class _ChaosReader:
+    def __init__(self, inner, faults, rng, owner: ChaosWire):
+        self._inner = inner
+        self._faults = faults
+        self._rng = rng
+        self._owner = owner
+
+    async def readexactly(self, n: int) -> bytes:
+        for f in self._faults:
+            if f.kind == "reset" and not f.spent:
+                f.spend()
+                self._owner._note_fired("reset")
+                raise ConnectionResetError("chaos: injected connection reset")
+            if f.kind == "blackhole":
+                self._owner._note_fired("blackhole")
+                # never completes; the caller's own I/O deadline is the only
+                # way out — exactly what a one-way partition looks like
+                await asyncio.Event().wait()
+            if f.kind == "delay":
+                self._owner._note_fired("delay")
+                await asyncio.sleep(f.duration_s)
+            if f.kind == "stall" and not f.spent:
+                f.spend()
+                self._owner._note_fired("stall")
+                await asyncio.sleep(f.duration_s)
+        data = await self._inner.readexactly(n)
+        for f in self._faults:
+            # corrupt payload reads only (n > 4): flipping length headers
+            # tests the max_frame guard, not integrity — aim at the bytes
+            # the crc trailer is supposed to protect
+            if f.kind == "corrupt" and not f.spent and n > 4:
+                f.spend()
+                self._owner._note_fired("corrupt")
+                buf = bytearray(data)
+                pos = self._rng.randrange(len(buf))
+                buf[pos] ^= 0xFF
+                data = bytes(buf)
+        return data
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _ChaosWriter:
+    def __init__(self, inner, faults, owner: ChaosWire):
+        self._inner = inner
+        self._faults = faults
+        self._owner = owner
+
+    def write(self, data) -> None:
+        for f in self._faults:
+            if f.kind == "reset" and not f.spent:
+                f.spend()
+                self._owner._note_fired("reset")
+                raise ConnectionResetError("chaos: injected connection reset")
+        self._inner.write(data)
+
+    async def drain(self) -> None:
+        for f in self._faults:
+            if f.kind == "delay":
+                await asyncio.sleep(f.duration_s)
+        await self._inner.drain()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class ChaosProxy:
+    """Frame-aware chaos TCP proxy: client ↔ proxy ↔ upstream worker.
+
+    ``mode`` is read per forwarded frame, so tests flip it mid-load:
+
+    - ``None``        transparent
+    - ``"delay"``     sleep ``delay_s`` before forwarding each frame
+    - ``"stall"``     forward header + half the payload, sleep ``stall_s``,
+                      then the rest (mid-frame slow-loris)
+    - ``"blackhole"`` drop worker→client frames; client→worker still flows
+                      (one-way partition: the worker stays alive and keeps
+                      accepting work, its answers never arrive)
+    - ``"reset"``     abort both directions on the next frame
+    - ``"corrupt"``   flip one seeded byte per payload, leave any crc32
+                      trailer untouched — the receiver must notice
+
+    ``only_actions`` (e.g. ``{"infer"}``) restricts faults to connections
+    whose first request frame names one of those actions; control traffic
+    (register/heartbeat) then passes clean.
+    """
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 host: str = "127.0.0.1", seed: int = 0,
+                 only_actions: Optional[set] = None):
+        self.upstream_host = upstream_host
+        self.upstream_port = int(upstream_port)
+        self.host = host
+        self.port = 0
+        self.mode: Optional[str] = None
+        self.delay_s = 0.05
+        self.stall_s = 5.0
+        self.only_actions = set(only_actions) if only_actions else None
+        self._rng = random.Random(seed)
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set = set()
+        self.frames_forwarded = 0
+        self.frames_dropped = 0
+        self.frames_corrupted = 0
+        self.conns_reset = 0
+
+    @property
+    def url(self) -> str:
+        return f"arkflow://{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("chaos proxy %s:%d -> %s:%d", self.host, self.port,
+                    self.upstream_host, self.upstream_port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 1.0)
+            except asyncio.TimeoutError:
+                pass
+        for w in list(self._conns):
+            try:
+                w.transport.abort()
+            except Exception:
+                pass
+
+    async def _serve(self, client_r, client_w) -> None:
+        try:
+            up_r, up_w = await asyncio.open_connection(
+                self.upstream_host, self.upstream_port)
+        except OSError:
+            client_w.close()
+            return
+        self._conns.update((client_w, up_w))
+        conn = {"faulted": self.only_actions is None, "action": None}
+
+        def _abort_both() -> None:
+            self.conns_reset += 1
+            for w in (client_w, up_w):
+                try:
+                    w.transport.abort()
+                except Exception:
+                    pass
+
+        async def pump(reader, writer, down: bool) -> None:
+            first = not down
+            try:
+                while True:
+                    hdr = await reader.readexactly(4)
+                    (word,) = struct.unpack(">I", hdr)
+                    n = word & ~CRC_BIT
+                    payload = await reader.readexactly(n) if n else b""
+                    trailer = (await reader.readexactly(4)
+                               if (word & CRC_BIT) and n else b"")
+                    if first:
+                        first = False
+                        self._sniff_action(conn, payload)
+                    mode = self.mode if conn["faulted"] else None
+                    if mode == "reset":
+                        _abort_both()
+                        return
+                    if mode == "blackhole" and down:
+                        self.frames_dropped += 1
+                        continue
+                    if mode == "delay":
+                        await asyncio.sleep(self.delay_s)
+                    if mode == "corrupt" and n > 0:
+                        buf = bytearray(payload)
+                        buf[self._rng.randrange(len(buf))] ^= 0xFF
+                        payload = bytes(buf)
+                        self.frames_corrupted += 1
+                    if mode == "stall" and n > 1:
+                        writer.write(hdr + payload[:n // 2])
+                        await writer.drain()
+                        await asyncio.sleep(self.stall_s)
+                        writer.write(payload[n // 2:] + trailer)
+                    else:
+                        writer.write(hdr + payload + trailer)
+                    await writer.drain()
+                    self.frames_forwarded += 1
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                pass
+            finally:
+                # a black-holed direction hides the FIN too: if the worker
+                # closes after answering, propagating that close would hand
+                # the client a loud IncompleteReadError — a real one-way
+                # partition leaves it hanging into its own read deadline
+                swallow = (down and self.mode == "blackhole"
+                           and conn["faulted"])
+                if not swallow:
+                    try:
+                        writer.close()
+                    except Exception:
+                        pass
+
+        try:
+            await asyncio.gather(pump(client_r, up_w, down=False),
+                                 pump(up_r, client_w, down=True))
+        finally:
+            self._conns.difference_update((client_w, up_w))
+            for w in (client_w, up_w):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+
+    def _sniff_action(self, conn: dict, payload: bytes) -> None:
+        if self.only_actions is None:
+            return
+        try:
+            conn["action"] = json.loads(payload.decode()).get("action")
+        except Exception:
+            conn["action"] = None
+        conn["faulted"] = conn["action"] in self.only_actions

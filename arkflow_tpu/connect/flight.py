@@ -31,12 +31,14 @@ import base64
 import json
 import logging
 import struct
+import zlib
 from typing import AsyncIterator, Optional
 
 import pyarrow as pa
 
 from arkflow_tpu.batch import MessageBatch
-from arkflow_tpu.errors import ConfigError, ConnectError, ReadError
+from arkflow_tpu.errors import (ConfigError, ConnectError,
+                                FrameIntegrityError, ReadError)
 
 logger = logging.getLogger("arkflow.flight")
 
@@ -71,17 +73,40 @@ def ipc_to_batches(data) -> list[pa.RecordBatch]:
 #: inputs' ``max_frame`` config key, or ``--max-frame`` on the CLI.
 DEFAULT_MAX_FRAME = 1 << 30
 
+#: Frame-integrity bit. Frame lengths are capped at 1 GiB (2**30), so the
+#: top bit of the u32 length header is free to mark a frame that carries a
+#: 4-byte crc32 trailer after the payload. The bit makes integrity
+#: self-describing per frame: readers verify whenever the bit is set and
+#: need no out-of-band negotiation, while writers only set it for peers
+#: that advertised the capability at ``register`` — an old reader facing a
+#: crc frame fails loudly on the oversized length rather than silently
+#: mis-parsing, and an old writer's plain frames pass through unchanged.
+CRC_BIT = 1 << 31
 
-async def _send_frame(writer: asyncio.StreamWriter, payload) -> None:
+
+def _crc32(payload) -> int:
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        return zlib.crc32(payload)
+    return zlib.crc32(memoryview(payload))
+
+
+async def _send_frame(writer: asyncio.StreamWriter, payload,
+                      crc: bool = False) -> None:
     """Write one length-prefixed frame. ``payload`` may be ``bytes`` or any
     buffer-protocol object (``pa.Buffer`` from ``batch_to_ipc`` rides
-    through untouched — the only copy is the kernel's)."""
+    through untouched — the only copy is the kernel's). With ``crc`` the
+    frame carries a crc32 trailer and sets ``CRC_BIT`` in the header."""
     if isinstance(payload, (bytes, bytearray)):
-        writer.write(struct.pack(">I", len(payload)) + payload)
+        n = len(payload)
+        hdr = struct.pack(">I", n | CRC_BIT) if crc else struct.pack(">I", n)
+        writer.write(hdr + payload)
     else:
         view = memoryview(payload)
-        writer.write(struct.pack(">I", view.nbytes))
+        n = view.nbytes
+        writer.write(struct.pack(">I", n | CRC_BIT) if crc else struct.pack(">I", n))
         writer.write(view)
+    if crc:
+        writer.write(struct.pack(">I", _crc32(payload)))
     await writer.drain()
 
 
@@ -94,45 +119,89 @@ ERROR_TAG = b"\x01"
 TRACE_TAG = b"\x02"
 
 
-async def _send_data(writer: asyncio.StreamWriter, payload) -> None:
+async def _send_data(writer: asyncio.StreamWriter, payload,
+                     crc: bool = False) -> None:
     """One tagged data frame; like ``_send_frame``, the payload may be a
     buffer-protocol object — tag and length go out as one small header
-    write, the Arrow buffer follows without an intermediate concat copy."""
+    write, the Arrow buffer follows without an intermediate concat copy.
+    The crc32 trailer covers tag + payload."""
     if isinstance(payload, (bytes, bytearray)):
-        writer.write(struct.pack(">I", len(payload) + 1) + DATA_TAG + payload)
+        n = len(payload) + 1
+        hdr = struct.pack(">I", n | CRC_BIT) if crc else struct.pack(">I", n)
+        writer.write(hdr + DATA_TAG + payload)
     else:
         view = memoryview(payload)
-        writer.write(struct.pack(">I", view.nbytes + 1) + DATA_TAG)
+        n = view.nbytes + 1
+        writer.write((struct.pack(">I", n | CRC_BIT) if crc
+                      else struct.pack(">I", n)) + DATA_TAG)
         writer.write(view)
+    if crc:
+        writer.write(struct.pack(">I", zlib.crc32(
+            memoryview(payload), zlib.crc32(DATA_TAG))))
     await writer.drain()
 
 
-async def _send_stream_error(writer: asyncio.StreamWriter, err: str) -> None:
-    await _send_frame(writer, ERROR_TAG + json.dumps({"error": err}).encode())
+async def _send_stream_error(writer: asyncio.StreamWriter, err: str,
+                             crc: bool = False) -> None:
+    await _send_frame(writer, ERROR_TAG + json.dumps({"error": err}).encode(),
+                      crc=crc)
 
 
 async def _end_stream(writer: asyncio.StreamWriter) -> None:
+    # the zero-length end marker is always plain: there is no payload to
+    # protect, and old peers must keep recognising it
     writer.write(struct.pack(">I", 0))
     await writer.drain()
 
 
 async def _read_frame(reader: asyncio.StreamReader,
-                      limit: int = DEFAULT_MAX_FRAME) -> Optional[bytes]:
+                      limit: int = DEFAULT_MAX_FRAME,
+                      what: str = "flight") -> Optional[bytes]:
     """One length-prefixed frame, or None for the zero-length end marker.
 
     The length header is untrusted input: a frame above ``limit`` raises a
     loud ``ConnectError`` *before* any payload byte is buffered, on both the
-    client and worker sides (both read through here)."""
+    client and worker sides (both read through here).
+
+    Frames with ``CRC_BIT`` set carry a crc32 trailer; a mismatch raises a
+    ``FrameIntegrityError`` naming the frame class (``what``) — corruption
+    is loud, never silent garbage. Whether the peer spoke crc is recorded on
+    the reader as ``_arkflow_crc`` so servers can echo the negotiation."""
     hdr = await reader.readexactly(4)
-    (n,) = struct.unpack(">I", hdr)
+    (word,) = struct.unpack(">I", hdr)
+    has_crc = bool(word & CRC_BIT)
+    n = word & ~CRC_BIT
     if n == 0:
+        if has_crc:
+            # a crc-marked EMPTY frame is never sent (the end marker is
+            # always plain): this word is either corruption or an old peer
+            # announcing a >= 2 GiB length, which no cap admits
+            raise ConnectError(
+                f"flight frame header {word:#010x} is invalid: the end "
+                f"marker is never crc-marked, and read as a length it "
+                f"would exceed any max_frame cap (limit here: {limit} "
+                "bytes)")
         return None
     if n > limit:
         raise ConnectError(
             f"flight frame of {n} bytes exceeds the configured max_frame "
             f"cap of {limit} bytes (raise max_frame / --max-frame if this "
             "payload is legitimate)")
-    return await reader.readexactly(n)
+    payload = await reader.readexactly(n)
+    # record the negotiation BEFORE validating: the peer provably spoke crc
+    # the moment the bit is seen, and a server answering a corrupted request
+    # must protect its error reply too (else that reply is the one frame a
+    # corrupting link can silently garble)
+    reader._arkflow_crc = has_crc  # type: ignore[attr-defined]
+    if has_crc:
+        (want,) = struct.unpack(">I", await reader.readexactly(4))
+        got = zlib.crc32(payload)
+        if got != want:
+            raise FrameIntegrityError(
+                f"crc32 mismatch on {what} frame: {n}-byte payload hashed to "
+                f"{got:#010x}, peer sent {want:#010x} — frame corrupted in "
+                "transit, refusing to decode it")
+    return payload
 
 
 def parse_remote_url(url: str) -> tuple[str, int]:
@@ -405,8 +474,14 @@ class FlightClient:
             "action": "scan", "path": path, "format": fmt,
             "query": query, "batch_rows": batch_rows,
         })
-        async for rb in self._stream(reader, writer):
-            yield rb
+        try:
+            async for rb in self._stream(reader, writer):
+                yield rb
+        finally:
+            # _stream closes once STARTED; this also covers a caller that
+            # abandons the generator between _open and the first read —
+            # otherwise the socket leaks until GC (close() is idempotent)
+            writer.close()
 
     async def sqlite(self, path: str, query: str,
                      batch_rows: int = 8192) -> AsyncIterator[pa.RecordBatch]:
@@ -415,8 +490,11 @@ class FlightClient:
             "action": "sqlite", "path": path, "query": query,
             "batch_rows": batch_rows,
         })
-        async for rb in self._stream(reader, writer):
-            yield rb
+        try:
+            async for rb in self._stream(reader, writer):
+                yield rb
+        finally:
+            writer.close()  # see scan(): covers the never-started path
 
     async def query(self, sql: str,
                     tables: Optional[dict[str, MessageBatch]] = None) -> MessageBatch:
@@ -427,5 +505,8 @@ class FlightClient:
         }
         reader, writer = await self._open(
             {"action": "query", "sql": sql, "tables": enc})
-        batches = [rb async for rb in self._stream(reader, writer)]
+        try:
+            batches = [rb async for rb in self._stream(reader, writer)]
+        finally:
+            writer.close()  # idempotent; guarantees release on every path
         return MessageBatch(batches[0]) if batches else MessageBatch.empty()

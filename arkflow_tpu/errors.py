@@ -29,6 +29,14 @@ class ReadError(ArkError):
     """Failed to read from an input."""
 
 
+class FrameIntegrityError(ReadError):
+    """A flight frame failed its crc32 integrity check: the bytes on the
+    wire do not match what the peer sent. Corruption is never silent —
+    the message names the frame class (infer request, kv_push slab, ...)
+    so a flipped bit in a raw bf16 slab surfaces as a loud, attributable
+    error instead of garbage logits."""
+
+
 class WriteError(ArkError):
     """Failed to write to an output."""
 

@@ -129,6 +129,7 @@ class FaultSchedule:
 
     def __init__(self, specs: Iterable[FaultSpec], seed: int = 0):
         self.specs = list(specs)
+        self.seed = seed
         self._rng = random.Random(seed)
 
     def due(self, op: int, payload: Optional[bytes] = None,

@@ -44,6 +44,12 @@ reproducible faults on its operation stream:
                                               # mangled tree (canary rollback)
           - {kind: swap_crash, at: 8}         # next hot-swap crashes mid-roll
                                               # (partial-flip rollback)
+          - {kind: net_blackhole, at: 4}      # one-way partition on the
+                                              # cluster dispatcher's NEXT
+                                              # flight connection; also
+                                              # net_delay / net_stall /
+                                              # net_reset / net_corrupt
+                                              # (requires a remote_tpu inner)
 
 Crash faults raise a plain RuntimeError (not ArkError) so they escape the
 stream's contained error paths and exercise the engine restart policy; their
@@ -86,8 +92,15 @@ INPUT_KINDS = frozenset(
     {"latency", "disconnect", "error", "crash", "ack_fail", "ack_dup",
      "reconnect_fail", "burst"})
 OUTPUT_KINDS = frozenset({"latency", "error", "crash"})
+#: network chaos against the wrapped processor's cluster dispatcher: armed
+#: on its chaos transport (connect/chaoswire.py), firing on the NEXT flight
+#: connection it opens — ``net_delay``/``net_stall``/``net_blackhole``/
+#: ``net_reset``/``net_corrupt`` mirror the ChaosWire kinds
+_NET_KINDS = frozenset(
+    {"net_delay", "net_stall", "net_blackhole", "net_reset", "net_corrupt"})
 PROCESSOR_KINDS = frozenset(
-    {"latency", "error", "crash", "hang", "oom", "swap_corrupt", "swap_crash"})
+    {"latency", "error", "crash", "hang", "oom", "swap_corrupt",
+     "swap_crash"}) | _NET_KINDS
 
 #: device-step faults: armed on the wrapped processor's runner (the fault
 #: fires INSIDE the next device step, exercising the real watchdog / OOM
@@ -315,6 +328,12 @@ class FaultInjectingProcessor(Processor):
         wrapping the same way they reach the runner."""
         return getattr(self._inner, "swapper", None)
 
+    @property
+    def dispatcher(self):
+        """The inner processor's cluster dispatcher (None for non-cluster
+        inners): net_* chaos arms on its chaos transport."""
+        return getattr(self._inner, "dispatcher", None)
+
     async def process(self, batch: MessageBatch) -> list[MessageBatch]:
         self._calls += 1
         payload = _batch_bytes(batch) if self._needs_payload else None
@@ -325,6 +344,8 @@ class FaultInjectingProcessor(Processor):
                 await self._apply_step_fault(spec)
             elif spec.kind in _SWAP_KINDS:
                 self._arm_swap_fault(spec)
+            elif spec.kind in _NET_KINDS:
+                self._arm_net_fault(spec)
             elif spec.kind == "error":
                 raise ProcessError(spec.message)
             elif spec.kind == "crash":
@@ -349,6 +370,23 @@ class FaultInjectingProcessor(Processor):
             await asyncio.sleep(spec.duration_s if spec.duration_s > 0 else 30.0)
         else:
             raise ProcessError(f"RESOURCE_EXHAUSTED: {spec.message}")
+
+    def _arm_net_fault(self, spec: FaultSpec) -> None:
+        """Arm a ``net_*`` chaos fault on the inner processor's cluster
+        dispatcher: the fault rides the NEXT flight connection it opens
+        (delay / mid-frame stall / one-way black-hole / abrupt reset / byte
+        corruption — connect/chaoswire.py). No emulation fallback: network
+        chaos against a non-cluster inner is a misconfigured schedule."""
+        from arkflow_tpu.runtime.cluster import _walk_inner
+
+        dispatcher = _walk_inner(self._inner, "dispatcher")
+        arm = getattr(dispatcher, "chaos_arm", None)
+        if arm is None:
+            raise ProcessError(
+                f"chaos: {spec.kind} requires a cluster-dispatch inner "
+                "processor (remote_tpu)")
+        arm(spec.kind[len("net_"):], duration_s=spec.duration_s,
+            seed=self._sched.seed)
 
     def _arm_swap_fault(self, spec: FaultSpec) -> None:
         """Arm a ``swap_corrupt``/``swap_crash`` on the inner processor's

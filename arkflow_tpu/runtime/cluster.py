@@ -82,6 +82,8 @@ import json
 import logging
 import os
 import socket
+import uuid
+from collections import deque
 from typing import Any, Mapping, Optional, Sequence
 
 from arkflow_tpu.batch import MessageBatch, batch_fingerprint
@@ -103,7 +105,10 @@ from arkflow_tpu.connect.flight import (
 from arkflow_tpu.errors import (
     ConfigError,
     ConnectError,
+    FrameIntegrityError,
+    Overloaded,
     ProcessError,
+    ReadError,
     SwapError,
 )
 from arkflow_tpu.obs import global_registry
@@ -184,6 +189,17 @@ def kv_export_from_wire(meta: Mapping, frames: Sequence[bytes]) -> dict:
             f"{2 * shards} (K+V x {shards} shards)")
     shape = tuple(int(d) for d in meta["shape"])
     dt = _wire_dtype(str(meta["dtype"]))
+    expect = int(np.prod(shape)) * dt.itemsize
+    for i, fr in enumerate(frames):
+        # the slabs are raw device memory with no Arrow IPC validation —
+        # a truncated or padded frame must fail HERE with an attributable
+        # error, not reshape into garbage pages downstream
+        if len(fr) != expect:
+            kind = "K" if i < shards else "V"
+            raise ConnectError(
+                f"kv_push slab {i + 1}/{2 * shards} ({kind} shard "
+                f"{i % shards}) is {len(fr)} bytes, expected {expect} "
+                f"({shape} x {dt.name}); refusing to adopt corrupt pages")
     out["k"] = [np.frombuffer(frames[i], dtype=dt).reshape(shape)
                 for i in range(shards)]
     out["v"] = [np.frombuffer(frames[shards + i], dtype=dt).reshape(shape)
@@ -357,7 +373,8 @@ class ClusterWorkerServer:
                  port: int = 50052, worker_id: Optional[str] = None,
                  max_in_flight: int = 1, max_frame: int = DEFAULT_MAX_FRAME,
                  tracing: Optional[TracingConfig] = None,
-                 grace_s: float = 30.0, role: str = "both"):
+                 grace_s: float = 30.0, role: str = "both",
+                 io_deadline_s: float = 30.0, crc: bool = True):
         from arkflow_tpu.runtime.overload import OverloadConfig, OverloadController
         from arkflow_tpu.runtime.pipeline import Pipeline
 
@@ -367,11 +384,25 @@ class ClusterWorkerServer:
         if role not in WORKER_ROLES:
             raise ConfigError(
                 f"worker.role must be one of {WORKER_ROLES}, got {role!r}")
+        if io_deadline_s <= 0:
+            raise ConfigError(
+                f"worker.io_deadline must be > 0, got {io_deadline_s}")
         self.role = role
         self.pipeline = Pipeline(list(processors))
         self.host = host
         self.port = port
         self.worker_id = worker_id or f"{socket.gethostname()}-{os.getpid()}"
+        #: incarnation epoch: minted fresh per server object (and re-minted
+        #: when the ingest tier fences this one), so a partition-healed
+        #: zombie is distinguishable from the worker it used to be. The
+        #: worker_id names the IDENTITY; the incarnation names the EPOCH.
+        self.incarnation = uuid.uuid4().hex[:12]
+        #: advertise crc32 frame integrity at register; peers that saw the
+        #: capability send crc-trailed frames and this worker echoes
+        self.crc = bool(crc)
+        #: per-frame read deadline: a peer stalling mid-frame (slow-loris)
+        #: must not pin a connection task forever
+        self.io_deadline_s = float(io_deadline_s)
         #: the worker's OWN tracer (never the process-global one): spans for
         #: an infer request accumulate here and export back to the ingest
         #: tier in a TRACE_TAG frame — per-instance so in-process test
@@ -402,6 +433,14 @@ class ClusterWorkerServer:
         self._kv_push_retries = 0  # decode candidates that refused/failed over
         self._kv_adopted = 0       # exports adopted + decoded locally
         self._kv_refused = 0       # kv_push receives refused (drain/role)
+        # network-robustness counters (heartbeat-visible)
+        self._stalled_reads = 0    # reads killed by the io_deadline
+        self._crc_errors = 0       # frames that failed the crc32 check
+        self._fence_refused = 0    # requests refused: this epoch was fenced
+        self.m_stalled = global_registry().counter(
+            "arkflow_cluster_stalled_reads_total",
+            "worker-side frame reads that stalled past io_deadline "
+            "(slow-loris / wedged peer)", {"worker": self.worker_id})
         # the PR-5 admission signals, re-used verbatim: window adapts by
         # AIMD on the semaphore wait, drain estimate = queued * step EWMA
         self.ctrl = OverloadController(
@@ -517,7 +556,12 @@ class ClusterWorkerServer:
             "worker_id": self.worker_id,
             "proto": PROTO_VERSION,
             "role": self.role,
+            "incarnation": self.incarnation,
+            "crc": self.crc,
             "draining": self.draining,
+            "stalled_reads": self._stalled_reads,
+            "crc_errors": self._crc_errors,
+            "fence_refused": self._fence_refused,
             "inflight": self._inflight,
             "served": self._served,
             "errors": self._errors,
@@ -547,48 +591,112 @@ class ClusterWorkerServer:
 
     # -- request handling --------------------------------------------------
 
-    async def _serve(self, reader, writer) -> None:
+    async def _read_bounded(self, reader, what: str):
+        """One frame under the per-frame io_deadline: a peer stalling
+        mid-frame (slow-loris) is cut loose and counted instead of pinning
+        this connection task forever."""
         try:
-            raw = await _read_frame(reader, self.max_frame)
+            return await asyncio.wait_for(
+                _read_frame(reader, self.max_frame, what=what),
+                self.io_deadline_s)
+        except asyncio.TimeoutError:
+            self._stalled_reads += 1
+            self.m_stalled.inc()
+            raise ConnectError(
+                f"read of {what} frame stalled past the "
+                f"{self.io_deadline_s:.1f}s io_deadline (slow-loris or "
+                "wedged peer); dropping the connection") from None
+
+    def _fence_check(self, req: dict) -> bool:
+        """True when the peer declared THIS incarnation fenced (it was
+        staleness-declared dead, e.g. across a healed partition). The
+        request is refused retryably and the worker re-mints its epoch, so
+        the next heartbeat re-admits it as a provably fresh member instead
+        of a zombie serving stale occupancy."""
+        fenced = req.get("fenced") or []
+        if self.incarnation not in fenced:
+            return False
+        self._fence_refused += 1
+        old, self.incarnation = self.incarnation, uuid.uuid4().hex[:12]
+        logger.warning(
+            "cluster worker %s: incarnation %s was fenced by the ingest "
+            "tier (stale after a partition?); re-minted as %s",
+            self.worker_id, old, self.incarnation)
+        return True
+
+    async def _serve(self, reader, writer) -> None:
+        crc = False
+        try:
+            raw = await self._read_bounded(reader, "request")
             if raw is None:
                 return
+            # echo negotiation: reply with crc trailers iff the request
+            # frame carried one (the peer learned the capability from our
+            # register report) and integrity is enabled locally
+            crc = bool(getattr(reader, "_arkflow_crc", False)) and self.crc
             req = json.loads(raw.decode())
             action = req.get("action")
             if action == "register":
+                fence = req.get("fence")
+                if fence and fence == self.incarnation:
+                    # explicit heal handshake: the ingest tier fenced this
+                    # epoch and asks for a fresh one before re-admission
+                    self._fence_refused += 1
+                    self.incarnation = uuid.uuid4().hex[:12]
+                    logger.info(
+                        "cluster worker %s: fenced incarnation %s healed; "
+                        "now %s", self.worker_id, fence, self.incarnation)
                 await _send_frame(writer, json.dumps({
                     "ok": True,
                     "processors": [type(p).__name__
                                    for p in self.pipeline.processors],
                     **self.load_report(),
-                }).encode())
+                }).encode(), crc=crc)
             elif action == "heartbeat":
                 await _send_frame(writer, json.dumps(
-                    {"ok": True, **self.load_report()}).encode())
+                    {"ok": True, **self.load_report()}).encode(), crc=crc)
             elif action == "drain":
                 self.draining = bool(req.get("drain", True))
                 logger.info("cluster worker %s drain=%s (inflight=%d)",
                             self.worker_id, self.draining, self._inflight)
                 await _send_frame(writer, json.dumps(
-                    {"ok": True, **self.load_report()}).encode())
+                    {"ok": True, **self.load_report()}).encode(), crc=crc)
             elif action == "swap":
                 await self._do_swap(req, writer)
             elif action == "infer":
-                await self._do_infer(req, reader, writer)
+                await self._do_infer(req, reader, writer, crc=crc)
             elif action == "kv_push":
-                await self._do_kv_push(req, reader, writer)
+                await self._do_kv_push(req, reader, writer, crc=crc)
             else:
                 await _send_frame(writer, json.dumps(
-                    {"ok": False, "error": f"unknown action {action!r}"}).encode())
+                    {"ok": False, "error": f"unknown action {action!r}"}
+                ).encode(), crc=crc)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         except Exception as e:
+            if isinstance(e, FrameIntegrityError):
+                self._crc_errors += 1
+            # the reader records the crc negotiation BEFORE validating, so
+            # even a refusal of a corrupted request carries a trailer — the
+            # reply crosses the same corrupting link the request did, and
+            # unprotected it would reach the peer as undecodable garbage
+            crc = bool(getattr(reader, "_arkflow_crc", False)) and self.crc
             try:
                 if getattr(writer, "_arkflow_streaming", False):
-                    await _send_stream_error(writer, repr(e)[:500])
+                    await _send_stream_error(writer, repr(e)[:500], crc=crc)
                     await _end_stream(writer)
                 else:
-                    await _send_frame(writer, json.dumps(
-                        {"ok": False, "error": repr(e)[:500]}).encode())
+                    status = {"ok": False, "error": repr(e)[:500]}
+                    if isinstance(e, FrameIntegrityError):
+                        # a corrupted REQUEST was never processed — refuse
+                        # retryably so the ingest ring fails the batch over
+                        # instead of quarantining it as a processing error;
+                        # the reason lets the client count it as a frame
+                        # error rather than a drain
+                        status["retryable"] = True
+                        status["reason"] = "frame_integrity"
+                    await _send_frame(writer, json.dumps(status).encode(),
+                                      crc=crc)
             except Exception:
                 pass
         finally:
@@ -626,23 +734,32 @@ class ClusterWorkerServer:
             {"ok": ok_all, "worker_id": self.worker_id,
              "results": results}).encode())
 
-    async def _do_infer(self, req: dict, reader, writer) -> None:
-        ipc = await _read_frame(reader, self.max_frame)
+    async def _do_infer(self, req: dict, reader, writer,
+                        crc: bool = False) -> None:
+        ipc = await self._read_bounded(reader, "infer batch")
         if ipc is None:
             raise ConnectError("infer request carried no batch frame")
+        if self._fence_check(req):
+            await _send_frame(writer, json.dumps(
+                {"ok": False, "error": "worker incarnation was fenced "
+                 "(stale epoch); re-minted — retry on the ring",
+                 "retryable": True}).encode(), crc=crc)
+            return
         if self.draining:
             # retryable: the dispatcher re-routes to the ring's next worker
             # instead of surfacing a processing error
             await _send_frame(writer, json.dumps(
                 {"ok": False, "error": "worker is draining",
-                 "retryable": True}).encode())
+                 "retryable": True, "incarnation": self.incarnation}
+            ).encode(), crc=crc)
             return
         if self.role == "decode":
             # a decode-role worker only adopts kv_push pages; prompts
             # re-route to a prefill-capable worker on the ring
             await _send_frame(writer, json.dumps(
                 {"ok": False, "error": "worker role is 'decode': accepts "
-                 "kv_push only", "retryable": True}).encode())
+                 "kv_push only", "retryable": True,
+                 "incarnation": self.incarnation}).encode(), crc=crc)
             return
         # cross-tier trace context: the ingest dispatcher parents the
         # worker's spans under its hop span; absent = untraced (old peer)
@@ -653,7 +770,8 @@ class ClusterWorkerServer:
         if not batches:
             raise ConnectError("infer batch frame decoded to zero batches")
         batch = MessageBatch(batches[0])
-        await _send_frame(writer, json.dumps({"ok": True}).encode())
+        await _send_frame(writer, json.dumps(
+            {"ok": True, "incarnation": self.incarnation}).encode(), crc=crc)
         writer._arkflow_streaming = True
         loop = asyncio.get_running_loop()
         self.tracer.record(tctx, "remote_deserialize", loop.time() - t_deser)
@@ -669,6 +787,8 @@ class ClusterWorkerServer:
                 # activate the worker's tracer so the hosted chain's spans
                 # (infeed prep, device step) nest under remote_step
                 decode_urls = [str(u) for u in req.get("decode_workers") or []]
+                decode_crc = {str(u) for u in req.get("decode_crc") or []}
+                fenced = [str(f) for f in req.get("fenced") or []]
                 disagg = (self._disagg_handle()
                           if self.role == "prefill" and decode_urls else None)
                 with activate(self.tracer, tctx):
@@ -678,8 +798,9 @@ class ClusterWorkerServer:
                         with stage_span("remote_step"):
                             exports = await disagg.prefill_rows(batch)
                         with stage_span("remote_kv_push"):
-                            token_lists = [await self._push_export(e, decode_urls)
-                                           for e in exports]
+                            token_lists = [await self._push_export(
+                                e, decode_urls, crc_urls=decode_crc,
+                                fenced=fenced) for e in exports]
                         results = disagg.finalize_rows(batch, token_lists)
                     else:
                         with stage_span("remote_step"):
@@ -687,12 +808,13 @@ class ClusterWorkerServer:
                 self.ctrl.observe_step(loop.time() - t0)
             t_ser = loop.time()
             for out in results:
-                await _send_data(writer, batch_to_ipc(out.record_batch))
+                await _send_data(writer, batch_to_ipc(out.record_batch),
+                                 crc=crc)
             self.tracer.record(tctx, "remote_serialize", loop.time() - t_ser)
             spans = self.tracer.export_open(tctx)
             if spans:
                 await _send_frame(writer, TRACE_TAG + json.dumps(
-                    {"spans": spans}).encode())
+                    {"spans": spans}).encode(), crc=crc)
             await _end_stream(writer)
             self._served += 1
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
@@ -734,8 +856,9 @@ class ClusterWorkerServer:
                 return runner
         return None
 
-    async def _push_export(self, export: Mapping,
-                           urls: Sequence[str]) -> list[int]:
+    async def _push_export(self, export: Mapping, urls: Sequence[str],
+                           crc_urls: Optional[set] = None,
+                           fenced: Optional[Sequence[str]] = None) -> list[int]:
         """Ship one prompt's KV pages to the first decode candidate that
         accepts, in the occupancy order the dispatcher planned. A retryable
         refusal (draining / role mismatch) or a transport error re-plans to
@@ -756,20 +879,29 @@ class ClusterWorkerServer:
                 self._kv_push_retries += 1
                 last = e
                 continue
+            # crc per peer: the dispatcher tells us which decode candidates
+            # advertised frame integrity — raw bf16 slabs bypass Arrow IPC
+            # validation, so the trailer is the ONLY corruption check
+            use_crc = self.crc and crc_urls is not None and url in crc_urls
             try:
                 try:
-                    await _send_frame(writer, json.dumps(
-                        {"action": "kv_push", "meta": meta}).encode())
+                    push_req: dict = {"action": "kv_push", "meta": meta}
+                    if fenced:
+                        push_req["fenced"] = list(fenced)
+                    await _send_frame(writer, json.dumps(push_req).encode(),
+                                      crc=use_crc)
                     for fr in frames:
-                        await _send_frame(writer, fr)
+                        await _send_frame(writer, fr, crc=use_crc)
                     raw = await asyncio.wait_for(
-                        _read_frame(reader, self.max_frame), 120.0)
+                        _read_frame(reader, self.max_frame,
+                                    what="kv_push status"), 120.0)
                     if raw is None:
                         raise ConnectError(
                             f"decode worker {url} closed before a status")
                     status = json.loads(raw.decode())
                 except (ConnectionError, OSError, asyncio.TimeoutError,
-                        asyncio.IncompleteReadError, ConnectError) as e:
+                        asyncio.IncompleteReadError, ConnectError,
+                        ReadError) as e:
                     self._kv_push_retries += 1
                     last = e
                     continue
@@ -793,19 +925,21 @@ class ClusterWorkerServer:
             f"kv_push: no decode worker accepted the pages "
             f"({len(urls)} candidates tried; last: {last!r})")
 
-    async def _do_kv_push(self, req: dict, reader, writer) -> None:
+    async def _do_kv_push(self, req: dict, reader, writer,
+                          crc: bool = False) -> None:
         """Adopt a prefill worker's KV pages and decode to completion.
 
         The slab frames are consumed BEFORE any refusal (same ordering as
         ``infer`` under drain: the peer already committed the frames to the
-        socket), then draining / role-mismatch refuse RETRYABLY so the
-        prefill side re-plans to the ring's next decode candidate instead
-        of surfacing a processing error."""
+        socket), then draining / role-mismatch / a fenced incarnation
+        refuse RETRYABLY so the prefill side re-plans to the ring's next
+        decode candidate instead of surfacing a processing error."""
         meta = req.get("meta")
         if not isinstance(meta, Mapping):
             await _send_frame(writer, json.dumps(
                 {"ok": False,
-                 "error": "kv_push needs a 'meta' mapping"}).encode())
+                 "error": "kv_push needs a 'meta' mapping"}).encode(),
+                crc=crc)
             return
         frames: list[bytes] = []
         if not meta.get("done"):
@@ -814,32 +948,40 @@ class ClusterWorkerServer:
                     or not 1 <= shards <= 64):
                 await _send_frame(writer, json.dumps(
                     {"ok": False,
-                     "error": f"kv_push shards invalid: {shards!r}"}).encode())
+                     "error": f"kv_push shards invalid: {shards!r}"}
+                ).encode(), crc=crc)
                 return
-            for _ in range(2 * shards):
-                fr = await _read_frame(reader, self.max_frame)
+            for i in range(2 * shards):
+                fr = await self._read_bounded(
+                    reader, f"kv_push slab {i + 1}/{2 * shards}")
                 if fr is None:
                     raise ConnectError(
                         "kv_push ended before all page-slab frames")
                 frames.append(bytes(fr))
+        if self._fence_check(req):
+            await _send_frame(writer, json.dumps(
+                {"ok": False, "error": "worker incarnation was fenced "
+                 "(stale epoch); re-minted — retry the next candidate",
+                 "retryable": True}).encode(), crc=crc)
+            return
         if self.draining:
             self._kv_refused += 1
             await _send_frame(writer, json.dumps(
                 {"ok": False, "error": "worker is draining",
-                 "retryable": True}).encode())
+                 "retryable": True}).encode(), crc=crc)
             return
         if self.role == "prefill":
             self._kv_refused += 1
             await _send_frame(writer, json.dumps(
                 {"ok": False, "error": "worker role is 'prefill': cannot "
                  "adopt KV pages it would never decode",
-                 "retryable": True}).encode())
+                 "retryable": True}).encode(), crc=crc)
             return
         server = self._generation_server()
         if server is None:
             await _send_frame(writer, json.dumps(
                 {"ok": False, "error": "no continuous generation server "
-                 "hosted on this worker"}).encode())
+                 "hosted on this worker"}).encode(), crc=crc)
             return
         export = kv_export_from_wire(meta, frames)
         loop = asyncio.get_running_loop()
@@ -856,13 +998,14 @@ class ClusterWorkerServer:
             self._served += 1
             await _send_frame(writer, json.dumps(
                 {"ok": True, "worker_id": self.worker_id,
-                 "tokens": [int(t) for t in tokens]}).encode())
+                 "incarnation": self.incarnation,
+                 "tokens": [int(t) for t in tokens]}).encode(), crc=crc)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             raise
         except Exception as e:
             self._errors += 1
             await _send_frame(writer, json.dumps(
-                {"ok": False, "error": repr(e)[:500]}).encode())
+                {"ok": False, "error": repr(e)[:500]}).encode(), crc=crc)
         finally:
             self._inflight -= 1
 
@@ -877,8 +1020,11 @@ def parse_worker_config(m: Any) -> tuple[list[dict], dict]:
     ``{pipeline: {processors: [...]}}``, or a full engine config (the FIRST
     stream's pipeline is hosted) — so a worker can reuse the exact
     processor block of the single-process config it was split out of.
-    Options ride under ``worker: {id, max_in_flight, max_frame, grace}``
-    (``grace`` = the SIGTERM self-drain budget, default 30s)."""
+    Options ride under ``worker: {id, max_in_flight, max_frame, grace,
+    role, io_deadline, crc}`` (``grace`` = the SIGTERM self-drain budget,
+    default 30s; ``io_deadline`` = the per-frame read deadline bounding
+    slow-loris peers, default 30s; ``crc`` = advertise crc32 frame
+    integrity, default true)."""
     if not isinstance(m, Mapping):
         raise ConfigError("cluster worker config must be a mapping")
     procs: Any = m.get("processors")
@@ -930,6 +1076,19 @@ def parse_worker_config(m: Any) -> tuple[list[dict], dict]:
     if grace_s <= 0:
         raise ConfigError(f"worker.grace must be > 0, got {grace!r}")
     opts["grace_s"] = grace_s
+    io_deadline = opts_raw.get("io_deadline", "30s")
+    try:
+        io_deadline_s = parse_duration(io_deadline)
+    except (ConfigError, TypeError, ValueError) as e:
+        raise ConfigError(f"worker.io_deadline invalid: {e}") from e
+    if io_deadline_s <= 0:
+        raise ConfigError(
+            f"worker.io_deadline must be > 0, got {io_deadline!r}")
+    opts["io_deadline_s"] = io_deadline_s
+    crc = opts_raw.get("crc", True)
+    if not isinstance(crc, bool):
+        raise ConfigError(f"worker.crc must be a bool, got {crc!r}")
+    opts["crc"] = crc
     # a worker accepts the same top-level `tracing:` block as the engine
     # (sample knobs matter less here — the ingest tier owns the sampling
     # decision — but span caps and the kill switch do). Parsed even when
@@ -955,7 +1114,9 @@ def build_worker_server(config: Mapping, *, host: str = "127.0.0.1",
         max_frame=max_frame or opts["max_frame"],
         tracing=opts["tracing"],
         grace_s=opts["grace_s"],
-        role=opts["role"])
+        role=opts["role"],
+        io_deadline_s=opts["io_deadline_s"],
+        crc=opts["crc"])
 
 
 async def run_worker(config: Mapping, *, host: str = "127.0.0.1",
@@ -1021,6 +1182,17 @@ class _WorkerDraining(Exception):
     """The worker refused the batch because it is draining — routable."""
 
 
+class RetryBudgetExhausted(Overloaded):
+    """The dispatcher's ring-retry token bucket is empty: a fleet-wide
+    brownout is amplifying offered load through failover retries, and the
+    budget caps the amplification. The stream sheds the batch through the
+    never-silent error-output path tagged ``reason=retry_budget`` (the
+    ``shed_reason`` attribute is the stream's generic hook) instead of
+    retry-storming a struggling fleet."""
+
+    shed_reason = "retry_budget"
+
+
 class RemoteWorker:
     """Ingest-side handle for one device worker: liveness, the advertised
     load signals, client-side in-flight accounting, and the per-worker
@@ -1041,6 +1213,16 @@ class RemoteWorker:
         self.dispatched = 0
         #: advertised disaggregation role (heartbeat; default both)
         self.role = "both"
+        #: advertised incarnation epoch (register/heartbeat); fencing keys
+        #: on it — a worker_id names the identity, this names the epoch
+        self.incarnation: Optional[str] = None
+        #: epochs declared dead by staleness/probe-timeout: frames from
+        #: them are zombie frames and get rejected until the heal handshake
+        #: re-mints (bounded — old fences age out, they only matter while
+        #: the zombie could still be holding the stale epoch)
+        self.fenced: deque = deque(maxlen=8)
+        #: peer advertised crc32 frame-integrity support at register
+        self.crc = False
         #: decode-side occupancy (heartbeat): generation slots and KV page
         #: pool pressure — real decode saturation, not just the AIMD window
         self.gen_slots = 0
@@ -1075,6 +1257,10 @@ class RemoteWorker:
         self.draining = bool(rep.get("draining", False))
         self.window = max(1, int(rep.get("window", 1)))
         self.drain_s = float(rep.get("drain_s", 0.0))
+        inc = rep.get("incarnation")
+        if isinstance(inc, str) and inc:
+            self.incarnation = inc
+        self.crc = bool(rep.get("crc", False))
         role = rep.get("role", "both")
         self.role = role if role in WORKER_ROLES else "both"
         self.gen_slots = int(rep.get("gen_slots", 0) or 0)
@@ -1091,6 +1277,18 @@ class RemoteWorker:
         self.alive = False
         self.last_error = f"{type(err).__name__}: {err}"
         self.m_alive.set(0.0)
+
+    def fence(self) -> Optional[str]:
+        """Fence the current incarnation: it was declared dead while
+        possibly still running (staleness / an unresponsive probe), so any
+        later frame from it is a zombie's. Returns the fenced epoch."""
+        inc = self.incarnation
+        if inc and inc not in self.fenced:
+            self.fenced.append(inc)
+        return inc
+
+    def is_fenced(self, incarnation: Optional[str]) -> bool:
+        return bool(incarnation) and incarnation in self.fenced
 
     def serves(self, role: str) -> bool:
         """True when this worker accepts work of the given role."""
@@ -1125,6 +1323,9 @@ class RemoteWorker:
             out["gen_slots"] = self.gen_slots
             out["gen_slots_busy"] = self.gen_slots_busy
             out["page_pool_occupancy"] = self.page_occupancy
+        if self.fenced:
+            out["incarnation"] = self.incarnation
+            out["fenced"] = list(self.fenced)
         if self.last_error:
             out["last_error"] = self.last_error
         remote_health = self.last_report.get("health")
@@ -1149,7 +1350,10 @@ class ClusterDispatcher:
                  connect_timeout_s: float = 5.0,
                  heartbeat_timeout_s: Optional[float] = None,
                  max_frame: int = DEFAULT_MAX_FRAME,
-                 decode_candidates: int = 3):
+                 decode_candidates: int = 3,
+                 crc: bool = True, io_deadline_floor_s: float = 0.1,
+                 hedge: Optional[Mapping] = None,
+                 retry_budget: Optional[Mapping] = None):
         from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD
 
         if not urls:
@@ -1188,6 +1392,40 @@ class ClusterDispatcher:
         self.decode_candidates = int(decode_candidates)
         self.virtual_nodes = virtual_nodes
         self.max_frame = int(max_frame)
+        #: send crc32-trailed frames to workers that advertised support
+        self.crc = bool(crc)
+        #: floor under the deadline-derived per-hop I/O timeout: a batch
+        #: with 3ms of budget left still gets a read window the transport
+        #: can physically meet (it will shed at admission next hop anyway)
+        self.io_deadline_floor_s = float(io_deadline_floor_s)
+        # hedged dispatch (None = disabled): after a p99-EWMA delay (or the
+        # configured fixed delay) re-send the infer to the ring successor,
+        # first response wins — duplicates are safe because fingerprint
+        # affinity + response caches make them idempotent under
+        # at-least-once. Budget-capped so hedges can't melt spare capacity.
+        self._hedge = dict(hedge) if hedge is not None else None
+        if self._hedge is not None:
+            self._hedge.setdefault("delay_s", None)  # None = auto (p99 EWMA)
+            self._hedge.setdefault("max_fraction", 0.1)
+            self._hedge.setdefault("burst", 4)
+            self._hedge.setdefault("min_delay_s", 0.01)
+        self._lat_samples: deque = deque(maxlen=128)
+        self._p99_ewma: Optional[float] = None
+        self._dispatch_count = 0
+        self._hedges_issued = 0
+        # ring-retry token bucket (None = unlimited, the historical
+        # behavior): each dispatch deposits ``ratio`` tokens, each ring
+        # failover spends one, so retries/offered <= ratio (+burst)
+        self._retry_budget = (dict(retry_budget)
+                              if retry_budget is not None else None)
+        if self._retry_budget is not None:
+            self._retry_budget.setdefault("ratio", 0.5)
+            self._retry_budget.setdefault("burst", 8)
+        self._retry_tokens = (float(self._retry_budget["burst"])
+                              if self._retry_budget is not None else None)
+        #: in-process chaos transport (chaoswire.ChaosWire); armed by the
+        #: fault plugin's net_* kinds, wraps the next opened connection
+        self.chaos = None
         self.workers: dict[str, RemoteWorker] = {
             url: RemoteWorker(url, name) for url in urls}
         self.ring = HashRing(list(urls), virtual_nodes)
@@ -1204,6 +1442,26 @@ class ClusterDispatcher:
         self.m_deaths = reg.counter(
             "arkflow_cluster_worker_down_total",
             "times a worker was marked down after a failed call", labels)
+        self.m_fenced = reg.counter(
+            "arkflow_cluster_fenced_total",
+            "frames/reports rejected because they came from a fenced "
+            "(staleness-declared-dead) worker incarnation", labels)
+        self.m_frame_errors = reg.counter(
+            "arkflow_cluster_frame_error_total",
+            "flight frames that failed the crc32 integrity check", labels)
+        self.m_retry_shed = reg.counter(
+            "arkflow_cluster_retry_budget_exhausted_total",
+            "dispatches shed because the ring-retry token bucket was empty",
+            labels)
+        self.m_hedge = {
+            o: reg.counter(
+                "arkflow_cluster_hedge_total",
+                "hedged dispatch outcomes (issued / win = hedge beat the "
+                "owner / primary_win = owner answered first / denied = "
+                "budget cap / failed = both attempts failed)",
+                {**labels, "outcome": o})
+            for o in ("issued", "win", "primary_win", "denied", "failed")
+        }
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1238,12 +1496,23 @@ class ClusterDispatcher:
             self._hb_task = None
 
     async def _heartbeat_loop(self) -> None:
-        while True:
-            await asyncio.sleep(self.heartbeat_s)
-            self._expire_stale()
-            await asyncio.gather(
-                *(self._probe(w) for w in self.workers.values()),
-                return_exceptions=True)
+        # per-worker probe tasks, NOT a gathered round: a black-holed member
+        # pins its probe for the full heartbeat_timeout, and waiting on it
+        # would stretch the round past the staleness cutoff — stale-fencing
+        # HEALTHY siblings that answered every probe they were sent
+        inflight: dict[str, asyncio.Task] = {}
+        try:
+            while True:
+                await asyncio.sleep(self.heartbeat_s)
+                self._expire_stale()
+                for w in list(self.workers.values()):
+                    t = inflight.get(w.url)
+                    if t is not None and not t.done():
+                        continue  # previous probe still inside its timeout
+                    inflight[w.url] = asyncio.create_task(self._probe(w))
+        finally:
+            for t in inflight.values():
+                t.cancel()
 
     def _is_stale(self, w: RemoteWorker, now: float) -> bool:
         return (w.alive and w.last_seen > 0.0
@@ -1259,10 +1528,12 @@ class ClusterDispatcher:
         for w in self.workers.values():
             if self._is_stale(w, now):
                 self.m_deaths.inc()
+                fenced = w.fence()
                 logger.warning(
                     "remote_tpu[%s]: worker %s heartbeats stale for %.1fs "
-                    "(timeout %.1fs); marking dead", self.name, w.url,
-                    now - w.last_seen, self.heartbeat_timeout_s)
+                    "(timeout %.1fs); marking dead, fencing incarnation %s",
+                    self.name, w.url, now - w.last_seen,
+                    self.heartbeat_timeout_s, fenced)
                 w.note_down(ConnectError(
                     f"heartbeats stale for {now - w.last_seen:.1f}s"))
 
@@ -1275,6 +1546,18 @@ class ClusterDispatcher:
         try:
             rep = await self._unary(w, {"action": action},
                                     timeout=self.heartbeat_timeout_s)
+        except asyncio.TimeoutError as e:
+            # answered nothing inside the probe bound: unresponsive but
+            # possibly still RUNNING (one-way partition, wedge) — fence the
+            # epoch so its frames are rejectable if it resurfaces
+            if w.alive:
+                self.m_deaths.inc()
+                logger.warning(
+                    "remote_tpu[%s]: worker %s probe timed out; marking "
+                    "dead, fencing incarnation %s", self.name, w.url,
+                    w.fence())
+            w.note_down(e)
+            return
         except Exception as e:
             if w.alive:
                 self.m_deaths.inc()
@@ -1282,6 +1565,28 @@ class ClusterDispatcher:
                                self.name, w.url, e)
             w.note_down(e)
             return
+        inc = rep.get("incarnation")
+        if w.is_fenced(inc):
+            # a partition-healed zombie heartbeating from its fenced epoch:
+            # reject the report (its occupancy/window are stale), then heal
+            # explicitly — ask it to re-mint, and admit the FRESH epoch
+            self.m_fenced.inc()
+            logger.warning(
+                "remote_tpu[%s]: worker %s answered from fenced incarnation "
+                "%s (partition-healed zombie); rejecting its report and "
+                "requesting a re-mint", self.name, w.url, inc)
+            try:
+                rep = await self._unary(
+                    w, {"action": "register", "fence": inc},
+                    timeout=self.heartbeat_timeout_s)
+            except Exception as e:
+                w.note_down(e)
+                return
+            if w.is_fenced(rep.get("incarnation")):
+                w.note_down(ConnectError(
+                    f"worker {w.url} still answering from fenced "
+                    f"incarnation {inc} after a heal handshake"))
+                return
         if not rep.get("ok") or not rep.get("worker_id"):
             # answers-but-refuses is NOT alive: a scan-tier FlightWorker (or
             # any wrong endpoint) replies {"ok": false, "error": "unknown
@@ -1304,23 +1609,39 @@ class ClusterDispatcher:
 
     # -- wire helpers ------------------------------------------------------
 
+    def chaos_arm(self, kind: str, *, duration_s: float = 0.0,
+                  seed: int = 0) -> None:
+        """Arm one network fault on the next flight connection this
+        dispatcher opens (the ``fault`` plugin's ``net_*`` kinds land
+        here). Lazily creates the seeded chaos transport."""
+        if self.chaos is None:
+            from arkflow_tpu.connect.chaoswire import ChaosWire
+
+            self.chaos = ChaosWire(seed=seed)
+        self.chaos.arm(kind, duration_s=duration_s)
+
     async def _open(self, w: RemoteWorker):
         try:
-            return await asyncio.wait_for(
+            reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(w.host, w.port),
                 self.connect_timeout_s)
         except (OSError, asyncio.TimeoutError) as e:
             raise ConnectError(
                 f"cluster worker {w.url} unreachable: {e}") from e
+        if self.chaos is not None and self.chaos.pending():
+            reader, writer = self.chaos.wrap(reader, writer)
+        return reader, writer
 
     async def _unary(self, w: RemoteWorker, request: dict,
                      timeout: Optional[float] = None) -> dict:
         """One request frame -> one JSON status frame."""
         reader, writer = await self._open(w)
+        what = f"{request.get('action', 'unary')} status"
         try:
-            await _send_frame(writer, json.dumps(request).encode())
+            await _send_frame(writer, json.dumps(request).encode(),
+                              crc=self.crc and w.crc)
             raw = await asyncio.wait_for(
-                _read_frame(reader, self.max_frame),
+                _read_frame(reader, self.max_frame, what=what),
                 timeout or self.request_timeout_s)
             if raw is None:
                 raise ConnectError(
@@ -1414,11 +1735,157 @@ class ClusterDispatcher:
             w.page_occupancy, w.inflight, w.url))
         return cands[: self.decode_candidates]
 
+    def _hop_timeout(self, batch: Optional[MessageBatch]) -> float:
+        """Per-hop I/O deadline: the batch's remaining end-to-end budget
+        (``__meta_ext_deadline_ms``) when it carries one, clamped between
+        the floor and the flat request timeout. A wedged owner then costs
+        the batch's own budget, not 30-60s of everyone's."""
+        t = self.request_timeout_s
+        if batch is None:
+            return t
+        try:
+            rem = batch.remaining_deadline_ms()
+        except Exception:
+            rem = None
+        if rem is None:
+            return t
+        return max(self.io_deadline_floor_s, min(t, rem / 1000.0))
+
+    def _note_latency(self, dt: float) -> None:
+        self._lat_samples.append(dt)
+        if len(self._lat_samples) >= 8:
+            s = sorted(self._lat_samples)
+            p99 = s[min(len(s) - 1, int(0.99 * len(s)))]
+            self._p99_ewma = (p99 if self._p99_ewma is None
+                              else 0.8 * self._p99_ewma + 0.2 * p99)
+
+    def latency_snapshot(self) -> list[float]:
+        """Recent per-dispatch latencies (seconds) — soaks read p99 here."""
+        return sorted(self._lat_samples)
+
+    def _hedge_delay_s(self) -> float:
+        assert self._hedge is not None
+        fixed = self._hedge["delay_s"]
+        if fixed is not None:
+            return fixed
+        floor = self._hedge["min_delay_s"]
+        if self._p99_ewma is not None:
+            return max(self._p99_ewma, floor)
+        # cold start (no latency samples yet): hedge late rather than
+        # doubling every warmup dispatch
+        return max(self.request_timeout_s / 4.0, floor)
+
+    def _hedge_budget_ok(self) -> bool:
+        assert self._hedge is not None
+        return (self._hedges_issued
+                < self._hedge["max_fraction"] * self._dispatch_count
+                + self._hedge["burst"])
+
+    async def _attempt(self, w: RemoteWorker, batch: MessageBatch, *,
+                       ctx, tracer, decode_urls: Sequence[str],
+                       decode_crc: Sequence[str],
+                       fenced: Sequence[str],
+                       timeout_s: float) -> list[MessageBatch]:
+        """One dispatch attempt on one worker, with the per-worker
+        accounting that used to live inline in the dispatch loop. Raises
+        classified: ``_WorkerDraining`` (marked), ``_RemoteProcessingError``
+        (terminal), transport errors (worker marked down)."""
+        w.inflight += 1
+        w.m_inflight.set(w.inflight)
+        try:
+            out = await self._infer_on(w, batch, ctx=ctx, tracer=tracer,
+                                       decode_urls=decode_urls,
+                                       decode_crc=decode_crc, fenced=fenced,
+                                       timeout_s=timeout_s)
+        except _WorkerDraining:
+            w.draining = True
+            raise
+        except _RemoteProcessingError:
+            raise
+        except FrameIntegrityError as e:
+            # one corrupted frame is transport damage, not a dead worker:
+            # fail over for THIS batch, keep the worker in the ring
+            self.m_frame_errors.inc()
+            logger.warning(
+                "remote_tpu[%s]: corrupt frame from %s (%s); failing over "
+                "without marking it down", self.name, w.url, e)
+            raise
+        except (ConnectError, ConnectionError, OSError,
+                asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
+            if w.alive:
+                self.m_deaths.inc()
+                logger.warning(
+                    "remote_tpu[%s]: worker %s failed mid-dispatch (%s); "
+                    "retrying on the ring's next worker", self.name,
+                    w.url, e)
+            w.note_down(e)
+            raise
+        else:
+            w.dispatched += 1
+            w.m_dispatched.inc()
+            return out
+        finally:
+            w.inflight -= 1
+            w.m_inflight.set(w.inflight)
+
+    async def _attempt_hedged(self, primary: RemoteWorker,
+                              hedge_w: RemoteWorker, batch: MessageBatch,
+                              **kw) -> list[MessageBatch]:
+        """Race the owner against its ring successor: the hedge launches
+        only after the hedge delay (p99 EWMA or configured) AND under the
+        hedge budget; first success wins, the loser is cancelled. Safe
+        duplicate execution: both workers compute the same fingerprint, so
+        response caches keep the answers byte-identical."""
+        p_task = asyncio.ensure_future(self._attempt(primary, batch, **kw))
+        done, _ = await asyncio.wait({p_task}, timeout=self._hedge_delay_s())
+        if p_task in done:
+            return p_task.result()  # raises through, classified
+        if not self._hedge_budget_ok():
+            self.m_hedge["denied"].inc()
+            return await p_task
+        self._hedges_issued += 1
+        self.m_hedge["issued"].inc()
+        h_task = asyncio.ensure_future(self._attempt(hedge_w, batch, **kw))
+        pending = {p_task, h_task}
+        failures: list[BaseException] = []
+        try:
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    try:
+                        result = t.result()
+                    except _RemoteProcessingError:
+                        raise  # terminal: no point waiting on the sibling
+                    except Exception as e:
+                        failures.append(e)
+                        continue
+                    loser = primary if t is h_task else hedge_w
+                    self.m_hedge["win" if t is h_task
+                                 else "primary_win"].inc()
+                    if t is h_task:
+                        logger.info(
+                            "remote_tpu[%s]: hedge to %s won the race; "
+                            "cancelled the owner %s", self.name,
+                            hedge_w.url, loser.url)
+                    return result
+            self.m_hedge["failed"].inc()
+            raise failures[-1]
+        finally:
+            for t in (p_task, h_task):
+                if not t.done():
+                    t.cancel()
+            # settle the cancelled loser so its inflight accounting and
+            # connection teardown finish before we return
+            await asyncio.gather(p_task, h_task, return_exceptions=True)
+
     async def dispatch(self, batch: MessageBatch) -> list[MessageBatch]:
         """Route one emission to the fleet; failover along the ring on
-        transport errors. Raises on remote PROCESSING errors (no sibling
-        retry — see _RemoteProcessingError) and when every worker is down
-        (the stream's nack path then preserves at-least-once).
+        transport errors, bounded by the retry budget; hedged against the
+        ring successor when configured. Raises on remote PROCESSING errors
+        (no sibling retry — see _RemoteProcessingError) and when every
+        worker is down (the stream's nack path then preserves
+        at-least-once).
 
         On a role-split fleet the plan is two-hop: prompts go to a
         prefill-capable worker chosen by prefix hash (hop 1), carrying the
@@ -1426,15 +1893,22 @@ class ClusterDispatcher:
         finished KV pages to the first accepting decode worker (hop 2) and
         relays its tokens on this same infer stream."""
         decode_urls: list[str] = []
+        decode_crc: list[str] = []
         if self.role_split():
             candidates = self.plan(self.routing_key(batch), role="prefill")
-            decode_urls = [w.url for w in self.decode_targets()]
+            targets = self.decode_targets()
+            decode_urls = [w.url for w in targets]
+            decode_crc = [w.url for w in targets if w.crc]
         else:
             candidates = self.plan(self.routing_key(batch))
         if not candidates:
             raise ConnectError(
                 f"remote_tpu[{self.name}]: no live cluster worker "
                 f"(fleet: {[w.report()['state'] for w in self.workers.values()]})")
+        # fence list rides with the request: a worker (or its kv_push
+        # peers) whose incarnation appears here knows it was declared dead
+        # and refuses retryably instead of serving from a stale epoch
+        fenced = sorted({f for w in self.workers.values() for f in w.fenced})
         # prefer the ambient stream scope (hops then parent under the
         # process span, and in-process test fleets keep tier separation);
         # fall back to the batch's own column for direct dispatcher use
@@ -1446,53 +1920,73 @@ class ClusterDispatcher:
         else:
             tracer = global_tracer()
             ctx = batch.trace_context() if tracer.enabled else None
+        self._dispatch_count += 1
+        if self._retry_tokens is not None:
+            self._retry_tokens = min(
+                self._retry_tokens + self._retry_budget["ratio"],
+                float(self._retry_budget["burst"]))
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        kw = dict(ctx=ctx, tracer=tracer, decode_urls=decode_urls,
+                  decode_crc=decode_crc, fenced=fenced,
+                  timeout_s=self._hop_timeout(batch))
         last_exc: Optional[BaseException] = None
-        for i, w in enumerate(candidates):
+        i, n = 0, len(candidates)
+        while i < n:
             if i > 0:
+                if self._retry_tokens is not None:
+                    if self._retry_tokens < 1.0:
+                        self.m_retry_shed.inc()
+                        raise RetryBudgetExhausted(
+                            f"remote_tpu[{self.name}]: ring retry budget "
+                            f"exhausted after {i} attempt(s) (ratio "
+                            f"{self._retry_budget['ratio']}, last: "
+                            f"{last_exc}); shedding instead of amplifying "
+                            "a fleet-wide brownout",
+                            retry_after_s=self.heartbeat_s)
+                    self._retry_tokens -= 1.0
                 self.m_retries.inc()
-            w.inflight += 1
-            w.m_inflight.set(w.inflight)
+            w = candidates[i]
+            hedge_w = (candidates[i + 1]
+                       if self._hedge is not None and i + 1 < n else None)
             try:
-                out = await self._infer_on(w, batch, ctx=ctx, tracer=tracer,
-                                           decode_urls=decode_urls)
-            except _WorkerDraining:
-                w.draining = True
-                last_exc = ConnectError(f"worker {w.url} draining")
-                continue
+                if hedge_w is not None:
+                    out = await self._attempt_hedged(w, hedge_w, batch, **kw)
+                else:
+                    out = await self._attempt(w, batch, **kw)
             except _RemoteProcessingError as e:
                 raise ProcessError(
                     f"cluster worker {w.url} failed the batch: {e}") from e
-            except (ConnectError, ConnectionError, OSError,
-                    asyncio.IncompleteReadError, asyncio.TimeoutError) as e:
-                if w.alive:
-                    self.m_deaths.inc()
-                    logger.warning(
-                        "remote_tpu[%s]: worker %s failed mid-dispatch (%s); "
-                        "retrying on the ring's next worker", self.name,
-                        w.url, e)
-                w.note_down(e)
-                last_exc = e
+            except (_WorkerDraining, ConnectError, ConnectionError, OSError,
+                    asyncio.IncompleteReadError, asyncio.TimeoutError,
+                    ReadError) as e:
+                last_exc = (ConnectError(f"worker {w.url} draining")
+                            if isinstance(e, _WorkerDraining) else e)
+                # a hedged round consumed two candidates; skip both
+                i += 2 if hedge_w is not None else 1
                 continue
             else:
-                w.dispatched += 1
-                w.m_dispatched.inc()
+                self._note_latency(loop.time() - t0)
                 return out
-            finally:
-                w.inflight -= 1
-                w.m_inflight.set(w.inflight)
         raise ConnectError(
-            f"remote_tpu[{self.name}]: all {len(candidates)} candidate "
+            f"remote_tpu[{self.name}]: all {n} candidate "
             f"workers failed for this batch (last: {last_exc}); leaving it "
             "to the redelivery path")
 
     async def _infer_on(self, w: RemoteWorker, batch: MessageBatch, *,
                         ctx: Optional[TraceContext] = None,
                         tracer: Optional[Tracer] = None,
-                        decode_urls: Sequence[str] = ()) -> list[MessageBatch]:
+                        decode_urls: Sequence[str] = (),
+                        decode_crc: Sequence[str] = (),
+                        fenced: Sequence[str] = (),
+                        timeout_s: Optional[float] = None) -> list[MessageBatch]:
         import time as _time
 
         from arkflow_tpu.obs.trace import _new_id
 
+        if timeout_s is None:
+            timeout_s = self.request_timeout_s
+        use_crc = self.crc and w.crc
         # per-hop tracing: the hop span's id is minted BEFORE the call so
         # the worker can parent its spans under it; serialize / transport /
         # deserialize are ingest-side children, remote_* spans arrive in the
@@ -1509,6 +2003,12 @@ class ClusterDispatcher:
                 # KV pages to these, in this occupancy order (skipping
                 # itself — a 'both' worker just decodes locally)
                 req["decode_workers"] = [u for u in decode_urls if u != w.url]
+                if decode_crc:
+                    # subset of decode_workers that negotiated crc framing,
+                    # so the prefill worker protects its kv_push slabs too
+                    req["decode_crc"] = [u for u in decode_crc if u != w.url]
+            if fenced:
+                req["fenced"] = list(fenced)
             if ctx is not None:
                 req["trace"] = ctx.with_parent(hop_id).to_dict()
             t0 = _time.perf_counter()
@@ -1517,10 +2017,11 @@ class ClusterDispatcher:
                 tracer.record(ctx, "flight_serialize",
                               _time.perf_counter() - t0, parent_id=hop_id)
             t_send = _time.perf_counter()
-            await _send_frame(writer, json.dumps(req).encode())
-            await _send_frame(writer, ipc)
+            await _send_frame(writer, json.dumps(req).encode(), crc=use_crc)
+            await _send_frame(writer, ipc, crc=use_crc)
             raw = await asyncio.wait_for(
-                _read_frame(reader, self.max_frame), self.request_timeout_s)
+                _read_frame(reader, self.max_frame, what="infer status"),
+                timeout_s)
             if raw is None:
                 raise ConnectError(f"worker {w.url} closed before a status")
             if tracer is not None:
@@ -1528,8 +2029,30 @@ class ClusterDispatcher:
                 # (its own decode/queue/step costs arrive as remote_* spans)
                 tracer.record(ctx, "flight_transport",
                               _time.perf_counter() - t_send, parent_id=hop_id)
-            status = json.loads(raw.decode())
+            try:
+                status = json.loads(raw.decode())
+            except (UnicodeDecodeError, ValueError) as e:
+                # a status frame that isn't JSON is wire damage from a peer
+                # without crc trailers (negotiated-off, or a corrupted
+                # register) — fail over loudly, don't quarantine the batch
+                raise FrameIntegrityError(
+                    f"undecodable infer status frame from {w.url}: "
+                    f"{e!r}") from e
+            inc = status.get("incarnation")
+            if isinstance(inc, str) and w.is_fenced(inc):
+                # a partition-healed zombie answered from its fenced epoch:
+                # its caches and occupancy are stale — reject and fail over
+                self.m_fenced.inc()
+                raise ConnectError(
+                    f"worker {w.url} answered from fenced incarnation "
+                    f"{inc}; rejecting the zombie's response")
             if not status.get("ok"):
+                if status.get("reason") == "frame_integrity":
+                    # OUR request arrived corrupted; the worker refused it
+                    # unprocessed — surface as the same loud integrity error
+                    # a corrupted response raises (failover, counted, and no
+                    # draining/death bookkeeping for a healthy worker)
+                    raise FrameIntegrityError(status.get("error"))
                 if status.get("retryable"):
                     raise _WorkerDraining(status.get("error"))
                 raise _RemoteProcessingError(status.get("error"))
@@ -1537,8 +2060,8 @@ class ClusterDispatcher:
             deser_s = 0.0
             while True:
                 frame = await asyncio.wait_for(
-                    _read_frame(reader, self.max_frame),
-                    self.request_timeout_s)
+                    _read_frame(reader, self.max_frame, what="infer frame"),
+                    timeout_s)
                 if frame is None:
                     if tracer is not None:
                         tracer.record(ctx, "flight_deserialize", deser_s,
@@ -1635,13 +2158,28 @@ class ClusterDispatcher:
     # -- introspection -----------------------------------------------------
 
     def report(self) -> dict:
-        return {
+        out = {
             "workers": {u: w.report() for u, w in sorted(self.workers.items())},
             "alive": sum(1 for w in self.workers.values() if w.alive),
             "route_key": self.route_key,
             "retries": self.m_retries.value,
             "spills": self.m_spills.value,
+            "fenced_rejections": self.m_fenced.value,
+            "frame_errors": self.m_frame_errors.value,
         }
+        if self._hedge is not None:
+            out["hedge"] = {
+                "dispatches": self._dispatch_count,
+                "issued": self._hedges_issued,
+                "outcomes": {k: c.value for k, c in self.m_hedge.items()},
+                "p99_ewma_s": self._p99_ewma,
+            }
+        if self._retry_tokens is not None:
+            out["retry_budget"] = {
+                "tokens": self._retry_tokens,
+                "shed": self.m_retry_shed.value,
+            }
+        return out
 
     def health_reports(self) -> list[dict]:
         """Engine /health and /readiness aggregation: one report per worker
@@ -1873,6 +2411,85 @@ def parse_remote_tpu_config(config: Mapping) -> dict:
     if tf is not None and not isinstance(tf, str):
         raise ConfigError(f"remote_tpu.text_field must be a string, got {tf!r}")
     out["text_field"] = tf
+    crc = config.get("crc", True)
+    if not isinstance(crc, bool):
+        raise ConfigError(f"remote_tpu.crc must be a bool, got {crc!r}")
+    out["crc"] = crc
+    out["io_deadline_floor_s"] = _dur("io_deadline_floor", "100ms")
+
+    hedge = config.get("hedge")
+    if hedge is not None:
+        if not isinstance(hedge, Mapping):
+            raise ConfigError(
+                f"remote_tpu.hedge must be a mapping, got {hedge!r}")
+        unknown = set(hedge) - {"delay", "max_fraction", "burst", "min_delay"}
+        if unknown:
+            raise ConfigError(
+                f"remote_tpu.hedge: unknown keys {sorted(unknown)} "
+                "(allowed: delay, max_fraction, burst, min_delay)")
+        h: dict = {}
+        delay = hedge.get("delay", "auto")
+        if delay == "auto":
+            h["delay_s"] = None  # p99-EWMA of recent dispatch latency
+        else:
+            try:
+                d = parse_duration(delay)
+            except (ConfigError, TypeError, ValueError) as e:
+                raise ConfigError(
+                    f"remote_tpu.hedge.delay must be 'auto' or a "
+                    f"duration: {e}") from e
+            if d <= 0:
+                raise ConfigError(
+                    f"remote_tpu.hedge.delay must be > 0, got {delay!r}")
+            h["delay_s"] = d
+        frac = hedge.get("max_fraction", 0.1)
+        if isinstance(frac, bool) or not isinstance(frac, (int, float)) \
+                or not 0.0 < frac <= 1.0:
+            raise ConfigError(
+                f"remote_tpu.hedge.max_fraction must be in (0, 1], "
+                f"got {frac!r}")
+        h["max_fraction"] = float(frac)
+        burst = hedge.get("burst", 4)
+        if isinstance(burst, bool) or not isinstance(burst, int) or burst < 0:
+            raise ConfigError(
+                f"remote_tpu.hedge.burst must be an int >= 0, got {burst!r}")
+        h["burst"] = burst
+        md = hedge.get("min_delay", "10ms")
+        try:
+            mds = parse_duration(md)
+        except (ConfigError, TypeError, ValueError) as e:
+            raise ConfigError(f"remote_tpu.hedge.min_delay invalid: {e}") from e
+        if mds <= 0:
+            raise ConfigError(
+                f"remote_tpu.hedge.min_delay must be > 0, got {md!r}")
+        h["min_delay_s"] = mds
+        out["hedge"] = h
+    else:
+        out["hedge"] = None
+
+    rb = config.get("retry_budget")
+    if rb is not None:
+        if not isinstance(rb, Mapping):
+            raise ConfigError(
+                f"remote_tpu.retry_budget must be a mapping, got {rb!r}")
+        unknown = set(rb) - {"ratio", "burst"}
+        if unknown:
+            raise ConfigError(
+                f"remote_tpu.retry_budget: unknown keys {sorted(unknown)} "
+                "(allowed: ratio, burst)")
+        ratio = rb.get("ratio", 0.5)
+        if isinstance(ratio, bool) or not isinstance(ratio, (int, float)) \
+                or ratio <= 0:
+            raise ConfigError(
+                f"remote_tpu.retry_budget.ratio must be > 0, got {ratio!r}")
+        burst = rb.get("burst", 8)
+        if isinstance(burst, bool) or not isinstance(burst, int) or burst < 1:
+            raise ConfigError(
+                f"remote_tpu.retry_budget.burst must be an int >= 1, "
+                f"got {burst!r}")
+        out["retry_budget"] = {"ratio": float(ratio), "burst": burst}
+    else:
+        out["retry_budget"] = None
     parse_response_cache_config(config.get("response_cache"))
     # elastic-fleet block (runtime/fleet.py owns the parse rules); pure —
     # config.py reaches this through fault.inner chains at --validate time
@@ -1899,7 +2516,11 @@ def build_remote_tpu(config: dict, resource: Resource) -> RemoteTpuProcessor:
         connect_timeout_s=parsed["connect_timeout_s"],
         heartbeat_timeout_s=parsed["heartbeat_timeout_s"],
         max_frame=parsed["max_frame"],
-        decode_candidates=parsed["decode_candidates"])
+        decode_candidates=parsed["decode_candidates"],
+        crc=parsed["crc"],
+        io_deadline_floor_s=parsed["io_deadline_floor_s"],
+        hedge=parsed["hedge"],
+        retry_budget=parsed["retry_budget"])
     cache = build_response_cache(config.get("response_cache"), name=name)
     fleet = None
     fleet_cfg = parsed["fleet"]

@@ -834,11 +834,19 @@ class FleetController:
     # -- introspection -----------------------------------------------------
 
     def report(self) -> dict:
+        # workers that died with a fenced incarnation and have not
+        # re-registered under a fresh one: partition-healed zombies the
+        # dispatcher is actively rejecting (runtime/cluster.py fencing) —
+        # the operator's first question after a partition event
+        fenced = {w.url: list(w.fenced)
+                  for w in self.dispatcher.workers.values()
+                  if w.fenced and not w.alive}
         return {
             "size": len(self._live()),
             "policy": self.cfg.report(),
             "scale_outs": int(self.m_scale_out.value),
             "scale_ins": int(self.m_scale_in.value),
             "departures": int(self.m_preempt.value),
+            "fenced": fenced,
             "events": list(self._events),
         }

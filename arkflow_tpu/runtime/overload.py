@@ -71,7 +71,7 @@ STATE_SHED = 2  #: queue wait over budget; admission actively shedding
 
 _STATE_NAMES = {STATE_ADMIT: "admit", STATE_THROTTLE: "throttle", STATE_SHED: "shed"}
 
-SHED_REASONS = ("deadline", "queue", "priority", "quota")
+SHED_REASONS = ("deadline", "queue", "priority", "quota", "retry_budget")
 
 #: label every tenant past the cardinality cap collapses into — one shared
 #: state/metric series for the long tail, so a tenant-id enumeration attack

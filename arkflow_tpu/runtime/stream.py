@@ -624,7 +624,10 @@ class Stream:
             await self._safe_nack(item.ack)
             # in-process brokers requeue instantly; pace the respin so the
             # read loop doesn't spin hot on shed->redeliver->shed
-            await self.overload.wait_capacity(0.05)
+            if self.overload is not None:
+                await self.overload.wait_capacity(0.05)
+            else:
+                await asyncio.sleep(0.05)
             return
         logger.warning("[%s] shed batch (%s) with no error_output and %s; "
                        "dropping WITH ack", self.name, reason,
@@ -744,6 +747,19 @@ class Stream:
 
     async def _emit(self, item: _WorkItem, results: list[MessageBatch], err: Optional[Exception]) -> None:
         if err is not None:
+            reason = getattr(err, "shed_reason", None)
+            if reason is not None:
+                # a load-shed raised from INSIDE the chain (e.g. the cluster
+                # dispatcher's retry budget during a brownout): not a
+                # processing failure — route through the shed path so the
+                # offered == delivered + shed identity holds and the batch
+                # doesn't burn delivery attempts toward quarantine
+                if self.overload is not None:
+                    c = self.overload.m_shed.get(reason)
+                    if c is not None:
+                        c.inc()
+                await self._shed_item(item, reason)
+                return
             self.m_errors.inc()
             attempts = self._bump_attempts(item.batch, trace=item.trace)
             # forced sampling: every failed attempt commits its trace (the

@@ -1,0 +1,860 @@
+"""Partition-tolerant flight plane (connect/chaoswire.py + the hardening in
+runtime/cluster.py and connect/flight.py): per-frame crc32 integrity and its
+register-time negotiation, seeded network chaos (in-process ChaosWire and the
+frame-aware ChaosProxy), hedged dispatch, ring-retry budgets, per-hop I/O
+deadlines, incarnation fencing of partition-healed zombies, and the
+FlightClient fd-leak audit. Everything here runs without jax — workers host
+trivial in-test processors; the soak smoke at the bottom spawns real
+device-tier subprocesses."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import struct
+import sys
+import zlib
+from pathlib import Path
+
+import pyarrow as pa
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from arkflow_tpu.batch import MessageBatch
+from arkflow_tpu.components import Processor, ensure_plugins_loaded
+from arkflow_tpu.config import StreamConfig
+from arkflow_tpu.connect.chaoswire import NET_KINDS, ChaosProxy, ChaosWire
+from arkflow_tpu.connect.flight import (
+    CRC_BIT,
+    DATA_TAG,
+    FlightClient,
+    _read_frame,
+    _send_data,
+    _send_frame,
+)
+from arkflow_tpu.errors import (
+    ConfigError,
+    ConnectError,
+    FrameIntegrityError,
+    Overloaded,
+    ProcessError,
+    ReadError,
+)
+from arkflow_tpu.runtime.cluster import (
+    ClusterDispatcher,
+    ClusterWorkerServer,
+    RetryBudgetExhausted,
+    kv_export_from_wire,
+    parse_remote_tpu_config,
+    parse_worker_config,
+)
+
+ensure_plugins_loaded()
+
+
+class _Upper(Processor):
+    """Trivial device-stage stand-in: uppercases the payload column."""
+
+    def __init__(self):
+        self.calls = 0
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        self.calls += 1
+        vals = [v.upper() for v in batch.to_binary()]
+        return [batch.with_column("__value__", pa.array(vals, type=pa.binary()))]
+
+
+class _Slow(Processor):
+    """Sleeps ``delay_s`` per call — a straggler for hedging races."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = delay_s
+        self.calls = 0
+
+    async def process(self, batch: MessageBatch) -> list[MessageBatch]:
+        self.calls += 1
+        await asyncio.sleep(self.delay_s)
+        return [batch]
+
+
+async def _start_worker(procs, worker_id, **kw) -> ClusterWorkerServer:
+    srv = ClusterWorkerServer(procs, host="127.0.0.1", port=0,
+                              worker_id=worker_id, **kw)
+    await srv.connect()
+    await srv.start()
+    return srv
+
+
+def _url(srv: ClusterWorkerServer) -> str:
+    return f"arkflow://127.0.0.1:{srv.port}"
+
+
+def _run(coro, timeout=30.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+# -- crc32 framing (connect/flight.py) ---------------------------------------
+
+
+class _PipePair:
+    """An in-memory (reader, writer)-alike pair for codec tests."""
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def write(self, data) -> None:
+        self.buf.extend(bytes(data))
+
+    async def drain(self) -> None:
+        pass
+
+    def reader(self) -> asyncio.StreamReader:
+        r = asyncio.StreamReader()
+        r.feed_data(bytes(self.buf))
+        r.feed_eof()
+        return r
+
+
+def test_crc_frame_roundtrip_and_negotiation_marker():
+    async def go():
+        pipe = _PipePair()
+        await _send_frame(pipe, b"hello integrity", crc=True)
+        r = pipe.reader()
+        out = await _read_frame(r)
+        assert out == b"hello integrity"
+        # servers echo the negotiation off this marker
+        assert r._arkflow_crc is True
+
+        plain = _PipePair()
+        await _send_frame(plain, b"no trailer", crc=False)
+        r2 = plain.reader()
+        assert await _read_frame(r2) == b"no trailer"
+        assert r2._arkflow_crc is False
+
+    _run(go())
+
+
+def test_crc_corruption_is_loud_and_names_the_frame_class():
+    async def go():
+        pipe = _PipePair()
+        await _send_frame(pipe, b"precious payload bytes", crc=True)
+        buf = bytearray(pipe.buf)
+        buf[9] ^= 0xFF  # flip a payload byte, leave header + trailer alone
+        r = asyncio.StreamReader()
+        r.feed_data(bytes(buf))
+        r.feed_eof()
+        with pytest.raises(FrameIntegrityError, match="kv_push slab"):
+            await _read_frame(r, what="kv_push slab")
+        # FrameIntegrityError subclasses ReadError: existing handlers that
+        # treat reads as retryable keep working
+        assert issubclass(FrameIntegrityError, ReadError)
+
+    _run(go())
+
+
+def test_crc_data_frame_trailer_covers_tag_and_payload():
+    async def go():
+        pipe = _PipePair()
+        await _send_data(pipe, b"row bytes", crc=True)
+        raw = bytes(pipe.buf)
+        (word,) = struct.unpack(">I", raw[:4])
+        assert word & CRC_BIT
+        n = word & ~CRC_BIT
+        body = raw[4:4 + n]
+        assert body == DATA_TAG + b"row bytes"
+        (trailer,) = struct.unpack(">I", raw[4 + n:8 + n])
+        assert trailer == zlib.crc32(body)
+        # and the reader accepts it
+        r = pipe.reader()
+        assert await _read_frame(r) == DATA_TAG + b"row bytes"
+
+    _run(go())
+
+
+def test_crc_end_marker_stays_plain_and_crc_bit_caps_length():
+    async def go():
+        # a frame with CRC_BIT carries a real length in the low bits only;
+        # lengths are capped at 1 GiB so the bit is never ambiguous
+        r = asyncio.StreamReader()
+        r.feed_data(struct.pack(">I", 0))
+        r.feed_eof()
+        assert await _read_frame(r) is None  # end marker: no trailer read
+
+        big = asyncio.StreamReader()
+        big.feed_data(struct.pack(">I", (1 << 30) - 1 | CRC_BIT))
+        big.feed_eof()
+        with pytest.raises(ConnectError, match="max_frame"):
+            await _read_frame(big, limit=1024)
+
+    _run(go())
+
+
+def test_crc_negotiated_per_peer_old_workers_interoperate():
+    """A crc-off worker still serves a crc-on dispatcher (and vice versa):
+    the dispatcher only sends trailers to peers that advertised the
+    capability in their register report."""
+    async def go():
+        old = await _start_worker([_Upper()], "old", crc=False)
+        new = await _start_worker([_Upper()], "new", crc=True)
+        d = ClusterDispatcher([_url(old), _url(new)], name="nc-mixed",
+                              heartbeat_s=999.0, crc=True)
+        try:
+            await d.start()
+            assert d.workers[_url(old)].crc is False
+            assert d.workers[_url(new)].crc is True
+            for i in range(6):
+                out = await d.dispatch(
+                    MessageBatch.new_binary([f"mix {i}".encode()]))
+                assert out[0].to_binary() == [f"MIX {i}".upper().encode()]
+        finally:
+            await d.close()
+            await old.stop()
+            await new.stop()
+
+    _run(go())
+
+
+# -- chaoswire: the in-process transport + the net_* fault kinds --------------
+
+
+def test_chaoswire_arm_validates_and_wrap_consumes():
+    class _W:
+        def write(self, data):
+            pass
+
+        async def drain(self):
+            pass
+
+    async def go():
+        wire = ChaosWire(seed=3)
+        with pytest.raises(ConfigError, match="unknown net fault"):
+            wire.arm("gremlins")
+        wire.arm("reset")
+        assert wire.pending()
+        cr, cw = wire.wrap(asyncio.StreamReader(), _W())
+        assert not wire.pending()  # wrap consumed the armed fault
+        # an unarmed wrap is a passthrough (no wrapper allocation)
+        r2, w2 = asyncio.StreamReader(), _W()
+        assert wire.wrap(r2, w2) == (r2, w2)
+        with pytest.raises(ConnectionResetError):
+            await cr.readexactly(4)
+        assert wire.fired["reset"] == 1
+
+    _run(go())
+
+
+def test_chaoswire_corrupt_flips_one_seeded_byte():
+    async def go():
+        wire = ChaosWire(seed=11)
+        wire.arm("corrupt")
+        r = asyncio.StreamReader()
+        payload = bytes(range(64))
+        r.feed_data(payload)
+        r.feed_eof()
+
+        class _W:
+            def write(self, data):
+                pass
+
+            async def drain(self):
+                pass
+
+        cr, _ = wire.wrap(r, _W())
+        out = await cr.readexactly(64)
+        diff = [i for i in range(64) if out[i] != payload[i]]
+        assert len(diff) == 1  # exactly one byte, xor 0xFF
+        assert out[diff[0]] == payload[diff[0]] ^ 0xFF
+        # determinism: same seed, same offset
+        wire2 = ChaosWire(seed=11)
+        wire2.arm("corrupt")
+        r2 = asyncio.StreamReader()
+        r2.feed_data(payload)
+        r2.feed_eof()
+        cr2, _ = wire2.wrap(r2, _W())
+        out2 = await cr2.readexactly(64)
+        assert out2 == out
+
+    _run(go())
+
+
+def test_net_fault_kinds_exposed_by_fault_plugin():
+    from arkflow_tpu.plugins.fault.wrappers import PROCESSOR_KINDS, _NET_KINDS
+
+    assert _NET_KINDS == {f"net_{k}" for k in NET_KINDS}
+    assert _NET_KINDS <= PROCESSOR_KINDS
+
+
+def test_net_fault_requires_a_dispatch_inner():
+    """Arming net chaos on a non-cluster inner is a loud config mistake,
+    not a silent no-op."""
+    cfg = StreamConfig.from_mapping({
+        "name": "netfault-miswired",
+        "input": {"type": "memory", "messages": ["x"]},
+        "pipeline": {"processors": [{
+            "type": "fault",
+            "faults": [{"kind": "net_reset", "at": 1}],
+            "inner": {"type": "python",
+                      "script": "def process(b): return b"},
+        }]},
+        "output": {"type": "drop"},
+    })
+    from arkflow_tpu.runtime import build_stream
+
+    stream = build_stream(cfg)
+    proc = stream.pipeline.processors[0]
+
+    async def go():
+        with pytest.raises(ProcessError, match="remote_tpu"):
+            await proc.process(MessageBatch.new_binary([b"x"]))
+
+    _run(go())
+
+
+def test_net_corrupt_fault_counts_frame_error_and_fails_over():
+    """The net_corrupt kind armed through the dispatcher: the first attempt
+    reads a corrupted frame (loud, counted), the ring retry delivers — and
+    the corrupt frame does NOT mark the worker dead."""
+    async def go():
+        w0 = await _start_worker([_Upper()], "w0")
+        w1 = await _start_worker([_Upper()], "w1")
+        d = ClusterDispatcher([_url(w0), _url(w1)], name="nc-netcorrupt",
+                              heartbeat_s=999.0)
+        try:
+            await d.start()
+            d.chaos_arm("corrupt", seed=5)
+            out = await d.dispatch(MessageBatch.new_binary([b"storm row"]))
+            assert out[0].to_binary() == [b"STORM ROW"]
+            assert d.m_frame_errors.value == 1
+            assert d.m_retries.value == 1
+            # both workers still alive: one corrupt frame != a dead peer
+            assert all(w.alive for w in d.workers.values())
+        finally:
+            await d.close()
+            await w0.stop()
+            await w1.stop()
+
+    _run(go())
+
+
+# -- corrupted kv_push slabs (satellite: loud + nack through redelivery) -----
+
+
+def test_kv_export_from_wire_validates_slab_lengths():
+    meta = {"shards": 1, "shape": [2, 2], "dtype": "float32",
+            "prompt_len": 4}
+    with pytest.raises(ConnectError, match="slab"):
+        # truncated K slab (expect 16 bytes for (2,2) float32)
+        kv_export_from_wire(meta, [b"\x00" * 7, b"\x00" * 16])
+    with pytest.raises(ConnectError, match="slab frames"):
+        kv_export_from_wire(meta, [b"\x00" * 16])  # missing the V slab
+    out = kv_export_from_wire(meta, [b"\x00" * 16, b"\x00" * 16])
+    assert out["k"][0].shape == (2, 2)
+
+
+def test_corrupted_kv_push_slab_is_loud_and_counted():
+    """A kv_push whose slab frame fails the crc check errors loudly on the
+    worker (crc_errors counted, named frame class) and the pusher sees a
+    retryable integrity refusal — never silently adopted garbage."""
+    async def go():
+        srv = await _start_worker([_Upper()], "decode0", crc=True)
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           srv.port)
+            meta = {"pages": [{"dtype": "uint8", "shape": [8]}],
+                    "page_len": 8, "prompt_len": 8}
+            req = {"action": "kv_push", "request_id": "r1", "meta": meta,
+                   "frames": 1}
+            await _send_frame(writer, json.dumps(req).encode(), crc=True)
+            # slab frame with a deliberately wrong trailer
+            slab = bytes(range(8))
+            writer.write(struct.pack(">I", len(slab) | CRC_BIT) + slab)
+            writer.write(struct.pack(">I", zlib.crc32(slab) ^ 0xDEADBEEF))
+            await writer.drain()
+            raw = await asyncio.wait_for(_read_frame(reader), 10.0)
+            status = json.loads(raw.decode())
+            assert status["ok"] is False
+            assert status.get("retryable") is True
+            assert status.get("reason") == "frame_integrity"
+            assert "crc32 mismatch" in status["error"]
+            writer.close()
+        finally:
+            await srv.stop()
+        assert srv._crc_errors == 1
+
+    _run(go())
+
+
+def test_corrupted_infer_request_nacks_through_redelivery():
+    """End-to-end: a stream whose EVERY dispatch reads one corrupted frame
+    still delivers every row — the loud integrity error nacks the attempt,
+    the ring retry (same batch, redelivered plan) lands clean."""
+    delivered: list[bytes] = []
+
+    from arkflow_tpu.plugins.output.drop import DropOutput
+
+    class _Collect(DropOutput):
+        async def write(self, batch: MessageBatch) -> None:
+            delivered.extend(batch.to_binary())
+
+    async def go():
+        w0 = await _start_worker([_Upper()], "w0")
+        w1 = await _start_worker([_Upper()], "w1")
+        cfg = StreamConfig.from_mapping({
+            "name": "netchaos-redelivery",
+            "input": {"type": "memory",
+                      "messages": [f"redeliver {i}" for i in range(6)]},
+            "pipeline": {"thread_num": 1, "max_delivery_attempts": 8,
+                         "processors": [{
+                             "type": "fault", "seed": 9,
+                             "faults": [{"kind": "net_corrupt", "every": 1,
+                                         "times": 0}],
+                             "inner": {"type": "remote_tpu",
+                                       "name": "netchaos-redelivery",
+                                       "workers": [_url(w0), _url(w1)],
+                                       "heartbeat": "30s"}}]},
+            "output": {"type": "drop"},
+        })
+        from arkflow_tpu.runtime import build_stream
+
+        stream = build_stream(cfg)
+        stream.output = _Collect()
+        try:
+            await asyncio.wait_for(stream.run(asyncio.Event()), 30.0)
+            disp = stream.pipeline.processors[0].dispatcher
+            assert disp.m_frame_errors.value == 6  # one loud error per row
+            assert disp.m_retries.value == 6
+        finally:
+            await w0.stop()
+            await w1.stop()
+        assert sorted(delivered) == sorted(
+            f"REDELIVER {i}".encode() for i in range(6))
+
+    _run(go())
+
+
+# -- blackhole staleness, fencing, zombie rejection ---------------------------
+
+
+def test_blackholed_worker_is_fenced_within_heartbeat_timeout():
+    """One-way partition via the frame-aware proxy: requests flow, responses
+    vanish. Detection comes from the probe timeout (not a transport error),
+    the epoch is fenced, and after the heal the zombie's report is REJECTED
+    and counted before a re-minted epoch is re-admitted."""
+    async def go():
+        srv = await _start_worker([_Upper()], "w0")
+        proxy = ChaosProxy("127.0.0.1", srv.port, seed=2)
+        await proxy.start()
+        d = ClusterDispatcher([proxy.url], name="nc-blackhole",
+                              heartbeat_s=0.1, heartbeat_timeout_s=0.5,
+                              connect_timeout_s=1.0)
+        try:
+            await d.start()
+            pw = d.workers[proxy.url]
+            inc0 = pw.incarnation
+            assert pw.alive and inc0
+
+            proxy.mode = "blackhole"
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            while pw.alive and loop.time() - t0 < 5.0:
+                await asyncio.sleep(0.02)
+            detected_s = loop.time() - t0
+            assert not pw.alive
+            # detection within heartbeat_timeout (+ one interval + slack)
+            assert detected_s <= 0.5 + 0.1 + 0.5, detected_s
+            assert inc0 in pw.fenced
+
+            proxy.mode = None  # partition heals; the zombie resurfaces
+            t0 = loop.time()
+            while loop.time() - t0 < 5.0:
+                if d.m_fenced.value >= 1 and pw.alive:
+                    break
+                await asyncio.sleep(0.02)
+            assert d.m_fenced.value >= 1  # zombie report rejected + counted
+            assert pw.alive  # re-admitted...
+            assert pw.incarnation != inc0  # ...under a fresh epoch
+            assert not pw.is_fenced(pw.incarnation)
+        finally:
+            await d.close()
+            await proxy.stop()
+            await srv.stop()
+
+    _run(go())
+
+
+def test_zombie_late_response_is_rejected_and_counted():
+    """A worker whose epoch was fenced answers an infer from the OLD
+    incarnation: the dispatcher rejects the response (counted) rather than
+    trusting a zombie's output, and fails over."""
+    async def go():
+        w0 = await _start_worker([_Upper()], "w0")
+        w1 = await _start_worker([_Upper()], "w1")
+        d = ClusterDispatcher([_url(w0), _url(w1)], name="nc-zombie",
+                              heartbeat_s=999.0)
+        try:
+            await d.start()
+            m_fenced0 = d.m_fenced.value
+            # fence w0's CURRENT incarnation without telling w0 (the
+            # one-way-partition case: it never saw the verdict)
+            for w in d.workers.values():
+                if w.worker_id == "w0":
+                    w.fenced.append(w.incarnation)
+                    zombie = w
+            out = await d.dispatch(MessageBatch.new_binary([b"late frame"]))
+            # delivered — but never by the zombie's fenced epoch
+            assert out[0].to_binary() == [b"LATE FRAME"]
+            routed_to_zombie = d.m_fenced.value > m_fenced0
+            if routed_to_zombie:
+                # the ring routed to w0 first: its answer was rejected
+                assert zombie.dispatched == 0
+        finally:
+            await d.close()
+            await w0.stop()
+            await w1.stop()
+
+    _run(go())
+
+
+def test_worker_refuses_fenced_incarnation_and_reminTs():
+    """Dispatch-side fencing propagation: an infer carrying the worker's own
+    incarnation in ``fenced`` is refused retryably and the worker re-mints
+    (so a stale ingest verdict can't wedge it forever)."""
+    async def go():
+        srv = await _start_worker([_Upper()], "w0")
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           srv.port)
+            inc0 = srv.incarnation
+            req = {"action": "infer", "fenced": [inc0]}
+            await _send_frame(writer, json.dumps(req).encode())
+            from arkflow_tpu.connect.flight import batch_to_ipc
+            ipc = batch_to_ipc(MessageBatch.new_binary([b"x"]).record_batch)
+            await _send_frame(writer, ipc)
+            raw = await asyncio.wait_for(_read_frame(reader), 10.0)
+            status = json.loads(raw.decode())
+            assert status["ok"] is False and status["retryable"] is True
+            assert srv.incarnation != inc0  # re-minted
+            assert srv._fence_refused == 1
+            writer.close()
+        finally:
+            await srv.stop()
+
+    _run(go())
+
+
+# -- hedged dispatch ----------------------------------------------------------
+
+
+def test_hedge_fires_on_straggler_and_cancels_loser():
+    """Primary owner is slow; the hedge (ring successor) answers first and
+    wins. The loser is cancelled, outcomes are counted, and the response is
+    the normal processed batch (idempotent by affinity: same batch, either
+    worker computes the same answer)."""
+    async def go():
+        slow = await _start_worker([_Slow(2.0)], "slow")
+        fast = await _start_worker([_Slow(0.0)], "fast")
+        d = ClusterDispatcher(
+            [_url(slow), _url(fast)], name="nc-hedgewin",
+            heartbeat_s=999.0,
+            hedge={"delay_s": 0.1, "max_fraction": 1.0, "burst": 4,
+                   "min_delay_s": 0.01})
+        try:
+            await d.start()
+            # find a key owned by the SLOW worker so the hedge matters
+            batch = None
+            for i in range(64):
+                b = MessageBatch.new_binary([f"probe {i}".encode()])
+                if d.plan(d.routing_key(b))[0].url == _url(slow):
+                    batch = b
+                    break
+            assert batch is not None
+            t0 = asyncio.get_running_loop().time()
+            out = await d.dispatch(batch)
+            dt = asyncio.get_running_loop().time() - t0
+            assert out[0].num_rows == 1
+            assert dt < 1.5, dt  # did not wait out the straggler
+            assert d.m_hedge["issued"].value == 1
+            assert d.m_hedge["win"].value == 1
+            assert d.m_hedge["primary_win"].value == 0
+        finally:
+            await d.close()
+            await slow.stop()
+            await fast.stop()
+
+    _run(go())
+
+
+def test_hedge_budget_caps_issuance():
+    """The hedge budget (max_fraction * dispatches + burst) denies further
+    hedges instead of doubling load on a struggling fleet."""
+    async def go():
+        slow = await _start_worker([_Slow(0.4)], "slow")
+        other = await _start_worker([_Slow(0.4)], "other")
+        d = ClusterDispatcher(
+            [_url(slow), _url(other)], name="nc-hedgecap",
+            heartbeat_s=999.0,
+            hedge={"delay_s": 0.01, "max_fraction": 0.0, "burst": 1,
+                   "min_delay_s": 0.01})
+        try:
+            await d.start()
+            for i in range(3):
+                await d.dispatch(
+                    MessageBatch.new_binary([f"capped {i}".encode()]))
+            # every dispatch outlives the 10ms hedge delay, but only the
+            # burst allowance may actually hedge
+            assert d.m_hedge["issued"].value == 1
+            assert d.m_hedge["denied"].value == 2
+        finally:
+            await d.close()
+            await slow.stop()
+            await other.stop()
+
+    _run(go())
+
+
+def test_hedge_config_parsing_and_auto_delay():
+    out = parse_remote_tpu_config({
+        "workers": ["arkflow://h:1"],
+        "hedge": {"delay": "auto", "max_fraction": 0.2, "burst": 2,
+                  "min_delay": "5ms"},
+    })
+    assert out["hedge"] == {"delay_s": None, "max_fraction": 0.2,
+                            "burst": 2, "min_delay_s": 0.005}
+    out2 = parse_remote_tpu_config({"workers": ["arkflow://h:1"],
+                                    "hedge": {"delay": "250ms"}})
+    assert out2["hedge"]["delay_s"] == 0.25
+    assert parse_remote_tpu_config({"workers": ["arkflow://h:1"]})["hedge"] is None
+    with pytest.raises(ConfigError, match="max_fraction"):
+        parse_remote_tpu_config({"workers": ["arkflow://h:1"],
+                                 "hedge": {"max_fraction": 1.5}})
+    with pytest.raises(ConfigError, match="unknown"):
+        parse_remote_tpu_config({"workers": ["arkflow://h:1"],
+                                 "hedge": {"bogus": 1}})
+
+
+# -- retry budget -------------------------------------------------------------
+
+
+def test_retry_budget_sheds_with_reason_instead_of_storming():
+    """With the token bucket drained, a ring retry becomes a LOUD
+    RetryBudgetExhausted (an Overloaded with shed_reason=retry_budget) —
+    the batch sheds through the accounted error path instead of amplifying
+    a brownout."""
+    async def go():
+        w0 = await _start_worker([_Upper()], "w0")
+        w1 = await _start_worker([_Upper()], "w1")
+        d = ClusterDispatcher([_url(w0), _url(w1)], name="nc-rbudget",
+                              heartbeat_s=999.0,
+                              retry_budget={"ratio": 0.001, "burst": 1})
+        try:
+            await d.start()
+            # every dispatch needs a retry: corrupt the first connection
+            d.chaos_arm("corrupt", seed=1)
+            out = await d.dispatch(MessageBatch.new_binary([b"first"]))
+            assert out[0].to_binary() == [b"FIRST"]  # burst token spent
+            d.chaos_arm("corrupt", seed=1)
+            with pytest.raises(RetryBudgetExhausted) as ei:
+                await d.dispatch(MessageBatch.new_binary([b"second"]))
+            assert ei.value.shed_reason == "retry_budget"
+            assert isinstance(ei.value, Overloaded)
+            assert d.m_retry_shed.value == 1
+            assert d.m_retries.value == 1
+        finally:
+            await d.close()
+            await w0.stop()
+            await w1.stop()
+
+    _run(go())
+
+
+def test_retry_budget_reason_is_a_registered_shed_reason():
+    from arkflow_tpu.runtime.overload import SHED_REASONS
+
+    assert "retry_budget" in SHED_REASONS
+
+
+def test_retry_budget_config_parsing():
+    out = parse_remote_tpu_config({"workers": ["arkflow://h:1"],
+                                   "retry_budget": {"ratio": 0.25, "burst": 2}})
+    assert out["retry_budget"] == {"ratio": 0.25, "burst": 2}
+    assert parse_remote_tpu_config(
+        {"workers": ["arkflow://h:1"]})["retry_budget"] is None
+    with pytest.raises(ConfigError, match="ratio"):
+        parse_remote_tpu_config({"workers": ["arkflow://h:1"],
+                                 "retry_budget": {"ratio": -1}})
+    with pytest.raises(ConfigError, match="unknown"):
+        parse_remote_tpu_config({"workers": ["arkflow://h:1"],
+                                 "retry_budget": {"nope": 1}})
+
+
+# -- per-hop I/O deadlines -----------------------------------------------------
+
+
+def test_hop_timeout_tracks_remaining_deadline():
+    import time as _time
+
+    d = ClusterDispatcher(["arkflow://h:1"], name="nc-hoptimeout",
+                          heartbeat_s=999.0, request_timeout_s=30.0,
+                          io_deadline_floor_s=0.1)
+    assert d._hop_timeout(None) == 30.0
+    b = MessageBatch.new_binary([b"x"])
+    assert d._hop_timeout(b) == 30.0  # no deadline meta: the flat timeout
+    now_ms = _time.time() * 1000.0
+    t = d._hop_timeout(b.with_deadline_ms(now_ms + 2_000))
+    assert 1.0 < t <= 2.0  # the batch's remaining budget, not 30s
+    # already past its deadline: floored, never zero or negative
+    assert d._hop_timeout(
+        b.with_deadline_ms(now_ms - 5_000)) == pytest.approx(0.1)
+    # a deadline looser than the flat timeout never RAISES the hop bound
+    assert d._hop_timeout(
+        b.with_deadline_ms(now_ms + 300_000)) == pytest.approx(30.0)
+
+
+def test_worker_config_parses_io_deadline_and_crc():
+    procs = [{"type": "python", "script": "def process(b): return b"}]
+    _, opts = parse_worker_config({
+        "processors": procs,
+        "worker": {"io_deadline": "5s", "crc": False}})
+    assert opts["io_deadline_s"] == 5.0
+    assert opts["crc"] is False
+    _, opts2 = parse_worker_config({"processors": procs})
+    assert opts2["io_deadline_s"] == 30.0
+    assert opts2["crc"] is True
+
+
+def test_worker_read_deadline_cuts_slow_loris_and_counts_it():
+    """A peer that sends half a frame and stalls is cut loose by the
+    per-frame io_deadline and counted in stalled_reads — not a wedged
+    connection task forever."""
+    async def go():
+        srv = await _start_worker([_Upper()], "w0", io_deadline_s=0.3)
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           srv.port)
+            writer.write(struct.pack(">I", 64) + b"half of the frame")
+            await writer.drain()  # ...and never the rest
+            t0 = asyncio.get_running_loop().time()
+            # the worker cuts the read and closes the connection
+            out = await asyncio.wait_for(reader.read(), 10.0)
+            dt = asyncio.get_running_loop().time() - t0
+            assert dt < 5.0, dt
+            assert srv._stalled_reads == 1
+            assert out == b"" or json.loads(out[4:].decode())  # closed or error
+            writer.close()
+        finally:
+            await srv.stop()
+
+    _run(go())
+
+
+# -- fd-leak audit (connect/flight.py FlightClient) ---------------------------
+
+
+def _open_fds() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+@pytest.mark.skipif(not os.path.isdir("/proc/self/fd"),
+                    reason="needs /proc (linux)")
+def test_flight_client_does_not_leak_fds_on_timeouts():
+    """100 dispatches against an accept-then-never-respond server, every one
+    timing out — the open-fd count stays flat (the scan/query paths close
+    their sockets on abandonment, not at GC's leisure)."""
+    async def go():
+        async def black_hole(reader, writer):
+            # consume until the client gives up (EOF), never respond —
+            # holding the accepted socket open past that would make the
+            # TEST the fd leak it is trying to pin
+            try:
+                await reader.read()
+            finally:
+                writer.close()
+
+        server = await asyncio.start_server(black_hole, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        client = FlightClient(f"arkflow://127.0.0.1:{port}")
+
+        # warm anything lazily allocated before measuring
+        for _ in range(3):
+            try:
+                await asyncio.wait_for(client.query("select 1"), 0.05)
+            except asyncio.TimeoutError:
+                pass
+        base = _open_fds()
+        for _ in range(100):
+            try:
+                await asyncio.wait_for(client.query("select 1"), 0.05)
+            except asyncio.TimeoutError:
+                pass
+        # let cancelled tasks run their finally blocks
+        await asyncio.sleep(0.2)
+        leaked = _open_fds() - base
+        assert leaked <= 3, f"fd leak: {leaked} new fds after 100 timeouts"
+        server.close()
+        await server.wait_closed()
+
+    _run(go(), timeout=60.0)
+
+
+# -- report plumbing -----------------------------------------------------------
+
+
+def test_dispatcher_report_carries_robustness_counters():
+    async def go():
+        w0 = await _start_worker([_Upper()], "w0")
+        d = ClusterDispatcher(
+            [_url(w0)], name="nc-report", heartbeat_s=999.0,
+            hedge={"delay_s": 0.5, "max_fraction": 0.1, "burst": 4,
+                   "min_delay_s": 0.01},
+            retry_budget={"ratio": 0.5, "burst": 8})
+        try:
+            await d.start()
+            await d.dispatch(MessageBatch.new_binary([b"one"]))
+            rep = d.report()
+            assert rep["fenced_rejections"] == 0
+            assert rep["frame_errors"] == 0
+            assert rep["hedge"]["dispatches"] == 1
+            assert rep["retry_budget"]["shed"] == 0
+            assert rep["retry_budget"]["tokens"] == 8.0
+        finally:
+            await d.close()
+            await w0.stop()
+
+    _run(go())
+
+
+# -- acceptance: the partition soak (fast tier-1 mode) ------------------------
+
+
+def test_chaos_soak_partition_fast_mode_smoke():
+    """Acceptance gate (tools/chaos_soak.py --partition --fast): two real
+    device-tier worker subprocesses, one behind the chaos proxy — hedged
+    dispatch rides out a mid-load one-way partition with bounded p99 and
+    in-timeout detection, the healed zombie's fenced epoch is rejected and
+    counted, corruption is loud with zero silent loss, and the retry budget
+    contains a brownout retry storm against a budget-off control."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        from chaos_soak import run_partition_soak
+    finally:
+        sys.path.pop(0)
+
+    verdict = run_partition_soak(seconds=60.0, seed=7, fast=True)
+    assert verdict["pass"], verdict
+    part = verdict["partition"]
+    assert part["detected"] and part["detected_s"] <= 2.0
+    assert part["p99_s"] <= part["p99_bound_s"]
+    assert part["hedge"]["issued"] >= 1
+    assert part["lost_rows"] == 0
+    fence = verdict["fencing"]
+    assert fence["zombie_reports_rejected"] >= 1
+    assert fence["incarnation_rotated"]
+    corrupt = verdict["corruption"]
+    assert corrupt["loud"] and corrupt["lost_rows"] == 0
+    brown = verdict["brownout"]
+    assert brown["budget_off"]["retry_amplification"] >= 0.9
+    assert brown["budget_on"]["retry_amplification"] <= brown[
+        "amplification_bound"]
+    assert brown["budget_on"]["retry_budget_shed"] >= 1

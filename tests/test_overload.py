@@ -368,7 +368,7 @@ def test_controller_report_shape():
     rep = ctrl.report()
     assert rep["state"] == "admit" and rep["queued"] == 1
     assert rep["deadline_ms"] == 123.0 and rep["max_window"] == 8
-    assert set(rep["shed"]) == {"deadline", "queue", "priority", "quota"}
+    assert set(rep["shed"]) == {"deadline", "queue", "priority", "quota", "retry_budget"}
     assert (STATE_ADMIT, STATE_THROTTLE, STATE_SHED) == (0, 1, 2)
 
 
@@ -750,7 +750,7 @@ def test_engine_health_reports_overload_controller_state():
             assert ov is not None, "no overload report in /health"
             assert ov["state"] in ("admit", "throttle", "shed")
             assert ov["deadline_ms"] == 500.0
-            assert set(ov["shed"]) == {"deadline", "queue", "priority", "quota"}
+            assert set(ov["shed"]) == {"deadline", "queue", "priority", "quota", "retry_budget"}
         finally:
             engine.shutdown()
             await asyncio.wait_for(run_task, timeout=15)

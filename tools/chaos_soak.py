@@ -121,9 +121,33 @@ SIGKILLed mid-stream loses nothing — in-flight requests nack through
 normal redelivery and re-prefill, offered == delivered + shed over
 distinct rows, and the restarted decode worker adopts pages again.
 
+``--partition`` soaks the partition-tolerant flight plane
+(connect/chaoswire.py + runtime/cluster.py): two worker processes, one
+fronted by a frame-aware chaos proxy that can black-hole one direction,
+corrupt payload bytes under the crc32 trailer, or stall mid-frame — flipped
+live mid-load::
+
+    python tools/chaos_soak.py --partition --fast    # tier-1 smoke
+    python tools/chaos_soak.py --partition --seed 3
+
+Partition PASS means: a mid-load ONE-WAY partition of a worker (requests
+flow, responses vanish) is detected within ``heartbeat_timeout``, hedged
+dispatch keeps delivered p99 within max(2x, +250ms) of the no-fault
+baseline with the hedge budget invariant intact; after the partition heals,
+the zombie's fenced incarnation is rejected and counted
+(``arkflow_cluster_fenced_total``) before the heal handshake re-admits it
+under a fresh epoch; byte corruption is NEVER silent (counted crc failures
+client- or worker-side, every row still delivered via ring failover); and a
+corrupt-every-dispatch brownout with the retry budget ON keeps ring
+retries/offered <= ratio + burst/offered with the overflow shed as
+``reason=retry_budget``, while the budget-OFF control reproduces ~1.0x
+retry amplification — zero silent loss (offered == delivered + shed over
+distinct rows) in every phase.
+
 Runs on the virtual-CPU JAX platform by default (no TPU needed; ``--burst``
-never imports jax at all, and ``--cluster``/``--preempt``/``--disagg``
-parent processes don't either — only their worker subprocesses); set ARKFLOW_SOAK_KEEP_ENV=1
+never imports jax at all, and ``--cluster``/``--preempt``/``--disagg``/
+``--partition`` parent processes don't either — only their worker
+subprocesses); set ARKFLOW_SOAK_KEEP_ENV=1
 to target whatever backend the environment provides.
 """
 
@@ -1604,6 +1628,454 @@ def run_cluster_soak(seconds: float = 60.0, seed: int = 7,
     return _attach_tracing(verdict, trace_seq0, trace_forced0)
 
 
+# -- partition-tolerance soak (connect/chaoswire.py + runtime/cluster.py) -----
+
+
+def _partition_ingest_config(name: str, urls: list[str], payloads: list[str],
+                             *, threads: int = 4, heartbeat: str = "250ms",
+                             heartbeat_timeout: str = "1s",
+                             request_timeout: str = "4s",
+                             hedge=None, retry_budget=None,
+                             net_faults=None, seed: int = 0) -> dict:
+    """Ingest-tier stream for the partition soak: memory source ->
+    remote_tpu (hedging / retry-budget knobs exposed) -> collect.
+    ``net_faults`` wraps the dispatch stage in the fault plugin so
+    ``net_*`` chaos arms on the dispatcher's own connections."""
+    proc: dict = {
+        "type": "remote_tpu",
+        "name": name,
+        "workers": urls,
+        "heartbeat": heartbeat,
+        "heartbeat_timeout": heartbeat_timeout,
+        "connect_timeout": "2s",
+        "request_timeout": request_timeout,
+    }
+    if hedge is not None:
+        proc["hedge"] = hedge
+    if retry_budget is not None:
+        proc["retry_budget"] = retry_budget
+    if net_faults is not None:
+        proc = {"type": "fault", "seed": seed, "faults": net_faults,
+                "inner": proc}
+    return {
+        "name": name,
+        "input": {"type": "memory", "messages": payloads},
+        "pipeline": {
+            "thread_num": threads,
+            "max_delivery_attempts": 8,
+            "processors": [proc],
+        },
+        "output": {"type": "drop"},
+        "error_output": {"type": "drop"},
+    }
+
+
+def run_partition_soak(seconds: float = 90.0, seed: int = 7,
+                       fast: bool = False) -> dict:
+    """Partition-tolerance soak (connect/chaoswire.py + the flight-plane
+    hardening in runtime/cluster.py): two local device-tier workers, one
+    fronted by a frame-aware chaos proxy, prove
+
+    - hedged dispatch rides out a mid-load ONE-WAY partition (requests
+      flow, responses black-holed): the wedged owner is detected within
+      ``heartbeat_timeout``, delivered p99 stays bounded against the
+      no-fault baseline, the hedge budget invariant holds, and zero rows
+      are lost (offered == delivered + shed over distinct rows);
+    - incarnation fencing: the black-holed (never dead) worker's epoch is
+      fenced on detection; after the partition heals, its zombie report is
+      REJECTED and counted (``arkflow_cluster_fenced_total``), the heal
+      handshake re-mints, and the worker is re-admitted under the fresh
+      epoch;
+    - corruption is never silent: with the proxy flipping one byte per
+      frame, every damaged exchange surfaces as a counted crc32 failure
+      (client ``arkflow_cluster_frame_error_total`` or the worker's
+      ``crc_errors``) and every row still delivers via ring failover;
+    - retry-budget brownout containment: a corrupt-every-dispatch storm
+      (the ``net_corrupt`` fault kind, armed through the fault plugin)
+      with the budget OFF reproduces retries/offered ~= 1.0; with the
+      budget ON the ratio stays <= ratio + burst/offered and the overflow
+      sheds as ``reason=retry_budget`` through error_output.
+
+    The parent process never imports jax — only the worker subprocesses do.
+    """
+    trace_seq0, trace_forced0 = _tracing_watermark()
+    import asyncio
+    import os
+    import socket as socket_mod
+    import subprocess
+    import tempfile
+
+    import yaml
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.connect.chaoswire import ChaosProxy
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import build_stream
+    from arkflow_tpu.runtime.cluster import ClusterDispatcher
+    from arkflow_tpu.utils.cleanenv import pin_cpu_env, strip_axon_pythonpath
+
+    ensure_plugins_loaded()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    step_ms = 40 if fast else 50
+    n_base = 12 if fast else 24          # baseline phase messages
+    # enough post-flip load that the stream OUTLIVES probe-based detection
+    # (<= heartbeat + heartbeat_timeout ~ 1.3s; the surviving worker
+    # serializes ~50ms/row, so ~38 post-flip rows ~ 2s of partitioned load)
+    n_part = 48 if fast else 96          # partition phase messages
+    flip_at = 10                         # >= 8: the hedge p99-EWMA is warm
+    n_corrupt = 8 if fast else 16        # corruption phase messages
+    n_brown = 12 if fast else 24         # brownout phase messages (per run)
+    rb_ratio, rb_burst = 0.25, 2
+    hb_s, ht_s = 0.25, 1.0
+    hedge_cfg = {"delay": "auto", "max_fraction": 0.5, "burst": 16,
+                 "min_delay": "10ms"}
+    startup_budget = 240.0
+
+    def free_port() -> int:
+        s = socket_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="arkflow-partition-soak-")
+    cfg_path = os.path.join(tmp, "worker.yaml")
+    with open(cfg_path, "w") as f:
+        yaml.safe_dump(_cluster_worker_config(seed, step_ms), f)
+
+    ports = [free_port(), free_port()]
+    urls = [f"arkflow://127.0.0.1:{p}" for p in ports]
+    logs = [os.path.join(tmp, f"worker-{i}.log") for i in range(2)]
+
+    def spawn(i: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        strip_axon_pythonpath(env)
+        pin_cpu_env(env, n_devices=1)
+        return subprocess.Popen(
+            [sys.executable, "-m", "arkflow_tpu", "--cluster-worker",
+             "--config", cfg_path, "--host", "127.0.0.1",
+             "--port", str(ports[i]), "--worker-id", f"part-w{i}"],
+            cwd=repo_root, env=env,
+            stdout=open(logs[i], "ab"), stderr=subprocess.STDOUT)
+
+    async def wait_ready(wait_urls: list[str], budget_s: float) -> None:
+        probe = ClusterDispatcher(wait_urls, name="partition-soak-probe",
+                                  heartbeat_s=999.0, connect_timeout_s=1.0)
+        deadline = time.monotonic() + budget_s
+        while True:
+            await asyncio.gather(
+                *(probe._probe(w) for w in probe.workers.values()),
+                return_exceptions=True)
+            if all(w.alive for w in probe.workers.values()):
+                return
+            if time.monotonic() >= deadline:
+                down = [w.url for w in probe.workers.values() if not w.alive]
+                raise RuntimeError(
+                    f"cluster workers not ready within {budget_s:.0f}s: {down} "
+                    f"(see {tmp}/worker-*.log)")
+            await asyncio.sleep(0.5)
+
+    class _Collect(DropOutput):
+        def __init__(self, sink: list):
+            self._sink = sink
+
+        async def write(self, batch: MessageBatch) -> None:
+            self._sink.extend(batch.to_binary())
+
+    async def phase(cfg_map: dict, budget_s: float, driver=None) -> dict:
+        """Build + run one ingest stream to EOF (bounded), in the CURRENT
+        loop — the chaos proxy's server lives in this loop, so every phase
+        shares it (unlike the other soaks' one-loop-per-phase shape)."""
+        stream = build_stream(StreamConfig.from_mapping(cfg_map))
+        delivered: list[bytes] = []
+        shed: list[bytes] = []
+        stream.output = _Collect(delivered)
+        stream.error_output = _Collect(shed)
+        out: dict = {"delivered": delivered, "shed": shed, "stream": stream}
+        cancel = asyncio.Event()
+        task = asyncio.create_task(stream.run(cancel))
+        driver_task = (asyncio.create_task(driver(stream, delivered))
+                       if driver is not None else None)
+        t0 = time.monotonic()
+        done, _ = await asyncio.wait({task}, timeout=budget_s)
+        out["elapsed_s"] = time.monotonic() - t0
+        out["wedged"] = not done
+        if done:
+            task.result()  # surface a crashed stream with its traceback
+        else:
+            cancel.set()
+            try:
+                await asyncio.wait_for(task, timeout=15.0)
+            except (asyncio.TimeoutError, Exception):
+                task.cancel()
+        if driver_task is not None:
+            try:
+                await asyncio.wait_for(driver_task, timeout=10.0)
+            except (asyncio.TimeoutError, Exception):
+                driver_task.cancel()
+        return out
+
+    def identity(payloads: list[str], ph: dict) -> dict:
+        expected = {p.encode() for p in payloads}
+        seen = set(ph["delivered"]) | set(ph["shed"])
+        lost = sorted(expected - seen)
+        out = {
+            "offered_rows": len(payloads),
+            "delivered_rows": len(ph["delivered"]),
+            "shed_rows": len(ph["shed"]),
+            "lost_rows": len(lost),
+            "wedged": ph["wedged"],
+            "identity_ok": not lost and not ph["wedged"],
+        }
+        if lost:
+            out["lost_sample"] = [x.decode() for x in lost[:5]]
+        return out
+
+    def p99_of(samples: list) -> float:
+        if not samples:
+            return 0.0
+        s = sorted(samples)
+        return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+    verdict: dict = {"mode": "partition", "seed": seed, "step_ms": step_ms,
+                     "workers": urls}
+    procs: list = [None, None]
+    t_start = time.monotonic()
+
+    async def go() -> None:
+        proxy = ChaosProxy("127.0.0.1", ports[0], seed=seed)
+        await proxy.start()
+        verdict["proxy"] = proxy.url
+        cfg_urls = [proxy.url, urls[1]]
+        try:
+            # -- phase 1: no-fault baseline, hedging on --------------------
+            pay_a = [f"baseline {i:05d}" for i in range(n_base)]
+            ph_a = await phase(_partition_ingest_config(
+                "partition-soak-base", cfg_urls, pay_a, threads=2,
+                heartbeat_timeout=f"{ht_s}s", hedge=hedge_cfg), seconds)
+            disp_a = ph_a["stream"].pipeline.processors[0].dispatcher
+            base_p99 = p99_of(disp_a.latency_snapshot())
+            baseline = {
+                **identity(pay_a, ph_a),
+                "p99_s": round(base_p99, 4),
+                "hedge": disp_a.report().get("hedge"),
+            }
+            baseline["pass"] = bool(
+                baseline["identity_ok"]
+                and baseline["delivered_rows"] == n_base)
+            verdict["baseline"] = baseline
+
+            # -- phase 2: one-way partition mid-load ------------------------
+            events: dict = {}
+
+            async def partition_driver(stream, delivered) -> None:
+                while len(delivered) < flip_at:
+                    await asyncio.sleep(0.01)
+                proxy.mode = "blackhole"
+                events["flipped_at_delivered"] = len(delivered)
+                t_flip = time.monotonic()
+                disp = stream.pipeline.processors[0].dispatcher
+                pw = disp.workers[proxy.url]
+                while pw.alive and time.monotonic() - t_flip < 15.0:
+                    await asyncio.sleep(0.02)
+                events["detected"] = not pw.alive
+                events["detected_s"] = round(time.monotonic() - t_flip, 3)
+                events["fenced_epochs"] = list(pw.fenced)
+
+            pay_b = [f"partition {i:05d}" for i in range(n_part)]
+            # 2 threads: post-partition the whole offered load queues on the
+            # one surviving max_in_flight=1 worker, and the p99 bound below
+            # must not be dominated by self-inflicted queueing
+            ph_b = await phase(_partition_ingest_config(
+                "partition-soak-part", cfg_urls, pay_b, threads=2,
+                heartbeat_timeout=f"{ht_s}s", hedge=hedge_cfg),
+                max(seconds, 30.0), driver=partition_driver)
+            disp_b = ph_b["stream"].pipeline.processors[0].dispatcher
+            rep_b = disp_b.report()
+            part_p99 = p99_of(disp_b.latency_snapshot())
+            hed = rep_b.get("hedge") or {}
+            # CI-jitter floor on the tiny-step p99 bound: with ~40ms steps,
+            # 2x baseline can be a single scheduler hiccup wide
+            p99_bound = max(2.0 * base_p99, base_p99 + 0.25)
+            partition = {
+                **identity(pay_b, ph_b),
+                **events,
+                "p99_s": round(part_p99, 4),
+                "p99_bound_s": round(p99_bound, 4),
+                "hedge": hed,
+                "fenced_epochs_on_dispatcher": rep_b["fenced_rejections"],
+            }
+            partition["pass"] = bool(
+                partition["identity_ok"]
+                and events.get("detected")
+                and events.get("detected_s", 99.0) <= ht_s + hb_s + 0.75
+                and part_p99 <= p99_bound
+                and hed.get("issued", 0) >= 1
+                and hed.get("issued", 0)
+                <= hedge_cfg["max_fraction"] * hed.get("dispatches", 0)
+                + hedge_cfg["burst"])
+            verdict["partition"] = partition
+
+            # -- phase 3: fencing — the healed zombie is rejected -----------
+            proxy.mode = None  # heal before the fresh register
+            fence: dict = {}
+            disp_c = ClusterDispatcher(
+                [proxy.url], name="partition-soak-fence", heartbeat_s=0.2,
+                heartbeat_timeout_s=1.0, connect_timeout_s=1.0)
+            await disp_c.start()
+            pw = disp_c.workers[proxy.url]
+            fence["registered"] = pw.alive
+            inc0 = pw.incarnation
+            fence["incarnation"] = inc0
+            proxy.mode = "blackhole"
+            t_flip = time.monotonic()
+            while pw.alive and time.monotonic() - t_flip < 10.0:
+                await asyncio.sleep(0.02)
+            fence["detected"] = not pw.alive
+            fence["detected_s"] = round(time.monotonic() - t_flip, 3)
+            fence["fenced_epochs"] = list(pw.fenced)
+            proxy.mode = None  # partition heals; the zombie resurfaces
+            t_heal = time.monotonic()
+            while time.monotonic() - t_heal < 10.0:
+                if disp_c.m_fenced.value >= 1 and pw.alive:
+                    break
+                await asyncio.sleep(0.05)
+            fence["zombie_reports_rejected"] = int(disp_c.m_fenced.value)
+            fence["healed_alive"] = pw.alive
+            fence["re_minted_incarnation"] = pw.incarnation
+            fence["incarnation_rotated"] = bool(
+                pw.incarnation and pw.incarnation != inc0
+                and inc0 in pw.fenced)
+            await disp_c.close()
+            fence["pass"] = bool(
+                fence["registered"] and fence["detected"]
+                and fence["detected_s"] <= 1.0 + 0.2 + 0.75
+                and fence["zombie_reports_rejected"] >= 1
+                and fence["healed_alive"]
+                and fence["incarnation_rotated"])
+            verdict["fencing"] = fence
+
+            # -- phase 4: corruption is never silent -------------------------
+            corrupt_events: dict = {}
+
+            async def corrupt_driver(stream, delivered) -> None:
+                while len(delivered) < 2:
+                    await asyncio.sleep(0.01)
+                proxy.mode = "corrupt"
+                corrupt_events["corrupt_at_delivered"] = len(delivered)
+                disp = stream.pipeline.processors[0].dispatcher
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 6.0:
+                    # a heartbeat or infer through the proxy has been
+                    # damaged once the client counts a frame error — or the
+                    # worker does (its up-frames are corrupted too); worker
+                    # crc_errors are read after the phase, direct
+                    if disp.m_frame_errors.value >= 1:
+                        break
+                    await asyncio.sleep(0.05)
+                corrupt_events["client_frame_errors"] = int(
+                    disp.m_frame_errors.value)
+                proxy.mode = None  # heal so the tail drains clean
+
+            pay_d = [f"corrupt {i:05d}" for i in range(n_corrupt)]
+            ph_d = await phase(_partition_ingest_config(
+                "partition-soak-corrupt", cfg_urls, pay_d, threads=2,
+                heartbeat_timeout=f"{ht_s}s", hedge=None),
+                max(seconds, 30.0), driver=corrupt_driver)
+            disp_d = ph_d["stream"].pipeline.processors[0].dispatcher
+            # the worker's own count of corrupted frames it refused to
+            # decode — read over a DIRECT connection, not the proxy
+            probe = ClusterDispatcher([urls[0]],
+                                      name="partition-soak-crcprobe",
+                                      heartbeat_s=999.0, connect_timeout_s=1.0)
+            try:
+                hb = await probe._unary(probe.workers[urls[0]],
+                                        {"action": "heartbeat"})
+            except Exception:
+                hb = {}
+            corrupt = {
+                **identity(pay_d, ph_d),
+                **corrupt_events,
+                "client_frame_errors": int(disp_d.m_frame_errors.value),
+                "worker_crc_errors": int(hb.get("crc_errors", 0) or 0),
+                "proxy_frames_corrupted": proxy.frames_corrupted,
+            }
+            corrupt["loud"] = (corrupt["client_frame_errors"]
+                               + corrupt["worker_crc_errors"]) >= 1
+            corrupt["pass"] = bool(
+                corrupt["identity_ok"] and corrupt["loud"]
+                and corrupt["proxy_frames_corrupted"] >= 1
+                and corrupt["delivered_rows"] == n_corrupt)
+            verdict["corruption"] = corrupt
+
+            # -- phase 5: brownout retry storm, budget off vs on -------------
+            async def brownout(name: str, budget) -> dict:
+                pay = [f"{name} {i:05d}" for i in range(n_brown)]
+                ph = await phase(_partition_ingest_config(
+                    name, urls, pay, threads=1, heartbeat="30s",
+                    heartbeat_timeout="150s", request_timeout="10s",
+                    retry_budget=budget,
+                    net_faults=[{"kind": "net_corrupt", "every": 1,
+                                 "times": 0}], seed=seed),
+                    max(seconds, 30.0))
+                disp = ph["stream"].pipeline.processors[0].dispatcher
+                return {
+                    **identity(pay, ph),
+                    "ring_retries": int(disp.m_retries.value),
+                    "retry_amplification": round(
+                        disp.m_retries.value / max(1, n_brown), 3),
+                    "retry_budget_shed": int(disp.m_retry_shed.value),
+                    "frame_errors": int(disp.m_frame_errors.value),
+                }
+
+            off = await brownout("partition-soak-brownoff", None)
+            on = await brownout("partition-soak-brownon",
+                                {"ratio": rb_ratio, "burst": rb_burst})
+            amp_bound = rb_ratio + rb_burst / n_brown + 0.05
+            brown = {
+                "budget_off": off,
+                "budget_on": on,
+                "ratio": rb_ratio, "burst": rb_burst,
+                "amplification_bound": round(amp_bound, 3),
+            }
+            brown["pass"] = bool(
+                off["identity_ok"] and on["identity_ok"]
+                # the control run reproduces the storm ...
+                and off["retry_amplification"] >= 0.9
+                and off["delivered_rows"] == n_brown
+                # ... the budget contains it, shedding the overflow loudly
+                and on["retry_amplification"] <= amp_bound
+                and on["retry_budget_shed"] >= 1
+                and on["shed_rows"] == on["retry_budget_shed"])
+            verdict["brownout"] = brown
+        finally:
+            await proxy.stop()
+
+    try:
+        procs[0] = spawn(0)
+        procs[1] = spawn(1)
+        asyncio.run(wait_ready(urls, startup_budget))
+        verdict["startup_s"] = round(time.monotonic() - t_start, 3)
+        asyncio.run(go())
+        verdict["pass"] = bool(verdict["baseline"]["pass"]
+                               and verdict["partition"]["pass"]
+                               and verdict["fencing"]["pass"]
+                               and verdict["corruption"]["pass"]
+                               and verdict["brownout"]["pass"])
+    finally:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+    verdict["elapsed_s"] = round(time.monotonic() - t_start, 3)
+    return _attach_tracing(verdict, trace_seq0, trace_forced0)
+
+
 # -- prefill/decode disaggregation soak (runtime/cluster.py + serving) --------
 
 
@@ -2940,6 +3412,14 @@ def main(argv=None) -> int:
                          "+ tokens/sec double win (core-count gated), "
                          "prefix affinity on the prefill sub-ring, and zero "
                          "silent loss through a mid-stream decode SIGKILL")
+    ap.add_argument("--partition", action="store_true",
+                    help="partition-tolerance soak: 2 worker processes, one "
+                         "behind a frame-aware chaos proxy; asserts hedged "
+                         "dispatch rides out a mid-load one-way partition "
+                         "(bounded p99, detection within heartbeat_timeout), "
+                         "the healed zombie's epoch stays fenced, corruption "
+                         "is never silent, and the retry budget contains a "
+                         "brownout retry storm with accounted sheds")
     ap.add_argument("--preempt", action="store_true",
                     help="elastic-fleet soak: 3 worker processes behind a "
                          "remote_tpu stream with the autoscaling controller "
@@ -3007,6 +3487,14 @@ def main(argv=None) -> int:
         # workers do (each pins its own virtual-CPU env)
         verdict = run_cluster_soak(seconds=args.seconds, seed=args.seed,
                                    fast=args.fast)
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["pass"] else 1
+
+    if args.partition:
+        # like --cluster: the parent never imports jax — worker subprocesses
+        # get their own pinned virtual-CPU env from the soak itself
+        verdict = run_partition_soak(seconds=args.seconds, seed=args.seed,
+                                     fast=args.fast)
         print(json.dumps(verdict, indent=2))
         return 0 if verdict["pass"] else 1
 
