@@ -179,6 +179,7 @@ class StreamConfig:
         _validate_dispatch_knobs(pipeline.processors)
         _validate_swap(pipeline.processors)
         _validate_tuner(pipeline.processors)
+        _validate_integrity(pipeline.processors)
         _validate_remote_tpu(pipeline.processors)
         temps = [TemporaryConfig.from_mapping(t) for t in m.get("temporary", [])]
         input_cfg = dict(m["input"])
@@ -286,6 +287,31 @@ def _validate_swap(processors: list[dict]) -> None:
         ptype = p.get("type")
         if ptype in ("tpu_inference", "tpu_generate") and p.get("swap") is not None:
             parse_swap_config(p["swap"], who=str(ptype))
+
+
+def _validate_integrity(processors: list[dict]) -> None:
+    """Parse-time validation of the ``integrity:`` silent-data-corruption
+    block on ``tpu_inference``/``tpu_generate`` (tpu/integrity.py owns the
+    parse rules; it imports no jax), looking through ``fault.inner`` chaos
+    wrappers like the other cross-checks — a bad probe cadence fails at
+    ``--validate`` instead of at stream build."""
+    from arkflow_tpu.tpu.integrity import parse_integrity_config
+
+    for p in processors:
+        while (isinstance(p, Mapping) and p.get("type") == "fault"
+               and isinstance(p.get("inner"), Mapping)):
+            p = p["inner"]
+        if not isinstance(p, Mapping):
+            continue
+        kind = p.get("type")
+        if kind in ("tpu_inference", "tpu_generate") \
+                and p.get("integrity") is not None:
+            parse_integrity_config(p["integrity"], who=kind)
+            if kind == "tpu_generate" \
+                    and p.get("serving", "batch") != "continuous":
+                raise ConfigError(
+                    "tpu_generate: integrity requires serving: continuous "
+                    "(batch mode holds no resident serving member to probe)")
 
 
 def _validate_tuner(processors: list[dict]) -> None:

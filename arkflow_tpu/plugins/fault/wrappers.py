@@ -40,6 +40,14 @@ reproducible faults on its operation stream:
           - {kind: oom, at: 5}                # next device step raises
                                               # RESOURCE_EXHAUSTED (bucket
                                               # degradation coverage)
+          - {kind: bitflip, at: 7}            # corrupt one param leaf of the
+                                              # inner runner's LIVE tree in
+                                              # place (silent-data-corruption
+                                              # coverage: tpu/integrity.py
+                                              # digests + golden probes)
+          - {kind: sdc, at: 9}                # persistently garble the
+                                              # runner's step outputs until
+                                              # the integrity repair clears it
           - {kind: swap_corrupt, at: 6}       # next hot-swap restores a
                                               # mangled tree (canary rollback)
           - {kind: swap_crash, at: 8}         # next hot-swap crashes mid-roll
@@ -99,13 +107,19 @@ OUTPUT_KINDS = frozenset({"latency", "error", "crash"})
 _NET_KINDS = frozenset(
     {"net_delay", "net_stall", "net_blackhole", "net_reset", "net_corrupt"})
 PROCESSOR_KINDS = frozenset(
-    {"latency", "error", "crash", "hang", "oom", "swap_corrupt",
-     "swap_crash"}) | _NET_KINDS
+    {"latency", "error", "crash", "hang", "oom", "bitflip", "sdc",
+     "swap_corrupt", "swap_crash"}) | _NET_KINDS
 
 #: device-step faults: armed on the wrapped processor's runner (the fault
 #: fires INSIDE the next device step, exercising the real watchdog / OOM
-#: degradation machinery) — or emulated in-wrapper when there is no runner
-_STEP_KINDS = frozenset({"hang", "oom"})
+#: degradation machinery) — or emulated in-wrapper when there is no runner.
+#: ``bitflip``/``sdc`` are the silent-data-corruption kinds the integrity
+#: plane (tpu/integrity.py) exists to catch: bitflip corrupts one param
+#: leaf in place on the armed runner, sdc persistently garbles step
+#: outputs — neither has an emulation fallback (corrupting rows in-wrapper
+#: would be a DIFFERENT failure than the HBM/chip corruption under test)
+_STEP_KINDS = frozenset({"hang", "oom", "bitflip", "sdc"})
+_SDC_KINDS = frozenset({"bitflip", "sdc"})
 #: hot-swap faults: armed on the wrapped processor's swapper (tpu/swap.py)
 #: and consumed by its NEXT swap — ``swap_corrupt`` mangles the restored
 #: tree (canary rollback path), ``swap_crash`` raises mid-roll after the
@@ -366,6 +380,13 @@ class FaultInjectingProcessor(Processor):
         if inject is not None:
             inject(spec.kind, spec.duration_s)
             return
+        if spec.kind in _SDC_KINDS:
+            # no emulation: silent corruption must corrupt REAL device
+            # state (a param leaf / step outputs) or the integrity plane
+            # under test would be probing a fake
+            raise ProcessError(
+                f"chaos: {spec.kind} requires an inner processor with a "
+                "device runner (tpu_inference)")
         if spec.kind == "hang":
             await asyncio.sleep(spec.duration_s if spec.duration_s > 0 else 30.0)
         else:

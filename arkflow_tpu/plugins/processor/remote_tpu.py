@@ -25,6 +25,12 @@ Config:
                                 # prefill worker per dispatch, occupancy-
                                 # ordered from heartbeats (role split only)
     response_cache: {capacity: 1024, ttl: 30s}   # optional ingest-side dedup
+    shadow_verify:              # optional SDC cross-check: every 1/fraction-th
+      fraction: 0.05            # batch dual-dispatches to the ring successor
+                                # and the response signatures are compared;
+                                # divergence triggers a golden-probe tiebreak
+                                # on BOTH workers and the corrupt one is
+                                # fenced (not used on role-split fleets)
     fleet:                      # optional autoscaling controller
       min_workers: 1            # floor (default: len(workers)); respawned
       max_workers: 4            # scale-out ceiling
@@ -50,8 +56,18 @@ dispatcher plans prompts onto prefill workers by prefix hash and hands
 them an occupancy-ordered list of decode destinations; finished KV pages
 stream decode-ward over ``kv_push`` frames.
 
-See docs/CONFIG.md "Cluster serving", "Elastic fleet", and
-"Disaggregated prefill/decode" for semantics.
+Integrity defense (tpu/integrity.py, cluster tier): worker heartbeats
+carry a ``param_digest`` epoch and a count of quarantined (CORRUPT)
+members. The dispatcher fences a worker that self-reports corruption
+immediately; a worker whose digest epoch disagrees with the majority of
+its peers (3+ reporting) is fenced only after its own on-demand golden
+probe confirms the mismatch — a clean probe means a different weights
+version (mid-swap), not corruption. Fencing rides the incarnation path
+(zombie rejection + heal handshake) and epoch-flushes the ingest
+response cache so duplicates of possibly-poisoned answers recompute.
+
+See docs/CONFIG.md "Cluster serving", "Elastic fleet",
+"Disaggregated prefill/decode", and "Integrity" for semantics.
 """
 
 from __future__ import annotations

@@ -62,6 +62,11 @@ Config:
     swap:                    # live hot-swap knobs (tpu/swap.py): continuous
       canary: {rows: 4}      # mode drains the slot grid, flips, rebuilds
       drain_timeout: 30s     # jits, and resets KV pools + prefix cache
+    integrity:               # SDC defense (tpu/integrity.py; continuous
+      probe_interval: 10s    # mode only): periodic golden forward-apply of
+      digest_every: 3        # the live tree vs a host reference + digest
+      golden: {rows: 2, seq: 16, seed: 2317}  # re-verification; mismatch
+      repair: true           # quarantines (CORRUPT) and repairs via swap
 """
 
 from __future__ import annotations
@@ -137,6 +142,10 @@ class TpuGenerateProcessor(Processor):
         from arkflow_tpu.tpu.runner import init_host_params
 
         params = init_host_params(self.family, self.cfg, seed, checkpoint)
+        #: retained known-good host tree — the integrity monitor's repair
+        #: source and golden-reference input (tpu/integrity.py), same
+        #: retention the batch ModelRunner keeps
+        self.host_params = params
         # tensor-parallel serving: shard params over a Mesh so decode runs
         # multi-chip via GSPMD (the KV cache shards over heads implicitly)
         self.mesh = None
@@ -218,6 +227,18 @@ class TpuGenerateProcessor(Processor):
         #: live hot-swap manager (tpu/swap.py), attached by the builder; the
         #: engine's POST /admin/swap and the fault plugin reach it here
         self.swapper = None
+        #: silent-data-corruption monitor (tpu/integrity.py), attached by
+        #: the builder for continuous serving; started/stopped with the
+        #: processor lifecycle
+        self.integrity = None
+
+    async def connect(self) -> None:
+        if self.integrity is not None:
+            self.integrity.start()
+
+    async def close(self) -> None:
+        if self.integrity is not None:
+            await self.integrity.stop()
 
     def _place_params(self, host_params):
         """Place a host param tree exactly like construction placed the
@@ -381,6 +402,17 @@ def _build(config: dict, resource: Resource) -> TpuGenerateProcessor:
         proc, model=str(model), seed=int(config.get("seed", 0)),
         swap_cfg=parse_swap_config(config.get("swap"), who="tpu_generate"),
         checkpoint=config.get("checkpoint"))
+    from arkflow_tpu.tpu.integrity import (build_generate_integrity_monitor,
+                                           parse_integrity_config)
+
+    proc.integrity = build_generate_integrity_monitor(
+        proc, model=str(model),
+        cfg=parse_integrity_config(config.get("integrity"),
+                                   who="tpu_generate"))
+    if proc.integrity is not None and proc.swapper is not None:
+        # swaps and probes must coexist: probing quiesces across the roll
+        # and the golden reference recomputes against committed weights
+        proc.swapper.integrity = proc.integrity
     return proc
 
 

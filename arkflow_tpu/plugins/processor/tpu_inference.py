@@ -105,6 +105,14 @@ Config:
                                    # predicted waste by min_improvement
                                    # (hysteresis — no flapping); POST
                                    # /admin/tune forces a cycle
+    integrity:                     # silent-data-corruption defense
+      probe_interval: 10s          # (tpu/integrity.py): a tie-free golden
+      digest_every: 3              # batch probes every member per interval
+      golden: {rows: 2, seed: 42}  # (argmax vs a host-computed reference);
+      repair: true                 # every Nth tick re-verifies per-leaf
+                                   # param digests off-path. A mismatch
+                                   # quarantines the member (CORRUPT) and
+                                   # repairs it from the retained host tree
 """
 
 from __future__ import annotations
@@ -129,8 +137,13 @@ class TpuInferenceProcessor(Processor):
     def __init__(self, runner: ModelRunner, *, text_field: str, tensor_field: Optional[str],
                  tokenizer, max_seq: int, outputs: Optional[list[str]], warmup: bool = False,
                  packing: bool = False, response_cache=None, swapper=None,
-                 tuner=None):
+                 tuner=None, integrity=None):
         self.runner = runner
+        #: silent-data-corruption defense (tpu/integrity.py): periodic param
+        #: digests + golden probes with quarantine-and-repair; None = off
+        #: (opt-in via the ``integrity:`` block). The engine's /health reads
+        #: its report here.
+        self.integrity = integrity
         #: live hot-swap manager (tpu/swap.py): the engine's POST /admin/swap
         #: and the fault plugin's swap_corrupt/swap_crash arming reach it here
         self.swapper = swapper
@@ -243,10 +256,14 @@ class TpuInferenceProcessor(Processor):
             await asyncio.get_running_loop().run_in_executor(None, self.runner.warmup)
         if self.tuner is not None:
             self.tuner.start()
+        if self.integrity is not None:
+            self.integrity.start()
 
     async def close(self) -> None:
         if self.tuner is not None:
             await self.tuner.stop()
+        if self.integrity is not None:
+            await self.integrity.stop()
 
     async def process(self, batch: MessageBatch) -> list[MessageBatch]:
         if batch.num_rows == 0:
@@ -438,6 +455,26 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         runner, model=str(model),
         cfg=parse_tuner_config(config.get("tuner"), who="tpu_inference"),
         packed=packing, cache=cache)
+    from arkflow_tpu.tpu.integrity import (build_integrity_monitor,
+                                           parse_integrity_config)
+
+    # silent-data-corruption defense (tpu/integrity.py): periodic golden
+    # probes + param digests over every member, quarantine-and-repair on a
+    # proven mismatch. Opt-in: no `integrity:` block, no monitor (a probe
+    # is a real device step per member per interval).
+    integrity = build_integrity_monitor(
+        runner, model=str(model),
+        cfg=parse_integrity_config(config.get("integrity"),
+                                   who="tpu_inference"))
+    if integrity is not None and cache is not None:
+        # a quarantined member's cached answers may be corrupt: epoch-flush
+        # so a post-quarantine byte-identical duplicate recomputes instead
+        # of replaying poisoned bytes
+        integrity.add_quarantine_hook(cache.bump_epoch)
+    if integrity is not None and swapper is not None:
+        # swaps and probes must coexist: probing quiesces across the roll
+        # and the golden reference recomputes against committed weights
+        swapper.integrity = integrity
     return TpuInferenceProcessor(
         runner,
         text_field=config.get("text_field", DEFAULT_BINARY_VALUE_FIELD),
@@ -450,4 +487,5 @@ def _build(config: dict, resource: Resource) -> TpuInferenceProcessor:
         response_cache=cache,
         swapper=swapper,
         tuner=tuner,
+        integrity=integrity,
     )

@@ -83,7 +83,7 @@ import logging
 import os
 import socket
 import uuid
-from collections import deque
+from collections import Counter, deque
 from typing import Any, Mapping, Optional, Sequence
 
 from arkflow_tpu.batch import MessageBatch, batch_fingerprint
@@ -324,6 +324,29 @@ def _swappers(processors: Sequence[Any]) -> list:
         sw = _walk_inner(proc, "swapper")
         if sw is not None and hasattr(sw, "swap"):
             out.append(sw)
+    return out
+
+
+def _combine_epochs(epochs: Sequence[str]) -> str:
+    """One heartbeat-sized digest over every monitor's epoch (most workers
+    host one monitor, where this is the identity-ish passthrough)."""
+    if len(epochs) == 1:
+        return epochs[0]
+    h = hashlib.blake2b(digest_size=16)
+    for e in epochs:
+        h.update(e.encode())
+    return h.hexdigest()
+
+
+def _integrity_monitors(processors: Sequence[Any]) -> list:
+    """SDC monitors (tpu/integrity.py) hosted by this worker's processors
+    — the heartbeat's ``param_digest`` epoch + corrupt-member summary, and
+    the targets of the dispatcher's ``integrity_probe`` tiebreak."""
+    out = []
+    for proc in processors:
+        mon = _walk_inner(proc, "integrity")
+        if mon is not None and hasattr(mon, "probe_now"):
+            out.append(mon)
     return out
 
 
@@ -576,6 +599,17 @@ class ClusterWorkerServer:
             "caches": _cache_reports(self.pipeline.processors),
             "shapes": _shape_reports(self.pipeline.processors),
         }
+        monitors = _integrity_monitors(self.pipeline.processors)
+        if monitors:
+            # SDC defense signals: the combined param-digest epoch (None
+            # until every member is baselined) lets the dispatcher spot a
+            # digest-outlier against same-model peers; a nonzero corrupt
+            # count fences this worker outright
+            epochs = [m.digest_epoch() for m in monitors]
+            rep["param_digest"] = (_combine_epochs(epochs)
+                                   if all(epochs) else None)
+            rep["integrity_corrupt"] = sum(m.corrupt_members()
+                                           for m in monitors)
         gen = [h for h in health if h.get("serving") == "continuous"]
         if gen:
             rep["gen_slots"] = sum(int(h.get("slots", 0)) for h in gen)
@@ -661,6 +695,8 @@ class ClusterWorkerServer:
                             self.worker_id, self.draining, self._inflight)
                 await _send_frame(writer, json.dumps(
                     {"ok": True, **self.load_report()}).encode(), crc=crc)
+            elif action == "integrity_probe":
+                await self._do_integrity_probe(writer, crc=crc)
             elif action == "swap":
                 await self._do_swap(req, writer)
             elif action == "infer":
@@ -704,6 +740,29 @@ class ClusterWorkerServer:
                 writer.close()
             except Exception:
                 pass
+
+    async def _do_integrity_probe(self, writer, crc: bool = False) -> None:
+        """On-demand full integrity pass — the dispatcher's shadow-verify
+        tiebreak: when two workers disagree on one batch, each runs its
+        golden probes NOW and the corrupt one self-identifies (and its
+        local monitor quarantines + repairs it on the spot)."""
+        monitors = _integrity_monitors(self.pipeline.processors)
+        summaries: list[dict] = []
+        ok = True
+        for mon in monitors:
+            try:
+                summaries.append(await mon.probe_now())
+            except Exception as e:
+                ok = False
+                summaries.append({"error": repr(e)[:200]})
+        mismatches = sum(int(s.get("mismatches", 0)) for s in summaries)
+        await _send_frame(writer, json.dumps({
+            "ok": ok, "worker_id": self.worker_id,
+            "probed": len(monitors),
+            "mismatches": mismatches,
+            "corrupt": sum(m.corrupt_members() for m in monitors),
+            "summaries": summaries,
+        }).encode(), crc=crc)
 
     async def _do_swap(self, req: dict, writer) -> None:
         """Apply a rolling hot-swap to the hosted processors via their own
@@ -1228,6 +1287,14 @@ class RemoteWorker:
         self.gen_slots = 0
         self.gen_slots_busy = 0
         self.page_occupancy = 0.0
+        #: SDC defense signals (heartbeat; tpu/integrity.py): the combined
+        #: param-digest epoch (None until the worker baselines), the
+        #: worker's self-reported quarantined-member count, and the last
+        #: digest value that passed an on-demand probe (so a legitimate
+        #: weights-version outlier is not re-probed every beat)
+        self.param_digest: Optional[str] = None
+        self.integrity_corrupt = 0
+        self.digest_cleared: Optional[str] = None
         self.last_report: dict = {}
         self.last_seen = 0.0
         self.last_error: Optional[str] = None
@@ -1266,6 +1333,9 @@ class RemoteWorker:
         self.gen_slots = int(rep.get("gen_slots", 0) or 0)
         self.gen_slots_busy = int(rep.get("gen_slots_busy", 0) or 0)
         self.page_occupancy = float(rep.get("page_pool_occupancy", 0.0) or 0.0)
+        dig = rep.get("param_digest")
+        self.param_digest = dig if isinstance(dig, str) and dig else None
+        self.integrity_corrupt = int(rep.get("integrity_corrupt", 0) or 0)
         self.last_report = rep
         self.last_seen = now
         self.last_error = None
@@ -1326,6 +1396,10 @@ class RemoteWorker:
         if self.fenced:
             out["incarnation"] = self.incarnation
             out["fenced"] = list(self.fenced)
+        if self.param_digest:
+            out["param_digest"] = self.param_digest
+        if self.integrity_corrupt:
+            out["integrity_corrupt"] = self.integrity_corrupt
         if self.last_error:
             out["last_error"] = self.last_error
         remote_health = self.last_report.get("health")
@@ -1353,7 +1427,8 @@ class ClusterDispatcher:
                  decode_candidates: int = 3,
                  crc: bool = True, io_deadline_floor_s: float = 0.1,
                  hedge: Optional[Mapping] = None,
-                 retry_budget: Optional[Mapping] = None):
+                 retry_budget: Optional[Mapping] = None,
+                 shadow_verify: Optional[Mapping] = None):
         from arkflow_tpu.batch import DEFAULT_BINARY_VALUE_FIELD
 
         if not urls:
@@ -1423,6 +1498,23 @@ class ClusterDispatcher:
             self._retry_budget.setdefault("burst", 8)
         self._retry_tokens = (float(self._retry_budget["burst"])
                               if self._retry_budget is not None else None)
+        # shadow verification (None = disabled): every (1/fraction)-th
+        # dispatch is ALSO sent to the ring successor and the two
+        # responses' fingerprints compared — the defense against corruption
+        # a worker cannot see in itself (its digests hash the corrupt tree
+        # it already has; its golden probe runs on the corrupt chip).
+        # Deterministic round-counting, not RNG: fraction 1.0 must shadow
+        # EVERY batch (the soak's zero-corrupt-rows proof depends on it).
+        self._shadow = dict(shadow_verify) if shadow_verify is not None else None
+        if self._shadow is not None:
+            self._shadow.setdefault("fraction", 0.05)
+            self._shadow_every = max(
+                1, round(1.0 / float(self._shadow["fraction"])))
+        self._shadow_count = 0
+        #: run when a worker is fenced for proven corruption — the ingest
+        #: response cache epoch-bumps here (its cached answers from that
+        #: worker may be poisoned)
+        self.integrity_hooks: list = []
         #: in-process chaos transport (chaoswire.ChaosWire); armed by the
         #: fault plugin's net_* kinds, wraps the next opened connection
         self.chaos = None
@@ -1462,6 +1554,20 @@ class ClusterDispatcher:
                 {**labels, "outcome": o})
             for o in ("issued", "win", "primary_win", "denied", "failed")
         }
+        self.m_shadow = {
+            o: reg.counter(
+                "arkflow_shadow_verify_total",
+                "shadow-verify outcomes (issued / match / diverged / "
+                "skipped = no partner or one attempt failed, so no "
+                "comparison happened)",
+                {**labels, "outcome": o})
+            for o in ("issued", "match", "diverged", "skipped")
+        }
+        self.m_integrity_fence = reg.counter(
+            "arkflow_cluster_integrity_fence_total",
+            "workers fenced for proven or self-reported silent-data-"
+            "corruption (heartbeat corrupt report, digest outlier confirmed "
+            "by probe, or shadow-verify tiebreak)", labels)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1606,6 +1712,70 @@ class ClusterDispatcher:
             logger.info("remote_tpu[%s]: worker %s up (id=%s)", self.name,
                         w.url, rep.get("worker_id"))
         w.note_report(rep, asyncio.get_running_loop().time())
+        await self._integrity_check(w)
+
+    # -- SDC defense (tpu/integrity.py, cluster tier) ----------------------
+
+    def _fence_for_integrity(self, w: RemoteWorker, reason: str) -> None:
+        """Fence a worker on proven (or self-reported) corruption through
+        the PR-19 incarnation path: its epoch is dead to the ring until the
+        heal handshake re-mints it, and anything caching its past answers
+        flushes. A worker whose member stays CORRUPT keeps re-reporting it
+        on every heartbeat, so backoff alone never re-admits it — only a
+        successful worker-side repair does."""
+        self.m_integrity_fence.inc()
+        self.m_deaths.inc()
+        logger.error(
+            "remote_tpu[%s]: fencing worker %s for integrity: %s "
+            "(incarnation %s)", self.name, w.url, reason, w.fence())
+        w.note_down(ProcessError(f"integrity: {reason}"))
+        for hook in self.integrity_hooks:
+            try:
+                hook()
+            except Exception:
+                logger.exception("integrity fence hook failed")
+
+    async def _integrity_check(self, w: RemoteWorker) -> None:
+        """Heartbeat-time SDC fencing. A worker self-reporting quarantined
+        (CORRUPT) members serves nothing until repaired. A worker whose
+        param-digest epoch disagrees with the majority of digest-reporting
+        peers (3+ reporting) is an OUTLIER — but an outlier is only proof
+        of different weights, not corruption (a mid-roll hot-swap looks
+        identical), so it is fenced only when its own on-demand golden
+        probe confirms a mismatch; a clean probe clears that digest value
+        until it changes again."""
+        if w.integrity_corrupt:
+            self._fence_for_integrity(
+                w, f"{w.integrity_corrupt} corrupt member(s) self-reported")
+            return
+        dig = w.param_digest
+        if not dig or dig == w.digest_cleared:
+            return
+        peers = [x.param_digest for x in self.workers.values()
+                 if x.alive and x.param_digest]
+        if len(peers) < 3:
+            return  # no majority to compare against
+        major, nmaj = Counter(peers).most_common(1)[0]
+        if dig == major or nmaj <= len(peers) // 2:
+            return
+        try:
+            rep = await self._unary(w, {"action": "integrity_probe"},
+                                    timeout=self.request_timeout_s)
+        except Exception as e:
+            w.note_down(e)
+            return
+        if int(rep.get("mismatches", 0) or 0) or int(rep.get("corrupt", 0)
+                                                     or 0):
+            self._fence_for_integrity(
+                w, f"digest outlier ({nmaj}/{len(peers)} peers agree on "
+                   f"{major[:12]}, this worker reports {dig[:12]}) confirmed "
+                   "by golden probe")
+            return
+        w.digest_cleared = dig
+        logger.warning(
+            "remote_tpu[%s]: worker %s is a param-digest outlier but passed "
+            "its golden probe — different weights version (mid-swap?), not "
+            "corruption; admitting", self.name, w.url)
 
     # -- wire helpers ------------------------------------------------------
 
@@ -1879,6 +2049,65 @@ class ClusterDispatcher:
             # connection teardown finish before we return
             await asyncio.gather(p_task, h_task, return_exceptions=True)
 
+    async def _attempt_shadow(self, primary: RemoteWorker,
+                              shadow_w: RemoteWorker, batch: MessageBatch,
+                              **kw) -> list[MessageBatch]:
+        """Dual-dispatch one sampled batch to the owner AND its ring
+        successor and compare response signatures. Unlike a hedge (first
+        success wins) shadow-verify needs BOTH answers: a lone corrupted
+        worker produces a plausible, well-formed response that only
+        disagreement can expose. On divergence neither side is trusted by
+        fiat — each runs its golden probe, and whichever fails it is fenced
+        as corrupt; the other's answer is delivered. Transport failure on
+        either leg degrades to normal single delivery ("skipped")."""
+        self.m_shadow["issued"].inc()
+        p_task = asyncio.ensure_future(self._attempt(primary, batch, **kw))
+        s_task = asyncio.ensure_future(self._attempt(shadow_w, batch, **kw))
+        results = await asyncio.gather(p_task, s_task, return_exceptions=True)
+        p_res, s_res = results
+        if isinstance(p_res, _RemoteProcessingError):
+            raise p_res  # terminal regardless of what the shadow said
+        if isinstance(p_res, BaseException) and isinstance(s_res,
+                                                           BaseException):
+            raise p_res  # both legs died: classified failover as usual
+        if isinstance(p_res, BaseException) or isinstance(s_res,
+                                                          BaseException):
+            # one leg lost transport — no comparison possible this round
+            self.m_shadow["skipped"].inc()
+            return s_res if isinstance(p_res, BaseException) else p_res
+        p_sig = tuple(batch_fingerprint(b) for b in p_res)
+        s_sig = tuple(batch_fingerprint(b) for b in s_res)
+        if p_sig == s_sig:
+            self.m_shadow["match"].inc()
+            return p_res
+        self.m_shadow["diverged"].inc()
+        logger.error(
+            "remote_tpu[%s]: shadow-verify divergence between %s and %s; "
+            "running golden-probe tiebreak", self.name, primary.url,
+            shadow_w.url)
+        bad: list[RemoteWorker] = []
+        for w in (primary, shadow_w):
+            try:
+                rep = await self._unary(w, {"action": "integrity_probe"},
+                                        timeout=self.request_timeout_s)
+            except Exception as e:
+                w.note_down(e)
+                bad.append(w)
+                continue
+            if int(rep.get("mismatches", 0) or 0) or int(
+                    rep.get("corrupt", 0) or 0):
+                self._fence_for_integrity(
+                    w, "shadow-verify divergence confirmed by golden probe")
+                bad.append(w)
+        if primary not in bad:
+            return p_res
+        if shadow_w not in bad:
+            return s_res
+        raise ConnectError(
+            f"remote_tpu[{self.name}]: shadow-verify divergence between "
+            f"{primary.url} and {shadow_w.url} and neither passed its "
+            "golden probe; failing over")
+
     async def dispatch(self, batch: MessageBatch) -> list[MessageBatch]:
         """Route one emission to the fleet; failover along the ring on
         transport errors, bounded by the retry budget; hedged against the
@@ -1932,6 +2161,18 @@ class ClusterDispatcher:
                   timeout_s=self._hop_timeout(batch))
         last_exc: Optional[BaseException] = None
         i, n = 0, len(candidates)
+        # deterministic every-Nth sampling (no RNG: fraction 1.0 must
+        # shadow EVERY batch, and the soak's accounting depends on it);
+        # role-split fleets skip it — prefill/decode answers aren't
+        # comparable across the two-hop path
+        do_shadow = False
+        if self._shadow is not None and not self.role_split():
+            self._shadow_count += 1
+            if self._shadow_count % self._shadow_every == 0:
+                if n >= 2:
+                    do_shadow = True
+                else:
+                    self.m_shadow["skipped"].inc()
         while i < n:
             if i > 0:
                 if self._retry_tokens is not None:
@@ -1947,10 +2188,16 @@ class ClusterDispatcher:
                     self._retry_tokens -= 1.0
                 self.m_retries.inc()
             w = candidates[i]
+            shadow_w = (candidates[i + 1]
+                        if do_shadow and i + 1 < n else None)
             hedge_w = (candidates[i + 1]
-                       if self._hedge is not None and i + 1 < n else None)
+                       if shadow_w is None and self._hedge is not None
+                       and i + 1 < n else None)
             try:
-                if hedge_w is not None:
+                if shadow_w is not None:
+                    out = await self._attempt_shadow(w, shadow_w, batch,
+                                                     **kw)
+                elif hedge_w is not None:
                     out = await self._attempt_hedged(w, hedge_w, batch, **kw)
                 else:
                     out = await self._attempt(w, batch, **kw)
@@ -1962,8 +2209,9 @@ class ClusterDispatcher:
                     ReadError) as e:
                 last_exc = (ConnectError(f"worker {w.url} draining")
                             if isinstance(e, _WorkerDraining) else e)
-                # a hedged round consumed two candidates; skip both
-                i += 2 if hedge_w is not None else 1
+                # a shadowed/hedged round consumed two candidates; skip both
+                i += (2 if (hedge_w is not None or shadow_w is not None)
+                      else 1)
                 continue
             else:
                 self._note_latency(loop.time() - t0)
@@ -2179,6 +2427,13 @@ class ClusterDispatcher:
                 "tokens": self._retry_tokens,
                 "shed": self.m_retry_shed.value,
             }
+        if self._shadow is not None:
+            out["shadow_verify"] = {
+                "fraction": self._shadow["fraction"],
+                "every": self._shadow_every,
+                "outcomes": {k: c.value for k, c in self.m_shadow.items()},
+            }
+        out["integrity_fences"] = self.m_integrity_fence.value
         return out
 
     def health_reports(self) -> list[dict]:
@@ -2301,6 +2556,10 @@ class RemoteTpuProcessor:
         self.swapper = ClusterSwapper(dispatcher, drain_timeout_s)
         if self.cache is not None:
             self.swapper.add_commit_hook(self.cache.bump_epoch)
+            # integrity satellite: a worker fenced for corruption may have
+            # poisoned cached answers — epoch-flush so a byte-identical
+            # duplicate recomputes on a healthy worker
+            dispatcher.integrity_hooks.append(self.cache.bump_epoch)
         #: elastic-fleet controller (runtime/fleet.py); None = static fleet
         self.fleet = fleet
         #: engine /health + /readiness integration (runner-shaped view)
@@ -2490,6 +2749,26 @@ def parse_remote_tpu_config(config: Mapping) -> dict:
         out["retry_budget"] = {"ratio": float(ratio), "burst": burst}
     else:
         out["retry_budget"] = None
+
+    sv = config.get("shadow_verify")
+    if sv is not None:
+        if not isinstance(sv, Mapping):
+            raise ConfigError(
+                f"remote_tpu.shadow_verify must be a mapping, got {sv!r}")
+        unknown = set(sv) - {"fraction"}
+        if unknown:
+            raise ConfigError(
+                f"remote_tpu.shadow_verify: unknown keys {sorted(unknown)} "
+                "(allowed: fraction)")
+        frac = sv.get("fraction", 0.05)
+        if isinstance(frac, bool) or not isinstance(frac, (int, float)) \
+                or not 0.0 < frac <= 1.0:
+            raise ConfigError(
+                f"remote_tpu.shadow_verify.fraction must be in (0, 1], "
+                f"got {frac!r}")
+        out["shadow_verify"] = {"fraction": float(frac)}
+    else:
+        out["shadow_verify"] = None
     parse_response_cache_config(config.get("response_cache"))
     # elastic-fleet block (runtime/fleet.py owns the parse rules); pure —
     # config.py reaches this through fault.inner chains at --validate time
@@ -2520,7 +2799,8 @@ def build_remote_tpu(config: dict, resource: Resource) -> RemoteTpuProcessor:
         crc=parsed["crc"],
         io_deadline_floor_s=parsed["io_deadline_floor_s"],
         hedge=parsed["hedge"],
-        retry_budget=parsed["retry_budget"])
+        retry_budget=parsed["retry_budget"],
+        shadow_verify=parsed["shadow_verify"])
     cache = build_response_cache(config.get("response_cache"), name=name)
     fleet = None
     fleet_cfg = parsed["fleet"]

@@ -166,6 +166,22 @@ class Engine:
                                      s.name)
             if clusters:
                 info["cluster"] = clusters
+            integrity = []
+            for proc in getattr(s.pipeline, "processors", None) or []:
+                # SDC defense plane (tpu/integrity.py): per-member state +
+                # last-probe age — same _inner-chain walk as the others
+                from arkflow_tpu.runtime.cluster import _walk_inner
+
+                mon = _walk_inner(proc, "integrity")
+                if mon is None or not hasattr(mon, "report"):
+                    continue
+                try:
+                    integrity.append(mon.report())
+                except Exception:
+                    logger.exception("integrity report failed for stream %s",
+                                     s.name)
+            if integrity:
+                info["integrity"] = integrity
             out[s.name] = info
         return out
 
@@ -192,8 +208,9 @@ class Engine:
                 return web.Response(status=503, text='{"status":"not_ready"}',
                                     content_type="application/json")
             # per-runner health instead of a binary flag: a stream whose
-            # device runners are ALL dead cannot serve — report not_ready so
-            # the orchestrator rotates this replica out
+            # device runners are ALL dead — or ALL quarantined CORRUPT, the
+            # DEAD-adjacent integrity state — cannot serve; report not_ready
+            # so the orchestrator rotates this replica out
             dead = {}
             runners = {}
             for s in self.streams:
@@ -201,7 +218,8 @@ class Engine:
                 if not reports:
                     continue
                 runners[s.name] = [r.get("state") for r in reports]
-                if all(r.get("state") == "dead" for r in reports):
+                if all(r.get("state") in ("dead", "corrupt")
+                       for r in reports):
                     dead[s.name] = len(reports)
             if dead:
                 body = {"status": "not_ready", "dead_runner_streams": dead,

@@ -17,15 +17,30 @@ hot-swap manager (``tpu/swap.py``), so their failure modes must be clean:
   ``ConfigError`` that names the offending leaves (what the model expects
   vs what the checkpoint holds), so a wrong-architecture checkpoint fails
   with an actionable message instead of a stack of orbax internals.
+- ``save`` additionally writes a **digest manifest** beside the tree (one
+  blake2b per leaf, tpu/integrity.py) with the same crash-atomic
+  discipline, and ``restore`` verifies the restored tree against it when
+  present — so corruption AT REST (truncated/mangled bytes that orbax can
+  still deserialize, a half-synced copy) fails loudly naming the drifted
+  leaves, not just corruption in HBM. Manifest-less checkpoints (older
+  saves, foreign writers) restore unverified, as before.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 from pathlib import Path
 
 from arkflow_tpu.errors import ConfigError
+
+#: digest-manifest sibling suffix (a FILE next to the checkpoint dir)
+_MANIFEST_SUFFIX = ".digests.json"
+
+
+def _manifest_path(p: Path) -> Path:
+    return p.parent / f"{p.name}{_MANIFEST_SUFFIX}"
 
 
 def _tmp_sibling(p: Path, tag: str) -> Path:
@@ -44,6 +59,8 @@ def _clean_stale_siblings(p: Path) -> None:
         shutil.rmtree(stale, ignore_errors=True)
     for stale in p.parent.glob(f".{p.name}.old-*"):
         shutil.rmtree(stale, ignore_errors=True)
+    for stale in p.parent.glob(f".{p.name}{_MANIFEST_SUFFIX}.tmp-*"):
+        stale.unlink(missing_ok=True)
 
 
 def save(path: str, params) -> None:
@@ -59,6 +76,16 @@ def save(path: str, params) -> None:
     p = Path(path).absolute()
     p.parent.mkdir(parents=True, exist_ok=True)
     _clean_stale_siblings(p)  # crashed saves (any pid) never half-read
+    # the digest manifest must never describe a DIFFERENT tree than the one
+    # on disk: drop the old manifest BEFORE the tree flips, write the new
+    # one after — every crash window leaves a tree without a manifest
+    # (restore skips verification, the pre-manifest behavior), never a tree
+    # with the WRONG manifest (which would fail a legitimate restore)
+    from arkflow_tpu.tpu.integrity import tree_digests
+
+    digests = tree_digests(params)
+    manifest = _manifest_path(p)
+    manifest.unlink(missing_ok=True)
     tmp = _tmp_sibling(p, "tmp")
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(tmp, params)
@@ -72,6 +99,9 @@ def save(path: str, params) -> None:
         shutil.rmtree(old, ignore_errors=True)
     else:
         os.rename(tmp, p)
+    mtmp = manifest.parent / f".{manifest.name}.tmp-{os.getpid()}"
+    mtmp.write_text(json.dumps({"digests": digests}, indent=0))
+    os.rename(mtmp, manifest)
 
 
 def _mismatch_hint(ckptr, p: Path, like_params) -> str:
@@ -99,12 +129,17 @@ def _mismatch_hint(ckptr, p: Path, like_params) -> str:
         return ""
 
 
-def restore(path: str, like_params):
+def restore(path: str, like_params, *, verify: bool = True):
     """Restore ``path`` into the structure/dtypes of ``like_params``.
 
     Raises ``ConfigError`` (never a raw orbax traceback) when the path is
     missing, the tree structure does not match the model's, or the
-    checkpoint bytes are unreadable (truncated / mangled files).
+    checkpoint bytes are unreadable (truncated / mangled files). When a
+    digest manifest sits beside the tree (written by :func:`save`) and
+    ``verify`` is on, the restored tree is hashed against it and a drift
+    raises a ``ConfigError`` naming the mismatched leaves — the
+    corrupt-at-rest defense: bytes orbax can still deserialize but that
+    are not the bytes ``save`` wrote must never reach a serving tree.
     """
     import orbax.checkpoint as ocp
 
@@ -113,7 +148,7 @@ def restore(path: str, like_params):
         raise ConfigError(f"checkpoint path {p} does not exist")
     ckptr = ocp.StandardCheckpointer()
     try:
-        return ckptr.restore(p, like_params)
+        restored = ckptr.restore(p, like_params)
     except ConfigError:
         raise
     except Exception as e:
@@ -121,3 +156,23 @@ def restore(path: str, like_params):
         raise ConfigError(
             f"failed to restore checkpoint {p}: "
             f"{hint if hint else f'{type(e).__name__}: {e}'}") from e
+    manifest = _manifest_path(p)
+    if verify and manifest.exists():
+        from arkflow_tpu.tpu.integrity import diff_digests, tree_digests
+
+        try:
+            want = json.loads(manifest.read_text())["digests"]
+        except Exception as e:
+            raise ConfigError(
+                f"checkpoint digest manifest {manifest} is unreadable "
+                f"({type(e).__name__}: {e}); delete it to restore "
+                "unverified") from e
+        drifted = diff_digests(want, tree_digests(restored))
+        if drifted:
+            preview = drifted[:3] + (["..."] if len(drifted) > 3 else [])
+            raise ConfigError(
+                f"checkpoint {p} failed digest verification: {len(drifted)} "
+                f"leaves drifted from the manifest: {preview} — the bytes "
+                "on disk are not the bytes save() wrote (corrupt at rest), "
+                "or the checkpoint was overwritten by a foreign writer")
+    return restored

@@ -14,6 +14,14 @@ module gives the DEVICE path the same property. Every ``ModelRunner`` owns a
                  exponential backoff between probes
     DEAD      -- ``dead_after`` consecutive incidents without one success;
                  terminal — never probed again, reported on ``/health``
+    CORRUPT   -- quarantined for a PROVEN integrity failure (param-digest
+                 mismatch confirmed by a failed golden probe, tpu/integrity.py):
+                 DEAD-adjacent — skipped by dispatch and NEVER re-admitted by
+                 the probe/backoff schedule alone, because a corrupt chip can
+                 pass a liveness probe while still answering wrongly. Only an
+                 explicit ``mark_repaired`` (after re-adopting known-good
+                 params, re-verifying digests, and passing the golden probe)
+                 returns it to HEALTHY.
 
 Transitions are driven by step outcomes (``mark_success`` / ``mark_unhealthy``
 / ``mark_degraded``); recovery probes are REAL traffic batches: when a probe
@@ -44,9 +52,10 @@ HEALTHY = "healthy"
 DEGRADED = "degraded"
 UNHEALTHY = "unhealthy"
 DEAD = "dead"
+CORRUPT = "corrupt"
 
 #: gauge encoding for ``arkflow_tpu_runner_health``
-GAUGE_VALUE = {HEALTHY: 0, DEGRADED: 1, UNHEALTHY: 2, DEAD: 3}
+GAUGE_VALUE = {HEALTHY: 0, DEGRADED: 1, UNHEALTHY: 2, DEAD: 3, CORRUPT: 4}
 
 
 @dataclass(frozen=True)
@@ -151,7 +160,7 @@ class RunnerHealth:
             return True
         if s == UNHEALTHY:
             return not self._probing and self.probe_due(now)
-        return False  # DEAD
+        return False  # DEAD / CORRUPT
 
     # -- transitions -------------------------------------------------------
 
@@ -167,7 +176,7 @@ class RunnerHealth:
         with self._lock:
             if self._state in (HEALTHY, DEGRADED):
                 return True
-            if self._state == DEAD:
+            if self._state in (DEAD, CORRUPT):
                 return False
             now = self._clock() if now is None else now
             if self._probing or now < self._next_probe_at:
@@ -186,7 +195,7 @@ class RunnerHealth:
         with self._lock:
             if self._state in (HEALTHY, DEGRADED):
                 return True
-            if self._state == DEAD:
+            if self._state in (DEAD, CORRUPT):
                 return False
             if self._probing:
                 if self._probe_handoff:
@@ -200,10 +209,13 @@ class RunnerHealth:
             return True
 
     def mark_success(self) -> None:
-        """A step completed: clear the incident streak; re-admit a suspect."""
+        """A step completed: clear the incident streak; re-admit a suspect.
+        CORRUPT is NOT cleared here: a quarantined member may still complete
+        steps (that is the failure mode — plausible-but-wrong answers), so
+        only the explicit repair path (``mark_repaired``) re-admits it."""
         with self._lock:
-            if self._state == DEAD:
-                return  # terminal
+            if self._state in (DEAD, CORRUPT):
+                return  # terminal / quarantined
             self._probing = False
             self._probe_handoff = False
             self._consecutive_failures = 0
@@ -224,8 +236,8 @@ class RunnerHealth:
         """An incident (deadline miss, repeated step failure): stop receiving
         traffic, schedule a recovery probe with exponential backoff."""
         with self._lock:
-            if self._state == DEAD:
-                return
+            if self._state in (DEAD, CORRUPT):
+                return  # CORRUPT outranks: repair owns the exit transition
             self._probing = False
             self._probe_handoff = False
             self._consecutive_failures += 1
@@ -247,3 +259,35 @@ class RunnerHealth:
                            "(incident %d)", self.name, reason, backoff,
                            self._consecutive_failures)
             self._set(UNHEALTHY)
+
+    def mark_corrupt(self, reason: str) -> None:
+        """Quarantine for a PROVEN integrity failure (tpu/integrity.py): the
+        member answered the golden probe wrongly or its param digests drifted.
+        DEAD-adjacent — dispatch skips it and no step success or probe
+        schedule ever re-admits it; only ``mark_repaired`` (after re-adopting
+        known-good params and re-passing the probe) exits this state."""
+        with self._lock:
+            if self._state in (DEAD, CORRUPT):
+                return
+            self._probing = False
+            self._probe_handoff = False
+            self._last_reason = reason
+            logger.error("[%s] runner CORRUPT — quarantined: %s",
+                         self.name, reason)
+            self._set(CORRUPT)
+
+    def mark_repaired(self) -> bool:
+        """Exit quarantine after a verified repair: the integrity monitor
+        re-adopted known-good params, re-verified the digests, and the golden
+        probe passed again. Returns False (no-op) from any other state — the
+        repair path must never resurrect a DEAD member."""
+        with self._lock:
+            if self._state != CORRUPT:
+                return False
+            self._probing = False
+            self._probe_handoff = False
+            self._consecutive_failures = 0
+            self._last_reason = ""
+            logger.info("[%s] runner repaired -> HEALTHY", self.name)
+            self._set(HEALTHY)
+            return True

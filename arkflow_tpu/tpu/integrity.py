@@ -1,0 +1,787 @@
+"""Silent-data-corruption defense plane: digests, golden probes, quarantine.
+
+PRs 4/16/19 made the serving tier survive crashes, hangs, OOMs, preemption
+and partitions with a zero-silent-LOSS identity — but nothing defended
+against the fleet returning wrong ANSWERS: a bit-flipped param leaf in HBM,
+a defective chip, or stale weights after a botched swap would serve corrupt
+results undetected, and an elastic controller spawning workers on arbitrary
+hosts makes defective hardware a routine event, not an anomaly. This module
+extends the invariant from "never silently lost" to "never silently wrong":
+
+1. **Param-tree digests** (:func:`tree_digests`): one blake2b-128 per leaf
+   over dtype+shape+bytes, keyed by the leaf's tree path. The baseline is
+   taken from the LIVE placed tree at the first off-path verification after
+   boot / adopt (``ModelRunner.param_digests`` invalidates on every
+   ``adopt_params``, so legitimate weight flips never read as drift) and
+   re-verified on the probe cadence — fetch-and-hash on an executor thread
+   holding the in-flight permit, exactly like ``warm_shapes_live``, so
+   verification never interleaves with a live device schedule. A mismatch
+   names the offending leaf paths and marks the member UNHEALTHY through
+   the PR-4 state machine, then forces a golden probe as the tiebreak.
+
+2. **Live golden probes**: a deterministic golden batch per model family —
+   tie-free BY CONSTRUCTION (:func:`find_golden_reference` searches seeds
+   until the smallest top-1/top-2 logit gap clears the serving dtype's
+   noise floor) — runs through each member's REAL serving path on a
+   periodic schedule, and its argmax signature is compared against a
+   host-computed reference. A mismatch is an integrity failure, not a
+   transient error: the member is quarantined (health ``CORRUPT``,
+   DEAD-adjacent — never re-admitted by backoff alone, because a corrupt
+   chip passes liveness probes while still answering wrongly) and repaired
+   (re-adopt the retained known-good host tree, digests re-baselined,
+   golden probe re-verified before re-admission).
+
+3. **Quarantine hooks**: anything whose cached state may hold a corrupt
+   member's answers registers here — the ingest ``ResponseCache`` bumps its
+   epoch so a post-quarantine byte-identical duplicate recomputes instead
+   of replaying poisoned bytes.
+
+The cluster tier reuses the same machinery: worker heartbeats carry this
+monitor's ``digest_epoch`` and corrupt-member count, the ingest dispatcher
+fences digest-outlier or corrupt-reporting workers through the PR-19
+incarnation-fencing path, and ``shadow_verify`` dual-dispatches a sampled
+fraction of live batches to the ring successor to catch corruption the
+worker cannot see in itself (runtime/cluster.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+import numpy as np
+
+from arkflow_tpu.errors import ConfigError, RunnerDead
+from arkflow_tpu.obs import global_registry
+from arkflow_tpu.tpu.health import CORRUPT, DEAD
+
+logger = logging.getLogger("arkflow.tpu.integrity")
+
+#: result label values of ``arkflow_integrity_probe_total``
+PROBE_RESULTS = ("ok", "mismatch", "digest_mismatch", "error")
+
+
+# -- param-tree digests ------------------------------------------------------
+
+
+def _leaf_digest(arr) -> str:
+    a = np.ascontiguousarray(np.asarray(arr))
+    h = hashlib.blake2b(digest_size=16)
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def tree_digests(tree) -> dict[str, str]:
+    """Per-leaf blake2b-128 digests keyed by tree path (``keystr``).
+
+    Blocking — ``device_get`` of every leaf — so callers keep it off the
+    event loop (executor thread, holding the in-flight permit when the
+    member is serving). Digest covers dtype + shape + bytes: a corrupt
+    value, a silent re-cast, and a re-shape all read as drift.
+    """
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    host = jax.device_get([leaf for _, leaf in flat])
+    return {jax.tree_util.keystr(path): _leaf_digest(a)
+            for (path, _), a in zip(flat, host)}
+
+
+def combined_digest(digests: Mapping[str, str]) -> str:
+    """One order-independent digest over a ``tree_digests`` map — the
+    ``param_digest`` epoch a cluster worker heartbeat carries."""
+    h = hashlib.blake2b(digest_size=16)
+    for path in sorted(digests):
+        h.update(path.encode())
+        h.update(digests[path].encode())
+    return h.hexdigest()
+
+
+def diff_digests(baseline: Mapping[str, str],
+                 current: Mapping[str, str]) -> list[str]:
+    """Leaf paths whose digests differ (missing/extra leaves included)."""
+    return [p for p in sorted(set(baseline) | set(current))
+            if baseline.get(p) != current.get(p)]
+
+
+# -- tie-free golden reference -----------------------------------------------
+
+#: minimum top-1/top-2 logit gap a golden batch must clear, per serving
+#: dtype: below this, benign rounding drift between the host-computed
+#: reference and the device step could flip an argmax and read as
+#: corruption. bf16 has ~2^-8 relative precision, int8 re-quantizes
+#: activations — their floors are far above float32's.
+MARGIN_FLOOR = {
+    None: 1e-5,
+    "float32": 1e-5,
+    "bfloat16": 1.0 / 64,
+    "float16": 1e-3,
+    "int8": 1e-2,
+}
+
+
+@dataclass(frozen=True)
+class GoldenReference:
+    """A deterministic golden batch and its host-computed answer: the
+    member-side inputs (serving layout — packed when the runner packs), the
+    reference argmax signature, the seed that produced a tie-free batch,
+    and the margin it cleared. Same (family, cfg, dtype, seed) => bitwise
+    identical across process restarts."""
+
+    inputs: dict[str, np.ndarray]
+    signature: np.ndarray
+    seed: int
+    margin: float
+
+
+def _packed_golden(spec_cfg, rows: int, seq: int, seed: int):
+    """Golden batch in the packed layout (tpu/packing.py): equal-length
+    full-seq examples, one per row — deterministic, and the packed apply's
+    [E] outputs land in input example order."""
+    from arkflow_tpu.tpu.packing import pack_tokens
+
+    rng = np.random.default_rng(seed)
+    vocab = int(getattr(spec_cfg, "vocab_size", 256) or 256)
+    ids = rng.integers(1, max(vocab, 2), size=(rows, seq)).astype(np.int32)
+    pk = pack_tokens(ids, np.full(rows, seq, np.int64), seq)
+    return {"input_ids": pk.input_ids, "segment_ids": pk.segment_ids,
+            "position_ids": pk.position_ids, "example_row": pk.example_row,
+            "example_pos": pk.example_pos}
+
+
+def find_golden_reference(family, cfg, host_params, *, rows: int, seq: int,
+                          seed: int, serving_dtype: Optional[str],
+                          packed: bool = False) -> GoldenReference:
+    """Build a tie-free golden batch + host-computed reference signature.
+
+    Seeds are searched (base, base+1, ...) until the batch's
+    :func:`~arkflow_tpu.tpu.swap.signature_margin` clears the serving
+    dtype's :data:`MARGIN_FLOOR` — so the signature cannot flip from benign
+    float drift, only from actual corruption. Blocking (one host forward
+    per candidate seed); callers run it off the event loop at build time.
+    """
+    from arkflow_tpu.tpu.swap import (argmax_signature, golden_inputs,
+                                      signature_margin)
+
+    floor = MARGIN_FLOOR.get(serving_dtype, 1e-2)
+    apply_fn = (family.extras["apply_packed"] if packed else family.apply)
+    best: Optional[tuple[float, int]] = None
+    for k in range(64):
+        s = seed + k
+        if packed:
+            golden = _packed_golden(cfg, rows, seq, s)
+        else:
+            golden = golden_inputs(family.input_spec(cfg), cfg, rows, s,
+                                   seq=seq)
+        out = apply_fn(host_params, cfg, **golden)
+        out = {k_: np.asarray(v) for k_, v in out.items()}
+        margin = signature_margin(out)
+        if margin >= floor:
+            return GoldenReference(inputs=golden,
+                                   signature=argmax_signature(out),
+                                   seed=s, margin=margin)
+        if best is None or margin > best[0]:
+            best = (margin, s)
+    raise ConfigError(
+        f"integrity: no tie-free golden batch for {family.name} in 64 seeds "
+        f"(best margin {best[0]:.2e} at seed {best[1]}, need >= {floor:.2e} "
+        f"for serving_dtype {serving_dtype or 'float32'}); raise golden.rows "
+        "or pick another golden.seed")
+
+
+# -- config ------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class IntegrityConfig:
+    """Knobs for the ``integrity:`` block on ``tpu_inference`` (opt-in: no
+    block, no monitor — probes cost one real golden step per member per
+    interval)."""
+
+    #: golden-probe cadence per member
+    probe_interval_s: float = 10.0
+    #: every Nth probe tick ALSO re-verifies param digests (full-tree
+    #: fetch-and-hash — heavier than the golden step; 0 disables)
+    digest_every: int = 3
+    #: golden-batch rows (kept small: the probe rides the live schedule)
+    golden_rows: int = 2
+    #: golden-batch sequence length (clamped to the smallest seq bucket)
+    golden_seq: int = 16
+    #: base seed for the tie-free seed search
+    golden_seed: int = 0x90D
+    #: repair quarantined members automatically (re-adopt the retained
+    #: host tree, re-baseline, golden re-verify); False = quarantine only
+    repair: bool = True
+
+
+def parse_integrity_config(cfg: Any, who: str = "processor"
+                           ) -> Optional[IntegrityConfig]:
+    """Pure parse of an ``integrity:`` block (config.py runs this at
+    --validate without importing jax). None in, None out: the monitor is
+    opt-in."""
+    if cfg is None:
+        return None
+    if not isinstance(cfg, Mapping):
+        raise ConfigError(f"{who}.integrity must be a mapping, got {cfg!r}")
+    unknown = set(cfg) - {"probe_interval", "digest_every", "golden", "repair"}
+    if unknown:
+        raise ConfigError(
+            f"{who}.integrity: unknown keys {sorted(unknown)} "
+            "(allowed: probe_interval, digest_every, golden, repair)")
+    out: dict[str, Any] = {}
+    if cfg.get("probe_interval") is not None:
+        from arkflow_tpu.utils.duration import parse_duration
+
+        v = parse_duration(cfg["probe_interval"])
+        if v <= 0:
+            raise ConfigError(f"{who}.integrity.probe_interval must be positive")
+        out["probe_interval_s"] = v
+    de = cfg.get("digest_every")
+    if de is not None:
+        if isinstance(de, bool) or not isinstance(de, int) or de < 0:
+            raise ConfigError(
+                f"{who}.integrity.digest_every must be an int >= 0, got {de!r}")
+        out["digest_every"] = de
+    golden = cfg.get("golden")
+    if golden is not None:
+        if not isinstance(golden, Mapping):
+            raise ConfigError(
+                f"{who}.integrity.golden must be a mapping, got {golden!r}")
+        bad = set(golden) - {"rows", "seq", "seed"}
+        if bad:
+            raise ConfigError(
+                f"{who}.integrity.golden: unknown keys {sorted(bad)} "
+                "(allowed: rows, seq, seed)")
+        for key, lo in (("rows", 1), ("seq", 1), ("seed", None)):
+            v = golden.get(key)
+            if v is None:
+                continue
+            if isinstance(v, bool) or not isinstance(v, int) \
+                    or (lo is not None and v < lo):
+                raise ConfigError(
+                    f"{who}.integrity.golden.{key} must be an int"
+                    f"{f' >= {lo}' if lo is not None else ''}, got {v!r}")
+            out[f"golden_{key}"] = v
+    repair = cfg.get("repair")
+    if repair is not None:
+        if not isinstance(repair, bool):
+            raise ConfigError(
+                f"{who}.integrity.repair must be a bool, got {repair!r}")
+        out["repair"] = repair
+    return IntegrityConfig(**out)
+
+
+# -- member adapters ---------------------------------------------------------
+
+
+class RunnerIntegrityMember:
+    """Integrity surface over one ``ModelRunner`` (standalone or a pool
+    member): the golden probe is one REAL step through the runner's own
+    serving path (heal gate, deadline watchdog, in-flight permit), digests
+    ride the runner's ``verify_params_live`` off-path discipline, and
+    repair re-adopts the runner's retained known-good host tree."""
+
+    def __init__(self, runner, label: str, golden: GoldenReference):
+        self.runner = runner
+        self.label = label
+        self.golden = golden
+        self.last_probe_at: Optional[float] = None
+        self.last_result = "never"
+
+    @property
+    def health(self):
+        return self.runner.health
+
+    def state(self) -> str:
+        return self.runner.health.state
+
+    async def verify_digests(self) -> list[str]:
+        return await self.runner.verify_params_live()
+
+    async def golden_probe(self) -> bool:
+        from arkflow_tpu.tpu.swap import argmax_signature
+
+        out = await self.runner.infer(
+            {k: v.copy() for k, v in self.golden.inputs.items()})
+        sig = argmax_signature({k: np.asarray(v) for k, v in out.items()})
+        return bool(np.array_equal(sig, self.golden.signature))
+
+    def note_probe_failure(self, e: Exception) -> None:
+        """A probe step that RAISED is a transient incident, not proof of
+        corruption: apply the shared external-failure policy so the member
+        enters the same probe/backoff schedule pool dispatch honors."""
+        self.runner.core.note_external_failure(e)
+
+    async def repair(self) -> None:
+        """Re-adopt the retained known-good host tree (one placement, one
+        atomic flip), clear any armed sdc fault (the 'replaced hardware'),
+        and re-baseline digests off the new tree."""
+        loop = asyncio.get_running_loop()
+        r = self.runner
+        placed = await loop.run_in_executor(None, r.place_params,
+                                            r.host_params)
+        r.adopt_params(placed)
+        r.core.clear_sdc()
+        await loop.run_in_executor(None, r.rebaseline_digests)
+
+    def report(self) -> dict:
+        rep = {"label": self.label, "state": self.state(),
+               "last_probe": self.last_result}
+        if self.last_probe_at is not None:
+            rep["last_probe_age_s"] = round(
+                time.monotonic() - self.last_probe_at, 3)
+        return rep
+
+    def baseline_digests(self) -> Optional[dict[str, str]]:
+        return self.runner.param_digests
+
+    def reset_baseline(self) -> None:
+        self.runner.param_digests = None
+
+
+class ServerIntegrityMember:
+    """Integrity surface over a continuous ``GenerationServer``: the probe
+    is a host-side forward-apply of the server's live tree against the
+    golden reference (the generation loop itself samples — its outputs are
+    not signature-comparable), digests hash the same tree, and repair
+    re-places a freshly-built known-good host tree through ``swap_params``
+    (which rebuilds the jits and resets page pools + prefix cache — cached
+    KV from corrupt weights must not survive the repair)."""
+
+    def __init__(self, server, label: str, golden: GoldenReference, *,
+                 family, cfg, place_fn: Callable[[Any], Any],
+                 host_source: Callable[[], Any],
+                 drain_timeout_s: float = 30.0, owner=None):
+        self.server = server
+        self.label = label
+        self.golden = golden
+        self.family = family
+        self.cfg = cfg
+        self._place_fn = place_fn
+        self._host_source = host_source
+        self._drain_timeout_s = drain_timeout_s
+        self._owner = owner
+        self._baseline: Optional[dict[str, str]] = None
+        self.last_probe_at: Optional[float] = None
+        self.last_result = "never"
+
+    @property
+    def health(self):
+        return self.server.core.health
+
+    def state(self) -> str:
+        return self.server.core.health.state
+
+    async def verify_digests(self) -> list[str]:
+        loop = asyncio.get_running_loop()
+        digests = await loop.run_in_executor(
+            None, tree_digests, self.server.params)
+        if self._baseline is None:
+            self._baseline = digests
+            return []
+        return diff_digests(self._baseline, digests)
+
+    async def golden_probe(self) -> bool:
+        from arkflow_tpu.tpu.swap import argmax_signature
+
+        def forward() -> np.ndarray:
+            out = self.family.apply(self.server.params, self.cfg,
+                                    **self.golden.inputs)
+            return argmax_signature(
+                {k: np.asarray(v) for k, v in out.items()})
+
+        sig = await asyncio.get_running_loop().run_in_executor(None, forward)
+        return bool(np.array_equal(sig, self.golden.signature))
+
+    def note_probe_failure(self, e: Exception) -> None:
+        core = getattr(self.server, "core", None)
+        if core is not None:
+            core.note_external_failure(e)
+
+    async def repair(self) -> None:
+        loop = asyncio.get_running_loop()
+        host = await loop.run_in_executor(None, self._host_source)
+        placed = await loop.run_in_executor(None, self._place_fn, host)
+        await self.server.swap_params(placed, self._drain_timeout_s)
+        if self._owner is not None:
+            self._owner.params = placed
+        core = getattr(self.server, "core", None)
+        if core is not None:
+            core.clear_sdc()
+        self._baseline = await loop.run_in_executor(
+            None, tree_digests, placed)
+
+    def report(self) -> dict:
+        rep = {"label": self.label, "state": self.state(),
+               "last_probe": self.last_result}
+        if self.last_probe_at is not None:
+            rep["last_probe_age_s"] = round(
+                time.monotonic() - self.last_probe_at, 3)
+        return rep
+
+    def baseline_digests(self) -> Optional[dict[str, str]]:
+        return self._baseline
+
+    def reset_baseline(self) -> None:
+        self._baseline = None
+
+
+# -- the monitor -------------------------------------------------------------
+
+
+class IntegrityMonitor:
+    """Periodic integrity verification + quarantine-and-repair over a list
+    of members (one per independently-servable surface, the same granularity
+    as swap units).
+
+    Per tick, for every member: skip DEAD; repair CORRUPT (when enabled);
+    otherwise run the golden probe — and on every ``digest_every``-th tick,
+    verify param digests first. Digest drift names the offending leaves,
+    marks the member UNHEALTHY (PR-4 machine) and forces the golden probe
+    as the behavioral tiebreak; a golden-probe signature mismatch is PROOF
+    of corruption: ``mark_corrupt`` (never re-admitted by backoff),
+    quarantine hooks fire (response-cache epoch bump), and the repair path
+    re-adopts known-good params, re-baselines, and golden re-verifies
+    before ``mark_repaired`` re-admits the member.
+    """
+
+    def __init__(self, *, name: str, cfg: IntegrityConfig,
+                 members: Sequence[Any]):
+        if not members:
+            raise ConfigError("IntegrityMonitor needs at least one member")
+        self.name = name
+        self.cfg = cfg
+        self.members = list(members)
+        self._task: Optional[asyncio.Task] = None
+        self._tick = 0
+        self._quarantine_hooks: list[Callable[[], None]] = []
+        self._lock = asyncio.Lock()
+        #: probing held off during a weights transition (hot-swap roll)
+        self._suspended = False
+        #: recompute the golden reference for a given host tree — set by
+        #: the builders, used when a committed swap changes the weights
+        self._golden_factory: Optional[Callable[[Any], GoldenReference]] = None
+
+        reg = global_registry()
+        labels = {"model": name}
+        self.m_probe = {
+            r: reg.counter(
+                "arkflow_integrity_probe_total",
+                "integrity probes by result (golden signature + digests)",
+                {**labels, "result": r})
+            for r in PROBE_RESULTS
+        }
+        self.m_quarantine = reg.counter(
+            "arkflow_integrity_quarantine_total",
+            "members quarantined (CORRUPT) for proven integrity failures",
+            labels)
+        self.m_repair = reg.counter(
+            "arkflow_integrity_repair_total",
+            "quarantined members repaired, re-verified, and re-admitted",
+            labels)
+        #: per-instance counts for report() (the registry dedupes series on
+        #: (name, labels): two streams serving one model share counters)
+        self.n_probes = self.n_mismatches = 0
+        self.n_quarantined = self.n_repaired = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the background probe loop (processor ``connect``)."""
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+
+    def add_quarantine_hook(self, hook: Callable[[], None]) -> None:
+        """Run whenever a member is quarantined: its past answers are no
+        longer trustworthy, so anything replaying them (response caches)
+        must epoch-flush here."""
+        self._quarantine_hooks.append(hook)
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.cfg.probe_interval_s)
+            try:
+                await self.probe_now()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("[%s] integrity probe tick failed", self.name)
+
+    # -- swap coexistence ----------------------------------------------------
+
+    async def begin_quiesce(self) -> None:
+        """Hold off probing for a weights transition (the hot-swap manager
+        calls this before its rolling flip): mid-roll, flipped members
+        legitimately diverge from the golden reference, and a probe would
+        quarantine them — whose repair would silently roll the swap back.
+        Awaits any in-flight tick, so the roll starts probe-free."""
+        self._suspended = True
+        async with self._lock:
+            pass
+
+    def end_quiesce(self) -> None:
+        self._suspended = False
+
+    def rebuild_reference(self, host_params) -> None:
+        """Recompute the golden reference + reset digest baselines against
+        a newly COMMITTED weights version (blocking — host forwards; the
+        swap manager runs it on an executor thread before re-enabling
+        probes). Without this, the first post-swap probe would read the new
+        weights as corruption."""
+        if self._golden_factory is None:
+            raise ConfigError(
+                f"IntegrityMonitor[{self.name}] has no golden factory; "
+                "cannot follow a weights swap")
+        golden = self._golden_factory(host_params)
+        for m in self.members:
+            m.golden = golden
+            m.reset_baseline()
+        logger.info("[%s] integrity reference rebuilt for new weights "
+                    "(golden seed %d, margin %.2e)", self.name,
+                    golden.seed, golden.margin)
+
+    # -- probing -------------------------------------------------------------
+
+    async def probe_now(self) -> dict:
+        """One full verification pass over every member (the loop body;
+        also the soak/test surface — and the worker-side handler of the
+        cluster's ``integrity_probe`` action). Returns a summary dict."""
+        if self._suspended:
+            return {"tick": self._tick, "suspended": True, "checked": 0,
+                    "ok": 0, "mismatches": 0, "repaired": 0}
+        async with self._lock:  # ticks never interleave (repair is stateful)
+            self._tick += 1
+            with_digests = bool(self.cfg.digest_every) and (
+                self._tick % self.cfg.digest_every == 0)
+            summary = {"tick": self._tick, "checked": 0, "ok": 0,
+                       "mismatches": 0, "repaired": 0}
+            for m in self.members:
+                await self._probe_member(m, with_digests, summary)
+            return summary
+
+    async def _probe_member(self, m, with_digests: bool, summary: dict) -> None:
+        state = m.state()
+        if state == DEAD:
+            return  # terminal: repair must never resurrect a DEAD member
+        if state == CORRUPT:
+            # quarantined earlier (possibly by the cluster dispatcher's
+            # shadow-verify tiebreak): this tick's job is the repair
+            if self.cfg.repair:
+                summary["repaired"] += await self._repair(m)
+            return
+        summary["checked"] += 1
+        self.n_probes += 1
+        if with_digests:
+            try:
+                drifted = await m.verify_digests()
+            except RunnerDead:
+                return
+            except Exception as e:
+                self.m_probe["error"].inc()
+                m.last_result = "error"
+                m.note_probe_failure(e)
+                return
+            if drifted:
+                # drift is a strong signal, not yet proof: name the leaves,
+                # mark UNHEALTHY (PR-4 schedule), and let the golden probe
+                # below decide whether behavior actually changed
+                self.m_probe["digest_mismatch"].inc()
+                m.last_result = "digest_mismatch"
+                preview = drifted[:3] + (["..."] if len(drifted) > 3 else [])
+                logger.error("[%s] %s: param digest drift on %d leaves: %s",
+                             self.name, m.label, len(drifted), preview)
+                m.health.mark_unhealthy(
+                    f"param digest drift: {preview}")
+        try:
+            ok = await m.golden_probe()
+        except RunnerDead:
+            return  # went DEAD/CORRUPT under us; next tick handles it
+        except Exception as e:
+            self.m_probe["error"].inc()
+            m.last_result = "error"
+            m.note_probe_failure(e)
+            return
+        m.last_probe_at = time.monotonic()
+        if ok:
+            self.m_probe["ok"].inc()
+            if m.last_result != "digest_mismatch":
+                m.last_result = "ok"
+            summary["ok"] += 1
+            return
+        self.m_probe["mismatch"].inc()
+        self.n_mismatches += 1
+        m.last_result = "mismatch"
+        summary["mismatches"] += 1
+        self.quarantine(m, "golden-probe signature mismatch")
+        if self.cfg.repair:
+            summary["repaired"] += await self._repair(m)
+
+    # -- quarantine / repair -------------------------------------------------
+
+    def quarantine(self, m, reason: str) -> None:
+        """Mark a member CORRUPT and fire the quarantine hooks. Also the
+        entry point for EXTERNAL proof (the cluster dispatcher's
+        shadow-verify tiebreak)."""
+        m.health.mark_corrupt(reason)
+        self.m_quarantine.inc()
+        self.n_quarantined += 1
+        for hook in self._quarantine_hooks:
+            try:
+                hook()
+            except Exception:  # a cache flush must not compound a quarantine
+                logger.exception("[%s] quarantine hook failed", self.name)
+
+    async def _repair(self, m) -> int:
+        """Repair one CORRUPT member: re-adopt known-good params, then
+        golden re-verify BEFORE the member serves again. Returns 1 on a
+        successful re-admission, 0 when the member stays quarantined."""
+        try:
+            await m.repair()
+        except Exception:
+            logger.exception("[%s] %s: repair failed; member stays "
+                             "quarantined", self.name, m.label)
+            return 0
+        # re-admit first (the heal gate rejects CORRUPT members, so the
+        # verifying probe could not run while quarantined), then verify:
+        # dispatch skips CORRUPT members throughout the repair, and a
+        # failed re-verify re-quarantines immediately
+        m.health.mark_repaired()
+        try:
+            ok = await m.golden_probe()
+        except Exception as e:
+            m.health.mark_corrupt(f"repair re-verify errored: {e}")
+            return 0
+        m.last_probe_at = time.monotonic()
+        if not ok:
+            m.health.mark_corrupt("repair failed golden re-verify")
+            m.last_result = "mismatch"
+            return 0
+        m.last_result = "ok"
+        self.m_repair.inc()
+        self.n_repaired += 1
+        logger.info("[%s] %s: repaired, re-verified, re-admitted",
+                    self.name, m.label)
+        return 1
+
+    # -- introspection -------------------------------------------------------
+
+    def digest_epoch(self) -> Optional[str]:
+        """One digest over every member's baseline — the ``param_digest``
+        a cluster worker's heartbeat carries, so the dispatcher can spot a
+        digest-outlier worker against its same-model peers. None until
+        every member has a baseline (first digest tick)."""
+        parts: dict[str, str] = {}
+        for i, m in enumerate(self.members):
+            base = m.baseline_digests()
+            if base is None:
+                return None
+            parts[str(i)] = combined_digest(base)
+        return combined_digest(parts)
+
+    def corrupt_members(self) -> int:
+        return sum(1 for m in self.members if m.state() == CORRUPT)
+
+    def report(self) -> dict:
+        """JSON-able snapshot for the engine's ``/health`` (per-member
+        integrity state + last-probe age) and worker heartbeats."""
+        rep = {
+            "probes": self.n_probes,
+            "mismatches": self.n_mismatches,
+            "quarantined": self.n_quarantined,
+            "repaired": self.n_repaired,
+            "members": [m.report() for m in self.members],
+        }
+        epoch = self.digest_epoch()
+        if epoch is not None:
+            rep["digest_epoch"] = epoch
+        return rep
+
+
+# -- builders ----------------------------------------------------------------
+
+
+def build_integrity_monitor(runner, *, model: str,
+                            cfg: Optional[IntegrityConfig]
+                            ) -> Optional[IntegrityMonitor]:
+    """Monitor over a ``ModelRunner`` or ``ModelRunnerPool`` (one member
+    per swap unit — the same granularity the rolling hot-swap flips).
+    None when the ``integrity:`` block is absent (opt-in)."""
+    if cfg is None:
+        return None
+    units = runner.swap_units()
+    first = units[0][1]
+    buckets = first.buckets
+    seq = (min(buckets.seq_buckets) if buckets.seq_buckets
+           else cfg.golden_seq)
+    def factory(host) -> GoldenReference:
+        return find_golden_reference(
+            first.family, first.cfg, host,
+            rows=cfg.golden_rows, seq=min(cfg.golden_seq, seq),
+            seed=cfg.golden_seed, serving_dtype=first.serving_dtype,
+            packed=first.packed)
+
+    # the reference is computed ONCE against the retained known-good host
+    # tree all members share (pool replication is by construction)
+    golden = factory(first.host_params)
+    members = [RunnerIntegrityMember(r, label, golden)
+               for label, r in units]
+    mon = IntegrityMonitor(name=model, cfg=cfg, members=members)
+    mon._golden_factory = factory
+    return mon
+
+
+def build_generate_integrity_monitor(proc, *, model: str,
+                                     cfg: Optional[IntegrityConfig]
+                                     ) -> Optional[IntegrityMonitor]:
+    """Monitor over a continuous ``TpuGenerateProcessor``: one member, the
+    generation server. The probe is a host-side forward-apply of the
+    server's live tree (the generation loop itself samples — its outputs
+    are not signature-comparable), repair re-places the retained host tree
+    through ``swap_params``. Batch-mode generation has no resident member
+    to probe between calls, so the block is rejected there."""
+    if cfg is None:
+        return None
+    server = getattr(proc, "_server", None)
+    if server is None:
+        raise ConfigError(
+            "tpu_generate: integrity requires serving: continuous (batch "
+            "mode holds no resident serving member to probe); drop the "
+            "integrity block or switch serving modes")
+    import jax
+    import jax.numpy as jnp
+
+    dtype = None
+    for leaf in jax.tree_util.tree_leaves(proc.host_params):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            dtype = str(dt)
+            break
+    def factory(host) -> GoldenReference:
+        return find_golden_reference(
+            proc.family, proc.cfg, host,
+            rows=cfg.golden_rows, seq=cfg.golden_seq, seed=cfg.golden_seed,
+            serving_dtype=dtype)
+
+    golden = factory(proc.host_params)
+    member = ServerIntegrityMember(
+        server, "generate[continuous]", golden,
+        family=proc.family, cfg=proc.cfg, place_fn=proc._place_params,
+        host_source=lambda: proc.host_params, owner=proc)
+    mon = IntegrityMonitor(name=model, cfg=cfg, members=[member])
+    mon._golden_factory = factory
+    return mon

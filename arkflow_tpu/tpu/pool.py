@@ -43,7 +43,7 @@ from typing import Optional
 import numpy as np
 
 from arkflow_tpu.errors import ConfigError, RunnerDead
-from arkflow_tpu.tpu.health import DEAD, DEGRADED, HEALTHY, UNHEALTHY
+from arkflow_tpu.tpu.health import CORRUPT, DEAD, DEGRADED, HEALTHY, UNHEALTHY
 from arkflow_tpu.obs import global_registry
 from arkflow_tpu.tpu.bucketing import BucketPolicy
 from arkflow_tpu.tpu.runner import (ModelRunner, convert_for_serving,
@@ -264,8 +264,8 @@ class ModelRunnerPool:
                 skipped = True
                 if probe is None and h.probe_due(now):
                     probe = i
-            else:  # DEAD
-                skipped = True
+            else:  # DEAD, or CORRUPT (quarantined: only integrity repair
+                skipped = True  # re-admits it — never the probe schedule)
         if probe is not None and self.members[probe].health.try_begin_probe(now):
             # the probe outranks healthy members: without routing one real
             # batch at it, a recovered chip would never be re-admitted
@@ -279,7 +279,12 @@ class ModelRunnerPool:
         return best
 
     def _all_dead(self, exclude: set[int]) -> bool:
-        return all(self.members[i].health.state == DEAD
+        """Every remaining member is terminally out of dispatch: DEAD, or
+        CORRUPT (quarantined for integrity). CORRUPT fails fast like DEAD
+        rather than waiting — the batch nacks for redelivery and serves
+        after the integrity monitor repairs a member, instead of parking
+        live traffic on an unbounded repair."""
+        return all(self.members[i].health.state in (DEAD, CORRUPT)
                    for i in range(self.pool_size) if i not in exclude)
 
     def _probe_wait_s(self, exclude: set[int]) -> float:
@@ -302,7 +307,8 @@ class ModelRunnerPool:
             if i is not None:
                 break
             if self._all_dead(set()):
-                raise RunnerDead("device pool: every member is DEAD")
+                raise RunnerDead(
+                    "device pool: every member is DEAD or quarantined CORRUPT")
             time.sleep(max(self._probe_wait_s(set()), 0.01))
         self._loads[i] += 1
         self.m_dispatch[i].inc()
@@ -332,7 +338,8 @@ class ModelRunnerPool:
                     raise last_err  # every member failed this batch
                 if self._all_dead(tried):
                     raise RunnerDead(
-                        "device pool: every remaining member is DEAD")
+                        "device pool: every remaining member is DEAD or "
+                        "quarantined CORRUPT")
                 # all untried members are unhealthy mid-backoff: wait for the
                 # earliest probe window instead of dropping the batch
                 await asyncio.sleep(max(self._probe_wait_s(tried), 0.01))

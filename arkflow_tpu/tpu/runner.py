@@ -273,6 +273,15 @@ class ModelRunner:
                 init_host_params(self.family, self.cfg, seed, checkpoint),
                 self.serving_dtype, self.family.name)
 
+        #: retained CONVERTED host tree — the known-good repair source the
+        #: integrity plane (tpu/integrity.py) re-adopts from when a member
+        #: is quarantined, and the reference tree its golden signature is
+        #: computed against. Pool members share ONE tree (the pool passes
+        #: ``host_params`` in), so retention costs one host copy per model,
+        #: not per chip. Captured BEFORE placement: the pp path repacks the
+        #: layer stack below, and ``place_params`` knows how to redo that.
+        self.host_params = params
+
         self.mesh = None
         self._device = None
         self._input_sharding = None
@@ -317,6 +326,11 @@ class ModelRunner:
             self._device = target
             platform = target.platform
         self.params = params
+        #: per-leaf blake2b baseline (tpu/integrity.py); None = not yet
+        #: baselined, or invalidated by ``adopt_params`` — the integrity
+        #: monitor recomputes it lazily off-path at its next digest pass
+        #: (right after the adopt is the known-good moment)
+        self.param_digests: Optional[dict[str, str]] = None
         self._axes = axes
         #: donate padded inputs to the jitted call so XLA reuses their HBM
         #: for outputs (input-output aliasing) — under a mesh the sharded
@@ -1022,9 +1036,49 @@ class ModelRunner:
     # policy, which is bucket-grid-specific)
 
     def inject_step_fault(self, kind: str, duration_s: float = 0.0) -> None:
-        """Arm a one-shot fault consumed by the NEXT device step (fault
-        plugin's processor wrapper; kinds ``hang`` / ``oom``)."""
+        """Arm a fault on this runner (fault plugin's processor wrapper):
+        ``hang``/``oom`` are one-shot step faults consumed by the next
+        device step, ``sdc`` persistently garbles step outputs until the
+        integrity repair clears it (both live in the shared core), and
+        ``bitflip`` corrupts one param leaf of the LIVE placed tree in
+        place — the HBM bit-flip / defective-chip failure mode the
+        integrity plane (tpu/integrity.py) exists to catch."""
+        if kind == "bitflip":
+            self._bitflip_params()
+            return
         self.core.inject_step_fault(kind, duration_s)
+
+    def _bitflip_params(self) -> None:
+        """Corrupt the largest float leaf of ``self.params`` in place (the
+        leaf most likely to be a weight matrix every forward touches). The
+        corruption persists until the integrity monitor repairs the member
+        by re-adopting ``host_params`` — exactly like real HBM corruption,
+        nothing on the serving path notices by itself."""
+        import jax.numpy as jnp
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.params)
+        best: Optional[int] = None
+        for i, (_, leaf) in enumerate(flat):
+            dt = getattr(leaf, "dtype", None)
+            if (dt is not None and jnp.issubdtype(dt, jnp.floating)
+                    and getattr(leaf, "size", 0)
+                    and (best is None or leaf.size > flat[best][1].size)):
+                best = i
+        if best is None:
+            raise ConfigError(
+                "bitflip: model has no float param leaf to corrupt")
+        path, leaf = flat[best]
+        host = np.asarray(jax.device_get(leaf))
+        garbled = (np.asarray(host, np.float32) * -1000.0 + 3.7).astype(
+            host.dtype)
+        placed = jax.device_put(garbled, leaf.sharding)
+        leaves = [l for _, l in flat]
+        leaves[best] = placed
+        # one-assignment flip, like adopt_params — but WITHOUT invalidating
+        # the digest baseline: the whole point is that the drift is silent
+        self.params = jax.tree_util.tree_unflatten(treedef, leaves)
+        logger.warning("[%s] chaos: bitflip corrupted param leaf %s",
+                       self.family.name, jax.tree_util.keystr(path))
 
     @property
     def step_deadline_s(self) -> Optional[float]:
@@ -1049,7 +1103,9 @@ class ModelRunner:
         backends — never on the event loop — and the deadline watchdog can
         abandon the thread if the device wedges."""
         self.core.apply_chaos()
-        return jax.device_get(self._dispatch(padded))
+        # corrupt_outputs: identity unless an sdc fault is armed (chaos)
+        return self.core.corrupt_outputs(
+            jax.device_get(self._dispatch(padded)))
 
     def _enqueue_step(self, padded: dict[str, Any]):
         """Dispatch half of a depth-split step (``dispatch_depth`` > 1):
@@ -1121,12 +1177,58 @@ class ModelRunner:
         dispatch serves the new weights, and — same structure/dtypes/
         shardings — no executable recompiles."""
         old, self.params = self.params, placed
+        # the digest baseline described the OLD tree; the integrity monitor
+        # re-baselines lazily at its next off-path pass (adopt must not pay
+        # a synchronous full-tree device_get on the event loop)
+        self.param_digests = None
         return old
 
     def swap_units(self) -> list[tuple[str, "ModelRunner"]]:
         """A single runner is one flippable unit (the pool overrides this
         with its per-member rolling order)."""
         return [("runner", self)]
+
+    # -- integrity surface (tpu/integrity.py) -------------------------------
+
+    def digest_params(self) -> dict[str, str]:
+        """Per-leaf digests of the LIVE placed tree. Blocking (device_get
+        of every leaf) — callers keep it off the event loop, holding the
+        in-flight permit when serving (:meth:`verify_params_live`)."""
+        from arkflow_tpu.tpu.integrity import tree_digests
+
+        return tree_digests(self.params)
+
+    def rebaseline_digests(self) -> dict[str, str]:
+        """Recompute and store the digest baseline — at a known-good
+        moment only (boot, committed swap, verified integrity repair).
+        Blocking, like :meth:`digest_params`."""
+        self.param_digests = self.digest_params()
+        return self.param_digests
+
+    async def verify_params_live(self) -> list[str]:
+        """Off-path digest verification WHILE serving: fetch-and-hash on
+        an executor thread holding the in-flight permit — serializing with
+        live device schedules, the same discipline ``warm_shapes_live``
+        follows — under the first-compile deadline so a wedged device
+        abandons the verification instead of blocking the monitor forever.
+        Returns the drifted leaf paths (empty = verified). The first call
+        after boot/adopt takes the baseline instead (the tree was just
+        placed from a known-good source)."""
+        from arkflow_tpu.tpu.integrity import diff_digests
+
+        self._ensure_sems()
+        loop = asyncio.get_running_loop()
+        async with self._inflight_sem:
+            deadline = self.core.deadline_for(True)
+            if deadline is None:
+                digests = await loop.run_in_executor(None, self.digest_params)
+            else:
+                digests = await self.core.run_deadlined(
+                    self.digest_params, deadline)
+        if self.param_digests is None:
+            self.param_digests = digests
+            return []
+        return diff_digests(self.param_digests, digests)
 
     # -- live shape retune surface (tpu/tuner.py) ---------------------------
 
@@ -1515,7 +1617,7 @@ class ModelRunner:
 
                 def fetch():
                     self.core.apply_chaos()
-                    return jax.device_get(dev_out)
+                    return self.core.corrupt_outputs(jax.device_get(dev_out))
 
                 try:
                     if deadline is None:

@@ -568,8 +568,52 @@ class GenerationServer:
 
     def inject_step_fault(self, kind: str, duration_s: float = 0.0) -> None:
         """Arm a one-shot ``hang``/``oom`` on the next device step (the fault
-        plugin's processor wrapper drives this, same as for ModelRunner)."""
+        plugin's processor wrapper drives this, same as for ModelRunner).
+        ``bitflip`` corrupts a param leaf in place — the generation-tier SDC
+        vector. ``sdc`` is rejected: decode picks tokens ON DEVICE (the
+        logits never reach the host), so post-fetch output negation cannot
+        model corruption honestly here; use ``bitflip`` instead."""
+        if kind == "sdc":
+            raise ConfigError(
+                "chaos: 'sdc' is not supported on the generation server — "
+                "decode argmax/sampling happens on device, so host-side "
+                "output corruption would be a lie; arm 'bitflip' instead")
+        if kind == "bitflip":
+            self._bitflip_params()
+            return
         self.core.inject_step_fault(kind, duration_s)
+
+    def _bitflip_params(self) -> None:
+        """Corrupt the largest float leaf of ``self.params`` in place. The
+        generation jits close over params as traced constants, so the flip
+        must also rebuild them (same sequence as ``swap_params``, minus the
+        drain — arming and the serve loop share the event loop, and a
+        corrupted tree mid-decode is exactly what real HBM corruption does).
+        Nothing on the serving path notices by itself; only the integrity
+        monitor's golden probe / digest verify can catch it."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.params)
+        best: Optional[int] = None
+        for i, (_, leaf) in enumerate(flat):
+            dt = getattr(leaf, "dtype", None)
+            if (dt is not None and jnp.issubdtype(dt, jnp.floating)
+                    and getattr(leaf, "size", 0)
+                    and (best is None or leaf.size > flat[best][1].size)):
+                best = i
+        if best is None:
+            raise ConfigError(
+                "bitflip: model has no float param leaf to corrupt")
+        path, leaf = flat[best]
+        host = np.asarray(jax.device_get(leaf))
+        garbled = (np.asarray(host, np.float32) * -1000.0 + 3.7).astype(
+            host.dtype)
+        placed = jax.device_put(garbled, leaf.sharding)
+        leaves = [l for _, l in flat]
+        leaves[best] = placed
+        self.params = jax.tree_util.tree_unflatten(treedef, leaves)
+        self._seen_steps.clear()
+        self._build_jitted()
+        logger.warning("chaos: bitflip corrupted generation param leaf %s",
+                       jax.tree_util.keystr(path))
 
     def health_report(self) -> dict:
         """JSON-able snapshot for the engine's ``/health``: health state +

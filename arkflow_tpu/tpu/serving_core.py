@@ -40,6 +40,7 @@ from typing import Any, Callable, Mapping, Optional
 
 from arkflow_tpu.errors import ConfigError, RunnerDead, StepDeadlineExceeded
 from arkflow_tpu.obs import global_registry
+from arkflow_tpu.tpu.health import CORRUPT as HEALTH_CORRUPT
 from arkflow_tpu.tpu.health import DEAD as HEALTH_DEAD
 from arkflow_tpu.tpu.health import HealthConfig, RunnerHealth
 
@@ -165,6 +166,10 @@ class ServingRunnerCore:
 
         #: armed chaos faults consumed by the next device steps (fault plugin)
         self._chaos: deque = deque()
+        #: persistent silent-data-corruption fault (``inject_step_fault('sdc')``):
+        #: unlike the one-shot hang/oom, corruption keeps corrupting every
+        #: step until the integrity repair path clears it
+        self.sdc_armed = False
         #: set on a deadline miss: the jitted step(s) are rebuilt before the
         #: next dispatch (stale executables on a wedged device aren't trusted)
         self._needs_rebuild = False
@@ -179,12 +184,21 @@ class ServingRunnerCore:
     # -- chaos hook ---------------------------------------------------------
 
     def inject_step_fault(self, kind: str, duration_s: float = 0.0) -> None:
-        """Arm a one-shot fault consumed by the NEXT device step: ``hang``
-        wedges the step for ``duration_s`` of dead time (as a stuck device
-        sync would) so the deadline watchdog fires; ``oom`` raises a
-        fabricated RESOURCE_EXHAUSTED so the degradation path runs."""
+        """Arm a fault on the device-step path: ``hang`` wedges the next step
+        for ``duration_s`` of dead time (as a stuck device sync would) so the
+        deadline watchdog fires; ``oom`` raises a fabricated
+        RESOURCE_EXHAUSTED on the next step so the degradation path runs;
+        ``sdc`` arms PERSISTENT silent data corruption — every step's float
+        outputs are perturbed until the integrity repair path clears it
+        (``clear_sdc``), because a corrupting chip doesn't stop after one
+        wrong answer. ``bitflip`` is owner-level (it mutates the param tree,
+        which the core doesn't hold) — runners intercept it before
+        delegating here."""
+        if kind == "sdc":
+            self.sdc_armed = True
+            return
         if kind not in ("hang", "oom"):
-            raise ConfigError(f"unknown step fault kind {kind!r} (hang/oom)")
+            raise ConfigError(f"unknown step fault kind {kind!r} (hang/oom/sdc)")
         self._chaos.append((kind, float(duration_s)))
 
     def apply_chaos(self) -> None:
@@ -197,6 +211,41 @@ class ServingRunnerCore:
             time.sleep(duration_s if duration_s > 0 else 30.0)
         else:
             raise InjectedOom()
+
+    def corrupt_outputs(self, out):
+        """Apply the armed ``sdc`` fault to fetched step outputs (executor
+        thread): float arrays (logits and their kin) are negated so every
+        downstream argmax flips, and integer arrays (device-computed labels
+        / token ids — already argmaxed BEFORE this host-side hook could
+        touch their logits) are shifted by one — wrong answers that look
+        structurally healthy, which is exactly what the golden probe exists
+        to catch. Identity when no fault is armed."""
+        if not self.sdc_armed:
+            return out
+        import jax.numpy as jnp
+        import numpy as np
+
+        def _garble(v):
+            arr = np.asarray(v)
+            if arr.ndim < 1:
+                return v
+            # jnp.issubdtype: bfloat16 (ml_dtypes, numpy kind 'V') must
+            # count as float — bf16 logits are the common serving case
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                return -arr
+            if jnp.issubdtype(arr.dtype, jnp.integer):
+                return arr + 1
+            return v
+
+        if isinstance(out, dict):
+            return {k: _garble(v) for k, v in out.items()}
+        import jax
+
+        return jax.tree_util.tree_map(_garble, out)
+
+    def clear_sdc(self) -> None:
+        """Integrity-repair side: the corrupting 'hardware' was replaced."""
+        self.sdc_armed = False
 
     # -- deadlines ----------------------------------------------------------
 
@@ -329,6 +378,10 @@ class ServingRunnerCore:
         while True:
             if h.state == HEALTH_DEAD:
                 raise RunnerDead(f"runner {h.name} is DEAD; not serving")
+            if h.state == HEALTH_CORRUPT:
+                raise RunnerDead(
+                    f"runner {h.name} is quarantined (CORRUPT) pending "
+                    "integrity repair; not serving")
             if h.join_or_begin_probe():
                 break
             time.sleep(min(max(h.seconds_until_probe(), 0.01), 0.5))
@@ -340,6 +393,10 @@ class ServingRunnerCore:
         while True:
             if h.state == HEALTH_DEAD:
                 raise RunnerDead(f"runner {h.name} is DEAD; not serving")
+            if h.state == HEALTH_CORRUPT:
+                raise RunnerDead(
+                    f"runner {h.name} is quarantined (CORRUPT) pending "
+                    "integrity repair; not serving")
             if h.join_or_begin_probe():
                 break
             await asyncio.sleep(min(max(h.seconds_until_probe(), 0.01), 0.5))
@@ -366,4 +423,6 @@ class ServingRunnerCore:
         extend it with their own serving detail."""
         rep = self.health.report()
         rep["deadline_misses"] = int(self.m_deadline_miss.value)
+        if self.sdc_armed:
+            rep["sdc_armed"] = True
         return rep

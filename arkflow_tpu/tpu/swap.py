@@ -166,6 +166,31 @@ def argmax_signature(outputs: Mapping[str, Any]) -> np.ndarray:
     return np.asarray(np.argmax(np.asarray(cand, np.float32), axis=-1))
 
 
+def signature_margin(outputs: Mapping[str, Any]) -> float:
+    """Smallest top-1/top-2 logit gap over every argmax position of the
+    signature: the tie-distance of :func:`argmax_signature`. A golden batch
+    is only trustworthy as a corruption detector when this margin clears the
+    serving dtype's noise floor — otherwise benign rounding drift between a
+    host-computed reference and the device flips the signature and reads as
+    corruption (tpu/integrity.py searches seeds until it does clear).
+    Returns +inf when no float output exists (exact-compare signatures have
+    no ties by construction)."""
+    cand = outputs.get("logits")
+    if cand is None:
+        for v in outputs.values():
+            arr = np.asarray(v)
+            if arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating):
+                cand = v
+                break
+    if cand is None:
+        return float("inf")
+    arr = np.asarray(cand, np.float32)
+    if arr.shape[-1] < 2:
+        return float("inf")
+    top2 = np.partition(arr, -2, axis=-1)[..., -2:]
+    return float(np.min(top2[..., 1] - top2[..., 0]))
+
+
 # -- swap units (one per independently-flippable serving surface) ------------
 
 
@@ -185,6 +210,12 @@ class BatchRunnerUnit:
 
     async def adopt(self, placed):
         return self.runner.adopt_params(placed)
+
+    def note_committed_host(self, host) -> None:
+        """A committed swap makes ``host`` the member's known-good tree:
+        the integrity monitor's repair source must track the serving
+        version, or a post-swap repair would silently roll weights back."""
+        self.runner.host_params = host
 
     def _probe_inputs(self) -> dict[str, np.ndarray]:
         r = self.runner
@@ -235,6 +266,9 @@ class BatchGenerateUnit:
     async def adopt(self, placed):
         old, self.proc.params = self.proc.params, placed
         return old
+
+    def note_committed_host(self, host) -> None:
+        self.proc.host_params = host
 
     def _probe_blocking(self) -> None:
         import jax
@@ -287,6 +321,10 @@ class GenerationServerUnit:
             self._owner.params = placed
         return old
 
+    def note_committed_host(self, host) -> None:
+        if self._owner is not None:
+            self._owner.host_params = host
+
     async def probe(self) -> None:
         vocab = int(getattr(self.server.cfg, "vocab_size", 256) or 256)
         await self.server.generate([t % max(vocab, 2) for t in (3, 5, 7)],
@@ -327,6 +365,13 @@ class ModelSwapManager:
         self._last_error: Optional[str] = None
         self._chaos: deque[str] = deque()
         self._commit_hooks: list[Callable[[], None]] = []
+        #: SDC monitor (tpu/integrity.py), attached by processor builders
+        #: when both features are on: probing quiesces across the roll
+        #: (mid-flip members legitimately diverge from the golden
+        #: reference — a probe would quarantine them and "repair" would
+        #: silently roll the swap back), and a committed swap recomputes
+        #: the reference + repair source against the new weights
+        self.integrity = None
 
         reg = global_registry()
         labels = {"model": name}
@@ -443,6 +488,8 @@ class ModelSwapManager:
             self.m_started.inc()
             self.n_started += 1
             self._state = "restoring"
+            if self.integrity is not None:
+                await self.integrity.begin_quiesce()
             try:
                 # 1. restore + convert the candidate OFF the serving path
                 try:
@@ -496,7 +543,17 @@ class ModelSwapManager:
                         self._run_flush_hooks()
                     raise self._fail("rolling flip", e) from e
 
-                # 4. commit
+                # 4. commit — the committed host tree becomes every unit's
+                # known-good repair source, and the integrity monitor's
+                # golden reference recomputes against it (the old reference
+                # would read the NEW weights as corruption)
+                for unit in self.units:
+                    note = getattr(unit, "note_committed_host", None)
+                    if note is not None:
+                        note(host)
+                if self.integrity is not None:
+                    await loop.run_in_executor(
+                        None, self.integrity.rebuild_reference, host)
                 self.version += 1
                 self.checkpoint = checkpoint
                 self.m_version.set(self.version)
@@ -510,6 +567,8 @@ class ModelSwapManager:
                 return self.report()
             finally:
                 self._state = "idle"
+                if self.integrity is not None:
+                    self.integrity.end_quiesce()
 
     def _canary_pair(self, placed_candidate) -> tuple[np.ndarray, np.ndarray]:
         """Blocking golden forwards (executor thread): live first, then the

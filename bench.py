@@ -91,6 +91,20 @@ def _bench_coalesce() -> bool:
     return os.environ.get("BENCH_COALESCE", default) == "1"
 
 
+def _bench_integrity() -> str | None:
+    """BENCH_INTEGRITY=<interval> runs the headline phase with the SDC
+    integrity monitor probing on that cadence ("1" = 500ms; default 0 =
+    probes off). Each probe fetches+hashes the param tree and runs the
+    golden batch off-path while holding ONE in-flight permit, so any cost
+    shows up as stolen device time in the headline — the overhead is
+    recorded in the phase detail (integrity_probes) for the PERF.md
+    probes-on vs probes-off comparison."""
+    v = os.environ.get("BENCH_INTEGRITY", "0")
+    if v in ("0", "", "off"):
+        return None
+    return "500ms" if v == "1" else v
+
+
 def _bench_ingest_shards() -> int:
     """BENCH_INGEST_SHARDS=N runs the headline phase's hot path in N ingest
     shard processes (runtime/hostshard.py); 0 (default) = single process.
@@ -229,6 +243,11 @@ def build_stream_config(batch: int, seq: int, tiny: bool) -> dict:
                     # token packing: several examples per model row, so the
                     # chip computes real tokens, not bucket padding
                     "packing": packing,
+                    # BENCH_INTEGRITY: SDC probe cadence for the overhead
+                    # phase (headline default is probes-off)
+                    **({"integrity":
+                        {"probe_interval": _bench_integrity()}}
+                       if _bench_integrity() else {}),
                 }
             ],
         },
@@ -528,10 +547,16 @@ def main() -> None:
         exec0, exrows0 = _exec_and_example_rows()
         infeed0 = _infeed_host_metrics()
         tok0 = _tokens_total()
+        probes0 = _integrity_probes()
         res = asyncio.run(run_bench(seconds, batch, seq, tiny))
         busy1, stall1 = _busy_stall_from_registry()
         exec1, exrows1 = _exec_and_example_rows()
         detail = dict(_infeed_detail(infeed0, _infeed_host_metrics()))
+        if _bench_integrity():
+            # the SDC-probe overhead phase self-describes: cadence + how
+            # many probes the measured window actually absorbed
+            detail["integrity_probe_interval"] = _bench_integrity()
+            detail["integrity_probes"] = int(_integrity_probes() - probes0)
         # examples/s -> device-rows/s via the phase's exec/example ratio
         # (both deltas span the same phase: the ratio is window-independent)
         exec_ratio = ((exec1 - exec0) / (exrows1 - exrows0)
@@ -1541,6 +1566,19 @@ def _tuner_detail() -> dict:
     if predicted is not None:
         out["tuner_predicted_waste"] = round(predicted, 4)
     return out
+
+
+def _integrity_probes() -> float:
+    """Integrity probes completed (all results summed) this process — the
+    delta across the headline phase records how many SDC probes the phase
+    actually paid for (BENCH_INTEGRITY overhead satellite)."""
+    from arkflow_tpu.obs import global_registry
+
+    n = 0.0
+    for m in global_registry().collect():
+        if getattr(m, "name", "") == "arkflow_integrity_probe_total":
+            n += m.value
+    return n
 
 
 def _busy_stall_from_registry() -> tuple[float, float]:

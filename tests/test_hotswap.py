@@ -64,9 +64,13 @@ def test_checkpoint_save_is_atomic_and_replaces(tmp_path):
     checkpoint.save(p, b)  # replace an existing checkpoint in place
     out = checkpoint.restore(p, jax.tree_util.tree_map(np.zeros_like, b))
     assert np.array_equal(np.asarray(out["w"]), b["w"])
-    # no temp/old siblings survive a completed save
-    leftovers = [f for f in os.listdir(tmp_path) if f != "ck"]
+    # no temp/old siblings survive a completed save — except the digest
+    # manifest, the one INTENTIONAL sibling (tpu/integrity.py: restore
+    # verifies the tree against it)
+    leftovers = [f for f in os.listdir(tmp_path)
+                 if f not in ("ck", "ck.digests.json")]
     assert leftovers == []
+    assert (tmp_path / "ck.digests.json").exists()
 
 
 def test_checkpoint_leftover_tmp_from_crashed_save_is_harmless(tmp_path):

@@ -122,8 +122,12 @@ def test_train_checkpoints_and_restores(tmp_path):
     asyncio.run(go())
     import pathlib
 
-    saved = sorted(pathlib.Path(save_dir).glob("step_*"))
+    # each checkpoint tree has a digest-manifest sibling (tpu/integrity.py)
+    saved = sorted(p for p in pathlib.Path(save_dir).glob("step_*")
+                   if not p.name.endswith(".digests.json"))
     assert len(saved) == 2  # steps 2 and 4
+    for p in saved:
+        assert p.with_name(f"{p.name}.digests.json").exists()
     # a fresh inference runner restores the trained weights
     from arkflow_tpu.tpu.bucketing import BucketPolicy
     from arkflow_tpu.tpu.runner import ModelRunner

@@ -3378,6 +3378,400 @@ def run_hostshard_soak(seconds: float = 60.0, seed: int = 7,
     return _attach_tracing(verdict, trace_seq0, trace_forced0)
 
 
+# -- silent-data-corruption soak (tpu/integrity.py) ---------------------------
+
+
+def _sdc_pool_config(seed: int, messages: int, step_ms: int) -> dict:
+    """In-process pool phase: a 2-member device pool with the integrity
+    plane on a fast probe cadence, paced by a per-batch latency fault so
+    the stream outlives detection + repair."""
+    tiny_model = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+                  "ffn": 64, "max_positions": 64, "num_labels": 2}
+    return {
+        "name": "sdc-pool",
+        "input": {"type": "memory",
+                  "messages": [f"sdc pool row {i:05d}" for i in range(messages)]},
+        "pipeline": {
+            "thread_num": 2,
+            "processors": [{
+                "type": "fault",
+                "seed": seed,
+                "faults": [{"kind": "latency", "every": 1, "times": 0,
+                            "duration": f"{step_ms}ms"}],
+                "inner": {
+                    "type": "tpu_inference",
+                    "model": "bert_classifier",
+                    "model_config": tiny_model,
+                    "max_seq": 16,
+                    "batch_buckets": [2],
+                    "seq_buckets": [16],
+                    "warmup": True,
+                    "device_pool": 2,
+                    "integrity": {"probe_interval": "300ms",
+                                  "digest_every": 1},
+                },
+            }],
+        },
+        "output": {"type": "drop"},
+        "error_output": {"type": "drop"},
+    }
+
+
+def _sdc_worker_config(seed: int, step_ms: int, arm_at: int) -> dict:
+    """Device-tier worker for the cluster phase. ``arm_at`` > 0 arms a
+    one-shot ``sdc`` fault on the worker's Nth processed batch — from then
+    on its outputs are garbled until the integrity plane repairs it. The
+    probe interval is parked high so detection is driven by the
+    dispatcher's shadow-verify tiebreak, not a background-probe race."""
+    tiny_model = {"vocab_size": 512, "hidden": 32, "layers": 2, "heads": 4,
+                  "ffn": 64, "max_positions": 64, "num_labels": 2}
+    faults = [{"kind": "latency", "every": 1, "times": 0,
+               "duration": f"{step_ms}ms"}]
+    if arm_at > 0:
+        faults.append({"kind": "sdc", "at": arm_at})
+    return {
+        "processors": [{
+            "type": "fault",
+            "seed": seed,
+            "faults": faults,
+            "inner": {
+                "type": "tpu_inference",
+                "model": "bert_classifier",
+                "model_config": tiny_model,
+                "max_seq": 16,
+                "batch_buckets": [2],
+                "seq_buckets": [16],
+                "warmup": True,
+                "integrity": {"probe_interval": "999s"},
+            },
+        }],
+    }
+
+
+def _sdc_ingest_config(name: str, urls: list[str], payloads: list[str],
+                       *, threads: int = 2, shadow_fraction=None,
+                       response_cache: bool = False) -> dict:
+    proc: dict = {
+        "type": "remote_tpu",
+        "name": name,
+        "workers": urls,
+        "heartbeat": "250ms",
+        "connect_timeout": "2s",
+        "request_timeout": "30s",
+    }
+    if shadow_fraction is not None:
+        proc["shadow_verify"] = {"fraction": shadow_fraction}
+    if response_cache:
+        proc["response_cache"] = {"capacity": 256}
+    return {
+        "name": name,
+        "input": {"type": "memory", "messages": payloads},
+        "pipeline": {
+            "thread_num": threads,
+            "max_delivery_attempts": 8,
+            "processors": [proc],
+        },
+        "output": {"type": "drop"},
+        "error_output": {"type": "drop"},
+    }
+
+
+def run_sdc_soak(seconds: float = 90.0, seed: int = 7,
+                 fast: bool = False) -> dict:
+    """Silent-data-corruption soak (tpu/integrity.py), two tiers:
+
+    - pool phase (in-process): a ``bitflip`` corrupts one param leaf of a
+      live 2-member device pool mid-load; the integrity monitor's digest
+      pass detects it within a probe period, the golden probe proves it,
+      the member is quarantined (CORRUPT), repaired from retained host
+      params, re-verified, and re-admitted — zero rows lost.
+    - cluster phase (2 worker subprocesses): one worker arms a persistent
+      ``sdc`` fault mid-load; shadow-verify (fraction 1.0) dual-dispatches
+      every batch, catches the divergence on the corrupt batch itself, the
+      golden-probe tiebreak fences the corrupt worker (which repairs), and
+      every delivered row's label matches a clean-worker reference — zero
+      corrupted rows delivered, offered == delivered + shed, and the
+      repaired worker re-registers and serves.
+    """
+    trace_seq0, trace_forced0 = _tracing_watermark()
+    import asyncio
+    import os
+    import socket as socket_mod
+    import subprocess
+    import tempfile
+
+    import yaml
+
+    from arkflow_tpu.batch import MessageBatch
+    from arkflow_tpu.components import ensure_plugins_loaded
+    from arkflow_tpu.config import StreamConfig
+    from arkflow_tpu.plugins.output.drop import DropOutput
+    from arkflow_tpu.runtime import build_stream
+    from arkflow_tpu.runtime.cluster import ClusterDispatcher
+    from arkflow_tpu.utils.cleanenv import pin_cpu_env, strip_axon_pythonpath
+
+    ensure_plugins_loaded()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    step_ms = 40 if fast else 50
+    n_pool = 48 if fast else 96        # pool phase messages
+    n_ref = 12 if fast else 24         # cluster reference rows
+    n_chaos = 32 if fast else 64       # cluster chaos rows
+    arm_at = 5                         # worker batch that arms the sdc fault
+    startup_budget = 240.0
+    verdict: dict = {"mode": "sdc", "seed": seed, "fast": fast}
+    t_start = time.monotonic()
+
+    # -- phase 1: pool-tier bitflip -> detect/quarantine/repair/re-admit ----
+    pool_events: dict = {}
+
+    async def pool_phase() -> dict:
+        stream = build_stream(StreamConfig.from_mapping(
+            _sdc_pool_config(seed, n_pool, step_ms)))
+        delivered: list[bytes] = []
+
+        class _Collect(DropOutput):
+            async def write(self, batch: MessageBatch) -> None:
+                delivered.extend(batch.to_binary())
+
+        stream.output = _Collect()
+        proc = stream.pipeline.processors[0]._inner
+        mon = proc.integrity
+
+        async def driver() -> None:
+            while len(delivered) < 6:
+                await asyncio.sleep(0.01)
+            proc.runner.members[1].inject_step_fault("bitflip")
+            t_arm = time.monotonic()
+            pool_events["armed_at_delivered"] = len(delivered)
+            while mon.n_quarantined < 1:
+                await asyncio.sleep(0.01)
+            pool_events["detect_s"] = round(time.monotonic() - t_arm, 3)
+            while mon.n_repaired < 1:
+                await asyncio.sleep(0.01)
+            pool_events["repair_s"] = round(time.monotonic() - t_arm, 3)
+
+        cancel = asyncio.Event()
+        task = asyncio.create_task(stream.run(cancel))
+        drv = asyncio.create_task(driver())
+        t0 = time.monotonic()
+        done, _ = await asyncio.wait({task}, timeout=max(seconds, 60.0))
+        wedged = not done
+        if done:
+            task.result()
+        else:
+            cancel.set()
+            try:
+                await asyncio.wait_for(task, timeout=15.0)
+            except (asyncio.TimeoutError, Exception):
+                task.cancel()
+        try:
+            await asyncio.wait_for(drv, timeout=5.0)
+        except (asyncio.TimeoutError, Exception):
+            drv.cancel()
+        states = [m.state() for m in mon.members]
+        return {"delivered": len(delivered), "wedged": wedged,
+                "elapsed_s": round(time.monotonic() - t0, 3),
+                "monitor": mon.report(), "member_states": states}
+
+    pool = asyncio.run(pool_phase())
+    probe_period_s = 0.3
+    pool_out = {
+        **pool_events,
+        "offered_rows": n_pool,
+        "delivered_rows": pool["delivered"],
+        "member_states": pool["member_states"],
+        "quarantined": pool["monitor"]["quarantined"],
+        "repaired": pool["monitor"]["repaired"],
+        # detection bound: a digest-bearing probe runs every period; allow
+        # scheduling + hash slack on a loaded CPU host
+        "detect_within_ok": (pool_events.get("detect_s") is not None
+                             and pool_events["detect_s"]
+                             <= 10 * probe_period_s),
+    }
+    pool_out["pass"] = bool(not pool["wedged"]
+                            and pool["delivered"] == n_pool
+                            and pool_out["quarantined"] >= 1
+                            and pool_out["repaired"] >= 1
+                            and pool_out["detect_within_ok"]
+                            and all(s == "healthy"
+                                    for s in pool["member_states"]))
+    verdict["pool"] = pool_out
+
+    # -- phase 2: cluster-tier sdc under shadow-verify ----------------------
+    def free_port() -> int:
+        s = socket_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    tmp = tempfile.mkdtemp(prefix="arkflow-sdc-soak-")
+    cfg_paths = [os.path.join(tmp, f"worker-{i}.yaml") for i in range(2)]
+    # worker 1 carries the armed sdc fault; worker 0 stays clean (the
+    # reference + the shadow-verify tiebreak's healthy side)
+    for i, path in enumerate(cfg_paths):
+        with open(path, "w") as f:
+            yaml.safe_dump(_sdc_worker_config(
+                seed, step_ms, arm_at if i == 1 else 0), f)
+    ports = [free_port(), free_port()]
+    urls = [f"arkflow://127.0.0.1:{p}" for p in ports]
+    logs = [os.path.join(tmp, f"worker-{i}.log") for i in range(2)]
+
+    def spawn(i: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        strip_axon_pythonpath(env)
+        pin_cpu_env(env, n_devices=1)
+        return subprocess.Popen(
+            [sys.executable, "-m", "arkflow_tpu", "--cluster-worker",
+             "--config", cfg_paths[i], "--host", "127.0.0.1",
+             "--port", str(ports[i]), "--worker-id", f"sdc-w{i}"],
+            cwd=repo_root, env=env,
+            stdout=open(logs[i], "ab"), stderr=subprocess.STDOUT)
+
+    async def wait_ready(wait_urls: list[str], budget_s: float) -> None:
+        probe = ClusterDispatcher(wait_urls, name="sdc-soak-probe",
+                                  heartbeat_s=999.0, connect_timeout_s=1.0)
+        deadline = time.monotonic() + budget_s
+        while True:
+            await asyncio.gather(
+                *(probe._probe(w) for w in probe.workers.values()),
+                return_exceptions=True)
+            if all(w.alive for w in probe.workers.values()):
+                return
+            if time.monotonic() >= deadline:
+                down = [w.url for w in probe.workers.values() if not w.alive]
+                raise RuntimeError(
+                    f"sdc workers not ready within {budget_s:.0f}s: {down} "
+                    f"(see {tmp}/worker-*.log)")
+            await asyncio.sleep(0.5)
+
+    class _LabelCollect(DropOutput):
+        """Collects (payload, label) pairs — the corruption-delivery check
+        compares delivered labels against a clean-worker reference."""
+
+        def __init__(self, sink: list):
+            self._sink = sink
+
+        async def write(self, batch: MessageBatch) -> None:
+            labels = batch.column("label").to_pylist()
+            self._sink.extend(zip(batch.to_binary(), labels))
+
+    def run_phase(cfg_map: dict, budget_s: float) -> dict:
+        stream = build_stream(StreamConfig.from_mapping(cfg_map))
+        delivered: list = []
+        shed: list[bytes] = []
+        stream.output = _LabelCollect(delivered)
+
+        class _Shed(DropOutput):
+            async def write(self, batch: MessageBatch) -> None:
+                shed.extend(batch.to_binary())
+
+        stream.error_output = _Shed()
+        out: dict = {"delivered": delivered, "shed": shed, "stream": stream}
+
+        async def bounded() -> None:
+            cancel = asyncio.Event()
+            task = asyncio.create_task(stream.run(cancel))
+            t0 = time.monotonic()
+            done, _ = await asyncio.wait({task}, timeout=budget_s)
+            out["elapsed_s"] = time.monotonic() - t0
+            out["wedged"] = not done
+            if done:
+                task.result()
+            else:
+                cancel.set()
+                try:
+                    await asyncio.wait_for(task, timeout=15.0)
+                except (asyncio.TimeoutError, Exception):
+                    task.cancel()
+
+        asyncio.run(bounded())
+        return out
+
+    procs: list = [None, None]
+    payloads = [f"sdc row {i:05d}" for i in range(n_chaos)]
+    try:
+        procs[0] = spawn(0)
+        procs[1] = spawn(1)
+        asyncio.run(wait_ready(urls, startup_budget))
+        verdict["startup_s"] = round(time.monotonic() - t_start, 3)
+
+        # reference: the clean worker's label for every chaos payload (a
+        # subset is enough to pin the mapping; we reference ALL of them so
+        # the corruption check covers every delivered row)
+        ref = run_phase(_sdc_ingest_config(
+            "sdc-ref", urls[:1], payloads, threads=2), max(seconds, 60.0))
+        reference = dict(ref["delivered"])
+        ref_ok = (not ref["wedged"] and len(reference) == n_chaos)
+        verdict["reference"] = {"rows": len(reference), "ok": ref_ok}
+
+        # chaos: both workers, shadow-verify on every batch; worker 1 arms
+        # sdc on its 5th batch and garbles everything after
+        chaos = run_phase(_sdc_ingest_config(
+            "sdc-chaos", urls, payloads, threads=2, shadow_fraction=1.0,
+            response_cache=True), max(seconds, 90.0))
+        dispatcher = chaos["stream"].pipeline.processors[0].dispatcher
+        cache = chaos["stream"].pipeline.processors[0].cache
+        shadow = {k: int(c.value) for k, c in dispatcher.m_shadow.items()}
+        delivered_payloads = [p for p, _ in chaos["delivered"]]
+        corrupted = [p.decode() for p, lab in chaos["delivered"]
+                     if reference.get(p) != lab]
+        expected = set(p.encode() for p in payloads)
+        seen = set(delivered_payloads) | set(chaos["shed"])
+        lost = sorted(expected - seen)
+        chaos_out = {
+            "wedged": chaos["wedged"],
+            "offered_rows": n_chaos,
+            "delivered_rows": len(chaos["delivered"]),
+            "shed_rows": len(chaos["shed"]),
+            "lost_rows": len(lost),
+            "corrupted_delivered_rows": len(corrupted),
+            "shadow": shadow,
+            "integrity_fences": int(dispatcher.m_integrity_fence.value),
+            "cache_epoch_bumps": int(cache.epoch),
+            "identity_ok": len(lost) == 0,
+        }
+        if corrupted:
+            chaos_out["corrupted_sample"] = corrupted[:5]
+
+        # the fenced worker must repair, re-register, and serve again
+        revived = False
+        revive_error = None
+        try:
+            asyncio.run(wait_ready(urls[1:], startup_budget))
+            post = run_phase(_sdc_ingest_config(
+                "sdc-revive", urls[1:],
+                [f"revive row {i}" for i in range(2)], threads=1),
+                max(seconds, 60.0))
+            revived = len(post["delivered"]) == 2
+        except Exception as e:
+            revive_error = f"{type(e).__name__}: {e}"
+        chaos_out["revived"] = revived
+        if revive_error:
+            chaos_out["revive_error"] = revive_error
+        chaos_out["pass"] = bool(not chaos["wedged"]
+                                 and ref_ok
+                                 and chaos_out["identity_ok"]
+                                 and chaos_out["corrupted_delivered_rows"] == 0
+                                 and shadow["diverged"] >= 1
+                                 and shadow["match"] >= 1
+                                 and chaos_out["integrity_fences"] >= 1
+                                 and chaos_out["cache_epoch_bumps"] >= 1
+                                 and revived)
+        verdict["chaos"] = chaos_out
+        verdict["pass"] = bool(pool_out["pass"] and chaos_out["pass"])
+    finally:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=5)
+                except Exception:
+                    pass
+    verdict["elapsed_s"] = round(time.monotonic() - t_start, 3)
+    return _attach_tracing(verdict, trace_seq0, trace_forced0)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seconds", type=float, default=60.0,
@@ -3442,6 +3836,14 @@ def main(argv=None) -> int:
                          "shard affinity, ordered zero-silent-loss through "
                          "a shard SIGKILL, and quota-once admission "
                          "(rows/s ratio gated on host cores)")
+    ap.add_argument("--sdc", action="store_true",
+                    help="silent-data-corruption soak: a bitflipped pool "
+                         "member is digest-detected, quarantined, repaired "
+                         "and re-admitted within a probe period; a "
+                         "sdc-corrupted cluster worker is caught by "
+                         "shadow-verify, fenced via golden-probe tiebreak "
+                         "and re-admitted after repair — zero corrupted "
+                         "rows delivered, zero silent loss")
     ap.add_argument("--factor", type=int, default=4,
                     help="burst mode: offered-load multiplier (default 4)")
     ap.add_argument("--fast", action="store_true",
@@ -3511,6 +3913,18 @@ def main(argv=None) -> int:
         # get their own pinned virtual-CPU env from the soak itself
         verdict = run_preempt_soak(seconds=args.seconds, seed=args.seed,
                                    fast=args.fast)
+        print(json.dumps(verdict, indent=2))
+        return 0 if verdict["pass"] else 1
+
+    if args.sdc:
+        if os.environ.get("ARKFLOW_SOAK_KEEP_ENV") != "1":
+            # the pool phase builds a 2-member device pool in THIS process;
+            # the cluster phase's worker subprocesses pin their own env
+            from arkflow_tpu.utils.cleanenv import pin_cpu_env
+
+            pin_cpu_env(os.environ, n_devices=2)
+        verdict = run_sdc_soak(seconds=args.seconds, seed=args.seed,
+                               fast=args.fast)
         print(json.dumps(verdict, indent=2))
         return 0 if verdict["pass"] else 1
 
